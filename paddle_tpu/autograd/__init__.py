@@ -1,0 +1,191 @@
+"""paddle.autograd — user-facing autograd API.
+
+Reference analogue: python/paddle/autograd/ (PyLayer at py_layer.py:202,
+paddle.grad in fluid/dygraph/base.py, functional vjp/jvp in functional.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.dispatch import GradNode, enable_grad, is_grad_enabled, no_grad  # noqa: F401
+from ..core.tensor import Tensor
+
+__all__ = ["grad", "backward", "PyLayer", "PyLayerContext", "no_grad", "enable_grad", "vjp", "jvp"]
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+    name=None,
+):
+    """paddle.grad (reference: fluid/dygraph/base.py grad) — returns grads of
+    `outputs` w.r.t. `inputs` without touching .grad."""
+    if create_graph:
+        raise NotImplementedError("create_graph=True (double grad) not yet supported")
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    single = isinstance(inputs, Tensor)
+    inputs = [inputs] if single else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    got = dispatch.run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=bool(retain_graph),
+        inputs=inputs,
+    )
+    results = []
+    for t in inputs:
+        g = got.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient "
+                    "(pass allow_unused=True to return None for it)"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results[0] if single else results
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    tensors = [tensors] if isinstance(tensors, Tensor) else list(tensors)
+    dispatch.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """reference: python/paddle/autograd/py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable = tensors
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op with user forward/backward.
+
+    Reference: python/paddle/autograd/py_layer.py:202. The tape integration
+    records a GradNode whose vjp calls the user's static `backward`.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        is_seq = isinstance(outputs, (tuple, list))
+        out_list = list(outputs) if is_seq else [outputs]
+
+        # paddle contract (py_layer.py backward docs): user backward returns
+        # one grad per *tensor* input of forward, in declaration order; the
+        # engine ignores grads for stop_gradient inputs.
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        any_trainable = any(not a.stop_gradient for a in tensor_inputs)
+        if not is_grad_enabled() or not any_trainable:
+            return outputs
+
+        out_avals = [
+            (tuple(o._value.shape), o._value.dtype) for o in out_list
+        ]
+
+        def vjp_fn(cotangents):
+            if not isinstance(cotangents, tuple):
+                cotangents = (cotangents,)
+            grads = cls.backward(
+                ctx, *[Tensor(c, stop_gradient=True) for c in cotangents]
+            )
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grads = [g._value if isinstance(g, Tensor) else g for g in grads]
+            if len(grads) != len(tensor_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads for "
+                    f"{len(tensor_inputs)} tensor inputs"
+                )
+            return tuple(grads)
+
+        node = GradNode(vjp_fn, tensor_inputs, out_avals, cls.__name__)
+        nd = set(map(id, ctx.non_differentiable))
+        wired = []
+        for i, o in enumerate(out_list):
+            t = o
+            if id(o) not in nd and jnp.issubdtype(o._value.dtype, jnp.floating):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._out_index = i
+            wired.append(t)
+        return wired if is_seq else wired[0]
+
+
+def vjp(func, xs, v=None):
+    """Functional vjp (reference: python/paddle/autograd/functional.py)."""
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    vals = [x._value for x in xs_list]
+
+    def f(*a):
+        outs = func(*[Tensor(x, stop_gradient=True) for x in a])
+        return outs._value if isinstance(outs, Tensor) else tuple(o._value for o in outs)
+
+    out, vjp_fn = jax.vjp(f, *vals)
+    if v is None:
+        v_val = jnp.ones_like(out)
+    else:
+        v_val = v._value if isinstance(v, Tensor) else v
+    grads = vjp_fn(v_val)
+    wrap = lambda g: Tensor(g, stop_gradient=True)  # noqa: E731
+    out_t = Tensor(out, stop_gradient=True) if not isinstance(out, tuple) else [wrap(o) for o in out]
+    gs = [wrap(g) for g in grads]
+    return out_t, gs if isinstance(xs, (tuple, list)) else gs[0]
+
+
+def jvp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    vals = [x._value for x in xs_list]
+
+    def f(*a):
+        outs = func(*[Tensor(x, stop_gradient=True) for x in a])
+        return outs._value if isinstance(outs, Tensor) else tuple(o._value for o in outs)
+
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        v_list = v if isinstance(v, (tuple, list)) else [v]
+        tangents = [t._value if isinstance(t, Tensor) else t for t in v_list]
+    out, jv = jax.jvp(f, tuple(vals), tuple(tangents))
+    wrap = lambda g: Tensor(g, stop_gradient=True)  # noqa: E731
+    out_t = wrap(out) if not isinstance(out, tuple) else [wrap(o) for o in out]
+    jv_t = wrap(jv) if not isinstance(jv, tuple) else [wrap(o) for o in jv]
+    return out_t, jv_t

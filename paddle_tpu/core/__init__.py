@@ -1,0 +1,38 @@
+"""Core runtime: Place/device, dtype, flags, RNG, Tensor, dispatch+autograd.
+
+The TPU-native replacement for the reference's L0-L2 + eager autograd core
+(see SURVEY.md §1): platform/device runtime, memory (owned by PJRT/XLA here),
+phi::DenseTensor, KernelFactory dispatch, and the eager GradNode engine.
+"""
+from . import dispatch, dtype, flags, place, random  # noqa: F401
+from .dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .dtype import (  # noqa: F401
+    DType,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .random import Generator, get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,19 @@
+"""Static-graph mode toggle (paddle.enable_static/disable_static).
+
+Reference: python/paddle/fluid/framework.py _dygraph_guard machinery. In the
+TPU framework "static mode" means the Program/Executor compatibility facade
+(paddle_tpu.static) is active; eager is the default.
+"""
+_enabled = [False]
+
+
+def enable():
+    _enabled[0] = True
+
+
+def disable():
+    _enabled[0] = False
+
+
+def enabled() -> bool:
+    return _enabled[0]

@@ -1,0 +1,135 @@
+"""Dtype system.
+
+TPU-native replacement for Paddle's proto dtypes (reference:
+paddle/phi/common/data_type.h, python/paddle/fluid/core.py VarDesc.VarType).
+Paddle exposes dtypes as strings ('float32') and enum objects; here a DType is
+a thin named wrapper over a numpy/jax dtype so both `paddle.float32` and
+'float32' work everywhere a dtype is accepted.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes  # noqa: F401 — jax dependency, provides bfloat16 numpy dtype
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+class DType:
+    """A framework dtype. Compares equal to its string name and numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if np_dtype is not None else None
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or (
+                self.np_dtype is not None and np.dtype(other) == self.np_dtype
+                if _is_np_name(other)
+                else False
+            )
+        try:
+            return np.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("int8", "int16", "int32", "int64", "uint8")
+
+
+def _is_np_name(s: str) -> bool:
+    try:
+        np.dtype(s)
+        return True
+    except TypeError:
+        return False
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BF16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [
+    bool_,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+
+_BY_NP = {d.np_dtype: d for d in _ALL if d.np_dtype is not None}
+
+
+def to_paddle_dtype(dtype) -> DType:
+    """Normalize any dtype-like (DType, str, np.dtype, jnp dtype) to a DType."""
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+    npd = np.dtype(dtype)
+    if npd in _BY_NP:
+        return _BY_NP[npd]
+    raise TypeError(f"unsupported dtype: {dtype!r}")
+
+
+def to_np_dtype(dtype) -> np.dtype:
+    return to_paddle_dtype(dtype).np_dtype
+
+
+# default dtype machinery — reference: python/paddle/framework/framework.py
+# set_default_dtype/get_default_dtype
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = to_paddle_dtype(d)
+    if not d.is_floating_point:
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name

@@ -1,0 +1,92 @@
+"""Global flags system.
+
+TPU-native analogue of Paddle's exported gflags (reference:
+paddle/fluid/platform/flags.cc — 56 PADDLE_DEFINE_EXPORTED_* flags — and the
+Python accessors get_flags/set_flags in python/paddle/fluid/framework.py via
+pybind/global_value_getter_setter.cc). Flags are definable in-process,
+overridable from the environment as FLAGS_<name>, and readable/settable at
+runtime.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_registry: Dict[str, dict] = {}
+
+
+def define_flag(name: str, default: Any, doc: str = "", writable: bool = True):
+    if name.startswith("FLAGS_"):
+        name = name[len("FLAGS_") :]
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        value = _parse(env, default)
+    _registry[name] = {
+        "value": value,
+        "default": default,
+        "doc": doc,
+        "writable": writable,
+    }
+    return value
+
+
+def _parse(text: str, default):
+    if isinstance(default, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(text)
+    if isinstance(default, float):
+        return float(text)
+    return text
+
+
+def _norm(name: str) -> str:
+    return name[len("FLAGS_") :] if name.startswith("FLAGS_") else name
+
+
+def get_flags(flags):
+    """paddle.get_flags — accepts a name or list of names."""
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        key = _norm(n)
+        if key not in _registry:
+            raise ValueError(f"unknown flag {n!r}")
+        out["FLAGS_" + key] = _registry[key]["value"]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags — {'FLAGS_name': value, ...}."""
+    for n, v in flags.items():
+        key = _norm(n)
+        if key not in _registry:
+            raise ValueError(f"unknown flag {n!r}")
+        if not _registry[key]["writable"]:
+            raise ValueError(f"flag {n!r} is not writable at runtime")
+        _registry[key]["value"] = v
+
+
+def flag(name: str):
+    return _registry[_norm(name)]["value"]
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of reference platform/flags.cc relevant on TPU)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debug mode)")
+define_flag("benchmark", False, "sync after each op and record timings")
+define_flag("eager_op_jit", True, "wrap per-op lowering in jax.jit with a compile cache")
+define_flag(
+    "use_standalone_executor", True, "use the compiled whole-program executor path"
+)
+define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
+define_flag("init_allocated_mem", False, "compat: poison fresh allocations")
+define_flag(
+    "allocator_strategy", "auto_growth", "compat: allocator strategy name (XLA owns HBM)"
+)
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "compat alias; XLA preallocation")
+define_flag("cudnn_deterministic", False, "compat: deterministic kernels")
+define_flag("embedding_deterministic", 0, "compat: deterministic embedding grad")

@@ -1,0 +1,107 @@
+"""RNG state management.
+
+TPU-native analogue of phi::Generator (reference: paddle/phi/core/generator.h:23,
+python/paddle/fluid/generator.py, paddle.seed in python/paddle/framework/random.py).
+Paddle keeps a mutable per-device Philox state; JAX is functional, so the
+Generator owns a root PRNG key and splits a fresh subkey per draw. Under a
+`to_static`/jit trace, random ops must not bake a constant key — a trace-time
+key provider can be pushed (see `rng_scope`) so compiled programs thread keys
+explicitly; the TP-aware RNGStatesTracker (reference:
+fleet/meta_parallel/parallel_layers/random.py) builds on the same scope.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful key source: each get_key() returns a fresh fold of the root key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(int(seed))
+        self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._key = jax.random.key(self._seed)
+
+    def get_key(self):
+        with self._lock:
+            self._counter += 1
+            return jax.random.fold_in(self._key, self._counter)
+
+
+default_generator = Generator(0)
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed — reseed the global generator."""
+    return default_generator.manual_seed(value)
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time key injection: inside jit tracing, random ops pull keys from the
+# innermost rng scope instead of the global stateful generator.
+# ---------------------------------------------------------------------------
+_scope = threading.local()
+
+
+class _KeyFeed:
+    def __init__(self, key):
+        self._key = key
+        self._n = 0
+
+    def next_key(self):
+        self._n += 1
+        return jax.random.fold_in(self._key, self._n)
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Thread an explicit PRNG key through all random ops in this scope."""
+    stack = getattr(_scope, "stack", None)
+    if stack is None:
+        stack = _scope.stack = []
+    stack.append(_KeyFeed(key))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def next_key(generator: Optional[Generator] = None):
+    """Key for one random draw: scope key if active, else the (global) generator."""
+    stack = getattr(_scope, "stack", None)
+    if stack:
+        return stack[-1].next_key()
+    return (generator or default_generator).get_key()
+
+
+def np_rng() -> np.random.Generator:
+    """Host-side numpy RNG derived from the global seed (for dataloader etc.)."""
+    return np.random.default_rng(default_generator.initial_seed())

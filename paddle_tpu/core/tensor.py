@@ -1,0 +1,365 @@
+"""The eager Tensor.
+
+TPU-native analogue of the reference's eager tensor stack:
+  - phi::DenseTensor (paddle/phi/core/dense_tensor.h:38) — the buffer+meta;
+    here the buffer is a jax.Array owned by PJRT (XLA manages HBM, replacing
+    paddle/fluid/memory/allocation/allocator_facade.h:43);
+  - imperative::VarBase / the eager paddle.Tensor with autograd fields
+    (paddle/fluid/eager/, python/paddle/fluid/dygraph/varbase_patch_methods.py);
+  - in-place version counters (imperative/variable_wrapper.h inplace_version).
+
+Mutation semantics on a functional runtime: a Tensor is a mutable *cell*
+holding an immutable jax.Array. In-place ops rebind the cell and bump
+`_inplace_version`; autograd residuals capture the immutable arrays, so
+mutation never corrupts recorded history (the reference needs version checks
+for this; here it is safe by construction — the version counter is kept for
+API parity and error parity on leaf params).
+
+Most tensor methods (x.add, x.reshape, …) are monkey-patched in
+paddle_tpu/tensor_api.py, mirroring how the reference patches VarBase methods
+at import (varbase_patch_methods.py:197).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dispatch
+from .dtype import DType, to_np_dtype, to_paddle_dtype, get_default_dtype
+from .place import CPUPlace, Place, TPUPlace, _expected_place
+
+
+def _commit(value, place: Optional[Place]):
+    """Put a concrete array on the expected device (no-op for tracers)."""
+    if place is None:
+        return value
+    if isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
+        try:
+            return jax.device_put(value, place.jax_device)
+        except Exception:
+            return value
+    return value
+
+
+class Tensor:
+    """Mutable eager tensor over a jax.Array (which may be a tracer under jit)."""
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "_backward_hooks",
+        "_inplace_version",
+        "name",
+        "persistable",
+        "is_parameter",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(
+        self,
+        value,
+        dtype=None,
+        place: Optional[Place] = None,
+        stop_gradient: bool = True,
+        name: Optional[str] = None,
+    ):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array) or isinstance(value, np.ndarray):
+            npd = to_np_dtype(dtype) if dtype is not None else None
+            from_ndarray = isinstance(value, (np.ndarray, np.generic))
+            arr = np.asarray(value)
+            if npd is None and not from_ndarray and arr.dtype == np.float64:
+                # python floats default to paddle's default dtype (float32);
+                # explicit numpy float64 arrays keep their dtype (paddle parity)
+                npd = to_np_dtype(get_default_dtype())
+            value = jnp.asarray(arr, dtype=npd)
+            value = _commit(value, place or _expected_place())
+        elif dtype is not None:
+            value = value.astype(to_np_dtype(dtype))
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._backward_hooks = []
+        self._inplace_version = 0
+        self.name = name or ""
+        self.persistable = False
+        self.is_parameter = False
+
+    # -- meta ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return to_paddle_dtype(self._value.dtype)
+
+    @property
+    def place(self) -> Place:
+        v = self._value
+        if isinstance(v, jax.core.Tracer):
+            return _expected_place()
+        dev = next(iter(v.devices()), None) if hasattr(v, "devices") else None
+        if dev is not None and dev.platform == "cpu":
+            return CPUPlace(dev.id)
+        return TPUPlace(getattr(dev, "id", 0))
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if isinstance(self._value, jax.core.Tracer):
+            return f"Tensor(traced, shape={self.shape}, dtype={self.dtype.name})"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place.device_type}, stop_gradient={sg},\n"
+            f"       {np.array2string(np.asarray(self._value), prefix='       ')})"
+        )
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self._value))
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """reference: varbase_patch_methods.py:197 → pybind dygraph_run_backward
+        → BasicEngine::Execute (imperative/basic_engine.cc:392)."""
+        dispatch.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in self._backward_hooks:
+                    self._backward_hooks.remove(hook)
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor.__new__(Tensor)
+        t._value = self._value
+        t.stop_gradient = True
+        t.grad = None
+        t._grad_node = None
+        t._out_index = 0
+        t._backward_hooks = []
+        t._inplace_version = self._inplace_version
+        t.name = self.name
+        t.persistable = False
+        t.is_parameter = False
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return dispatch.apply(jnp.copy, self, op_name="clone")
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- mutation (in-place) -------------------------------------------------
+    def _bump_version(self):
+        self._inplace_version += 1
+
+    def set_value(self, value):
+        """In-place rebind, keeping identity (optimizer.step / load_state_dict)."""
+        if isinstance(value, Tensor):
+            new = value._value
+        elif isinstance(value, jax.Array):
+            new = value
+        else:
+            new = jnp.asarray(np.asarray(value), dtype=self._value.dtype)
+        if tuple(new.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {new.shape} vs {self._value.shape}"
+            )
+        if new.dtype != self._value.dtype:
+            new = new.astype(self._value.dtype)
+        self._value = _commit(new, None)
+        self._bump_version()
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        self._bump_version()
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # -- device movement ----------------------------------------------------
+    def cpu(self):
+        t = self.detach()
+        t._value = jax.device_put(self._value, jax.devices("cpu")[0])
+        t.stop_gradient = self.stop_gradient
+        return t
+
+    def to(self, *args, **kwargs):
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, Place)):
+                if isinstance(a, str) and a in (
+                    "float16", "bfloat16", "float32", "float64",
+                    "int32", "int64", "bool", "uint8", "int8",
+                ):
+                    dtype = a
+                else:
+                    device = a
+            elif isinstance(a, DType):
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .place import set_device
+
+            place = device if isinstance(device, Place) else None
+            if place is None:
+                import paddle_tpu.core.place as _p
+
+                prev = _p._expected_place()
+                place = _p.set_device(device)
+                _p._set_expected_place(prev)
+            t = out.detach()
+            t._value = jax.device_put(out._value, place.jax_device)
+            t.stop_gradient = out.stop_gradient
+            out = t
+        return out
+
+    def astype(self, dtype):
+        npd = to_np_dtype(dtype)
+        return dispatch.apply(
+            lambda x, dtype: x.astype(dtype), self, dtype=str(npd), op_name="cast"
+        )
+
+    cast = astype
+
+    # -- indexing (dynamic — bypasses per-op jit cache) ----------------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+
+        def _getitem(x):
+            return x[idx]
+
+        if _index_is_traceable(idx):
+            return dispatch.apply(_getitem, self, op_name="getitem")
+        # boolean-mask indexing → dynamic shape, run un-jitted on host values
+        out = self._value[idx]
+        return Tensor(out, stop_gradient=True)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        if isinstance(v, (int, float, bool)):
+            pass
+        else:
+            v = jnp.asarray(v)
+            if v.dtype != self._value.dtype:
+                v = v.astype(self._value.dtype)
+        self._value = self._value.at[idx].set(v)
+        self._bump_version()
+
+    # pytree-friendliness: jax can flatten Tensors transparently
+    def __jax_array__(self):
+        return self._value
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def _index_is_traceable(idx) -> bool:
+    """Boolean masks produce dynamic shapes — keep those out of jit."""
+    if isinstance(idx, (jax.Array, np.ndarray)) and idx.dtype == np.bool_:
+        return False
+    if isinstance(idx, tuple):
+        return all(_index_is_traceable(i) for i in idx)
+    return True
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py:87)."""
+    if isinstance(data, Tensor):
+        t = data.astype(dtype) if dtype is not None else data.clone()
+        t.stop_gradient = stop_gradient
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# register Tensor as a jax pytree leaf-unwrapper? Tensors are treated as
+# leaves; functional bridges unwrap explicitly (see paddle_tpu/jit/).

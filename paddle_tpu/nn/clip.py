@@ -1,0 +1,82 @@
+"""Gradient clipping. Reference: python/paddle/fluid/clip.py
+(ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm — used by optimizers
+via grad_clip=...)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    @no_grad()
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    @no_grad()
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
+            scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
+            out.append((p, Tensor(g._value * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: fluid/clip.py ClipGradByGlobalNorm; TP-aware variant lives
+    in distributed.fleet (HybridParallelClipGrad)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    @no_grad()
+    def _clip(self, params_grads):
+        sq = [
+            jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            for _, g in params_grads
+            if g is not None
+        ]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,630 @@
+"""paddle.nn.functional — functional neural-net API.
+
+Reference analogue: python/paddle/nn/functional/ (activation.py, common.py,
+conv.py, loss.py, norm.py, pooling.py, input.py). Dispatches to
+paddle_tpu.ops.nn_ops through the autograd-aware dispatcher.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import random as _random
+from ...core.dispatch import apply, is_grad_enabled
+from ...core.tensor import Tensor, to_tensor
+from ...ops import nn_ops as _nn
+from ...ops import manipulation as _mp
+
+__all__ = []  # populated at bottom
+
+
+def _t(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+# ----------------------------- activations ---------------------------------
+def relu(x, name=None):
+    return apply(_nn.relu, x, op_name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value = out._value
+    return x
+
+
+def relu6(x, name=None):
+    return apply(_nn.relu6, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(_nn.leaky_relu, x, negative_slope=negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if isinstance(w, Tensor) and w.size > 1 and x.ndim > 1:
+        shape = [1] * x.ndim
+        c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[c_axis] = w.size
+        w = w.reshape(shape)
+    return apply(_nn.prelu, x, w)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(_nn.elu, x, alpha=alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(_nn.selu, x, scale=scale, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(_nn.celu, x, alpha=alpha)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(_nn.gelu, x, approximate=approximate, op_name="gelu")
+
+
+def sigmoid(x, name=None):
+    return apply(_nn.sigmoid, x, op_name="sigmoid")
+
+
+def silu(x, name=None):
+    return apply(_nn.silu, x)
+
+
+def swish(x, name=None):
+    return apply(_nn.swish, x)
+
+
+def mish(x, name=None):
+    return apply(_nn.mish, x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(_nn.softplus, x, beta=beta, threshold=threshold)
+
+
+def softsign(x, name=None):
+    return apply(_nn.softsign, x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(_nn.softshrink, x, threshold=threshold)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(_nn.hardshrink, x, threshold=threshold)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(_nn.hardtanh, x, min=min, max=max)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return apply(_nn.hardsigmoid, x, slope=slope, offset=offset)
+
+
+def hardswish(x, name=None):
+    return apply(_nn.hardswish, x)
+
+
+def tanhshrink(x, name=None):
+    return apply(_nn.tanhshrink, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(_nn.thresholded_relu, x, threshold=threshold)
+
+
+def log_sigmoid(x, name=None):
+    return apply(_nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply(_nn.maxout, x, groups=groups, axis=axis)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(_nn.glu, x, axis=axis)
+
+
+def tanh(x, name=None):
+    import jax.numpy as jnp
+
+    return apply(jnp.tanh, x, op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = apply(_nn.softmax, x, axis=axis, op_name="softmax")
+    return out.astype(dtype) if dtype is not None else out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    out = apply(_nn.log_softmax, x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    return apply(
+        _nn.gumbel_softmax, x, _random.next_key(), temperature=temperature,
+        hard=hard, axis=axis,
+    )
+
+
+# ----------------------------- linear/conv ----------------------------------
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return apply(_nn.linear, x, weight, op_name="linear")
+    return apply(_nn.linear, x, weight, bias, op_name="linear")
+
+
+def conv2d(
+    x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCHW", name=None,
+):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(
+        _nn.conv2d, *args, stride=_t(stride), padding=_t(padding),
+        dilation=_t(dilation), groups=groups, data_format=data_format,
+        op_name="conv2d",
+    )
+
+
+def conv1d(
+    x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCL", name=None,
+):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(
+        _nn.conv1d, *args, stride=_t(stride), padding=_t(padding),
+        dilation=_t(dilation), groups=groups, data_format=data_format,
+    )
+
+
+def conv3d(
+    x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCDHW", name=None,
+):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(
+        _nn.conv3d, *args, stride=_t(stride), padding=_t(padding),
+        dilation=_t(dilation), groups=groups, data_format=data_format,
+    )
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, data_format="NCHW", output_size=None, name=None,
+):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(
+        _nn.conv2d_transpose, *args, stride=_t(stride), padding=_t(padding),
+        output_padding=_t(output_padding), dilation=_t(dilation), groups=groups,
+        data_format=data_format,
+    )
+
+
+# ----------------------------- pooling --------------------------------------
+def max_pool2d(
+    x, kernel_size, stride=None, padding=0, ceil_mode=False,
+    return_mask=False, data_format="NCHW", name=None,
+):
+    out = apply(
+        _nn.max_pool2d, x, kernel_size=_t(kernel_size), stride=_t(stride),
+        padding=_t(padding), ceil_mode=ceil_mode, data_format=data_format,
+        op_name="max_pool2d",
+    )
+    if return_mask:
+        raise NotImplementedError("return_mask")
+    return out
+
+
+def avg_pool2d(
+    x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+    divisor_override=None, data_format="NCHW", name=None,
+):
+    return apply(
+        _nn.avg_pool2d, x, kernel_size=_t(kernel_size), stride=_t(stride),
+        padding=_t(padding), ceil_mode=ceil_mode, exclusive=exclusive,
+        data_format=data_format, op_name="avg_pool2d",
+    )
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return apply(
+        _nn.adaptive_avg_pool2d, x, output_size=_t(output_size),
+        data_format=data_format,
+    )
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, name=None):
+    return apply(
+        _nn.max_pool1d, x, kernel_size=_t(kernel_size), stride=_t(stride),
+        padding=_t(padding), ceil_mode=ceil_mode,
+    )
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return apply(_nn.adaptive_avg_pool1d, x, output_size=output_size)
+
+
+# ----------------------------- norm ------------------------------------------
+def batch_norm(
+    x, running_mean, running_var, weight, bias, training=False,
+    momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None,
+):
+    """reference: nn/functional/norm.py batch_norm; running stats updated
+    in-place like the reference's BatchNorm kernels (momentum semantics:
+    running = momentum*running + (1-momentum)*batch)."""
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return apply(
+            _nn.batch_norm_infer, x, running_mean, running_var, weight, bias,
+            epsilon=epsilon, data_format=data_format, op_name="batch_norm_infer",
+        )
+    out, bm, bv = apply(
+        _nn.batch_norm_train, x, weight, bias, epsilon=epsilon,
+        data_format=data_format, op_name="batch_norm",
+    )
+    # update running stats (no tape)
+    if isinstance(running_mean, Tensor) and not isinstance(
+        x._value, __import__("jax").core.Tracer
+    ):
+        with __import__("paddle_tpu").no_grad():
+            running_mean.set_value(
+                running_mean._value * momentum + bm._value * (1 - momentum)
+            )
+            n = x.size / bm.size
+            unbiased = bv._value * (n / (n - 1)) if n > 1 else bv._value
+            running_var.set_value(
+                running_var._value * momentum + unbiased * (1 - momentum)
+            )
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(normalized_shape)
+    # None weight/bias pass straight through apply (empty pytree under jit)
+    return apply(
+        _nn.layer_norm, x, weight, bias, epsilon=epsilon, begin_norm_axis=begin,
+        op_name="layer_norm",
+    )
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    return apply(
+        _nn.group_norm, x, weight, bias, num_groups=num_groups, epsilon=epsilon,
+        data_format=data_format,
+    )
+
+
+def instance_norm(
+    x, running_mean=None, running_var=None, weight=None, bias=None,
+    use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None,
+):
+    args = [x]
+    if weight is not None:
+        args += [weight, bias]
+    return apply(_nn.instance_norm, *args, epsilon=eps)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    import jax.numpy as jnp
+
+    def _norm(v, p, axis, epsilon):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply(_norm, x, p=float(p), axis=axis, epsilon=epsilon)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    import jax.numpy as jnp
+
+    def _lrn(v, size, alpha, beta, k):
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = sum(
+            padded[:, i : i + v.shape[1]] for i in range(size)
+        )
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return apply(_lrn, x, size=size, alpha=alpha, beta=beta, k=k)
+
+
+# ----------------------------- dropout ---------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else to_tensor(x)
+    if axis is not None:
+        raise NotImplementedError("dropout axis")
+    return apply(
+        _nn.dropout, x, _random.next_key(), p=float(p), mode=mode, op_name="dropout"
+    )
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    import jax
+    import jax.numpy as jnp
+
+    def _d2(v, key, *, p, data_format):
+        if data_format == "NCHW":
+            shape = (v.shape[0], v.shape[1], 1, 1)
+        else:  # NHWC: channel last
+            shape = (v.shape[0], 1, 1, v.shape[3])
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+
+    return apply(_d2, x, _random.next_key(), p=float(p), data_format=data_format)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    import jax
+    import jax.numpy as jnp
+
+    def _ad(v, key, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        neg = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / (scale * ((1 - p) * (1 + p * alpha**2)) ** 0.5))
+        b = -a * neg * p
+        return a * jnp.where(keep, v, neg) + b
+
+    return apply(_ad, x, _random.next_key(), p=float(p))
+
+
+# ----------------------------- losses ----------------------------------------
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def cross_entropy(
+    input, label, weight=None, ignore_index=-100, reduction="mean",
+    soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None,
+):
+    """reference: nn/functional/loss.py cross_entropy →
+    softmax_with_cross_entropy op (operators/softmax_with_cross_entropy_op)."""
+    if label_smoothing > 0.0:
+        num = input.shape[axis]
+        if not soft_label:
+            import paddle_tpu as paddle
+
+            label = paddle.nn.functional.one_hot(label, num)
+            soft_label = True
+        label = label * (1.0 - label_smoothing) + label_smoothing / num
+    if not use_softmax:
+        lg = apply(
+            lambda p: __import__("jax.numpy", fromlist=["log"]).log(
+                __import__("jax.numpy", fromlist=["clip"]).clip(p, 1e-12, None)
+            ),
+            input,
+        )
+        loss = nll_from_logprob(lg, label, soft_label, ignore_index, axis)
+    else:
+        loss = apply(
+            _nn.softmax_with_cross_entropy, input, label, soft_label=soft_label,
+            ignore_index=ignore_index, axis=axis, op_name="softmax_with_cross_entropy",
+        )
+    loss = loss.squeeze(axis) if loss.ndim > max(input.ndim - 1, 1) - 0 else loss
+    if weight is not None and not soft_label:
+        w = apply(
+            lambda wt, lb: __import__("jax.numpy", fromlist=["take"]).take(
+                wt, __import__("jax.numpy", fromlist=["clip"]).clip(lb, 0, None)
+            ),
+            weight, label,
+        )
+        loss = loss * w
+        if reduction == "mean":
+            return loss.sum() / w.sum()
+    if reduction == "mean" and ignore_index != -100 and not soft_label:
+        import paddle_tpu as paddle
+
+        valid = (label != ignore_index).astype(loss.dtype)
+        denom = valid.sum().clip(min=1.0)
+        return loss.sum() / denom
+    return _reduce(loss, reduction)
+
+
+def nll_from_logprob(logp, label, soft_label, ignore_index, axis):
+    import jax.numpy as jnp
+
+    if soft_label:
+        return apply(
+            lambda lp, lb, axis: -jnp.sum(lb * lp, axis=axis), logp, label, axis=axis
+        )
+    return apply(
+        lambda lp, lb, axis, ignore_index: jnp.where(
+            lb != ignore_index,
+            -jnp.take_along_axis(
+                lp, jnp.expand_dims(jnp.clip(lb, 0, None).astype(jnp.int32), axis), axis=axis
+            ).squeeze(axis),
+            0.0,
+        ),
+        logp, label, axis=axis, ignore_index=ignore_index,
+    )
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True,
+    return_softmax=False, axis=-1,
+):
+    loss = apply(
+        _nn.softmax_with_cross_entropy, logits, label, soft_label=soft_label,
+        ignore_index=ignore_index, axis=axis,
+    )
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce(apply(_nn.mse_loss, input, label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce(apply(_nn.l1_loss, input, label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _reduce(apply(_nn.smooth_l1_loss, input, label, delta=delta), reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    loss = apply(_nn.bce_loss, input, label)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None, name=None
+):
+    if pos_weight is not None:
+        loss = apply(_nn.bce_with_logits, logit, label, pos_weight)
+    else:
+        loss = apply(_nn.bce_with_logits, logit, label)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    if weight is not None:
+        loss = apply(_nn.nll_loss, input, label, weight, ignore_index=ignore_index)
+    else:
+        loss = apply(_nn.nll_loss, input, label, ignore_index=ignore_index)
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    loss = apply(_nn.kl_div, input, label)
+    if reduction == "batchmean":
+        return loss.sum() / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return _reduce(
+        apply(_nn.margin_ranking_loss, input, other, label, margin=margin), reduction
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _reduce(
+        apply(_nn.hinge_embedding_loss, input, label, margin=margin), reduction
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply(_nn.cosine_similarity, x1, x2, axis=axis, eps=eps)
+
+
+def sigmoid_focal_loss(
+    logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None
+):
+    import jax
+    import jax.numpy as jnp
+
+    def _focal(lg, lb, alpha, gamma):
+        p = jax.nn.sigmoid(lg)
+        ce = _nn.bce_with_logits(lg, lb)
+        p_t = p * lb + (1 - p) * (1 - lb)
+        a_t = alpha * lb + (1 - alpha) * (1 - lb)
+        return a_t * ((1 - p_t) ** gamma) * ce
+
+    loss = apply(_focal, logit, label, alpha=alpha, gamma=gamma)
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+# ----------------------------- embedding / inputs ----------------------------
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return apply(_nn.embedding, x, weight, padding_idx=padding_idx, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops import creation as _c
+
+    return apply(_c.one_hot, x, num_classes=num_classes, differentiable=False)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return apply(_nn.label_smooth, label, epsilon=epsilon)
+
+
+# ----------------------------- shape / vision --------------------------------
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return apply(
+        _mp.pad, x, pad=tuple(int(p) for p in pad), mode=mode, value=value,
+        data_format=data_format, op_name="pad",
+    )
+
+
+def interpolate(
+    x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+    align_mode=0, data_format="NCHW", name=None,
+):
+    return apply(
+        _nn.interpolate, x,
+        size=None if size is None else tuple(int(s) for s in size),
+        scale_factor=_t(scale_factor), mode=mode, align_corners=align_corners,
+        data_format=data_format,
+    )
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply(_nn.pixel_shuffle, x, upscale_factor=upscale_factor, data_format=data_format)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    return apply(
+        _nn.grid_sample, x, grid, mode=mode, padding_mode=padding_mode,
+        align_corners=align_corners,
+    )
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return apply(
+        _mp.unfold, x, kernel_sizes=_t(kernel_sizes), strides=_t(strides),
+        paddings=_t(paddings), dilations=_t(dilations),
+    )
+
+
+# ----------------------------- attention -------------------------------------
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
+    training=True, name=None,
+):
+    dropout_key = (
+        _random.next_key() if (dropout_p > 0.0 and training) else None
+    )
+    return apply(
+        _nn.scaled_dot_product_attention, query, key, value, attn_mask,
+        dropout_key, is_causal=is_causal, dropout_p=dropout_p, op_name="sdpa",
+    )
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,231 @@
+"""paddle.nn.initializer — parameter initializers.
+
+Reference analogue: python/paddle/nn/initializer/ + fluid/initializer.py
+(Constant, Uniform, Normal, TruncatedNormal, Xavier, KaimingNormal/MSRA,
+Assign, Bilinear). Initializers generate concrete jax arrays host-side using
+the global Generator key stream (core/random.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _random
+from ...core.dtype import to_np_dtype
+
+__all__ = [
+    "Initializer",
+    "Constant",
+    "Uniform",
+    "Normal",
+    "TruncatedNormal",
+    "XavierNormal",
+    "XavierUniform",
+    "KaimingNormal",
+    "KaimingUniform",
+    "Assign",
+    "Orthogonal",
+    "Dirac",
+    "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return recommended[nonlinearity]
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle linear weight is [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def _generate(self, shape, dtype):
+        raise NotImplementedError
+
+    def __call__(self, param, block=None):
+        # fluid-style imperative init on an existing tensor
+        param.set_value(self._generate(tuple(param.shape), param._value.dtype))
+        return param
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=to_np_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, dtype):
+        return jax.random.uniform(
+            _random.next_key(), shape, dtype=to_np_dtype(dtype),
+            minval=self.low, maxval=self.high,
+        )
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype):
+        return (
+            jax.random.normal(_random.next_key(), shape, dtype=to_np_dtype(dtype))
+            * self.std
+            + self.mean
+        )
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype):
+        return (
+            jax.random.truncated_normal(
+                _random.next_key(), -2.0, 2.0, shape, dtype=to_np_dtype(dtype)
+            )
+            * self.std
+            + self.mean
+        )
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        fan_in, fan_out = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        fan_out = self._fan_out or fan_out
+        std = self._gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return (
+            jax.random.normal(_random.next_key(), shape, dtype=to_np_dtype(dtype)) * std
+        )
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        fan_in, fan_out = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        fan_out = self._fan_out or fan_out
+        limit = self._gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(
+            _random.next_key(), shape, dtype=to_np_dtype(dtype),
+            minval=-limit, maxval=limit,
+        )
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self._slope = negative_slope
+        self._nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        fan_in, _ = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        gain = calculate_gain(self._nonlinearity, self._slope)
+        std = gain / math.sqrt(fan_in)
+        return (
+            jax.random.normal(_random.next_key(), shape, dtype=to_np_dtype(dtype)) * std
+        )
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self._slope = negative_slope
+        self._nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        fan_in, _ = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        gain = calculate_gain(self._nonlinearity, self._slope)
+        limit = gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(
+            _random.next_key(), shape, dtype=to_np_dtype(dtype),
+            minval=-limit, maxval=limit,
+        )
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = np.asarray(value)
+
+    def _generate(self, shape, dtype):
+        arr = jnp.asarray(self.value, dtype=to_np_dtype(dtype))
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"Assign shape {arr.shape} != param shape {shape}")
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _generate(self, shape, dtype):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(_random.next_key(), (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(to_np_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _generate(self, shape, dtype):
+        out = np.zeros(shape, dtype=to_np_dtype(dtype))
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        per = oc // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, ic)):
+                idx = (g * per + i, i) + tuple(centers)
+                out[idx] = 1.0
+        return jnp.asarray(out)
+
+
+# fluid-era aliases (reference: fluid/initializer.py)
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

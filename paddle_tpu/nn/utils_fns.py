@@ -1,0 +1,53 @@
+"""nn.utils — clip_grad_norm_, clip_grad_value_, parameters_to_vector.
+
+Reference: python/paddle/nn/utils/clip_grad_norm_.py etc.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+
+
+@no_grad()
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.sum(
+            jnp.stack([jnp.sum(jnp.abs(g._value) ** norm_type) for g in grads])
+        ) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite total norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._value = g._value * scale
+    return Tensor(total)
+
+
+@no_grad()
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec._value[offset : offset + n].reshape(tuple(p.shape)))
+        offset += n

@@ -1,0 +1,21 @@
+"""Pure-JAX functional op library — the PHI-kernel layer of the framework.
+
+Reference analogue: paddle/phi/kernels/ (164k LoC of per-backend C++/CUDA
+kernels) + paddle/phi/infermeta/. On TPU one compiler replaces the per-device
+kernel zoo: every op here is a pure function `fn(*arrays, **static_config)`
+lowered by XLA; shape/dtype inference (InferMeta) is jax's abstract
+evaluation. These functions contain no framework types — the Tensor-level
+wrappers live in paddle_tpu.tensor_api and dispatch through
+paddle_tpu.core.dispatch.apply (the KernelFactory analogue).
+"""
+from . import (  # noqa: F401
+    creation,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    nn_ops,
+    random_ops,
+    reduction,
+    search,
+)

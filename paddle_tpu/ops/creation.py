@@ -1,0 +1,64 @@
+"""Creation kernels (pure jax).
+
+Reference analogue: phi full/empty/arange/eye/linspace kernels,
+python/paddle/tensor/creation.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def full(*, shape, fill_value, dtype="float32"):
+    return jnp.full(tuple(shape), fill_value, dtype=dtype)
+
+
+def full_like(x, *, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def zeros_like(x, *, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def ones_like(x, *, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def empty_like(x, *, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def arange(*, start, end, step, dtype="int64"):
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def linspace(*, start, stop, num, dtype="float32"):
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+def logspace(*, start, stop, num, base=10.0, dtype="float32"):
+    return jnp.logspace(start, stop, num, base=base, dtype=dtype)
+
+
+def eye(*, num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=dtype)
+
+
+def meshgrid(*xs, indexing="ij"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+def tril_indices(*, row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+def triu_indices(*, row, col, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+def one_hot(x, *, num_classes):
+    import jax
+
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)

@@ -1,0 +1,174 @@
+"""Linear-algebra kernels (pure jax → MXU).
+
+Reference analogue: phi/kernels/funcs/blas/ (cuBLAS wrappers), matmul kernels,
+python/paddle/tensor/linalg.py. On TPU these are the MXU ops — matmuls stay
+large/batched so XLA tiles them onto the systolic array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def matmul(x, y, *, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    # paddle.dot: 1-D/2-D elementwise-mul + reduce on last axis
+    return jnp.sum(x * y, axis=-1)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, y):
+    return jnp.matmul(x, y)
+
+
+def t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+def norm(x, *, p="fro", axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+def dist(x, y, *, p=2.0):
+    return norm(x - y, p=p)
+
+
+def cross(x, y, *, axis=None):
+    return jnp.cross(x, y, axis=-1 if axis is None else axis)
+
+
+def cholesky(x, *, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, *, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    s, l = jnp.linalg.slogdet(x)
+    return jnp.stack([s, l])
+
+
+def matrix_rank(x, *, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def qr(x, *, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+def svd(x, *, full_matrices=False):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+def eig(x):
+    w, v = jnp.linalg.eig(x)
+    return w, v
+
+
+def eigh(x, *, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, *, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+def cholesky_solve(x, y, *, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def lstsq(x, y, *, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv
+
+
+def trace(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def histogram(x, *, bins=100, min=0, max=0):
+    lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+    if lo is None:
+        h, _ = jnp.histogram(x, bins=bins)
+    else:
+        h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return h
+
+
+def bincount(x, weights=None, *, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength, length=None)
+
+
+def cov(x, *, rowvar=True, ddof=True):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)
+
+
+def corrcoef(x, *, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def multi_dot(*mats):
+    return jnp.linalg.multi_dot(mats)
+
+
+def cond(x, *, p=None):
+    return jnp.linalg.cond(x, p=p)

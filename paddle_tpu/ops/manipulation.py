@@ -1,0 +1,343 @@
+"""Shape / layout manipulation kernels (pure jax).
+
+Reference analogue: paddle/phi/kernels/{reshape,transpose,concat,split,...}
+kernels; API parity with python/paddle/tensor/manipulation.py.
+All static config (shapes, axes) comes in as hashable keywords so the
+dispatcher's per-op jit cache (core/dispatch.py) can key on it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def reshape(x, *, shape):
+    shape = list(shape)
+    # paddle semantics: 0 means "copy this dim from input"
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return jnp.reshape(x, tuple(shape))
+
+
+def transpose(x, *, perm):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+def squeeze(x, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def unsqueeze(x, *, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.expand_dims(x, axis=tuple(axis))
+
+
+def concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def unstack(x, *, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(p, axis=axis) for p in jnp.split(x, n, axis=axis))
+
+
+def split(x, *, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def chunk(x, *, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+def flatten(x, *, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    flat = 1
+    for s in x.shape[start : stop + 1]:
+        flat *= int(s)
+    shape = x.shape[:start] + (flat,) + x.shape[stop + 1 :]
+    return jnp.reshape(x, shape)
+
+
+def tile(x, *, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, *, shape):
+    shape = list(shape)
+    # paddle: -1 keeps the original dim
+    ndiff = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - ndiff]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, *, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def flip(x, *, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def rot90(x, *, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, *, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+def slice_op(x, *, axes, starts, ends):
+    """reference: phi/kernels/slice_kernel.h — static start/ends."""
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def gather(x, index, *, axis=0):
+    index = index.reshape(-1)
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def scatter(x, index, updates, *, overwrite=True):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, *, shape):
+    import jax.numpy as jnp
+
+    zeros = jnp.zeros(tuple(shape), dtype=updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+def put_along_axis(x, index, value, *, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
+    if reduce == "add":
+        dim_idx = jnp.indices(index.shape)
+        full_idx = list(dim_idx)
+        full_idx[axis] = index
+        return x.at[tuple(full_idx)].add(value)
+    if reduce in ("mul", "multiply"):
+        dim_idx = jnp.indices(index.shape)
+        full_idx = list(dim_idx)
+        full_idx[axis] = index
+        return x.at[tuple(full_idx)].multiply(value)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+def take_along_axis(x, index, *, axis):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def index_select(x, index, *, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, value, *, axis=0):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index.reshape(-1)].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, *, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def masked_select(x, mask):
+    # dynamic output shape — not jittable; dispatcher runs it eagerly
+    import numpy as np
+
+    xn = np.asarray(x)
+    mn = np.asarray(mask)
+    return jnp.asarray(xn[mn])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def pad(x, *, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """paddle.nn.functional.pad semantics (nn/functional/common.py)."""
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        # full-rank paddle pad: [before0, after0, before1, after1, ...]? No —
+        # paddle full-rank is per-dim pairs in order
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # partial spec applies to trailing spatial dims (reversed pairs, like
+        # torch); e.g. NCHW with pad=[l, r, t, b]
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * x.ndim
+        if data_format.endswith("C"):  # NHWC-style: spatial dims before channel
+            spatial_axes = list(range(1, 1 + n_spatial))
+        else:
+            spatial_axes = list(range(x.ndim - n_spatial, x.ndim))
+        for i, ax in enumerate(reversed(spatial_axes)):
+            widths[ax] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def tril(x, *, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, *, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, *, offset=0, padding_value=0.0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0.0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return jnp.diag(x, k=offset)
+
+
+def diagonal(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    rows = jnp.arange(x.shape[-1]) + (abs(offset) if offset < 0 else 0)
+    cols = jnp.arange(x.shape[-1]) + (offset if offset > 0 else 0)
+    out = base.at[..., rows, cols].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def repeat_interleave(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, *, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def unfold(x, *, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col — reference: phi/kernels/unfold_kernel.h."""
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = (kernel_sizes, kernel_sizes)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+    if isinstance(paddings, int):
+        paddings = (paddings, paddings, paddings, paddings)
+    elif len(paddings) == 2:
+        paddings = (paddings[0], paddings[1], paddings[0], paddings[1])
+    if isinstance(dilations, int):
+        dilations = (dilations, dilations)
+    n, c, h, w = x.shape
+    x = jnp.pad(
+        x,
+        ((0, 0), (0, 0), (paddings[0], paddings[2]), (paddings[1], paddings[3])),
+    )
+    kh, kw = kernel_sizes
+    oh = (x.shape[2] - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (x.shape[3] - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=(kh, kw),
+        window_strides=tuple(strides),
+        padding="VALID",
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def tensordot(x, y, *, axes=2):
+    return jnp.tensordot(x, y, axes=axes)

@@ -1,0 +1,404 @@
+"""Elementwise & scalar math kernels (pure jax).
+
+Reference analogue: paddle/phi/kernels/{cpu,gpu}/elementwise_*ated kernels,
+activation_kernel.cc, scale_kernel.cc etc.; API parity with
+python/paddle/tensor/math.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+# ---- binary elementwise (broadcast follows numpy semantics, matching
+# paddle's elementwise ops with axis=-1) ----
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+# ---- unary ----
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def sgn(x):
+    return jnp.sign(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def exponent_bits_isnan(x):  # helper
+    return jnp.isnan(x)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, *, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def logit(x, *, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    """reference: phi/kernels/scale_kernel.h."""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def clip(x, min, max):
+    return jnp.clip(x, min, max)
+
+
+def clip_scalar(x, *, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def multiplex(index, *inputs):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0
+    )[0]
+
+
+def addmm(input, x, y, *, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def diff(x, *, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def cumsum(x, *, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumprod(x, *, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+def cummax(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+def cummin(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def logcumsumexp(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def trapezoid(y, x=None, *, dx=None, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def complex_(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def polygamma(x, *, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def take(x, index, *, mode="raise"):
+    flat = x.reshape(-1)
+    idx = index
+    if mode == "wrap":
+        idx = jnp.mod(idx, flat.shape[0])
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    else:
+        idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+    return jnp.take(flat, idx.reshape(-1), mode="clip").reshape(index.shape)

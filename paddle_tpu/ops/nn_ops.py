@@ -1,0 +1,690 @@
+"""Neural-network kernels (pure jax).
+
+Reference analogue: phi conv/pool/norm/softmax/activation kernels
+(paddle/phi/kernels/{conv_kernel.h,pool_kernel.h,batch_norm_kernel.h,...})
+and the fused ops in paddle/fluid/operators/fused/. Convs and matmuls are the
+MXU path; keep NCHW data arriving from the paddle-compatible API but lower via
+lax.conv_general_dilated which XLA lays out for TPU.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_padding(padding, spatial, kernel, stride, dilation):
+    """Normalize paddle padding spec to lax padding list."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "SAME":
+            return "SAME"
+        if p == "VALID":
+            return "VALID"
+        raise ValueError(padding)
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
+    raise ValueError(f"bad padding {padding}")
+
+
+# ---------------------------------------------------------------------------
+# Convolution — reference: phi/kernels/conv_kernel.h, conv_transpose_kernel.h
+# ---------------------------------------------------------------------------
+def conv2d(
+    x,
+    weight,
+    bias=None,
+    *,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    data_format="NCHW",
+):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, 2, weight.shape[-2:], stride, dilation)
+    dn = (data_format, "OIHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        if data_format == "NCHW":
+            out = out + bias.reshape(1, -1, 1, 1)
+        else:
+            out = out + bias.reshape(1, 1, 1, -1)
+    return out
+
+
+def conv1d(x, weight, bias=None, *, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1, weight.shape[-1:], stride, dilation)
+    fmt = "NCH" if data_format in ("NCL", "NCH") else "NHC"
+    out = jax.lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=(fmt, "OIH", fmt),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1) if fmt == "NCH" else bias.reshape(1, 1, -1))
+    return out
+
+
+def conv3d(x, weight, bias=None, *, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3, weight.shape[-3:], stride, dilation)
+    dn = (data_format, "OIDHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        if data_format == "NCDHW":
+            out = out + bias.reshape(1, -1, 1, 1, 1)
+        else:
+            out = out + bias.reshape(1, 1, 1, 1, -1)
+    return out
+
+
+def conv2d_transpose(
+    x, weight, bias=None, *, stride=1, padding=0, output_padding=0,
+    dilation=1, groups=1, data_format="NCHW",
+):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    output_padding = _pair(output_padding)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    padding = _conv_padding(padding, 2, weight.shape[-2:], stride, dilation)
+    kh, kw = weight.shape[-2:]
+    # gradient-style transpose conv: lax conv with lhs dilation
+    pad_t = [
+        (
+            dilation[i] * (k - 1) - padding[i][0],
+            dilation[i] * (k - 1) - padding[i][1] + output_padding[i],
+        )
+        for i, k in enumerate((kh, kw))
+    ]
+    # weight is (in, out/groups, kh, kw) in paddle conv_transpose layout
+    w = jnp.flip(weight, axis=(-2, -1))
+    if groups > 1:
+        ci = w.shape[0]
+        w = w.reshape(groups, ci // groups, *w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2).reshape(-1, ci // groups, kh, kw)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = (data_format, "OIHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad_t, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        if data_format == "NCHW":
+            out = out + bias.reshape(1, -1, 1, 1)
+        else:
+            out = out + bias.reshape(1, 1, 1, -1)
+    return out
+
+
+def linear(x, weight, bias=None):
+    """reference: phi matmul + elementwise_add; paddle weight layout [in, out]."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling — reference: phi/kernels/pool_kernel.h
+# ---------------------------------------------------------------------------
+def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    pad = _conv_padding(padding, 2, ks, st, (1, 1))
+    if data_format == "NCHW":
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * 2)
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * 2) + [(0, 0)]
+    if pad == "SAME" or pad == "VALID":
+        pads = pad
+    return jax.lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        jax.lax.max, window, strides, pads,
+    )
+
+
+def avg_pool2d(
+    x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+    exclusive=True, data_format="NCHW",
+):
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    pad = _conv_padding(padding, 2, ks, st, (1, 1))
+    if data_format == "NCHW":
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [])
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else []) + [(0, 0)]
+    if pad in ("SAME", "VALID"):
+        pads = pad
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and pads not in ("SAME", "VALID"):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+    return summed / (ks[0] * ks[1])
+
+
+def adaptive_avg_pool2d(x, *, output_size, data_format="NCHW"):
+    os = _pair(output_size)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % os[0] == 0 and w % os[1] == 0:
+        ks = (h // os[0], w // os[1])
+        return avg_pool2d(
+            x, kernel_size=ks, stride=ks, padding=0, exclusive=False,
+            data_format=data_format,
+        )
+    # general case: mean over variable windows via interpolation-style gather
+    axis_h = 2 if data_format == "NCHW" else 1
+    out = x
+    for ax, o, n in ((axis_h, os[0], h), (axis_h + 1, os[1], w)):
+        starts = (jnp.arange(o) * n) // o
+        ends = ((jnp.arange(o) + 1) * n + o - 1) // o
+        # build averaging matrix [o, n]
+        idx = jnp.arange(n)
+        mask = (idx[None, :] >= starts[:, None]) & (idx[None, :] < ends[:, None])
+        mat = mask.astype(x.dtype) / jnp.sum(mask, axis=1, keepdims=True).astype(x.dtype)
+        out = jnp.tensordot(out, mat, axes=[[ax], [1]])
+        out = jnp.moveaxis(out, -1, ax)
+    return out
+
+
+def max_pool1d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False):
+    xs = x[..., None]
+    out = max_pool2d(
+        xs, kernel_size=(kernel_size if isinstance(kernel_size, int) else kernel_size[0], 1),
+        stride=(stride if isinstance(stride, int) else (stride[0] if stride else kernel_size), 1),
+        padding=(padding if isinstance(padding, int) else padding[0], 0),
+    )
+    return out[..., 0]
+
+
+def adaptive_avg_pool1d(x, *, output_size):
+    xs = x[..., None]
+    out = adaptive_avg_pool2d(xs, output_size=(output_size, 1))
+    return out[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Normalization — reference: phi/kernels/batch_norm_kernel.h,
+# layer_norm_kernel.h, group_norm; cuDNN replaced by XLA-fused elementwise.
+# ---------------------------------------------------------------------------
+def batch_norm_infer(x, mean, var, scale, bias, *, epsilon=1e-5, data_format="NCHW"):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * (inv * scale).reshape(shape) + bias.reshape(shape)
+    return out
+
+
+def batch_norm_train(x, scale, bias, *, epsilon=1e-5, data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var)."""
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * (inv * scale).reshape(shape) + bias.reshape(shape)
+    return out, mean, var
+
+
+def layer_norm(x, weight=None, bias=None, *, epsilon=1e-5, begin_norm_axis=-1):
+    if begin_norm_axis < 0:
+        begin_norm_axis = x.ndim + begin_norm_axis
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight, *, epsilon=1e-6):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + epsilon) * weight
+
+
+def group_norm(x, weight=None, bias=None, *, num_groups, epsilon=1e-5, data_format="NCHW"):
+    if data_format != "NCHW":
+        raise NotImplementedError
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    g = num_groups
+    xg = x.reshape(n, g, c // g, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, *, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Activations — reference: phi/kernels/activation_kernel.h
+# ---------------------------------------------------------------------------
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def leaky_relu(x, *, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, x * weight)
+
+
+def elu(x, *, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, *, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def gelu(x, *, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, *, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jnp.log1p(jnp.exp(scaled)) / beta)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def softshrink(x, *, threshold=0.5):
+    return jnp.where(
+        x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)
+    )
+
+
+def hardshrink(x, *, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardtanh(x, *, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardsigmoid(x, *, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, *, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def maxout(x, *, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def glu(x, *, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def softmax(x, *, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, key, *, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard + jax.lax.stop_gradient(-y) + y  # straight-through
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Losses — reference: phi cross_entropy / bce / mse kernels,
+# operators/softmax_with_cross_entropy_op
+# ---------------------------------------------------------------------------
+def softmax_with_cross_entropy(
+    logits, label, *, soft_label=False, ignore_index=-100, axis=-1
+):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+        return loss
+    lab = label
+    if lab.ndim == logits.ndim:
+        lab = jnp.squeeze(lab, axis=axis)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(jnp.clip(lab, 0, None).astype(jnp.int32), axis), axis=axis
+    )
+    loss = -picked
+    valid = jnp.expand_dims(lab != ignore_index, axis)
+    loss = jnp.where(valid, loss, 0.0)
+    return loss
+
+
+def mse_loss(input, label):
+    return jnp.square(input - label)
+
+
+def l1_loss(input, label):
+    return jnp.abs(input - label)
+
+
+def smooth_l1_loss(input, label, *, delta=1.0):
+    d = jnp.abs(input - label)
+    return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+
+
+def bce_loss(input, label):
+    eps = 1e-12
+    return -(label * jnp.log(input + eps) + (1 - label) * jnp.log(1 - input + eps))
+
+
+def bce_with_logits(logit, label, pos_weight=None):
+    log_p = jax.nn.log_sigmoid(logit)
+    log_not_p = jax.nn.log_sigmoid(-logit)
+    if pos_weight is not None:
+        return -(pos_weight * label * log_p + (1 - label) * log_not_p)
+    return -(label * log_p + (1 - label) * log_not_p)
+
+
+def nll_loss(log_prob, label, weight=None, *, ignore_index=-100):
+    picked = jnp.take_along_axis(
+        log_prob, jnp.clip(label, 0, None)[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    loss = -picked
+    if weight is not None:
+        loss = loss * jnp.take(weight, jnp.clip(label, 0, None))
+    return jnp.where(label != ignore_index, loss, 0.0)
+
+
+def kl_div(input, label):
+    # input is log-prob
+    return label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+
+
+def cosine_similarity(x1, x2, *, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.clip(n1 * n2, eps, None)
+
+
+def hinge_embedding_loss(input, label, *, margin=1.0):
+    return jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+
+
+def margin_ranking_loss(input, other, label, *, margin=0.0):
+    return jnp.maximum(0.0, -label * (input - other) + margin)
+
+
+# ---------------------------------------------------------------------------
+# Embedding — reference: phi/kernels/embedding_kernel.h,
+# operators/collective/c_embedding_op (vocab-parallel variant in parallel/)
+# ---------------------------------------------------------------------------
+def embedding(x, weight, *, padding_idx=None):
+    out = jnp.take(weight, x.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dropout — key passed explicitly (see core/random.py for key plumbing)
+# ---------------------------------------------------------------------------
+def dropout(x, key, *, p=0.5, mode="upscale_in_train"):
+    if p == 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention — reference: operators/fused/fused_attention_op.cu, fmha_ref.h.
+# XLA fuses this well already; a Pallas flash kernel lives in
+# paddle_tpu/ops/pallas/flash_attention.py for long sequences.
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(
+    q, k, v, mask=None, dropout_key=None, *, scale=None, is_causal=False,
+    dropout_p=0.0,
+):
+    """q,k,v: [batch, seq, heads, head_dim] (paddle fused_attention layout).
+    Attention dropout applies to the probabilities when dropout_key is given
+    (the functional wrapper threads a key only in training)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d**0.5)
+    qf = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+    kf = jnp.swapaxes(k, 1, 2)
+    vf = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+    return jnp.swapaxes(out, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Interpolate / vision ops — reference: phi interpolate kernels
+# ---------------------------------------------------------------------------
+def interpolate(
+    x, *, size=None, scale_factor=None, mode="nearest", align_corners=False,
+    data_format="NCHW",
+):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        spatial = (h, w)
+    else:
+        n, h, w, c = x.shape
+        spatial = (h, w)
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (tuple, list)) else (scale_factor,) * 2
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = tuple(int(s) for s in size)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if data_format == "NCHW":
+        shape = (n, c) + size
+    else:
+        shape = (n,) + size + (c,)
+    if align_corners and method != "nearest":
+        # jax.image.resize has no align_corners; emulate with explicit coords
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        out = x
+        for ax, o in zip(axes, size):
+            n_in = out.shape[ax]
+            if o == 1:
+                coords = jnp.zeros((1,))
+            else:
+                coords = jnp.linspace(0.0, n_in - 1.0, o)
+            i0 = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, n_in - 1)
+            i1 = jnp.clip(i0 + 1, 0, n_in - 1)
+            t = (coords - i0).astype(x.dtype)
+            a = jnp.take(out, i0, axis=ax)
+            b = jnp.take(out, i1, axis=ax)
+            tshape = [1] * out.ndim
+            tshape[ax] = o
+            out = a + (b - a) * t.reshape(tshape)
+        return out
+    return jax.image.resize(x, shape, method=method)
+
+
+def pixel_shuffle(x, *, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    raise NotImplementedError
+
+
+def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros", align_corners=True):
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = fx - x0
+    wy = fy - y0
+
+    def sample(xi, yi):
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        xi = jnp.clip(xi, 0, w - 1)
+        yi = jnp.clip(yi, 0, h - 1)
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        vals = x[batch, :, yi, xi]  # [n, gh, gw, c]
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    v00 = sample(x0, y0)
+    v01 = sample(x1, y0)
+    v10 = sample(x0, y1)
+    v11 = sample(x1, y1)
+    wx_ = wx[..., None]
+    wy_ = wy[..., None]
+    out = (
+        v00 * (1 - wx_) * (1 - wy_)
+        + v01 * wx_ * (1 - wy_)
+        + v10 * (1 - wx_) * wy_
+        + v11 * wx_ * wy_
+    )
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def label_smooth(label, *, epsilon=0.1):
+    num = label.shape[-1]
+    return (1.0 - epsilon) * label + epsilon / num
+
+
+def npair_normalize(x, *, axis=1, epsilon=1e-12):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)

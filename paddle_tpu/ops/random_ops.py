@@ -1,0 +1,71 @@
+"""Random kernels (pure jax; key passed explicitly).
+
+Reference analogue: phi gaussian/uniform/bernoulli/multinomial kernels backed
+by phi::Generator (paddle/phi/core/generator.h:23). The stateful key handling
+lives in core/random.py; these kernels take the PRNG key as the first array
+argument so they stay pure and jittable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def uniform(key, *, shape, dtype="float32", min=-1.0, max=1.0):
+    return jax.random.uniform(
+        key, tuple(shape), dtype=dtype, minval=min, maxval=max
+    )
+
+
+def gaussian(key, *, shape, dtype="float32", mean=0.0, std=1.0):
+    return jax.random.normal(key, tuple(shape), dtype=dtype) * std + mean
+
+
+def randint(key, *, low, high, shape, dtype="int64"):
+    return jax.random.randint(key, tuple(shape), low, high, dtype=dtype)
+
+
+def randperm(key, *, n, dtype="int64"):
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+def bernoulli(key, p):
+    return jax.random.bernoulli(key, p).astype(p.dtype)
+
+
+def poisson(key, lam):
+    return jax.random.poisson(key, lam).astype(lam.dtype)
+
+
+def exponential(key, x, *, lam=1.0):
+    return jax.random.exponential(key, x.shape, dtype=x.dtype) / lam
+
+
+def multinomial(key, x, *, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, axis=-1, shape=x.shape[:-1] + (num_samples,)
+        ).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape, dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def normal(key, *, mean=0.0, std=1.0, shape=None, dtype="float32"):
+    return jax.random.normal(key, tuple(shape), dtype=dtype) * std + mean
+
+
+def truncated_gaussian(key, *, shape, mean=0.0, std=1.0, a=-2.0, b=2.0, dtype="float32"):
+    return (
+        jax.random.truncated_normal(key, a, b, tuple(shape), dtype=dtype) * std + mean
+    )
+
+
+def shuffle(key, x, *, axis=0):
+    return jax.random.permutation(key, x, axis=axis, independent=False)
+
+
+def dropout_mask(key, *, shape, p, dtype="float32"):
+    return jax.random.bernoulli(key, 1.0 - p, tuple(shape)).astype(dtype)

@@ -1,0 +1,92 @@
+"""Reduction kernels (pure jax).
+
+Reference analogue: paddle/fluid/operators/reduce_ops/, phi reduce kernels;
+API parity with python/paddle/tensor/math.py (sum/mean/...) and stat.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def sum(x, *, axis=None, keepdim=False, dtype=None):
+    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+def mean(x, *, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def max(x, *, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def min(x, *, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amax(x, *, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amin(x, *, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def prod(x, *, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+def logsumexp(x, *, axis=None, keepdim=False):
+    from jax.scipy.special import logsumexp as _lse
+
+    return _lse(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def all(x, *, axis=None, keepdim=False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def any(x, *, axis=None, keepdim=False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(
+        x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim
+    )
+
+
+def var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(
+        x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim
+    )
+
+
+def median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nanmedian(x, *, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, *, axis=None, keepdim=False, dtype=None):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+def nanmean(x, *, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, *, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, *, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)

@@ -1,0 +1,140 @@
+"""Search / sort / sampling-index kernels (pure jax).
+
+Reference analogue: phi argmin_max/top_k/sort kernels,
+python/paddle/tensor/search.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def argmax(x, *, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmin(x, *, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argsort(x, *, axis=-1, descending=False, stable=True):
+    idx = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return idx.astype(jnp.int64)
+
+
+def sort(x, *, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def topk(x, k, *, axis=-1, largest=True, sorted=True):
+    # k arrives as a static int via kwargs in the public wrapper; accept both
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = topk(xm, k, axis=-1, largest=largest, sorted=sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    import jax
+
+    if largest:
+        v, i = jax.lax.top_k(x, k)
+    else:
+        v, i = jax.lax.top_k(-x, k)
+        v = -v
+    return v, i.astype(jnp.int64)
+
+
+def kthvalue(x, *, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int64)
+
+
+def mode(x, *, axis=-1, keepdim=False):
+    """Most frequent value along axis; ties resolved to the larger value
+    (paddle returns the max among equally-frequent values). O(n^2) pairwise
+    count — fine for the typical small-axis use of mode."""
+    n = x.shape[axis]
+    xm = jnp.moveaxis(x, axis, -1)
+    counts = jnp.sum(
+        (xm[..., :, None] == xm[..., None, :]), axis=-1, dtype=jnp.int32
+    )
+    # lexicographic argmax on (count, value): scale counts above value rank
+    order = jnp.argsort(xm, axis=-1, stable=True)
+    rank = jnp.argsort(order, axis=-1, stable=True)  # rank of each value
+    score = counts * (n + 1) + rank
+    best = jnp.argmax(score, axis=-1)
+    v = jnp.take_along_axis(xm, best[..., None], axis=-1)[..., 0]
+    # index of the last occurrence of the modal value in the original order
+    matches = (xm == v[..., None]).astype(jnp.int32)
+    idx = jnp.argmax(matches * jnp.arange(1, n + 1), axis=-1)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return v, idx.astype(jnp.int64)
+
+
+def nonzero(x, *, as_tuple=False):
+    import numpy as np
+
+    xn = np.asarray(x)
+    idx = np.nonzero(xn)
+    if as_tuple:
+        return tuple(jnp.asarray(i.reshape(-1, 1)) for i in idx)
+    return jnp.asarray(np.stack(idx, axis=1).astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, *, out_int32=False, right=False):
+    out = jnp.searchsorted(
+        sorted_sequence, values, side="right" if right else "left"
+    )
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def bucketize(x, sorted_sequence, *, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, *, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+
+    res = np.unique(
+        np.asarray(x),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, *, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+
+    xn = np.asarray(x)
+    if axis is None:
+        xn = xn.reshape(-1)
+        keep = np.concatenate([[True], xn[1:] != xn[:-1]])
+        out = xn[keep]
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    outs = [jnp.asarray(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(jnp.asarray(inv))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.concatenate([idx, [len(xn)]]))
+        outs.append(jnp.asarray(counts))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def index_of_max_run(x):  # internal helper
+    return jnp.argmax(x)

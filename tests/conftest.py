@@ -1,0 +1,33 @@
+"""Test config: run on a virtual 8-device CPU platform.
+
+Mirrors the reference's strategy of simulating multi-device on one host
+(SURVEY.md §4): instead of spawning NCCL subprocess rings
+(test_collective_base.py), we give XLA 8 virtual CPU devices so sharding /
+collective tests compile and run the same SPMD programs as a real pod slice.
+"""
+import os
+
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+# force CPU even when the session env preselects a TPU platform: unit tests
+# must be fast, deterministic, and runnable without the accelerator tunnel.
+# The env var alone is not enough — the PJRT plugin's sitecustomize imports
+# jax at interpreter startup, freezing the platform config — so override the
+# live jax config too (must happen before any backend initializes).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu
+
+    paddle_tpu.seed(1234)
+    np.random.seed(1234)
+    yield

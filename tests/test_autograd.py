@@ -1,0 +1,197 @@
+"""Autograd engine tests — analytic grads vs numeric/NumPy reference,
+mirroring OpTest.check_grad (unittests/op_test.py:1803) finite-difference
+checks and the eager backward tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = f(x)
+        flat[i] = old - eps
+        fm = f(x)
+        flat[i] = old
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor([0.5], stop_gradient=False)
+    y = paddle.tanh(paddle.exp(x))
+    y.backward()
+    e = np.exp(0.5)
+    expected = (1 - np.tanh(e) ** 2) * e
+    np.testing.assert_allclose(x.grad.numpy(), [expected], rtol=1e-4)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x  # 4
+    z = y * y + y  # used twice
+    z.backward()
+    # dz/dx = (2y + 1) * 2x = 9 * 4 = 36
+    np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+
+def test_matmul_grad_vs_numeric():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 2).astype(np.float32)
+    x = paddle.to_tensor(a.copy(), stop_gradient=False)
+    w = paddle.to_tensor(b.copy(), stop_gradient=False)
+    paddle.matmul(x, w).sum().backward()
+
+    ng = numeric_grad(lambda v: (v @ b).sum(), a.astype(np.float64).copy())
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-2)
+    ng_w = numeric_grad(lambda v: (a @ v).sum(), b.astype(np.float64).copy())
+    np.testing.assert_allclose(w.grad.numpy(), ng_w, rtol=1e-2, atol=1e-2)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)  # summed over bcast dim
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])  # only through z=y*x
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_backward_nonscalar_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y2 = x * 2
+    y2.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()  # second time ok with retained graph
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    z = x * x
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    (parts[0].sum() + 2 * parts[2].sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 2], [1, 0, 2]])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert seen and seen[0][0] == pytest.approx(3.0)
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad([z], [x, y])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    np.testing.assert_allclose(gy.numpy(), [4.0])
+    # .grad not polluted by paddle.grad
+    assert x.grad is None
+
+
+def test_reduction_grads():
+    a = np.random.randn(4, 5).astype(np.float32)
+    x = paddle.to_tensor(a.copy(), stop_gradient=False)
+    x.mean().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full_like(a, 1 / 20), rtol=1e-6)
+
+    x2 = paddle.to_tensor(a.copy(), stop_gradient=False)
+    x2.max().backward()
+    g = x2.grad.numpy()
+    assert g.sum() == pytest.approx(1.0)
+    assert g.reshape(-1)[a.argmax()] == pytest.approx(1.0)
+
+
+def test_softmax_cross_entropy_grad():
+    logits = np.random.randn(4, 10).astype(np.float32)
+    labels = np.array([1, 3, 5, 7])
+    x = paddle.to_tensor(logits.copy(), stop_gradient=False)
+    loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels))
+    loss.backward()
+    # analytic: (softmax - onehot)/N
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    p[np.arange(4), labels] -= 1
+    np.testing.assert_allclose(x.grad.numpy(), p / 4, rtol=1e-4, atol=1e-5)
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.ones((3, 3), np.float32), stop_gradient=False)
+    y = x[1]
+    y.sum().backward()
+    expected = np.zeros((3, 3))
+    expected[1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)

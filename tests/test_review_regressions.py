@@ -1,0 +1,118 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.autograd import PyLayer
+
+
+def test_grad_wrt_intermediate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    z = (y * y).sum()
+    (gy,) = paddle.grad([z], [y])
+    np.testing.assert_allclose(gy.numpy(), 2 * (np.array([1.0, 2.0]) * 2))
+
+
+def test_inplace_add_keeps_graph():
+    b = paddle.to_tensor([1.0], stop_gradient=False)
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    y.add_(b)
+    y.sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [1.0])
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_inplace_under_no_grad_keeps_leaf():
+    p = nn.Parameter(np.ones(3, np.float32))
+    with paddle.no_grad():
+        p.add_(paddle.ones([3]))
+    assert not p.stop_gradient and p.is_leaf
+    (p.sum() * 2).backward()
+    np.testing.assert_allclose(p.grad.numpy(), [2, 2, 2])
+
+
+def test_pylayer_grad_alignment_with_stop_gradient_input():
+    class Mul(PyLayer):
+        @staticmethod
+        def forward(ctx, a, w):
+            ctx.save_for_backward(a, w)
+            return a * w
+
+        @staticmethod
+        def backward(ctx, g):
+            a, w = ctx.saved_tensor
+            return g * w, g * a  # grads for (a, w)
+
+    a = paddle.to_tensor([10.0], stop_gradient=True)
+    w = paddle.to_tensor([7.0], stop_gradient=False)
+    out = Mul.apply(a, w)
+    out.backward()
+    np.testing.assert_allclose(w.grad.numpy(), [10.0])  # g*a, not g*w
+    assert a.grad is None
+
+
+def test_mode_correct():
+    arr = np.array([3, 2, 2, 1, 1, 0, 0, 0, 0, 3, 2, 3, 2, 2, 3, 2, 2], np.int64)
+    v, _ = paddle.mode(paddle.to_tensor(arr))
+    assert int(v) == 2  # 2 appears 7x, more than any other
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        a = rng.integers(0, 5, 17)
+        v, i = paddle.mode(paddle.to_tensor(a))
+        counts = np.bincount(a)
+        best = counts.max()
+        assert counts[int(v)] == best
+        assert a[int(i)] == int(v)
+
+
+def test_in_dynamic_mode():
+    assert paddle.in_dynamic_mode() is True
+
+
+def test_sdpa_dropout_applies():
+    q = paddle.randn([2, 4, 2, 8])
+    a = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=True)
+    b = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=True)
+    assert not np.allclose(a.numpy(), b.numpy())
+    c = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=False)
+    d = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0, training=True)
+    np.testing.assert_allclose(c.numpy(), d.numpy(), rtol=1e-5)
+
+
+def test_state_dict_excludes_sublayer_nonpersistable():
+    class Child(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("tmp", paddle.ones([2]), persistable=False)
+            self.register_buffer("keep", paddle.ones([2]), persistable=True)
+
+    class Root(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c = Child()
+
+    sd = Root().state_dict()
+    assert "c.keep" in sd and "c.tmp" not in sd
+
+
+def test_hook_ids_not_reused():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h1 = layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    h2 = layer.register_forward_post_hook(lambda l, i, o: calls.append(2))
+    h1.remove()
+    layer.register_forward_post_hook(lambda l, i, o: calls.append(3))
+    layer(paddle.ones([1, 2]))
+    assert sorted(calls) == [2, 3]
+
+
+def test_layer_norm_bias_without_weight():
+    x = paddle.randn([2, 4])
+    bias = paddle.ones([4])
+    out = F.layer_norm(x, 4, weight=None, bias=bias)
+    ref = F.layer_norm(x, 4, weight=None, bias=None)
+    np.testing.assert_allclose(out.numpy(), ref.numpy() + 1.0, rtol=1e-5)

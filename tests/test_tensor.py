@@ -1,0 +1,215 @@
+"""Tensor basics — creation, meta, conversion, indexing, in-place.
+
+Harness style follows the reference OpTest idea (unittests/op_test.py:289):
+every op checks numerical parity against a NumPy reference.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    assert paddle.to_tensor([1, 2]).dtype.name in ("int32", "int64")
+    assert paddle.to_tensor([1.0]).dtype == paddle.float32
+    assert paddle.to_tensor(np.zeros(3, np.float64)).dtype == paddle.float64
+    assert paddle.to_tensor([True]).dtype == paddle.bool_
+
+
+def test_creation_ops():
+    np.testing.assert_allclose(paddle.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+    np.testing.assert_allclose(paddle.ones([4]).numpy(), np.ones(4))
+    np.testing.assert_allclose(paddle.full([2], 7.5).numpy(), np.full(2, 7.5))
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+    )
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+
+def test_binary_math_matches_numpy():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose(paddle.maximum(x, y).numpy(), np.maximum(a, b))
+    np.testing.assert_allclose((x**2).numpy(), a**2, rtol=1e-4)
+    np.testing.assert_allclose((2.0 - x).numpy(), 2.0 - a, rtol=1e-6)
+
+
+def test_matmul():
+    a = np.random.randn(5, 3).astype(np.float32)
+    b = np.random.randn(3, 7).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4, atol=1e-4)
+    out_t = paddle.matmul(
+        paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True
+    )
+    np.testing.assert_allclose(out_t.numpy(), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_reductions():
+    a = np.random.randn(4, 5).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(x.sum().numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(x.mean(axis=1).numpy(), a.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        x.max(axis=0, keepdim=True).numpy(), a.max(0, keepdims=True)
+    )
+    np.testing.assert_allclose(x.std().numpy(), a.std(ddof=1), rtol=1e-5)
+
+
+def test_manipulation():
+    a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    assert x.reshape([4, 6]).shape == [4, 6]
+    assert x.reshape([0, -1]).shape == [2, 12]  # paddle 0 = copy dim
+    assert x.transpose([2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.unsqueeze(x, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+    cat = paddle.concat([x, x], axis=1)
+    assert cat.shape == [2, 6, 4]
+    parts = paddle.split(cat, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == [2, 3, 4]
+    np.testing.assert_allclose(parts[0].numpy(), a)
+    st = paddle.stack([x, x], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    assert paddle.flatten(x, 1).shape == [2, 12]
+    np.testing.assert_allclose(paddle.flip(x, [0]).numpy(), a[::-1])
+
+
+def test_split_sections():
+    x = paddle.arange(10).astype("float32")
+    parts = paddle.split(x, [3, 3, -1], axis=0)
+    assert [p.shape[0] for p in parts] == [3, 3, 4]
+
+
+def test_indexing():
+    a = np.arange(20).reshape(4, 5).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(x[1].numpy(), a[1])
+    np.testing.assert_allclose(x[1:3, ::2].numpy(), a[1:3, ::2])
+    np.testing.assert_allclose(x[:, -1].numpy(), a[:, -1])
+    # integer-array indexing
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), a[[0, 2]])
+    # boolean mask (dynamic shape path)
+    m = x > 10
+    np.testing.assert_allclose(x[m].numpy(), a[a > 10])
+
+
+def test_setitem():
+    a = np.zeros((3, 3), np.float32)
+    x = paddle.to_tensor(a)
+    x[1] = 5.0
+    a[1] = 5.0
+    np.testing.assert_allclose(x.numpy(), a)
+    x[0, 0] = -1
+    a[0, 0] = -1
+    np.testing.assert_allclose(x.numpy(), a)
+    assert x._inplace_version == 2
+
+
+def test_inplace_ops():
+    x = paddle.ones([3])
+    y = x
+    x.add_(paddle.ones([3]))
+    np.testing.assert_allclose(y.numpy(), [2, 2, 2])
+    x.scale_(scale=0.5)
+    np.testing.assert_allclose(y.numpy(), [1, 1, 1])
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.7, -2.3])
+    assert x.astype("int32").numpy().tolist() == [1, -2]
+    assert x.astype(paddle.float64).dtype == paddle.float64
+    assert x.astype("bfloat16").dtype == paddle.bfloat16
+
+
+def test_comparison_and_logic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([3.0, 2.0, 1.0])
+    assert (x == y).numpy().tolist() == [False, True, False]
+    assert (x < y).numpy().tolist() == [True, False, False]
+    assert paddle.logical_and(x > 1, y > 1).numpy().tolist() == [False, True, False]
+    assert bool(paddle.allclose(x, x))
+
+
+def test_search_sort():
+    a = np.array([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], np.float32)
+    x = paddle.to_tensor(a)
+    assert paddle.argmax(x, axis=1).numpy().tolist() == [0, 0]
+    np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(), np.sort(a, 1))
+    v, i = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), [[3, 2], [9, 8]])
+    assert i.numpy().tolist() == [[0, 2], [0, 2]]
+
+
+def test_where_gather_scatter():
+    a = np.arange(12).reshape(3, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    out = paddle.where(x > 5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), np.where(a > 5, a, 0))
+    g = paddle.gather(x, paddle.to_tensor([2, 0]), axis=0)
+    np.testing.assert_allclose(g.numpy(), a[[2, 0]])
+    s = paddle.scatter(
+        x, paddle.to_tensor([0]), paddle.to_tensor(np.ones((1, 4), np.float32))
+    )
+    assert s.numpy()[0].tolist() == [1, 1, 1, 1]
+
+
+def test_item_and_scalar():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == pytest.approx(3.5)
+    assert float(x) == pytest.approx(3.5)
+    assert paddle.to_tensor([7]).item() == 7
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([4]).numpy()
+    paddle.seed(42)
+    b = paddle.rand([4]).numpy()
+    np.testing.assert_allclose(a, b)
+    c = paddle.randn([1000]).numpy()
+    assert abs(c.mean()) < 0.2 and abs(c.std() - 1) < 0.2
+    r = paddle.randint(0, 10, [100]).numpy()
+    assert r.min() >= 0 and r.max() < 10
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    c.add_(paddle.ones([1]))
+    np.testing.assert_allclose(x.numpy(), [1.0])
+
+
+def test_default_dtype():
+    paddle.set_default_dtype("float64")
+    try:
+        assert paddle.ones([1]).dtype == paddle.float64
+    finally:
+        paddle.set_default_dtype("float32")
+
+
+def test_flags():
+    assert "FLAGS_check_nan_inf" in paddle.get_flags("FLAGS_check_nan_inf")
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with pytest.raises(FloatingPointError):
+            (x / paddle.zeros([1])).backward()
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
