@@ -1,0 +1,222 @@
+"""paddle.amp — automatic mixed precision (bf16-first on TPU).
+
+Reference analogue: python/paddle/amp/ (auto_cast.py:21, grad_scaler.py:26)
+over fluid/dygraph/amp/ (AmpScaler loss_scaler.py:40, auto_cast.py cast
+lists) and the C++ AmpOperators allow/block lists
+(paddle/fluid/imperative/amp_auto_cast.h:44).
+
+TPU-native notes: the native fast dtype is bfloat16 (MXU), so 'O1' amp
+auto-casts matmul/conv inputs to bf16 and 'O2' keeps parameters in bf16.
+bf16 has fp32's exponent range, so GradScaler is numerically unnecessary —
+it is implemented faithfully anyway (dynamic loss scaling + inf skip) for
+fp16 parity and script compatibility.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import no_grad
+from ..core.dtype import to_np_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "amp_guard", "is_bfloat16_supported", "is_float16_supported"]
+
+# reference: imperative/amp_auto_cast.cc AmpOperators — ops safe to run in
+# low precision (matmul/conv heavy) vs ops that must stay fp32
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "sdpa",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
+    "log_softmax", "softmax_with_cross_entropy", "cross_entropy",
+    "layer_norm", "batch_norm", "batch_norm_infer", "group_norm", "norm",
+    "reduce_sum", "pow", "square", "cumsum",
+}
+
+_state = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_state, "level"):
+        _state.level = "O0"
+        _state.dtype = "bfloat16"
+        _state.custom_white = set()
+        _state.custom_black = set()
+    return _state
+
+
+def amp_active():
+    return _amp_state().level in ("O1", "O2")
+
+
+def amp_dtype():
+    return _amp_state().dtype
+
+
+def maybe_cast_inputs(op_name: str, vals):
+    """Called by the dispatcher: cast op inputs per the O1 cast lists."""
+    st = _amp_state()
+    if st.level != "O1":
+        return vals
+    name = op_name.split(":")[-1]
+    low = to_np_dtype(st.dtype)
+    if name in (WHITE_LIST | st.custom_white) - st.custom_black:
+        return [
+            v.astype(low)
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != low
+            else v
+            for v in vals
+        ]
+    if name in (BLACK_LIST | st.custom_black):
+        return [
+            v.astype(jnp.float32)
+            if hasattr(v, "dtype") and v.dtype in (jnp.bfloat16, jnp.float16)
+            else v
+            for v in vals
+        ]
+    return vals
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """reference: python/paddle/amp/auto_cast.py:21."""
+    st = _amp_state()
+    prev = (st.level, st.dtype, st.custom_white, st.custom_black)
+    st.level = level if enable else "O0"
+    st.dtype = dtype
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        st.level, st.dtype, st.custom_white, st.custom_black = prev
+
+
+autocast = auto_cast
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """reference: python/paddle/amp/auto_cast.py decorate — O2 casts the
+    model parameters to the low dtype (master weights live in the optimizer
+    accumulators, which stay fp32 here)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True
+
+
+class GradScaler:
+    """reference: python/paddle/amp/grad_scaler.py:26 over AmpScaler
+    (fluid/dygraph/amp/loss_scaler.py:40) — dynamic loss scaling."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        found = False
+        with no_grad():
+            for p in optimizer._param_list():
+                if p.grad is not None:
+                    g = p.grad._value / self._scale
+                    if not bool(jnp.all(jnp.isfinite(g))):
+                        found = True
+                    p.grad._value = g
+        self._found_inf = found
+        self._unscaled = True
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)

@@ -140,15 +140,19 @@ class GradNode:
         "vjp_fn",
         "inputs",
         "out_avals",
+        "out_is_seq",
         "op_name",
         "__weakref__",
     )
 
-    def __init__(self, vjp_fn, inputs, out_avals, op_name):
+    def __init__(self, vjp_fn, inputs, out_avals, op_name, out_is_seq=None):
         self.vjp_fn = vjp_fn
         # List[Edge] — differentiable inputs in vjp order
         self.inputs = [a if isinstance(a, Edge) else Edge(a) for a in inputs]
         self.out_avals = out_avals  # [(shape, dtype)] per output
+        # cotangent pytree structure must mirror the primal output exactly:
+        # a 1-tuple output still needs a 1-tuple cotangent
+        self.out_is_seq = len(out_avals) > 1 if out_is_seq is None else out_is_seq
         self.op_name = op_name
 
     def __repr__(self):
@@ -182,6 +186,14 @@ def apply(
     vals = [a._value if isinstance(a, Tensor) else a for a in args]
     kw_items = tuple(sorted((k, _hashable(v)) for k, v in kwargs.items()))
 
+    # AMP O1 input casting (reference: tracer.cc:222-240 AMP auto-cast)
+    from .. import amp as _amp
+
+    if _amp.amp_active():
+        vals = _amp.maybe_cast_inputs(
+            op_name or getattr(fn, "__name__", "op"), vals
+        )
+
     record = (
         differentiable
         and is_grad_enabled()
@@ -207,15 +219,45 @@ def apply(
     ]
     diff_set = set(diff_idx)
 
+    # run the recorded primal through the jitted op as well: jax.vjp of a
+    # jit-wrapped fn stages the whole primal (residuals included) into one
+    # compiled XLA call, cached by fn identity — this is what makes a
+    # to_static forward a single fused program even under the tape
+    jfn = _jitted(fn, kw_items) if flags.flag("eager_op_jit") else None
+
     def partial_fn(*diff_vals):
         full = list(vals)
         for i, v in zip(diff_idx, diff_vals):
             full[i] = v
-        res = fn(*full, **dict(kw_items))
+        if jfn is not None:
+            res = jfn(*full)
+        else:
+            res = fn(*full, **dict(kw_items))
         # normalize list outputs to tuple so cotangent pytree structure is fixed
         return tuple(res) if isinstance(res, list) else res
 
     out_vals, vjp_fn = jax.vjp(partial_fn, *[vals[i] for i in diff_idx])
+
+    # AMP O1 casts inputs (e.g. fp32 weight → bf16) before the op; the
+    # reference records the cast op so its backward restores fp32 grads
+    # (tracer.cc AMP cast). Here the cast is fused into this node, so cast
+    # cotangents back to each input's ORIGINAL dtype on the way out.
+    orig_dtypes = [args[i]._value.dtype for i in diff_idx]
+    if any(
+        vals[i].dtype != od for i, od in zip(diff_idx, orig_dtypes)
+    ):
+        inner_vjp = vjp_fn
+
+        def vjp_fn(cts, _inner=inner_vjp, _dts=orig_dtypes):
+            gs = _inner(cts)
+            return tuple(
+                g.astype(dt)
+                if hasattr(g, "dtype")
+                and g.dtype != dt
+                and g.dtype != jax.dtypes.float0
+                else g
+                for g, dt in zip(gs, _dts)
+            )
 
     flat_outs, is_seq = _flatten_outputs(out_vals)
     out_avals = [(tuple(o.shape), o.dtype) for o in flat_outs]
@@ -224,6 +266,7 @@ def apply(
         [args[i] for i in diff_idx],
         out_avals,
         op_name or getattr(fn, "__name__", "op"),
+        out_is_seq=is_seq,
     )
     outs = []
     for i, o in enumerate(flat_outs):
@@ -383,7 +426,7 @@ def run_backward(
                 "trying to backward through the graph a second time "
                 "(set retain_graph=True to allow this)"
             )
-        in_grads = node.vjp_fn(cts if len(cts) > 1 else cts[0])
+        in_grads = node.vjp_fn(cts if node.out_is_seq else cts[0])
         if not retain_graph:
             node.vjp_fn = None
         for edge, g in zip(node.inputs, in_grads):

@@ -1,0 +1,7 @@
+"""paddle.framework — save/load + misc framework API.
+
+Reference analogue: python/paddle/framework/ (io.py save:568/load:784,
+random.py, framework.py).
+"""
+from . import io_utils  # noqa: F401
+from .io_utils import load, save  # noqa: F401

@@ -1,0 +1,69 @@
+"""paddle.save / paddle.load.
+
+Reference analogue: python/paddle/framework/io.py (save:568, load:784) —
+pickle-based object state with Tensors converted to ndarrays. Sharded/async
+checkpoint (orbax-backed) lives in paddle_tpu.distributed.checkpoint; this is
+the single-host object-state path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle surrogate for a Tensor (value + trainability + name)."""
+
+    def __init__(self, t: Tensor):
+        self.array = t.numpy()
+        self.stop_gradient = t.stop_gradient
+        self.name = t.name
+        self.is_parameter = t.is_parameter
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, name=obj.name)
+        t.is_parameter = obj.is_parameter
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save — state_dicts, Tensors, nested containers."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

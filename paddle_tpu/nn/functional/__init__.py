@@ -269,17 +269,17 @@ def batch_norm(
         _nn.batch_norm_train, x, weight, bias, epsilon=epsilon,
         data_format=data_format, op_name="batch_norm",
     )
-    # update running stats (no tape)
-    if isinstance(running_mean, Tensor) and not isinstance(
-        x._value, __import__("jax").core.Tracer
-    ):
+    # update running stats (no tape). Works under a jit trace too: traced
+    # buffer values are threaded out of the compiled program by
+    # StaticFunction / CompiledTrainStep (paddle_tpu.jit).
+    if isinstance(running_mean, Tensor):
         with __import__("paddle_tpu").no_grad():
-            running_mean.set_value(
+            running_mean._value = (
                 running_mean._value * momentum + bm._value * (1 - momentum)
             )
             n = x.size / bm.size
             unbiased = bv._value * (n / (n - 1)) if n > 1 else bv._value
-            running_var.set_value(
+            running_var._value = (
                 running_var._value * momentum + unbiased * (1 - momentum)
             )
     return out

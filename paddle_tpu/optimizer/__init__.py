@@ -1,0 +1,17 @@
+"""paddle.optimizer — optimizers + lr schedulers.
+
+Reference analogue: python/paddle/optimizer/ (5.9k LoC).
+"""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

@@ -1,0 +1,470 @@
+"""Optimizer base + the standard optimizers.
+
+Reference analogue: python/paddle/optimizer/optimizer.py:50 (base Optimizer,
+minimize:1120, step:1185) and the per-optimizer phi kernels
+(paddle/phi/kernels/{sgd,adam,adamw,momentum,...}_kernel.h).
+
+Design: every optimizer defines a *pure* per-parameter update rule
+`_update(p, g, lr, state) -> (new_p, new_state)` (arrays in, arrays out).
+Eager `step()` applies it through one fused jitted call per parameter; the
+compiled training-step path (paddle_tpu.jit) calls the same rule inside the
+whole-program trace, so eager and jit share optimizer math exactly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+_jit_update_cache: Dict = {}
+
+
+class Optimizer:
+    _update_has_state = True
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+        multi_precision=False,
+    ):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._weight_decay = self._parse_wd(weight_decay)
+        self._grad_clip = grad_clip
+        # per-parameter optimizer state: id(param) -> dict[str, jax.Array]
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+
+    @staticmethod
+    def _parse_wd(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, float):
+            return weight_decay
+        # L2Decay regularizer object
+        coeff = getattr(weight_decay, "_coeff", None)
+        return float(coeff) if coeff is not None else float(weight_decay)
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "optimizer's learning rate is an LRScheduler; call scheduler.step()"
+            )
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state rules (override per optimizer) --------------------------------
+    def _create_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update(self, p, g, lr, state, **hyper):
+        raise NotImplementedError
+
+    def _hyper(self) -> Dict:
+        """Static hyper-parameters baked into the jitted update."""
+        return {}
+
+    def _per_param_hyper(self, p: Tensor) -> Dict:
+        """Static per-parameter hyper overrides (e.g. no-decay params) —
+        consumed by the compiled whole-step path (paddle_tpu.jit)."""
+        return {}
+
+    # -- main API ------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        """reference: optimizer.py:1185 step — one phi optimizer-kernel launch
+        per param; here one cached jitted XLA call per (rule, shape, dtype)."""
+        params_grads = [
+            (p, p.grad)
+            for p in self._param_list()
+            if not p.stop_gradient and p.grad is not None
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        for p, g in params_grads:
+            self._apply_one(p, g)
+
+    def _param_list(self) -> List[Tensor]:
+        if self._parameters is None:
+            raise ValueError(
+                "optimizer was created without a parameter list (static-graph "
+                "mode is driven through minimize())"
+            )
+        return self._parameters
+
+    def _apply_one(self, p: Tensor, g: Tensor):
+        state = self._accumulators.get(id(p))
+        if state is None:
+            state = self._create_state(p)
+            self._accumulators[id(p)] = state
+        gval = g._value if isinstance(g, Tensor) else g
+        if gval.dtype != p._value.dtype:
+            gval = gval.astype(p._value.dtype)
+        # the key must cover EVERY value the traced rule reads off self —
+        # _hyper() plus the base-class weight decay — or a second optimizer
+        # instance would silently reuse a stale compiled update
+        key = (
+            type(self),
+            tuple(sorted(self._hyper().items())),
+            self._weight_decay,
+            p._value.shape,
+            str(p._value.dtype),
+        )
+        fn = _jit_update_cache.get(key)
+        if fn is None:
+            hyper = self._hyper()
+            rule = type(self)._update
+
+            def pure(pv, gv, lr, st, _self=self):
+                return rule(_self, pv, gv, lr, st, **hyper)
+
+            fn = jax.jit(pure)
+            _jit_update_cache[key] = fn
+        new_p, new_state = fn(
+            p._value, gval, jnp.asarray(self.get_lr(), dtype=jnp.float32), state
+        )
+        p._value = new_p
+        self._accumulators[id(p)] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """reference: optimizer.py:1120 — backward + apply."""
+        loss.backward()
+        self.step()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._param_list():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        params = self._param_list()
+        for i, p in enumerate(params):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name or i}.{k}"] = Tensor(v)
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("_step_count", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        params = self._param_list()
+        for i, p in enumerate(params):
+            prefix = f"{p.name or i}."
+            st = {}
+            for k, v in state_dict.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                    st[k[len(prefix):]] = val
+            if st:
+                cur = self._accumulators.get(id(p)) or self._create_state(p)
+                cur.update(st)
+                self._accumulators[id(p)] = cur
+
+    set_dict = set_state_dict
+
+    def _apply_weight_decay_l2(self, g, p):
+        if self._weight_decay:
+            return g + self._weight_decay * p
+        return g
+
+
+class SGD(Optimizer):
+    """reference: phi/kernels/sgd_kernel.h."""
+
+    def _update(self, p, g, lr, state):
+        g = self._apply_weight_decay_l2(g, p)
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    """reference: phi momentum_kernel; use_nesterov supported."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _hyper(self):
+        return {"mu": self._momentum, "nesterov": self._nesterov}
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update(self, p, g, lr, state, *, mu, nesterov):
+        g = self._apply_weight_decay_l2(g, p)
+        v = mu * state["velocity"] + g
+        if nesterov:
+            step = g + mu * v
+        else:
+            step = v
+        return p - lr.astype(p.dtype) * step, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: phi adam_kernel; bias-corrected like the reference
+    (beta1/beta2 pow accumulators)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon}
+
+    def _create_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value),
+            "moment2": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, lr, state, *, b1, b2, eps):
+        g = self._apply_weight_decay_l2(g, p)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).astype(p.dtype)
+        new_p = p - lr_t * m / (jnp.sqrt(v) + eps)
+        return new_p, {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+        }
+
+
+class AdamW(Adam):
+    """reference: phi adamw_kernel — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._wd_coeff = float(weight_decay) if not hasattr(weight_decay, "_coeff") else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._skip_decay_ids = None
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
+                "wd": self._wd_coeff}
+
+    @no_grad()
+    def step(self):
+        if self._apply_decay_param_fun is not None and self._skip_decay_ids is None:
+            self._skip_decay_ids = {
+                id(p)
+                for p in self._param_list()
+                if not self._apply_decay_param_fun(p.name)
+            }
+        super().step()
+
+    def _update(self, p, g, lr, state, *, b1, b2, eps, wd):
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).astype(p.dtype)
+        new_p = p * (1.0 - (lr * wd).astype(p.dtype)) - lr_t * m / (jnp.sqrt(v) + eps)
+        return new_p, {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+        }
+
+    def _per_param_hyper(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(
+            p.name
+        ):
+            return {"wd": 0.0}
+        return {}
+
+    def _apply_one(self, p, g):
+        if self._skip_decay_ids and id(p) in self._skip_decay_ids:
+            saved = self._wd_coeff
+            self._wd_coeff = 0.0
+            try:
+                super()._apply_one(p, g)
+            finally:
+                self._wd_coeff = saved
+        else:
+            super()._apply_one(p, g)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon}
+
+    def _create_state(self, p):
+        return {
+            "moment": jnp.zeros_like(p._value),
+            "inf_norm": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, lr, state, *, b1, b2, eps):
+        g = self._apply_weight_decay_l2(g, p)
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"] * b1
+        new_p = p - (lr / (1 - b1p)).astype(p.dtype) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _hyper(self):
+        return {"eps": self._epsilon}
+
+    def _create_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _update(self, p, g, lr, state, *, eps):
+        g = self._apply_weight_decay_l2(g, p)
+        acc = state["moment"] + jnp.square(g)
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(acc) + eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _hyper(self):
+        return {"eps": self._epsilon, "rho": self._rho}
+
+    def _create_state(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p._value),
+            "avg_squared_update": jnp.zeros_like(p._value),
+        }
+
+    def _update(self, p, g, lr, state, *, eps, rho):
+        g = self._apply_weight_decay_l2(g, p)
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        update = (
+            jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps) * g
+        )
+        asu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return p - lr.astype(p.dtype) * update, {
+            "avg_squared_grad": asg, "avg_squared_update": asu,
+        }
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _hyper(self):
+        return {"rho": self._rho, "eps": self._epsilon,
+                "mu": self._momentum, "centered": self._centered}
+
+    def _create_state(self, p):
+        return {
+            "mean_square": jnp.zeros_like(p._value),
+            "mean_grad": jnp.zeros_like(p._value),
+            "momentum": jnp.zeros_like(p._value),
+        }
+
+    def _update(self, p, g, lr, state, *, rho, eps, mu, centered):
+        g = self._apply_weight_decay_l2(g, p)
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        if centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state["momentum"] + lr.astype(p.dtype) * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op + LambOptimizer meta-optimizer."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
+                "wd": self._wd}
+
+    def _create_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value),
+            "moment2": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, lr, state, *, b1, b2, eps, wd):
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where(
+            (w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0
+        ).astype(p.dtype)
+        return p - lr.astype(p.dtype) * trust * r, {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+        }

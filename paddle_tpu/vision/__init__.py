@@ -1,0 +1,14 @@
+"""paddle.vision — datasets, transforms, models.
+
+Reference analogue: python/paddle/vision/ (11k LoC).
+"""
+from . import datasets, models, transforms  # noqa: F401
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, vgg16  # noqa: F401
+
+
+def get_image_backend():
+    return "numpy"
+
+
+def set_image_backend(backend):
+    pass

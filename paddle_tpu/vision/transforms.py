@@ -1,0 +1,211 @@
+"""Vision transforms (numpy host-side, CHW float arrays).
+
+Reference analogue: python/paddle/vision/transforms/transforms.py.
+Transforms run on the host in the dataloader workers; heavy augmentation is
+numpy — device work starts at the batch boundary.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 -> CHW float32 in [0,1] (reference: transforms ToTensor)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.ndim == 3 and img.shape[-1] in (1, 3, 4) and self.data_format == "CHW":
+            img = np.transpose(img, (2, 0, 1))
+        img = img.astype(np.float32)
+        if img.max() > 1.5:
+            img = img / 255.0
+        return img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        c = img.shape[0] if self.data_format == "CHW" else img.shape[-1]
+        mean = self.mean[:c] if self.mean.size >= c else np.resize(self.mean, c)
+        std = self.std[:c] if self.std.size >= c else np.resize(self.std, c)
+        if self.data_format == "CHW":
+            return (img - mean[:, None, None]) / std[:, None, None]
+        return (img - mean) / std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        # nearest/bilinear resize on CHW via simple index math (no PIL dep)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        h, w = (img.shape[1], img.shape[2]) if chw else (img.shape[0], img.shape[1])
+        th, tw = self.size
+        ys = np.clip((np.arange(th) + 0.5) * h / th - 0.5, 0, h - 1)
+        xs = np.clip((np.arange(tw) + 0.5) * w / tw - 0.5, 0, w - 1)
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0).astype(np.float32)
+        wx = (xs - x0).astype(np.float32)
+        if chw:
+            a = img[:, y0][:, :, x0]
+            b = img[:, y0][:, :, x1]
+            c = img[:, y1][:, :, x0]
+            d = img[:, y1][:, :, x1]
+            top = a * (1 - wx) + b * wx
+            bot = c * (1 - wx) + d * wx
+            return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+        a = img[y0][:, x0]
+        b = img[y0][:, x1]
+        c = img[y1][:, x0]
+        d = img[y1][:, x1]
+        top = a * (1 - wx[None, :, None] if img.ndim == 3 else 1 - wx[None, :]) + b * (
+            wx[None, :, None] if img.ndim == 3 else wx[None, :]
+        )
+        bot = c * (1 - wx[None, :, None] if img.ndim == 3 else 1 - wx[None, :]) + d * (
+            wx[None, :, None] if img.ndim == 3 else wx[None, :]
+        )
+        wyb = wy[:, None, None] if img.ndim == 3 else wy[:, None]
+        return top * (1 - wyb) + bot * wyb
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            ax = -2
+            return np.flip(img, axis=ax).copy()
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        chw = img.ndim == 3
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            pads = ((0, 0), (p[1], p[3]), (p[0], p[2])) if chw else ((p[1], p[3]), (p[0], p[2]))
+            img = np.pad(img, pads)
+        h, w = (img.shape[1], img.shape[2]) if chw else img.shape[:2]
+        th, tw = self.size
+        y = np.random.randint(0, max(1, h - th + 1))
+        x = np.random.randint(0, max(1, w - tw + 1))
+        return img[:, y : y + th, x : x + tw] if chw else img[y : y + th, x : x + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        chw = img.ndim == 3
+        h, w = (img.shape[1], img.shape[2]) if chw else img.shape[:2]
+        th, tw = self.size
+        y = max(0, (h - th) // 2)
+        x = max(0, (w - tw) // 2)
+        return img[:, y : y + th, x : x + tw] if chw else img[y : y + th, x : x + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        chw = img.ndim == 3
+        h, w = (img.shape[1], img.shape[2]) if chw else img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x = np.random.randint(0, w - cw + 1)
+                crop = img[:, y : y + ch, x : x + cw] if chw else img[y : y + ch, x : x + cw]
+                return self._resize(crop)
+        return self._resize(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(img, self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(img * alpha, 0, 255 if img.max() > 1.5 else 1.0)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        self.b = BrightnessTransform(brightness)
+
+    def _apply_image(self, img):
+        return self.b(img)

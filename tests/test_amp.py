@@ -1,0 +1,89 @@
+"""AMP tests: O1 auto_cast lists, O2 decorate, GradScaler dynamics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_o1_casts_matmul_to_bf16():
+    x = paddle.randn([4, 8])
+    w = paddle.randn([8, 4])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, w)
+    assert out.dtype == paddle.bfloat16
+    out2 = paddle.matmul(x, w)
+    assert out2.dtype == paddle.float32  # outside context
+
+
+def test_o1_blacklist_stays_fp32():
+    x = paddle.randn([4, 8]).astype("bfloat16")
+    with paddle.amp.auto_cast(level="O1"):
+        out = F.softmax(x)
+    assert out.dtype == paddle.float32
+
+
+def test_o1_training_converges():
+    paddle.seed(0)
+    m = nn.Linear(4, 1)
+    o = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.randn([32, 4])
+    y = x.sum(axis=1, keepdim=True)
+    for _ in range(40):
+        with paddle.amp.auto_cast(level="O1"):
+            loss = F.mse_loss(m(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert float(loss) < 0.1
+    assert m.weight.dtype == paddle.float32  # params stay fp32 in O1
+
+
+def test_o2_decorate_casts_params():
+    m = nn.Linear(4, 4)
+    m2 = paddle.amp.decorate(m, level="O2", dtype="bfloat16")
+    assert m2.weight.dtype == paddle.bfloat16
+    out = m2(paddle.randn([2, 4]).astype("bfloat16"))
+    assert out.dtype == paddle.bfloat16
+
+
+def test_grad_scaler_scales_and_unscales():
+    p = nn.Parameter(np.zeros(2, np.float32))
+    o = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    loss = (p * paddle.to_tensor([1.0, 2.0])).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    np.testing.assert_allclose(p.grad.numpy(), [128.0, 256.0])
+    scaler.step(o)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [-1.0, -2.0])  # unscaled applied
+
+
+def test_grad_scaler_skips_inf_and_decays():
+    p = nn.Parameter(np.zeros(1, np.float32))
+    o = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(
+        init_loss_scaling=64.0, decr_every_n_nan_or_inf=1
+    )
+    p.grad = paddle.to_tensor([np.inf], dtype="float32")
+    scaler.step(o)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [0.0])  # step skipped
+    assert scaler.get_init_loss_scaling() == pytest.approx(32.0)  # decayed
+
+
+def test_scaler_minimize():
+    paddle.seed(0)
+    m = nn.Linear(4, 1)
+    o = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    scaler = paddle.amp.GradScaler()
+    x = paddle.randn([16, 4])
+    y = x.sum(axis=1, keepdim=True)
+    for _ in range(50):
+        with paddle.amp.auto_cast(level="O1"):
+            loss = F.mse_loss(m(x), y)
+        scaler.minimize(o, scaler.scale(loss))
+        o.clear_grad()
+    assert float(loss) < 0.2

@@ -1,0 +1,124 @@
+"""io/DataLoader + vision datasets + save/load tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler,
+    ConcatDataset,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+
+
+class RangeDs(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+def test_batch_sampler():
+    bs = BatchSampler(RangeDs(10), batch_size=3, drop_last=False)
+    batches = list(bs)
+    assert len(batches) == 4 and batches[-1] == [9]
+    bs2 = BatchSampler(RangeDs(10), batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3
+
+
+def test_dataloader_single_process():
+    dl = DataLoader(RangeDs(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4] and y.shape == [4]
+    np.testing.assert_allclose(x.numpy(), [0, 1, 2, 3])
+
+
+def test_dataloader_workers_ordered():
+    dl = DataLoader(RangeDs(64), batch_size=8, num_workers=3)
+    batches = list(dl)
+    assert len(batches) == 8
+    flat = np.concatenate([b[0].numpy() for b in batches])
+    np.testing.assert_allclose(flat, np.arange(64))
+
+
+def test_dataloader_shuffle_reproducible():
+    paddle.seed(5)
+    a = np.concatenate([b[0].numpy() for b in DataLoader(RangeDs(16), batch_size=4, shuffle=True)])
+    assert not np.allclose(a, np.arange(16))  # actually shuffled
+    assert sorted(a.tolist()) == list(range(16))
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.float32(i)
+
+    dl = DataLoader(Stream(), batch_size=3)
+    batches = list(dl)
+    assert [b.shape[0] for b in batches] == [3, 3, 1]
+
+
+def test_tensor_dataset_and_ops():
+    xs = paddle.arange(12).reshape([6, 2]).astype("float32")
+    ys = paddle.arange(6)
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 6
+    x0, y0 = ds[2]
+    np.testing.assert_allclose(x0.numpy(), [4, 5])
+    sub = Subset(ds, [0, 5])
+    assert len(sub) == 2
+    cat = ConcatDataset([RangeDs(3), RangeDs(4)])
+    assert len(cat) == 7
+    assert cat[5][0] == 2.0
+    a, b = random_split(RangeDs(10), [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler_shards():
+    ds = RangeDs(16)
+    shards = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=rank)
+        idxs = [i for b in s for i in b]
+        assert len(idxs) == 4
+        shards.append(set(idxs))
+    assert set().union(*shards) == set(range(16))
+
+
+def test_mnist_dataset_and_transform():
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.transforms import Compose, Normalize
+
+    ds = MNIST(mode="test", transform=Compose([Normalize(mean=127.5, std=127.5)]))
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28)
+    assert -1.01 <= img.min() and img.max() <= 1.01
+    assert 0 <= int(label) < 10
+
+
+def test_save_load_nested(tmp_path):
+    obj = {
+        "w": paddle.to_tensor([1.0, 2.0]),
+        "step": 3,
+        "nested": [paddle.ones([2, 2]), "text"],
+    }
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), [1, 2])
+    assert loaded["step"] == 3
+    np.testing.assert_allclose(loaded["nested"][0].numpy(), np.ones((2, 2)))
+    arr = paddle.load(p, return_numpy=True)
+    assert isinstance(arr["w"], np.ndarray)
