@@ -1,0 +1,107 @@
+"""Benchmark: GPT-2 345M pretraining tokens/sec/chip (BASELINE config 4).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference repo publishes no numbers (BASELINE.md: `published: {}`), so
+`vs_baseline` is computed against a 20,000 tokens/sec/chip proxy — the
+commonly reported reference-framework GPT-2 345M per-accelerator pretraining
+throughput on the A100-class hardware the reference targets. value/20000 > 1
+means this framework on one TPU v5e chip beats that proxy.
+
+Env knobs: BENCH_STEPS (default 10), BENCH_BATCH (default 8),
+BENCH_SEQ (default 1024), BENCH_MODEL (345m|small|tiny).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models import (
+        GPTConfig,
+        GPTForPretraining,
+        GPTPretrainingCriterion,
+        gpt2_345m,
+        gpt2_small,
+    )
+
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+    bsz = int(os.environ.get("BENCH_BATCH", 8))
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    which = os.environ.get("BENCH_MODEL", "345m")
+
+    if which == "tiny":
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=seq)
+    elif which == "small":
+        cfg = gpt2_small(max_seq_len=seq)
+    else:
+        cfg = gpt2_345m(max_seq_len=seq)
+    cfg.dropout = 0.0
+    cfg.attn_dropout = 0.0
+    cfg.use_recompute = os.environ.get("BENCH_RECOMPUTE", "0") == "1"
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    # bf16 weights: MXU-native matmul precision (AMP O2)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    criterion = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01
+    )
+
+    def loss_fn(logits, labels):
+        return criterion(logits.astype("float32"), labels)
+
+    step = paddle.jit.compile_train_step(model, loss_fn, opt)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (bsz, seq + 1)), jnp.int32)
+    ids = jax.device_put(ids)  # device-resident: exclude host upload
+    x = paddle.Tensor(ids[:, :-1], stop_gradient=True)
+    y = paddle.Tensor(ids[:, 1:], stop_gradient=True)
+
+    t0 = time.time()
+    loss = step(x, y)
+    first_loss = float(loss)  # host read = hard sync (block_until_ready is
+    compile_s = time.time() - t0  # not reliable through the remote relay)
+
+    # warmup one more (cache hit path)
+    float(step(x, y))
+
+    t1 = time.time()
+    last = None
+    for _ in range(steps):
+        last = step(x, y)
+    last_loss = float(last)  # forces execution of the whole dependent chain
+    dt = time.time() - t1
+
+    tokens_per_step = bsz * seq
+    tps = tokens_per_step * steps / dt
+    baseline = 20000.0
+    result = {
+        "metric": f"gpt2_{which}_pretrain_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps / baseline, 3),
+    }
+    print(json.dumps(result))
+    print(
+        f"# {which}: {steps} steps x {tokens_per_step} tok in {dt:.2f}s "
+        f"({dt/steps*1000:.0f} ms/step); first loss {first_loss:.3f} -> "
+        f"{last_loss:.3f}; compile {compile_s:.0f}s; "
+        f"devices={jax.devices()}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""paddle.distributed — collective API, fleet, launch.
+
+Reference analogue: python/paddle/distributed/ (69.8k LoC Python) +
+paddle/fluid/distributed/ (36.8k C++). See SURVEY.md §2.C/D and the
+TPU-native mapping: mesh axes replace comm rings, XLA collectives over
+ICI/DCN replace NCCL, the JAX coordination service replaces TCPStore.
+"""
+from . import collective  # noqa: F401
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    irecv,
+    is_initialized,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    spawn,
+)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """reference: collective.py:1483 paddle.distributed.split — auto-sharded
+    Linear/Embedding; superseded by fleet.meta_parallel layers on TPU."""
+    from .fleet import meta_parallel as mp
+
+    if operation == "linear":
+        if axis == 0:
+            return mp.RowParallelLinear(size[0], size[1], input_is_parallel=False)
+        return mp.ColumnParallelLinear(size[0], size[1], gather_output=gather_out)
+    if operation == "embedding":
+        return mp.VocabParallelEmbedding(size[0], size[1])
+    raise ValueError(f"unsupported split operation {operation!r}")

@@ -1,0 +1,339 @@
+"""Collective communication API.
+
+Reference analogue: python/paddle/distributed/collective.py (new_group:314,
+all_reduce:580, all_gather:798, alltoall:1696, ...) over ProcessGroupNCCL
+(paddle/fluid/distributed/collective/ProcessGroup.h:53) and the 143 graph
+collective ops (paddle/fluid/operators/collective/).
+
+TPU-native semantics: a Group names a mesh axis. Inside a compiled/sharded
+region (shard_map / pjit trace) these functions lower to the XLA HLO
+collectives (psum/all_gather/ppermute/all_to_all) over that axis — executed
+on ICI with replica_groups derived from the mesh, replacing NCCL rings.
+Called eagerly in a single-process (single-controller) context they operate
+on the global array view: all_reduce of an already-global value is the
+identity, matching the reference's semantics where the eager tensor holds
+the local shard and the collective materializes the group result.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "ReduceOp",
+    "Group",
+    "new_group",
+    "get_group",
+    "is_initialized",
+    "destroy_process_group",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "broadcast",
+    "reduce",
+    "scatter",
+    "reduce_scatter",
+    "alltoall",
+    "alltoall_single",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "wait",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a set of ranks + (on TPU) a mesh axis name."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks: List[int], axis_name: Optional[str] = None, pg=None):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.axis_name = axis_name
+        self.id = Group._next_id[0]
+        Group._next_id[0] += 1
+
+    @property
+    def rank(self):
+        from . import get_rank
+
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axis={self.axis_name})"
+
+
+_groups = {}
+_default_group: Optional[Group] = None
+
+
+def _ensure_default() -> Group:
+    global _default_group
+    if _default_group is None:
+        from . import get_world_size
+
+        _default_group = Group(list(range(get_world_size())), axis_name=None)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Group:
+    _ensure_default()
+    return _groups.get(gid)
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """reference: collective.py:314 new_group — builds a comm ring; here a
+    rank-set handle (a mesh axis when created by the topology layer)."""
+    from . import get_world_size
+
+    g = Group(list(ranks) if ranks is not None else list(range(get_world_size())))
+    _groups[g.id] = g
+    return g
+
+
+def is_initialized() -> bool:
+    return _default_group is not None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+
+
+def _is_traced(val) -> bool:
+    return isinstance(val, jax.core.Tracer)
+
+
+def _axis(group: Optional[Group]):
+    g = group or _ensure_default()
+    return g.axis_name
+
+
+def _group_size(group: Optional[Group]):
+    g = group or _ensure_default()
+    return g.nranks
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """reference: collective.py:580 → c_allreduce_{sum,max,min,prod}.
+    In-place on `tensor` (paddle semantics); returns the tensor."""
+    val = tensor._value
+    axis = _axis(group)
+    if _is_traced(val) and axis is not None:
+        if op == ReduceOp.SUM:
+            out = jax.lax.psum(val, axis)
+        elif op == ReduceOp.MAX:
+            out = jax.lax.pmax(val, axis)
+        elif op == ReduceOp.MIN:
+            out = jax.lax.pmin(val, axis)
+        elif op == ReduceOp.AVG:
+            out = jax.lax.pmean(val, axis)
+        else:
+            raise NotImplementedError("PROD allreduce inside trace")
+        tensor._value = out
+        return tensor
+    if _group_size(group) == 1 or axis is None:
+        return tensor
+    raise RuntimeError(
+        "eager cross-rank all_reduce outside a compiled region requires a "
+        "multi-process launch (paddle.distributed.launch); inside "
+        "shard_map/pjit it lowers to an XLA psum"
+    )
+
+
+def all_gather(tensor_list, tensor: Tensor, group: Optional[Group] = None,
+               sync_op=True):
+    """reference: collective.py:798 → c_allgather."""
+    val = tensor._value
+    axis = _axis(group)
+    if _is_traced(val) and axis is not None:
+        out = jax.lax.all_gather(val, axis)  # [group, ...]
+        n = _group_size(group)
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(out[i], stop_gradient=True) for i in range(n))
+        return Tensor(out, stop_gradient=True)
+    n = _group_size(group)
+    if n == 1 or axis is None:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor.clone())
+        return tensor
+    raise RuntimeError("eager all_gather requires a compiled region or 1 rank")
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op=True):
+    """reference: collective.py:494 → c_broadcast. Single-controller global
+    values are already consistent; in-trace this is a no-op (XLA keeps
+    replicated values in sync)."""
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op=True):
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op=True):
+    """reference: collective.py:895 — rank takes its slice."""
+    axis = _axis(group)
+    val = tensor._value
+    if _is_traced(val) and axis is not None and tensor_list is not None:
+        stacked = jnp.stack([t._value if isinstance(t, Tensor) else t for t in tensor_list])
+        idx = jax.lax.axis_index(axis)
+        tensor._value = jnp.take(stacked, idx, axis=0)
+        return tensor
+    if _group_size(group) == 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    raise RuntimeError("eager scatter requires a compiled region or 1 rank")
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
+                   op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    """reference: c_reducescatter op."""
+    axis = _axis(group)
+    inp = tensor_or_tensor_list
+    if isinstance(inp, list):
+        val = jnp.concatenate(
+            [t._value if isinstance(t, Tensor) else t for t in inp], axis=0
+        )
+    else:
+        val = inp._value if isinstance(inp, Tensor) else inp
+    if _is_traced(val) and axis is not None:
+        out = jax.lax.psum_scatter(val, axis, scatter_dimension=0, tiled=True)
+        tensor._value = out
+        return tensor
+    if _group_size(group) == 1:
+        tensor.set_value(val)
+        return tensor
+    raise RuntimeError("eager reduce_scatter requires a compiled region or 1 rank")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None,
+             sync_op=True):
+    """reference: collective.py:1696 → alltoall op; the MoE dispatch
+    primitive (global_scatter/global_gather)."""
+    axis = _axis(group)
+    if isinstance(in_tensor_list, list):
+        val = jnp.stack([t._value if isinstance(t, Tensor) else t for t in in_tensor_list])
+    else:
+        val = in_tensor_list._value
+    if _is_traced(val) and axis is not None:
+        out = jax.lax.all_to_all(val, axis, split_axis=0, concat_axis=0, tiled=False)
+        res = [Tensor(out[i], stop_gradient=True) for i in range(out.shape[0])]
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(res)
+        return res
+    if _group_size(group) == 1:
+        res = [Tensor(val[i], stop_gradient=True) for i in range(val.shape[0])]
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(res)
+        return res
+    raise RuntimeError("eager alltoall requires a compiled region or 1 rank")
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group: Optional[Group] = None, sync_op=True):
+    axis = _axis(group)
+    val = in_tensor._value if isinstance(in_tensor, Tensor) else in_tensor
+    if _is_traced(val) and axis is not None:
+        out = jax.lax.all_to_all(val, axis, split_axis=0, concat_axis=0, tiled=True)
+        if isinstance(out_tensor, Tensor):
+            out_tensor._value = out
+            return out_tensor
+        return Tensor(out, stop_gradient=True)
+    if _group_size(group) == 1:
+        if isinstance(out_tensor, Tensor):
+            out_tensor.set_value(val)
+            return out_tensor
+        return Tensor(val, stop_gradient=True)
+    raise RuntimeError("eager alltoall_single requires a compiled region or 1 rank")
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
+    """reference: collective.py:1793 send → send_v2 op. In-trace p2p is a
+    collective_permute (ppermute) — see parallel/pipeline.py for the PP
+    schedule built on it."""
+    axis = _axis(group)
+    val = tensor._value
+    if _is_traced(val) and axis is not None:
+        n = _group_size(group)
+        perm = [(i, dst) for i in range(n)]
+        return Tensor(jax.lax.ppermute(val, axis, perm), stop_gradient=True)
+    if _group_size(group) == 1:
+        return tensor
+    raise RuntimeError("eager send requires a compiled region")
+
+
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    axis = _axis(group)
+    val = tensor._value
+    if _is_traced(val) and axis is not None:
+        n = _group_size(group)
+        perm = [(src, i) for i in range(n)]
+        tensor._value = jax.lax.ppermute(val, axis, perm)
+        return tensor
+    if _group_size(group) == 1:
+        return tensor
+    raise RuntimeError("eager recv requires a compiled region")
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group: Optional[Group] = None):
+    """reference: barrier op — XLA programs are bulk-synchronous; eager
+    single-controller needs only a device sync."""
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: c_wait_compute/c_wait_comm — stream sync is XLA's job; we
+    block on the value for API parity."""
+    if isinstance(tensor, Tensor) and not _is_traced(tensor._value):
+        tensor._value.block_until_ready()
+    return tensor

@@ -1,0 +1,89 @@
+"""fleet.utils — recompute re-export + filesystem clients.
+
+Reference analogue: fleet/utils/__init__.py (recompute), fleet/utils/fs.py
+(LocalFS, HDFSClient).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+from ...incubate.recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise ExecuteError(fs_path)
+        open(fs_path, "a").close()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(LocalFS):
+    """HDFS client facade (reference: fs.py HDFSClient shells out to
+    `hadoop fs`). This environment has no Hadoop; paths under hdfs:// raise,
+    local paths behave like LocalFS so auto-checkpoint flows run."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000, sleep_inter=1000):
+        self._hadoop_home = hadoop_home
+
+    def _check(self, fs_path):
+        if str(fs_path).startswith("hdfs://"):
+            raise ExecuteError(
+                "no hadoop runtime in this environment; HDFSClient operates "
+                "on local paths only"
+            )
+
+    def is_exist(self, fs_path):
+        self._check(fs_path)
+        return super().is_exist(fs_path)

@@ -1,0 +1,7 @@
+"""python -m paddle_tpu.distributed.launch — multi-process/multi-host launcher.
+
+Reference analogue: python/paddle/distributed/launch/ (Context
+context/__init__.py:24, CollectiveController controllers/collective.py:23
+build_pod:32, master KV controllers/master.py).
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,220 @@
+"""Launcher implementation.
+
+Reference analogue: launch/main.py:18 + controllers/collective.py.
+
+TPU-native topology note: ONE process drives all chips of a host
+(single-controller JAX), so `--nproc_per_node` defaults to 1 (the reference
+spawns one process per GPU). Multi-host jobs launch one controller per host;
+rendezvous uses the JAX coordination service at --master (the TCPStore
+replacement). Env contract kept verbatim: PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT,
+PADDLE_MASTER.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+class Container:
+    """One managed child process (reference: launch/job/container.py)."""
+
+    def __init__(self, cmd: List[str], env: dict, log_path: Optional[str] = None):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+
+    def start(self):
+        out = None
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+            self._log_f = open(self.log_path, "w")
+            out = self._log_f
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=out, stderr=subprocess.STDOUT
+        )
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self._log_f:
+            self._log_f.close()
+
+
+class Pod:
+    """The set of containers this node runs (reference: launch/job/pod.py)."""
+
+    def __init__(self):
+        self.containers: List[Container] = []
+
+    def add(self, c: Container):
+        self.containers.append(c)
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def watch(self, restart: bool = False) -> int:
+        """Watch children; on failure kill the pod (elastic relaunch is the
+        manager's job — fleet/elastic)."""
+        try:
+            while True:
+                codes = [c.exit_code for c in self.containers]
+                if all(code == 0 for code in codes):
+                    return 0
+                bad = [code for code in codes if code not in (None, 0)]
+                if bad:
+                    self.stop()
+                    return bad[0]
+                time.sleep(1)
+        except KeyboardInterrupt:
+            self.stop()
+            return 1
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+class Context:
+    """reference: launch/context/__init__.py:24 — args + env + device info."""
+
+    def __init__(self, args=None):
+        self.args = args
+        self.envs = dict(os.environ)
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a (multi-host) paddle_tpu training job",
+    )
+    p.add_argument("--master", default=None,
+                   help="coordination address ip:port (JAX coordination service)")
+    p.add_argument("--nnodes", type=int, default=int(os.getenv("PADDLE_NNODES", "1")))
+    p.add_argument("--rank", type=int, default=int(os.getenv("PADDLE_RANK", "-1")),
+                   help="node rank; -1 = from env/auto")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (1 = single controller for all local chips)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", "--tpus", "--gpus", dest="devices", default=None)
+    p.add_argument("--run_mode", default="collective", choices=["collective", "ps"])
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--trainer_num", type=int, default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _build_pod_collective(args) -> Pod:
+    """reference: controllers/collective.py:32 build_pod."""
+    pod = Pod()
+    nnodes = args.nnodes
+    node_rank = args.rank if args.rank >= 0 else 0
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    master = args.master or "127.0.0.1:49170"
+    base_port = 49171
+    endpoints = []
+    for node in range(nnodes):
+        host = "127.0.0.1" if nnodes == 1 else f"node{node}"
+        for i in range(nproc):
+            endpoints.append(f"{host}:{base_port + i}")
+
+    for local in range(nproc):
+        rank = node_rank * nproc + local
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_MASTER": master,
+                "PADDLE_JOB_ID": args.job_id,
+                "FLAGS_selected_tpus": str(local),
+            }
+        )
+        cmd = [sys.executable, "-u", args.training_script] + list(
+            args.training_script_args or []
+        )
+        log = os.path.join(args.log_dir, f"workerlog.{rank}")
+        pod.add(Container(cmd, env, log))
+    return pod
+
+
+def _build_pod_ps(args) -> Pod:
+    """reference: controllers/ps.py — servers + trainers on one node."""
+    pod = Pod()
+    server_num = args.server_num or 1
+    trainer_num = args.trainer_num or 1
+    base = 49300
+    server_eps = [f"127.0.0.1:{base + i}" for i in range(server_num)]
+    trainer_eps = [f"127.0.0.1:{base + 100 + i}" for i in range(trainer_num)]
+    for role, count, eps in (
+        ("PSERVER", server_num, server_eps),
+        ("TRAINER", trainer_num, trainer_eps),
+    ):
+        for i in range(count):
+            env = dict(os.environ)
+            env.update(
+                {
+                    "TRAINING_ROLE": role,
+                    "PADDLE_PORT": eps[i].split(":")[1],
+                    "POD_IP": "127.0.0.1",
+                    "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+                    "PADDLE_TRAINER_ENDPOINTS": ",".join(trainer_eps),
+                    "PADDLE_TRAINERS_NUM": str(trainer_num),
+                    "PADDLE_TRAINER_ID": str(i),
+                }
+            )
+            cmd = [sys.executable, "-u", args.training_script] + list(
+                args.training_script_args or []
+            )
+            log = os.path.join(args.log_dir, f"{role.lower()}log.{i}")
+            pod.add(Container(cmd, env, log))
+    return pod
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    pod = (
+        _build_pod_collective(args)
+        if args.run_mode == "collective"
+        else _build_pod_ps(args)
+    )
+    pod.deploy()
+
+    def _sig(*_):
+        pod.stop()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _sig)
+    return pod.watch()
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

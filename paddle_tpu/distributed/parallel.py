@@ -1,0 +1,144 @@
+"""Parallel environment + DataParallel.
+
+Reference analogue: python/paddle/distributed/parallel.py
+(init_parallel_env:91, ParallelEnv) and python/paddle/fluid/dygraph/
+parallel.py:413 (DataParallel with C++ Reducer bucketed allreduce).
+
+TPU-native: a single controller drives all local devices, so
+init_parallel_env maps to (a) jax.distributed.initialize for multi-host
+(rendezvous via the JAX coordination service — the TCPStore replacement,
+SURVEY.md §2.C) and (b) installing the global device mesh. DataParallel
+keeps the wrapper API; gradient synchronization is the mesh's job — the
+compiled train step shards the batch over `dp` and XLA inserts the gradient
+all-reduce (the Reducer's bucketing/overlap is XLA latency-hiding now).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..nn.layer_base import Layer
+from ..parallel.topology import init_mesh
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size", "DataParallel", "spawn"]
+
+
+class ParallelEnv:
+    """reference: parallel.py ParallelEnv — env-var view of the launch."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.getenv("FLAGS_selected_tpus", os.getenv("FLAGS_selected_gpus", "0")).split(",")[0])
+        self._trainer_endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    # legacy names
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+def get_rank(group=None) -> int:
+    """Process index (multi-host) — single-controller SPMD is process 0."""
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def init_parallel_env():
+    """reference: parallel.py:91 — env checks, device binding, TCPStore
+    rendezvous, default NCCL group. TPU: initialize the JAX distributed
+    service if a multi-host env contract is present, then install a
+    data-parallel mesh over all visible devices."""
+    env = ParallelEnv()
+    if env.world_size > 1 and os.getenv("PADDLE_MASTER") and jax.process_count() == 1:
+        # multi-host rendezvous: coordination service replaces TCPStore
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PADDLE_MASTER"],
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    init_mesh(dp=len(jax.devices()))
+    from .collective import _ensure_default
+
+    _ensure_default()
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """reference: fluid/dygraph/parallel.py:413.
+
+    Wrapping keeps script parity; the gradient all-reduce happens in the
+    compiled step via batch sharding over `dp` (see
+    parallel/sharding.py ShardedTrainStep). In pure-eager single-process
+    mode there is nothing to synchronize, matching reference behavior with
+    world_size 1.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py — single-controller SPMD drives all
+    local devices from one process, so spawn degenerates to a direct call
+    (kept for script parity; multi-host uses paddle.distributed.launch)."""
+    func(*args)

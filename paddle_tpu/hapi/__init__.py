@@ -1,0 +1,16 @@
+"""paddle.hapi — the Keras-like high-level Model API.
+
+Reference analogue: python/paddle/hapi/model.py:907 (Model with
+prepare:1486/fit/evaluate/predict, dygraph & static adapters) + callbacks.py.
+The TPU adapter is the compiled train step (paddle_tpu.jit), so hapi fit()
+trains through one fused XLA program per shape.
+"""
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .summary import summary  # noqa: F401

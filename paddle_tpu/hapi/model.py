@@ -1,0 +1,215 @@
+"""hapi Model. Reference: python/paddle/hapi/model.py:907."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer_base import Layer
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    """reference: hapi/model.py Model(network, inputs=None, labels=None)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """reference: model.py:1486."""
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, list) else [metrics]
+        self._train_step = None
+        return self
+
+    def _loss_fn(self, outputs, labels):
+        loss = self._loss(outputs, labels)
+        if isinstance(loss, (list, tuple)):
+            loss = sum(loss[1:], loss[0])
+        if loss.ndim > 0:
+            loss = loss.mean()
+        return loss
+
+    # -- batch-level API -----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._train_step is None:
+            self._train_step = paddle.jit.compile_train_step(
+                self.network, self._loss_fn, self._optimizer
+            )
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        loss = self._train_step(*ins, *labs)
+        return [float(loss)]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*ins)
+        loss = self._loss_fn(outputs, labels if not isinstance(labels, (list, tuple)) else labels[0])
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels if not isinstance(labels, (list, tuple)) else labels[0]))
+            metrics.append(m.accumulate())
+        return [float(loss)], metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*ins)
+        return [out.numpy() if isinstance(out, Tensor) else out]
+
+    # -- loop API ------------------------------------------------------------
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        drop_last=False,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+        **kwargs,
+    ):
+        """reference: model.py fit."""
+        train_loader = (
+            train_data
+            if isinstance(train_data, DataLoader)
+            else DataLoader(
+                train_data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, num_workers=num_workers,
+            )
+        )
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = (
+                eval_data
+                if isinstance(eval_data, DataLoader)
+                else DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+            )
+        cbks = CallbackList(callbacks or ([ProgBarLogger(log_freq, verbose)] if verbose else []))
+        cbks.set_model(self)
+        cbks.set_params(
+            {
+                "epochs": epochs,
+                "steps": len(train_loader) if hasattr(train_loader, "__len__") else None,
+                "verbose": verbose,
+                "metrics": ["loss"] + [m.name() for m in self._metrics],
+            }
+        )
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                x, y = self._split_batch(batch)
+                (loss,) = self.train_batch(x, y)
+                logs = {"loss": loss, "step": step}
+                cbks.on_train_batch_end(step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+        cbks.on_train_end(logs if "logs" in dir() else {})
+        return self
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        return batch, None
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, **kwargs):
+        loader = (
+            eval_data
+            if isinstance(eval_data, DataLoader)
+            else DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        )
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = self._split_batch(batch)
+            (loss,), _ = self.eval_batch(x, y)
+            losses.append(loss)
+        out = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            name = m.name()
+            res = m.accumulate()
+            if isinstance(name, list):
+                out.update(dict(zip(name, res)))
+            else:
+                out[name] = res
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = (
+            test_data
+            if isinstance(test_data, DataLoader)
+            else DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        )
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x)[0])
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        """reference: model.py save — training=False exports for inference."""
+        if training:
+            paddle.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            raise NotImplementedError(
+                "inference export via Model.save(training=False): use "
+                "paddle.jit.save with an input_spec"
+            )
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(paddle.load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtypes=dtype)

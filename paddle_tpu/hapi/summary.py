@@ -1,0 +1,63 @@
+"""paddle.summary. Reference: python/paddle/hapi/model_summary.py."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..nn.layer_base import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Layer-by-layer output shapes + param counts via forward hooks."""
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        def hook(l, ins, outs):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            shape = list(out.shape) if hasattr(out, "shape") else "?"
+            n_params = sum(p.size for p in l._parameters.values() if p is not None)
+            rows.append((prefix or l.__class__.__name__, l.__class__.__name__, shape, n_params))
+
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            register(sub, name)
+
+    if input is not None:
+        x = input
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        dts = dtypes if isinstance(dtypes, list) else [dtypes] * len(sizes)
+        xs = []
+        for s, dt in zip(sizes, dts):
+            shape = [1 if (d is None or d == -1) else d for d in s]
+            xs.append(paddle.zeros(shape, dtype=dt or "float32"))
+        x = xs if len(xs) > 1 else xs[0]
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x) if isinstance(x, list) else net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if not p.stop_gradient)
+    width = max([len(r[0]) for r in rows] + [10])
+    lines = [f"{'Layer':<{width}}  {'Type':<20} {'Output Shape':<20} {'Params':>10}"]
+    lines.append("-" * (width + 55))
+    for name, typ, shape, n in rows:
+        lines.append(f"{name:<{width}}  {typ:<20} {str(shape):<20} {n:>10}")
+    lines.append("-" * (width + 55))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": int(total), "trainable_params": int(trainable)}

@@ -1,0 +1,8 @@
+"""paddle.incubate — experimental / advanced features.
+
+Reference analogue: python/paddle/incubate/ (MoE, autograd prims, ASP,
+fused ops) + fleet/utils/recompute.py.
+"""
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import recompute  # noqa: F401
+from . import nn  # noqa: F401

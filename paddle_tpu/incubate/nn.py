@@ -1,0 +1,87 @@
+"""paddle.incubate.nn — fused transformer layers.
+
+Reference analogue: python/paddle/incubate/nn/ (FusedMultiHeadAttention,
+FusedFeedForward, FusedTransformerEncoderLayer backed by
+operators/fused/fused_attention_op.cu etc.). On TPU "fused" means
+XLA-fused: these classes run the same math through single traced segments
+— kept so reference scripts importing incubate.nn work unchanged.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None, normalize_before=False,
+                 need_weights=False, qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5, **kw):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.attn = nn.MultiHeadAttention(
+            embed_dim, num_heads, dropout=attn_dropout_rate
+        )
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        out = self.attn(x, x, x, attn_mask)
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-05,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, **kw):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.fc1 = nn.Linear(d_model, dim_feedforward)
+        self.fc2 = nn.Linear(dim_feedforward, d_model)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate
+        )
+        self.activation = activation
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        out = self.fc2(self.act_dropout(getattr(F, self.activation)(self.fc1(x))))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, **kw):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before,
+        )
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+        )
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, src_mask))

@@ -1,0 +1,17 @@
+"""Flagship model families (training-scale).
+
+Reference analogue: the fleet example models the reference targets (GPT /
+BERT / ERNIE collective configs, SURVEY.md §6) — the reference keeps them in
+external repos (PaddleNLP/FleetX); here they are first-class so the
+distributed engine has in-tree users.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForPretraining,
+    GPTModel,
+    GPTPretrainingCriterion,
+    gpt2_small,
+    gpt2_medium,
+    gpt2_345m,
+)
+from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401

@@ -1,0 +1,155 @@
+"""BERT model family (BASELINE config 3: BERT-base pretraining).
+
+Reference analogue: the Fleet BERT pretraining configs (model code upstream
+in PaddleNLP). TPU-first: TP-ready encoder built on the same meta_parallel
+layers as GPT; MLM + NSP heads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import paddle_tpu as paddle
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..parallel.sharding import with_sharding_constraint
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30528
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init
+        )
+        self.position_embeddings = nn.Embedding(cfg.max_seq_len, cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertEncoderLayer(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=init, gather_output=False
+        )
+        self.out_proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True
+        )
+        self.fc1 = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_hidden_size, weight_attr=init, gather_output=False
+        )
+        self.fc2 = RowParallelLinear(
+            cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True
+        )
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unstack(axis=2)
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.cfg.attn_dropout if self.training else 0.0,
+            training=self.training,
+        )
+        attn = attn.reshape([b, s, self.num_heads * self.head_dim])
+        x = self.ln1(x + self.dropout(self.out_proj(attn)))
+        h = self.fc2(F.gelu(self.fc1(x), approximate=True))
+        return self.ln2(x + self.dropout(h))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = nn.LayerList([BertEncoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 → additive [b, 1, 1, s]
+            mask = (1.0 - attention_mask.astype("float32")) * -1e9
+            mask = mask.unsqueeze([1, 2])
+        h = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            h = layer(h, mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads with tied decoder weight."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_ln = nn.LayerNorm(cfg.hidden_size)
+        self.mlm_bias = self.create_parameter([cfg.vocab_size], is_bias=True)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_ln(F.gelu(self.mlm_transform(seq), approximate=True))
+        w = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = paddle.matmul(h, w, transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def __init__(self, vocab_size=None):
+        super().__init__()
+
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels, mlm_mask=None):
+        mlm_loss = F.cross_entropy(mlm_logits, mlm_labels, reduction="none", ignore_index=-100)
+        if mlm_mask is not None:
+            mlm_loss = (mlm_loss * mlm_mask).sum() / mlm_mask.sum().clip(min=1.0)
+        else:
+            mlm_loss = mlm_loss.mean()
+        nsp_loss = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm_loss + nsp_loss

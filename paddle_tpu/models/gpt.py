@@ -1,0 +1,219 @@
+"""GPT model family — the flagship decoder-only LM.
+
+Reference analogue: the GPT configs the reference's fleet stack trains
+(BASELINE config 4: GPT-2 345M hybrid TP+PP) — model code lives in
+PaddleNLP upstream; rebuilt here TPU-first:
+  - attention/MLP built from fleet.meta_parallel TP layers (Column/Row
+    parallel with mp sharding specs — mp_layers.py analogues);
+  - sequence parallelism via `sep`-axis sharding constraints on the token
+    axis (capability gap in the reference — SURVEY.md §5 long-context);
+  - causal attention through ops.nn_ops.scaled_dot_product_attention (XLA
+    flash-pattern fusion; Pallas kernel in ops/pallas for long sequences);
+  - weight-tied LM head (SharedLayerDesc semantics) with vocab-parallel
+    cross entropy.
+
+Everything is shape-static and scan-friendly: one compiled step trains it
+under any mesh (dp / mp / sharding / sep) via fleet.distributed_train_step.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import paddle_tpu as paddle
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..parallel.sharding import with_sharding_constraint
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    max_seq_len: int = 1024
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    initializer_range: float = 0.02
+    sequence_parallel: bool = False
+    use_recompute: bool = False
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+
+
+def _sp(x, cfg, *spec):
+    """Activation sharding hint; batch on dp(+sharding), seq on sep."""
+    return with_sharding_constraint(x, *spec)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=init,
+            gather_output=False,
+        )
+        self.out_proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, weight_attr=init,
+            input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)  # [b, s, 3h] sharded on mp
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unstack(axis=2)
+        # heads axis is the mp-sharded axis (TP attention)
+        q = _sp(q, cfg, ("dp", "sharding"), "sep", "mp", None)
+        k = _sp(k, cfg, ("dp", "sharding"), None, "mp", None)
+        v = _sp(v, cfg, ("dp", "sharding"), None, "mp", None)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=cfg.attn_dropout if self.training else 0.0,
+            training=self.training,
+        )
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        out_init = I.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2.0 * cfg.num_layers)
+        )
+        self.fc1 = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_hidden_size, weight_attr=init,
+            gather_output=False,
+        )
+        self.fc2 = RowParallelLinear(
+            cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=out_init,
+            input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def _block(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return _sp(x, self.cfg, ("dp", "sharding"), "sep", None)
+
+    def forward(self, x):
+        if self.cfg.use_recompute:
+            from ..incubate.recompute import recompute
+
+            return recompute(self._block, x)
+        return self._block(x)
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init
+        )
+        self.position_embeddings = nn.Embedding(
+            cfg.max_seq_len, cfg.hidden_size, weight_attr=init
+        )
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        h = _sp(h, self.cfg, ("dp", "sharding"), "sep", None)
+        return self.dropout(h)
+
+
+class GPTModel(nn.Layer):
+    """Decoder-only transformer trunk."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = nn.LayerList([GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.final_ln = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids):
+        h = self.embeddings(input_ids)
+        for layer in self.layers:
+            h = layer(h)
+        return self.final_ln(h)
+
+
+class GPTForPretraining(nn.Layer):
+    """Trunk + weight-tied vocab-parallel LM head."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        # tied head: logits = h @ E^T (SharedLayerDesc semantics)
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = paddle.matmul(h, w, transpose_y=True)
+        return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """reference: ParallelCrossEntropy (mp_layers.py:249) over shifted LM
+    labels, masked mean."""
+
+    def __init__(self, cfg: Optional[GPTConfig] = None):
+        super().__init__()
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = F.cross_entropy(logits, labels, reduction="none")
+        if loss_mask is not None:
+            loss = loss * loss_mask
+            return loss.sum() / loss_mask.sum().clip(min=1.0)
+        return loss.mean()
+
+
+def gpt2_small(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt2_medium(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt2_345m(**kw) -> GPTConfig:
+    """BASELINE config 4: GPT-2 345M."""
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)

@@ -29,6 +29,11 @@ def relu(x, name=None):
 def relu_(x, name=None):
     out = relu(x)
     x._value = out._value
+    if out._grad_node is not None:
+        x._grad_node = out._grad_node
+        x._out_index = out._out_index
+        x.stop_gradient = out.stop_gradient
+    x._bump_version()
     return x
 
 
@@ -343,6 +348,10 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW
 # ----------------------------- dropout ---------------------------------------
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
+        # downscale_in_infer (the fluid-era default) scales at INFERENCE:
+        # out = x * (1-p) in eval, unscaled masking in train
+        if mode == "downscale_in_infer" and p > 0.0:
+            return x * (1.0 - p)
         return x if isinstance(x, Tensor) else to_tensor(x)
     if axis is not None:
         raise NotImplementedError("dropout axis")
@@ -424,15 +433,17 @@ def cross_entropy(
         )
     loss = loss.squeeze(axis) if loss.ndim > max(input.ndim - 1, 1) - 0 else loss
     if weight is not None and not soft_label:
-        w = apply(
-            lambda wt, lb: __import__("jax.numpy", fromlist=["take"]).take(
-                wt, __import__("jax.numpy", fromlist=["clip"]).clip(lb, 0, None)
-            ),
-            weight, label,
-        )
+        import jax.numpy as jnp
+
+        def _w(wt, lb, *, ignore_index):
+            w = jnp.take(wt, jnp.clip(lb, 0, None))
+            # ignored positions contribute neither loss nor denominator
+            return jnp.where(lb != ignore_index, w, 0.0)
+
+        w = apply(_w, weight, label, ignore_index=ignore_index)
         loss = loss * w
         if reduction == "mean":
-            return loss.sum() / w.sum()
+            return loss.sum() / w.sum().clip(min=1e-12)
     if reduction == "mean" and ignore_index != -100 and not soft_label:
         import paddle_tpu as paddle
 

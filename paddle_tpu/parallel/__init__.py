@@ -1,0 +1,22 @@
+"""paddle_tpu.parallel — the mesh/SPMD engine under paddle.distributed.
+
+This package is the TPU-native machinery that replaces the reference's
+NCCL-ring world (SURVEY.md §2.C/D): a global `jax.sharding.Mesh` built from
+the HybridCommunicateGroup topology, sharding specs for every parallelism
+strategy (dp / sharding-ZeRO / mp-TP / pp / sep / ep), and the compiled
+sharded train step (GSPMD inserts the collectives that the reference's 143
+c_* ops insert by hand).
+"""
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_mesh,
+    global_mesh,
+    init_mesh,
+)
+from .sharding import (  # noqa: F401
+    ShardingSpec,
+    param_spec,
+    shard_params,
+    sharded_train_step,
+)

@@ -1,0 +1,254 @@
+"""Sharding specs + the compiled sharded train step (GSPMD path).
+
+This replaces, in one mechanism, four reference subsystems (SURVEY.md §2.D):
+  - DP grad allreduce (imperative/reducer.cc bucketed NCCL allreduce) —
+    XLA inserts the gradient all-reduce when the batch is sharded on `dp`;
+  - ZeRO stages 1-3 (meta_parallel/sharding/group_sharded_stage{2,3}.py,
+    meta_optimizers/sharding_optimizer.py:45) — optimizer state (stage 1/2)
+    and parameters (stage 3) carry a `sharding`-axis spec; XLA materializes
+    reduce-scatter + all-gather exactly where the hand-written stages put
+    them;
+  - TP (meta_parallel/parallel_layers/mp_layers.py) — weight specs partition
+    on `mp`, activations get sharding constraints;
+  - the 143 collective ops (operators/collective/) — GSPMD emits the HLO
+    collectives with replica_groups derived from the mesh.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from .topology import get_mesh
+
+ShardingSpec = P
+
+
+def param_spec(p: Tensor, zero_stage: int = 0, mesh: Optional[Mesh] = None) -> P:
+    """Sharding spec for one parameter: explicit layer-assigned spec first
+    (TP layers set `dist_spec`), else ZeRO-3 shards the first divisible dim
+    over `sharding`, else replicated."""
+    mesh = mesh or get_mesh()
+    spec = getattr(p, "dist_spec", None)
+    if spec is not None:
+        spec = P(*spec) if not isinstance(spec, P) else spec
+    else:
+        spec = P(*([None] * p.ndim))
+    if zero_stage >= 3 and mesh is not None:
+        n_shard = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sharding", 1)
+        if n_shard > 1:
+            entries = list(spec) + [None] * (p.ndim - len(list(spec)))
+            for d in range(p.ndim):
+                if entries[d] is None and p.shape[d] % n_shard == 0:
+                    entries[d] = "sharding"
+                    break
+            spec = P(*entries)
+    return spec
+
+
+def _state_spec(pspec: P, shape, zero_stage: int, mesh: Mesh) -> P:
+    """Optimizer-state spec: mirrors the param spec; ZeRO-1/2 additionally
+    shards moments over `sharding` (the optimizer-state partitioning of
+    group_sharded_optimizer_stage2.py:41)."""
+    entries = list(pspec) + [None] * (len(shape) - len(list(pspec)))
+    if zero_stage >= 1 and mesh is not None and len(shape) > 0:
+        n_shard = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sharding", 1)
+        if n_shard > 1 and "sharding" not in entries:
+            for d in range(len(shape)):
+                if entries[d] is None and shape[d] % n_shard == 0:
+                    entries[d] = "sharding"
+                    break
+    return P(*entries)
+
+
+def shard_params(model, mesh: Optional[Mesh] = None, zero_stage: int = 0):
+    """Device_put every parameter/buffer with its NamedSharding — after this
+    the weights physically live distributed across the mesh."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return model
+    with no_grad():
+        for p in model.parameters():
+            s = NamedSharding(mesh, param_spec(p, zero_stage, mesh))
+            p._value = jax.device_put(p._value, s)
+        for b in model.buffers():
+            b._value = jax.device_put(b._value, NamedSharding(mesh, P()))
+    return model
+
+
+def with_sharding_constraint(x, *spec):
+    """Annotation helper usable inside layer forwards (no-op without a mesh).
+    The TPU analogue of inserting a c_split/c_concat/c_identity op."""
+    mesh = get_mesh()
+    val = x._value if isinstance(x, Tensor) else x
+    if mesh is None or isinstance(val, np.ndarray):
+        return x
+    try:
+        out = jax.lax.with_sharding_constraint(val, NamedSharding(mesh, P(*spec)))
+    except (ValueError, TypeError):
+        return x
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        t._grad_node = x._grad_node
+        t._out_index = x._out_index
+        return t
+    return out
+
+
+class ShardedTrainStep:
+    """Compiled hybrid-parallel train step over the global mesh.
+
+    The single entry point that turns (model, loss, optimizer, strategy)
+    into one SPMD XLA program: batch sharded over (dp, sharding), params per
+    their specs (TP/ZeRO-3), optimizer state ZeRO-sharded, buffers
+    replicated. Donation keeps params/opt-state in place in HBM.
+    Reference counterpart: the whole
+    fleet.distributed_model + HybridParallelOptimizer + reducer pipeline
+    (fleet/meta_parallel/*).
+    """
+
+    def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=0,
+                 batch_axes=("dp", "sharding")):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or get_mesh()
+        self.zero_stage = zero_stage
+        self.batch_axes = tuple(
+            a for a in batch_axes if a in (self.mesh.axis_names if self.mesh else ())
+        )
+        self._params = [p for p in model.parameters() if not p.stop_gradient]
+        self._buffers = [b for _, b in model.named_buffers()]
+        self._hyper = optimizer._hyper()
+        self._step = None
+        self._opt_state = None
+
+    def _init_state(self):
+        states = []
+        for p in self._params:
+            st = self.optimizer._accumulators.get(id(p))
+            if st is None:
+                st = self.optimizer._create_state(p)
+                self.optimizer._accumulators[id(p)] = st
+            states.append(st)
+        return states
+
+    def _shardings(self):
+        mesh = self.mesh
+        p_specs = [param_spec(p, self.zero_stage, mesh) for p in self._params]
+        p_sh = tuple(NamedSharding(mesh, s) for s in p_specs)
+        st_sh = []
+        for p, spec, st in zip(self._params, p_specs, self._opt_state):
+            st_sh.append(
+                {
+                    k: NamedSharding(
+                        mesh,
+                        _state_spec(spec, v.shape, max(self.zero_stage, 1), mesh)
+                        if v.ndim > 0
+                        else P(),
+                    )
+                    for k, v in st.items()
+                }
+            )
+        b_sh = tuple(NamedSharding(mesh, P()) for _ in self._buffers)
+        batch_spec = P(self.batch_axes if self.batch_axes else None)
+        return p_sh, tuple(st_sh), b_sh, NamedSharding(mesh, batch_spec)
+
+    def _build(self, n_batch_args):
+        from ..jit import _bind_values
+        from ..core import random as _random
+
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        params, buffers = self._params, self._buffers
+        hyper = self._hyper
+        per_hyper = [dict(hyper, **opt._per_param_hyper(p)) for p in params]
+        rule = type(opt)._update
+        grad_clip = opt._grad_clip
+
+        def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
+            def loss_of(p_vals):
+                ins = [Tensor(v, stop_gradient=True) for v in batch_vals]
+                with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
+                        no_grad(), _random.rng_scope(key):
+                    out = model(*ins[:-1]) if len(ins) > 1 else model(ins[0])
+                    loss = loss_fn(out, ins[-1]) if loss_fn is not None else out
+                    new_b = tuple(b._value for b in buffers)
+                lv = loss._value if isinstance(loss, Tensor) else loss
+                return lv, new_b
+
+            (loss, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                tuple(p_vals)
+            )
+            if grad_clip is not None:
+                pairs = grad_clip(
+                    [
+                        (Tensor(pv, stop_gradient=True), Tensor(gv, stop_gradient=True))
+                        for pv, gv in zip(p_vals, grads)
+                    ]
+                )
+                grads = [g._value for _, g in pairs]
+            new_p, new_s = [], []
+            for pv, gv, st, h in zip(p_vals, grads, opt_states, per_hyper):
+                if gv.dtype != pv.dtype:
+                    gv = gv.astype(pv.dtype)
+                np_, ns_ = rule(opt, pv, gv, lr, st, **h)
+                new_p.append(np_)
+                new_s.append(ns_)
+            return loss, tuple(new_p), tuple(new_s), new_b
+
+        p_sh, st_sh, b_sh, batch_sh = self._shardings()
+        repl = NamedSharding(self.mesh, P())
+        in_sh = (p_sh, st_sh, b_sh, repl, repl) + (batch_sh,) * n_batch_args
+        out_sh = (repl, p_sh, st_sh, b_sh)
+        return jax.jit(
+            step_fn, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=(0, 1),
+        )
+
+    @no_grad()
+    def __call__(self, *batch) -> Tensor:
+        if self._step is None:
+            self._opt_state = self._init_state()
+            # physically place optimizer state per its (ZeRO) spec — jit
+            # donation requires argument shardings to match declarations
+            _, st_sh, _, _ = self._shardings()
+            self._opt_state = [
+                {k: jax.device_put(v, sh[k]) for k, v in st.items()}
+                for st, sh in zip(self._opt_state, st_sh)
+            ]
+            self._step = self._build(len(batch))
+        batch_vals = [
+            b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
+        ]
+        p_vals = tuple(p._value for p in self._params)
+        b_vals = tuple(b._value for b in self._buffers)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _next_key()
+        loss, new_p, new_s, new_b = self._step(
+            p_vals, tuple(self._opt_state), b_vals, key, lr, *batch_vals
+        )
+        for p, v in zip(self._params, new_p):
+            p._value = v
+        for b, v in zip(self._buffers, new_b):
+            b._value = v
+        self._opt_state = list(new_s)
+        for p, st in zip(self._params, self._opt_state):
+            self.optimizer._accumulators[id(p)] = st
+        self.optimizer._step_count += 1
+        return Tensor(loss, stop_gradient=True)
+
+
+def _next_key():
+    from ..core import random as _random
+
+    return _random.next_key()
+
+
+def sharded_train_step(model, loss_fn, optimizer, mesh=None, zero_stage=0,
+                       batch_axes=("dp", "sharding")):
+    return ShardedTrainStep(model, loss_fn, optimizer, mesh, zero_stage, batch_axes)

@@ -1,0 +1,229 @@
+"""Device-mesh topology.
+
+Reference analogue: fleet/base/topology.py (CommunicateTopology:52,
+HybridCommunicateGroup:133 — the 4-D dp×mp×pp×sharding process topology that
+builds NCCL comm groups per axis). TPU-native: the topology IS a
+`jax.sharding.Mesh` with named axes; "comm groups" become mesh axis names
+consumed by collectives/`PartitionSpec`s, and XLA lays the collectives onto
+ICI rings. Axes extend the reference's four with `sep` (sequence/context
+parallel — absent upstream, SURVEY.md §5) and `ep` (expert parallel is
+folded over dp×sharding like the reference's MoE).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical axis order: outermost (slowest-varying, cross-slice OK) first.
+# pp communicates least → outermost; mp communicates most → innermost so its
+# collectives ride the fastest ICI loops (scaling-book layout discipline).
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+
+_global = {"hcg": None, "mesh": None}
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:52 — named hybrid dims + rank math."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        ranges = [range(d) for d in self._dims]
+        import itertools
+
+        self._coord2rank = {}
+        self._rank2coord = {}
+        for rank, coord in enumerate(itertools.product(*ranges)):
+            c = self.coordinate(*coord)
+            self._coord2rank[c] = rank
+            self._rank2coord[rank] = c
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(
+            r for c, r in self._coord2rank.items() if c[axis] == index
+        )
+
+    def get_comm_list(self, axis_name):
+        """Rank groups that vary only along `axis_name` — these are the
+        reference's NCCL comm rings and our HLO replica_groups."""
+        axis = self._parallel_names.index(axis_name)
+        groups = collections.defaultdict(list)
+        for c, r in sorted(self._coord2rank.items(), key=lambda kv: kv[1]):
+            key = tuple(v for i, v in enumerate(c) if i != axis)
+            groups[key].append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:133 — per-axis group handles.
+
+    On TPU the "group" for an axis is the mesh axis name itself; rank/world
+    queries map to mesh coordinates of the current process's first device
+    (single-controller) or of jax.process_index() (multi-host).
+    """
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = 0
+        names = topology.get_hybrid_group_names()
+        dim = topology.get_dim
+        self._dp_degree = dim("data") if "data" in names else 1
+        self._mp_degree = dim("model") if "model" in names else 1
+        self._pp_degree = dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = dim("sharding") if "sharding" in names else 1
+        self._sep_degree = dim("sep") if "sep" in names else 1
+
+    # degrees (reference: topology.py:139-142)
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def _coord(self):
+        return self._topo.get_coord(self.global_rank)
+
+    def get_data_parallel_rank(self):
+        return self._coord().data if "data" in self._topo.get_hybrid_group_names() else 0
+
+    def get_model_parallel_rank(self):
+        return self._coord().model if "model" in self._topo.get_hybrid_group_names() else 0
+
+    def get_stage_id(self):
+        return self._coord().pipe if "pipe" in self._topo.get_hybrid_group_names() else 0
+
+    def get_sharding_parallel_rank(self):
+        return (
+            self._coord().sharding
+            if "sharding" in self._topo.get_hybrid_group_names()
+            else 0
+        )
+
+    # group handles — on TPU these carry the mesh axis name
+    def _group(self, axis):
+        from ..distributed.collective import Group
+
+        mesh_axis = {"data": "dp", "model": "mp", "pipe": "pp",
+                     "sharding": "sharding", "sep": "sep"}[axis]
+        names = self._topo.get_hybrid_group_names()
+        if axis not in names:
+            return Group(ranks=[0], axis_name=mesh_axis)
+        comm = self._topo.get_comm_list(axis)
+        mine = next(g for g in comm if self.global_rank in g)
+        return Group(ranks=mine, axis_name=mesh_axis)
+
+    def get_data_parallel_group(self):
+        return self._group("data")
+
+    def get_model_parallel_group(self):
+        return self._group("model")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._group("sharding")
+
+    def get_check_parallel_group(self):
+        from ..distributed.collective import Group
+
+        return Group(ranks=list(range(self.nranks)), axis_name=None)
+
+    def get_data_parallel_group_src_rank(self):
+        return self._group("data").ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._group("model").ranks[0]
+
+    def topology(self):
+        return self._topo
+
+    # mesh view ---------------------------------------------------------
+    def mesh_shape(self) -> Dict[str, int]:
+        return {
+            "pp": self._pp_degree,
+            "dp": self._dp_degree,
+            "sharding": self._sharding_degree,
+            "sep": self._sep_degree,
+            "mp": self._mp_degree,
+        }
+
+
+def _build_mesh(shape: Dict[str, int], devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    axes = [a for a in AXIS_ORDER if shape.get(a, 1) >= 1]
+    dims = [shape.get(a, 1) for a in axes]
+    n = int(np.prod(dims))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(axes, dims))} needs {n} devices, "
+            f"only {len(devices)} visible"
+        )
+    dev = np.asarray(devices[:n]).reshape(dims)
+    return Mesh(dev, tuple(axes))
+
+
+def init_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None) -> Mesh:
+    """Create and install the global mesh (+ HCG view of it)."""
+    topo = CommunicateTopology(
+        ["pipe", "data", "sharding", "sep", "model"], [pp, dp, sharding, sep, mp]
+    )
+    hcg = HybridCommunicateGroup(topo)
+    mesh = _build_mesh(hcg.mesh_shape(), devices)
+    _global["hcg"] = hcg
+    _global["mesh"] = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global["mesh"]
+
+
+def get_hcg() -> Optional[HybridCommunicateGroup]:
+    return _global["hcg"]
+
+
+def _set_hcg(hcg):
+    _global["hcg"] = hcg
+
+
+def global_mesh() -> Mesh:
+    m = _global["mesh"]
+    if m is None:
+        m = init_mesh(dp=len(jax.devices()))
+    return m

@@ -1,0 +1,301 @@
+"""paddle.profiler — profiling with the TPU/XLA backend.
+
+Reference analogue: python/paddle/profiler/ (profiler.py scheduler states,
+RecordEvent host annotation api → HostTracer host_event_recorder.h, CUPTI
+CudaTracer, ChromeTracingLogger chrome://tracing export; SURVEY.md §5).
+
+TPU-native: device-side tracing is jax.profiler (XPlane → TensorBoard/
+perfetto, replacing CUPTI), host annotations keep the RecordEvent API
+(lowering to jax.profiler.TraceAnnotation inside traces and wall-clock spans
+eagerly), and the chrome-trace export writes the host-span timeline JSON.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = [
+    "Profiler",
+    "ProfilerState",
+    "ProfilerTarget",
+    "RecordEvent",
+    "make_scheduler",
+    "export_chrome_tracing",
+    "load_profiler_result",
+    "SummaryView",
+    "SortedKeys",
+]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    GPUTotal = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+_host_events = []
+_events_lock = threading.Lock()
+
+
+class RecordEvent:
+    """Host-side annotation (reference: profiler/utils.py RecordEvent over
+    platform/profiler/event_tracing.h:47). Usable as context manager or
+    begin()/end(); inside jit traces it becomes an XLA TraceAnnotation."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._annot = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._annot = jax.profiler.TraceAnnotation(self.name)
+            self._annot.__enter__()
+        except Exception:
+            self._annot = None
+
+    def end(self):
+        if self._annot is not None:
+            self._annot.__exit__(None, None, None)
+            self._annot = None
+        if self._t0 is not None:
+            t1 = time.perf_counter_ns()
+            with _events_lock:
+                _host_events.append(
+                    {
+                        "name": self.name,
+                        "ph": "X",
+                        "ts": self._t0 / 1000.0,
+                        "dur": (t1 - self._t0) / 1000.0,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000,
+                        "cat": "host",
+                    }
+                )
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference: profiler.py make_scheduler — step-phase state machine."""
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """reference: profiler.py export_chrome_tracing callback."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        prof.export(path, "json")
+        return path
+
+    return handle
+
+
+class Profiler:
+    """reference: profiler.py:43 Profiler — composes host + device tracers.
+
+    Device side: jax.profiler.start_trace/stop_trace writes XPlane data
+    (TensorBoard-loadable). Host side: RecordEvent spans collected into a
+    chrome-trace JSON.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, record_shapes=False, profile_memory=False,
+                 timer_only=False, with_flops=False):
+        self._scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(0, lo), ready=0, record=hi - lo, repeat=1
+            )
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._device_dir = None
+        self._tracing = False
+
+    def start(self):
+        self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_device()
+
+    def _start_device(self):
+        if not self._tracing and not self._timer_only:
+            self._device_dir = os.path.join(
+                os.environ.get("PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_prof"),
+                str(int(time.time())),
+            )
+            os.makedirs(self._device_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._device_dir)
+                self._tracing = True
+            except Exception:
+                self._tracing = False
+
+    def _stop_device(self):
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+
+    def step(self, num_samples: Optional[int] = None):
+        self._step += 1
+        new_state = self._scheduler(self._step)
+        if new_state != self._state:
+            if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                self._start_device()
+            elif self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                self._stop_device()
+                if self._on_trace_ready:
+                    self._on_trace_ready(self)
+            self._state = new_state
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._stop_device()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path: str, format: str = "json"):
+        """Write host-span chrome trace; device XPlane dir noted in metadata."""
+        with _events_lock:
+            events = list(_host_events)
+        trace = {
+            "traceEvents": events,
+            "metadata": {
+                "device_trace_dir": self._device_dir,
+                "framework": "paddle_tpu",
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
+                thread_sep=False, time_unit="ms", views=None):
+        """reference: profiler_statistic.py — aggregated span table."""
+        with _events_lock:
+            events = list(_host_events)
+        agg = {}
+        for e in events:
+            a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0, "max_us": 0.0})
+            a["calls"] += 1
+            a["total_us"] += e["dur"]
+            a["max_us"] = max(a["max_us"], e["dur"])
+        rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])
+        lines = [f"{'Name':<40} {'Calls':>6} {'Total(ms)':>12} {'Avg(ms)':>10} {'Max(ms)':>10}"]
+        for name, a in rows:
+            lines.append(
+                f"{name[:40]:<40} {a['calls']:>6} {a['total_us']/1000:>12.3f} "
+                f"{a['total_us']/a['calls']/1000:>10.3f} {a['max_us']/1000:>10.3f}"
+            )
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class _Timer:
+    """Throughput timer (reference: python/paddle/profiler/timer.py)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self._n = 0
+        self._elapsed = 0.0
+
+    def step(self, num_samples=1):
+        now = time.perf_counter()
+        if self._start is not None:
+            self._elapsed += now - self._start
+            self._n += num_samples
+        self._start = now
+
+    def ips(self):
+        return self._n / self._elapsed if self._elapsed else 0.0
+
+
+benchmark_timer = _Timer()
+
+
+def benchmark():
+    return benchmark_timer

@@ -515,9 +515,11 @@ def _shape_allow_minus(shape):
 def reshape_(x, shape, name=None):
     out = reshape(x, shape)
     x._value = out._value
-    x._grad_node = out._grad_node
-    x._out_index = out._out_index
-    x.stop_gradient = out.stop_gradient
+    if out._grad_node is not None:
+        x._grad_node = out._grad_node
+        x._out_index = out._out_index
+        x.stop_gradient = out.stop_gradient
+    x._bump_version()
     return x
 
 
@@ -639,6 +641,10 @@ def scatter(x, index, updates, overwrite=True, name=None):
 def scatter_(x, index, updates, overwrite=True, name=None):
     out = scatter(x, index, updates, overwrite)
     x._value = out._value
+    if out._grad_node is not None:
+        x._grad_node = out._grad_node
+        x._out_index = out._out_index
+        x.stop_gradient = out.stop_gradient
     x._bump_version()
     return x
 

@@ -1,0 +1,252 @@
+"""Distributed tests on the 8-virtual-device CPU mesh.
+
+Mirrors the reference's strategy (SURVEY.md §4): multi-device behavior is
+validated in-process — here via the mesh + compiled SPMD programs instead of
+subprocess NCCL rings; numerical parity is asserted against the
+single-device run of the same logical model.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+import paddle_tpu.distributed as dist
+from paddle_tpu.parallel.topology import get_mesh, init_mesh
+
+
+@pytest.fixture
+def mesh8():
+    m = init_mesh(dp=2, mp=4)
+    yield m
+
+
+@pytest.fixture
+def fleet8():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield fleet.get_hybrid_communicate_group()
+
+
+def test_topology_groups(fleet8):
+    hcg = fleet8
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 4
+    assert hcg.nranks == 8
+    mp_group = hcg.get_model_parallel_group()
+    assert mp_group.nranks == 4
+    assert mp_group.axis_name == "mp"
+    topo = hcg.topology()
+    comm = topo.get_comm_list("model")
+    assert len(comm) == 2 and all(len(g) == 4 for g in comm)
+    # groups partition the world
+    assert sorted(r for g in comm for r in g) == list(range(8))
+
+
+def test_collectives_lower_to_xla_inside_shard_map(mesh8):
+    """all_reduce/all_gather/reduce_scatter through the paddle API lower to
+    psum/all_gather/psum_scatter when traced over a mesh axis."""
+    mesh = mesh8
+    grp = dist.Group(list(range(4)), axis_name="mp")
+
+    def body(x):
+        t = paddle.Tensor(x, stop_gradient=True)
+        dist.all_reduce(t, group=grp)
+        return t._value
+
+    x = jnp.arange(8.0)
+    out = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("mp"), out_specs=P("mp"))
+    )(x)
+    # each mp shard (2 elems) summed across the 4 mp members in its dp row
+    expected = np.tile(
+        np.asarray(x).reshape(4, 2).sum(0), 4
+    )
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+    def body_gather(x):
+        t = paddle.Tensor(x, stop_gradient=True)
+        got = dist.all_gather([], t, group=grp)
+        return got._value
+
+    out2 = jax.jit(
+        shard_map(body_gather, mesh=mesh, in_specs=P("mp"), out_specs=P(None, "mp"))
+    )(x)
+    assert np.asarray(out2).shape == (4, 8)
+
+    def body_rs(x):
+        t = paddle.Tensor(jnp.zeros(2), stop_gradient=True)
+        dist.reduce_scatter(t, paddle.Tensor(x, stop_gradient=True), group=grp)
+        return t._value
+
+    out3 = jax.jit(
+        shard_map(body_rs, mesh=mesh, in_specs=P(None), out_specs=P("mp"))
+    )(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(out3), np.arange(8.0) * 4)
+
+
+def test_tp_layers_match_single_device(fleet8):
+    paddle.seed(3)
+
+    class TPMLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = fleet.VocabParallelEmbedding(32, 16)
+            self.fc1 = fleet.ColumnParallelLinear(16, 64, gather_output=False)
+            self.fc2 = fleet.RowParallelLinear(64, 16, input_is_parallel=True)
+
+        def forward(self, x):
+            h = self.emb(x)
+            return self.fc2(F.relu(self.fc1(h)))
+
+    model = TPMLP()
+    ref_out_layers = nn.Sequential()  # plain equivalent sharing weights
+    x = paddle.randint(0, 32, [4, 6])
+    ref = F.linear(
+        F.relu(F.linear(F.embedding(x, model.emb.weight), model.fc1.weight, model.fc1.bias)),
+        model.fc2.weight,
+        model.fc2.bias,
+    )
+    model = fleet.distributed_model(model)
+    out = model(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-5)
+    # weights physically sharded over mp
+    spec = model.fc1.weight._value.sharding.spec
+    assert tuple(spec) == (None, "mp")
+
+
+def test_hybrid_sharded_step_matches_single_device(fleet8):
+    def build():
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 8))
+        return m
+
+    x = paddle.randn([16, 8])
+    y = paddle.randn([16, 8])
+
+    # single-device compiled step
+    m1 = build()
+    o1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    s1 = paddle.jit.compile_train_step(m1, F.mse_loss, o1)
+    l1 = [float(s1(x, y)) for _ in range(5)]
+
+    # dp=2 × mp=4 sharded step on the mesh
+    m2 = build()
+    m2 = fleet.distributed_model(m2)
+    o2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+    s2 = fleet.distributed_train_step(m2, F.mse_loss, o2)
+    l2 = [float(s2(x, y)) for _ in range(5)]
+
+    np.testing.assert_allclose(l1, l2, rtol=1e-4, atol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_zero3_params_physically_sharded():
+    m = init_mesh(dp=1, sharding=8)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"sharding_degree": 8}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 3}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 16))
+    model = fleet.distributed_model(model)
+    w = model[0].weight._value
+    assert "sharding" in tuple(w.sharding.spec)  # ZeRO-3: param sharded
+    # per-device memory is 1/8 of the logical param
+    shard_shape = w.sharding.shard_shape(w.shape)
+    assert int(np.prod(shard_shape)) == int(np.prod(w.shape)) // 8
+
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    step = fleet.distributed_train_step(model, F.mse_loss, opt)
+    x = paddle.randn([8, 16])
+    y = paddle.randn([8, 16])
+    losses = [float(step(x, y)) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5
+    # optimizer moments ZeRO-sharded too
+    st = opt._accumulators[id(model[0].weight)]
+    assert "sharding" in tuple(st["moment1"].sharding.spec)
+
+
+def test_zero1_opt_state_sharded_params_replicated():
+    init_mesh(dp=2, sharding=4)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    model = fleet.distributed_model(model)
+    assert tuple(model[0].weight._value.sharding.spec) in ((), (None, None))
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    step = fleet.distributed_train_step(model, F.mse_loss, opt)
+    x = paddle.randn([8, 16])
+    y = paddle.randn([8, 4])
+    for _ in range(3):
+        step(x, y)
+    st = opt._accumulators[id(model[0].weight)]
+    assert "sharding" in tuple(st["moment1"].sharding.spec)
+
+
+def test_pipeline_layer_segments(fleet8):
+    from paddle_tpu.distributed.fleet import PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+    pl = PipelineLayer(descs, num_stages=4, loss_fn=F.mse_loss)
+    assert pl.segment_parts == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    assert pl.get_stage_from_index(5) == 2
+    out = pl(paddle.randn([2, 8]))
+    assert out.shape == [2, 8]
+
+
+def test_pipeline_parallel_train_batch(fleet8):
+    from paddle_tpu.distributed.fleet import PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineParallel
+
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    pl = PipelineLayer(
+        [LayerDesc(nn.Linear, 8, 32), LayerDesc(nn.ReLU), LayerDesc(nn.Linear, 32, 1)],
+        num_stages=1,
+        loss_fn=F.mse_loss,
+    )
+    pp = PipelineParallel(pl, strategy=strategy)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=pl.parameters())
+    x = paddle.randn([16, 8])
+    y = x.sum(axis=1, keepdim=True)
+    losses = [float(pp.train_batch((x, y), opt)) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.3
+
+
+def test_dataparallel_wrapper():
+    dist.init_parallel_env()
+    m = nn.Linear(4, 2)
+    dp = paddle.DataParallel(m)
+    out = dp(paddle.ones([1, 4]))
+    assert out.shape == [1, 2]
+    assert len(dp.state_dict()) == len(m.state_dict())
+
+
+def test_collective_world1_eager_semantics():
+    t = paddle.to_tensor([1.0, 2.0])
+    g = dist.new_group([0])
+    dist.all_reduce(t, group=g)
+    np.testing.assert_allclose(t.numpy(), [1, 2])
+    lst = []
+    dist.all_gather(lst, t, group=g)
+    assert len(lst) == 1
+    dist.broadcast(t, src=0, group=g)
+    dist.barrier()
