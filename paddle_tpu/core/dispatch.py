@@ -98,9 +98,31 @@ def enable_grad(func=None):
 _jit_cache: Dict[Tuple, Callable] = {}
 
 
-def _jitted(fn: Callable, kw_items: Tuple) -> Callable:
-    key = (fn, kw_items)
-    cached = _jit_cache.get(key)
+def _cache_token(fn: Callable):
+    """Stable cache identity for `fn`, or None if fn must not be cached.
+
+    Ops are often passed as lambdas / nested defs created fresh on every
+    call; caching by function identity would then grow _jit_cache (and pile
+    up live jax.jit wrappers) without bound. A fresh function object still
+    shares one code object with its siblings, and its behavior depends only
+    on that code plus the (static) kwargs — *unless* it closes over
+    call-specific values, in which case it is uncacheable.
+    """
+    if getattr(fn, "__closure__", None):
+        return None
+    code = getattr(fn, "__code__", None)
+    return code if code is not None else fn
+
+
+def _jitted(fn: Callable, kw_items: Tuple, token=None) -> Optional[Callable]:
+    token = token if token is not None else _cache_token(fn)
+    if token is None:
+        return None
+    key = (token, kw_items)
+    try:
+        cached = _jit_cache.get(key)
+    except TypeError:  # unhashable static kwarg — run unjitted
+        return None
     if cached is None:
         cached = jax.jit(functools.partial(fn, **dict(kw_items)))
         _jit_cache[key] = cached
@@ -206,8 +228,9 @@ def apply(
     )
 
     if not record:
-        if flags.flag("eager_op_jit"):
-            out_vals = _jitted(fn, kw_items)(*vals)
+        jfn = _jitted(fn, kw_items) if flags.flag("eager_op_jit") else None
+        if jfn is not None:
+            out_vals = jfn(*vals)
         else:
             out_vals = fn(*vals, **dict(kw_items))
         return _wrap_outputs(out_vals, stop_gradient=True, node=None)

@@ -307,14 +307,21 @@ class Tensor:
     def __getitem__(self, idx):
         idx = _unwrap_index(idx)
 
+        # closure over idx → dispatch skips the jit cache for it, but still
+        # records the tape (vjp handles the scatter-back for masks/gathers)
         def _getitem(x):
             return x[idx]
 
         if _index_is_traceable(idx):
             return dispatch.apply(_getitem, self, op_name="getitem")
-        # boolean-mask indexing → dynamic shape, run un-jitted on host values
-        out = self._value[idx]
-        return Tensor(out, stop_gradient=True)
+        # boolean-mask indexing → dynamic output shape: must stay out of any
+        # jit trace, but eager vjp with a concrete mask is well-defined
+        if isinstance(self._value, jax.core.Tracer):
+            raise ValueError(
+                "boolean-mask indexing inside jit produces a dynamic shape; "
+                "use paddle.masked_select outside jit or paddle.where instead"
+            )
+        return dispatch.apply(_getitem, self, op_name="getitem_mask")
 
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)

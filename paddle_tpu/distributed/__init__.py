@@ -23,11 +23,13 @@ from .collective import (  # noqa: F401
     is_initialized,
     isend,
     new_group,
+    ppermute,
     recv,
     reduce,
     reduce_scatter,
     scatter,
     send,
+    shift,
     wait,
 )
 from .parallel import (  # noqa: F401

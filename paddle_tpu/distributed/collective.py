@@ -42,6 +42,8 @@ __all__ = [
     "reduce_scatter",
     "alltoall",
     "alltoall_single",
+    "ppermute",
+    "shift",
     "send",
     "recv",
     "isend",
@@ -203,9 +205,18 @@ def all_gather_object(object_list, obj, group=None):
 
 def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
               sync_op=True):
-    """reference: collective.py:494 → c_broadcast. Single-controller global
-    values are already consistent; in-trace this is a no-op (XLA keeps
-    replicated values in sync)."""
+    """reference: collective.py:494 → c_broadcast. In a shard_map trace the
+    per-rank values may genuinely differ, so broadcast is mask-and-psum
+    (ppermute cannot express one-to-all: duplicate sources are invalid).
+    Eager single-controller global values are already consistent → no-op."""
+    val = tensor._value
+    axis = _axis(group)
+    if _is_traced(val) and axis is not None:
+        # where (not multiply-by-mask): inf/nan on non-source ranks is exactly
+        # the garbage broadcast must overwrite, and 0*inf would poison psum
+        masked = jnp.where(jax.lax.axis_index(axis) == src, val, jnp.zeros_like(val))
+        tensor._value = jax.lax.psum(masked, axis)
+        return tensor
     return tensor
 
 
@@ -293,32 +304,68 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     raise RuntimeError("eager alltoall_single requires a compiled region or 1 rank")
 
 
-def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
-    """reference: collective.py:1793 send → send_v2 op. In-trace p2p is a
-    collective_permute (ppermute) — see parallel/pipeline.py for the PP
-    schedule built on it."""
+def ppermute(tensor: Tensor, perm, group: Optional[Group] = None):
+    """Raw collective-permute over the group's mesh axis. `perm` is a list of
+    (src, dst) pairs; sources and destinations must each be distinct (XLA
+    CollectivePermute contract). Ranks not named as a destination receive
+    zeros. This is the TPU p2p primitive the pipeline schedule is built on
+    (reference send_v2/recv_v2 ops → paddle/fluid/operators/collective/)."""
     axis = _axis(group)
-    val = tensor._value
+    val = tensor._value if isinstance(tensor, Tensor) else tensor
     if _is_traced(val) and axis is not None:
-        n = _group_size(group)
-        perm = [(i, dst) for i in range(n)]
         return Tensor(jax.lax.ppermute(val, axis, perm), stop_gradient=True)
     if _group_size(group) == 1:
+        # match traced semantics: rank 0 receives its value only when (0, 0)
+        # is in the perm; otherwise it was not a destination → zeros
+        if (0, 0) in [tuple(p) for p in perm]:
+            return tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+        return Tensor(jnp.zeros_like(val), stop_gradient=True)
+    raise RuntimeError("eager ppermute requires a compiled region")
+
+
+def shift(tensor: Tensor, offset: int = 1, group: Optional[Group] = None,
+          wrap: bool = False):
+    """Shift values along the group axis by `offset` ranks: rank i's value
+    goes to rank i+offset. Without wrap, edge ranks receive zeros — exactly
+    the boundary a pipeline stage wants. This is the valid permutation form
+    of p2p (every source and destination distinct)."""
+    n = _group_size(group)
+    if wrap:
+        perm = [(i, (i + offset) % n) for i in range(n)]
+    else:
+        perm = [(i, i + offset) for i in range(n) if 0 <= i + offset < n]
+    return ppermute(tensor, perm, group)
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
+    """reference: collective.py:1793 send → send_v2 op.
+
+    Paired send/recv with per-rank control flow only exists in the
+    multi-process world; a single-program SPMD trace cannot express "this
+    rank sends" (every rank runs the same trace). In-trace p2p must instead
+    be written as one data movement: `shift` / `ppermute` above (used by
+    parallel/pipeline.py)."""
+    if _group_size(group) == 1:
         return tensor
-    raise RuntimeError("eager send requires a compiled region")
+    if _is_traced(tensor._value):
+        raise RuntimeError(
+            "send/recv have per-rank control flow and cannot appear inside a "
+            "single-program SPMD trace; express the transfer as "
+            "paddle.distributed.shift(x, offset) or ppermute(x, [(src, dst)])"
+        )
+    raise RuntimeError("eager send requires a multi-process launch")
 
 
 def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
-    axis = _axis(group)
-    val = tensor._value
-    if _is_traced(val) and axis is not None:
-        n = _group_size(group)
-        perm = [(src, i) for i in range(n)]
-        tensor._value = jax.lax.ppermute(val, axis, perm)
-        return tensor
     if _group_size(group) == 1:
         return tensor
-    raise RuntimeError("eager recv requires a compiled region")
+    if _is_traced(tensor._value):
+        raise RuntimeError(
+            "send/recv have per-rank control flow and cannot appear inside a "
+            "single-program SPMD trace; express the transfer as "
+            "paddle.distributed.shift(x, offset) or ppermute(x, [(src, dst)])"
+        )
+    raise RuntimeError("eager recv requires a multi-process launch")
 
 
 isend = send

@@ -17,7 +17,11 @@ from ..core.tensor import Tensor
 
 
 class _TensorPayload:
-    """Pickle surrogate for a Tensor (value + trainability + name)."""
+    """Legacy pickle surrogate — kept only so old checkpoints still load.
+
+    New files store Tensors as plain ndarrays (the reference's pickle format,
+    python/paddle/framework/io.py:568), so .pdparams files are readable
+    without this package installed."""
 
     def __init__(self, t: Tensor):
         self.array = t.numpy()
@@ -28,7 +32,7 @@ class _TensorPayload:
 
 def _pack(obj: Any):
     if isinstance(obj, Tensor):
-        return _TensorPayload(obj)
+        return obj.numpy()
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -38,12 +42,14 @@ def _pack(obj: Any):
 
 
 def _unpack(obj: Any, return_numpy=False):
-    if isinstance(obj, _TensorPayload):
+    if isinstance(obj, _TensorPayload):  # legacy files
         if return_numpy:
             return obj.array
         t = Tensor(obj.array, stop_gradient=obj.stop_gradient, name=obj.name)
         t.is_parameter = obj.is_parameter
         return t
+    if isinstance(obj, np.ndarray) and obj.dtype != object:
+        return obj if return_numpy else Tensor(obj)
     if isinstance(obj, dict):
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, list):

@@ -282,10 +282,11 @@ def batch_norm(
             running_mean._value = (
                 running_mean._value * momentum + bm._value * (1 - momentum)
             )
-            n = x.size / bm.size
-            unbiased = bv._value * (n / (n - 1)) if n > 1 else bv._value
+            # the reference accumulates the *biased* batch variance into
+            # running_var (phi/kernels/cpu/batch_norm_kernel.cc:152) — no
+            # Bessel correction, so eval-mode outputs match it exactly
             running_var._value = (
-                running_var._value * momentum + unbiased * (1 - momentum)
+                running_var._value * momentum + bv._value * (1 - momentum)
             )
     return out
 

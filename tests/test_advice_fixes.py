@@ -1,0 +1,142 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md):
+jit-cache growth from per-call closures, broken in-trace p2p perms,
+grad-dropping boolean-mask indexing, batch_norm running-var Bessel
+correction, and non-portable paddle.save payloads.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core import dispatch
+
+
+@pytest.fixture()
+def mesh8():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("dp", "mp"))
+
+
+def test_jit_cache_bounded_over_repeated_astype_and_getitem():
+    x = paddle.randn([8, 8])
+    # warm one iteration so code-object keys exist
+    _ = x.astype("float32")[1:3, 2]
+    F.normalize(x)
+    _ = x.mT
+    before = len(dispatch._jit_cache)
+    for _ in range(50):
+        _ = x.astype("float32")
+        _ = x[1:3, 2]
+        _ = F.normalize(x)
+        _ = x.mT
+    assert len(dispatch._jit_cache) == before
+
+
+def test_closure_ops_not_cached_but_still_correct():
+    x = paddle.to_tensor(np.arange(12.0, dtype=np.float32).reshape(3, 4))
+    idx = paddle.to_tensor(np.array([2, 0]))
+    before = len(dispatch._jit_cache)
+    for _ in range(20):
+        out = x[idx]  # array index closure → uncacheable
+    assert len(dispatch._jit_cache) == before
+    np.testing.assert_allclose(out.numpy(), x.numpy()[[2, 0]])
+
+
+def test_boolean_mask_getitem_has_gradient():
+    x = paddle.to_tensor(
+        np.arange(6.0, dtype=np.float32).reshape(2, 3), stop_gradient=False
+    )
+    mask = paddle.to_tensor(np.array([[True, False, True], [False, True, False]]))
+    out = x[mask]
+    assert not out.stop_gradient
+    loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), np.array([[1, 0, 1], [0, 1, 0]], np.float32)
+    )
+
+
+def test_shift_and_ppermute_point_to_point(mesh8):
+    grp = dist.Group(list(range(4)), axis_name="mp")
+
+    def body(x):
+        t = paddle.Tensor(x, stop_gradient=True)
+        return dist.shift(t, 1, group=grp)._value
+
+    x = jnp.arange(4.0)
+    out = jax.jit(shard_map(body, mesh=mesh8, in_specs=P("mp"), out_specs=P("mp")))(x)
+    # rank i's value moved to i+1; rank 0 receives zeros
+    np.testing.assert_allclose(np.asarray(out), [0.0, 0.0, 1.0, 2.0])
+
+    def body_p2p(x):
+        t = paddle.Tensor(x, stop_gradient=True)
+        return dist.ppermute(t, [(1, 3)], group=grp)._value
+
+    out2 = jax.jit(
+        shard_map(body_p2p, mesh=mesh8, in_specs=P("mp"), out_specs=P("mp"))
+    )(x)
+    np.testing.assert_allclose(np.asarray(out2), [0.0, 0.0, 0.0, 1.0])
+
+
+def test_send_recv_raise_inside_trace(mesh8):
+    grp = dist.Group(list(range(4)), axis_name="mp")
+
+    def body(x):
+        t = paddle.Tensor(x, stop_gradient=True)
+        with pytest.raises(RuntimeError, match="shift"):
+            dist.send(t, dst=1, group=grp)
+        with pytest.raises(RuntimeError, match="shift"):
+            dist.recv(t, src=0, group=grp)
+        return t._value
+
+    jax.jit(shard_map(body, mesh=mesh8, in_specs=P("mp"), out_specs=P("mp")))(
+        jnp.arange(4.0)
+    )
+
+
+def test_broadcast_from_src_inside_trace(mesh8):
+    grp = dist.Group(list(range(4)), axis_name="mp")
+
+    def body(x):
+        t = paddle.Tensor(x, stop_gradient=True)
+        dist.broadcast(t, src=2, group=grp)
+        return t._value
+
+    out = jax.jit(
+        shard_map(body, mesh=mesh8, in_specs=P("mp"), out_specs=P("mp"))
+    )(jnp.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out), [2.0] * 4)
+
+
+def test_batchnorm_running_var_uses_biased_variance():
+    bn = nn.BatchNorm1D(3, momentum=0.0)  # running stats = batch stats
+    bn.train()
+    x = paddle.to_tensor(
+        np.array([[1.0, 2.0, 3.0], [3.0, 6.0, 9.0]], np.float32)
+    )
+    bn(x)
+    # biased variance of each column over n=2 samples, not n/(n-1) corrected
+    np.testing.assert_allclose(
+        bn._variance.numpy(), np.var(x.numpy(), axis=0), rtol=1e-6
+    )
+
+
+def test_paddle_save_is_plain_ndarray_pickle(tmp_path):
+    lin = nn.Linear(3, 2)
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(lin.state_dict(), path)
+    with open(path, "rb") as f:
+        raw = pickle.load(f)  # loadable without paddle_tpu-specific classes
+    assert all(isinstance(v, np.ndarray) for v in raw.values())
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(
+        loaded["weight"].numpy(), lin.weight.numpy()
+    )
