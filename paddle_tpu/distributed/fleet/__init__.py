@@ -118,9 +118,22 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
 def distributed_train_step(model, loss_fn, optimizer):
     """Build the compiled hybrid-parallel train step for the current
     strategy/mesh — the single API that replaces the reference's
-    fleet.distributed_model(...).train_batch / minimize pipeline."""
+    fleet.distributed_model(...).train_batch / minimize pipeline.
+    With pp_degree > 1 this is the pipelined (GPipe-over-ppermute) step."""
     from ...parallel.sharding import sharded_train_step
+    from ...parallel.topology import axis_size
 
+    strategy = _strategy()
+    pp = axis_size("pp")
+    if pp > 1:
+        from ...parallel.pipeline import pipelined_train_step
+
+        target = model._layers if hasattr(model, "_layers") else model
+        return pipelined_train_step(
+            target, loss_fn, optimizer,
+            num_micro=strategy.pipeline_configs.get("accumulate_steps", pp),
+            zero_stage=strategy.sharding_stage,
+        )
     return sharded_train_step(
         model, loss_fn, optimizer, zero_stage=_strategy().sharding_stage
     )

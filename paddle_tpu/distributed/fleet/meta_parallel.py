@@ -266,6 +266,71 @@ class PipelineLayer(Layer):
                 return stage
         return self._num_stages - 1
 
+    # pipeline-partition protocol (parallel/pipeline.py): the longest run of
+    # same-class layers is the homogeneous middle; everything before it is
+    # the (replicated) pre stage, everything after the post stage
+    def _homogeneous_middle(self):
+        def sig(item):
+            if item[0] != "own":
+                return None
+            layer = item[1]
+            return (
+                type(layer),
+                tuple(
+                    (k, tuple(p.shape))
+                    for k, p in sorted(layer.named_parameters(), key=lambda kv: kv[0])
+                ),
+            )
+
+        items = self._built
+        best = (0, 0)  # (start, stop)
+        i = 0
+        while i < len(items):
+            s = sig(items[i])
+            if s is None:
+                i += 1
+                continue
+            j = i
+            while j < len(items) and sig(items[j]) == s:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        return best
+
+    def _run_items(self, items, x):
+        for item in items:
+            kind = item[0]
+            if kind == "own":
+                _, layer, desc = item
+                if isinstance(desc, SharedLayerDesc) and desc.forward_func is not None:
+                    x = desc.forward_func(layer, x)
+                else:
+                    x = layer(x)
+            elif kind == "shared":
+                _, desc = item
+                layer = self._shared[desc.layer_name]
+                if desc.forward_func is not None:
+                    x = desc.forward_func(layer, x)
+                else:
+                    x = layer(x)
+            else:
+                x = item[1](x)
+        return x
+
+    def pp_embed(self, x):
+        lo, _ = self._homogeneous_middle()
+        return self._run_items(self._built[:lo], x)
+
+    @property
+    def pp_blocks(self):
+        lo, hi = self._homogeneous_middle()
+        return [it[1] for it in self._built[lo:hi]]
+
+    def pp_head(self, h):
+        _, hi = self._homogeneous_middle()
+        return self._run_items(self._built[hi:], h)
+
     def forward(self, x):
         for item in self._built:
             kind = item[0]
@@ -291,28 +356,59 @@ class PipelineParallel(Layer):
     """reference: pipeline_parallel.py:30 — train_batch with the 1F1B
     schedule over p2p sends.
 
-    TPU-native round 1: microbatched gradient accumulation with the whole
-    (sharded) model per microbatch — mathematically identical to GPipe with
-    the all-reduce at the end; the ppermute-based per-stage schedule that
-    overlaps stages on the `pp` mesh axis lives in parallel/pipeline.py and
-    is wired to this API as it matures."""
+    TPU-native: with pp_degree > 1 on the mesh, train_batch runs the
+    compiled GPipe-over-ppermute schedule (parallel/pipeline.py) — stage
+    weights stacked and pp-sharded, activations rotated by collective
+    permute, backward pipelined by XLA's reverse scan. With pp == 1 it
+    falls back to microbatched gradient accumulation (no host syncs until
+    the final loss read)."""
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
         self._layers = layers
         self._strategy = strategy
+        self._hcg = hcg
         self.accumulate_steps = (
             strategy.pipeline_configs.get("accumulate_steps", 1) if strategy else 1
         )
+        self._pipelined = None  # compiled schedule, built on first train_batch
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    def _pp_degree(self):
+        from ...parallel.topology import axis_size
+
+        return axis_size("pp")
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         import paddle_tpu as paddle
 
         x, y = data
-        micro = self.accumulate_steps
+        micro = max(1, self.accumulate_steps)
+        if self._pp_degree() > 1:
+            if self._pipelined is None or self._pipelined.optimizer is not optimizer:
+                from ...parallel.pipeline import pipelined_train_step
+
+                loss_fn = getattr(self._layers, "_loss_fn", None)
+                stage = (
+                    self._strategy.sharding_stage if self._strategy else 0
+                )
+                self._pipelined = pipelined_train_step(
+                    self._layers, loss_fn, optimizer,
+                    num_micro=micro, zero_stage=stage,
+                )
+            loss = self._pipelined(x, y)
+            if scaler is not None:
+                # grads live in fp32 inside the fused step, so dynamic loss
+                # scaling is mathematically a no-op (bf16 AMP); advance the
+                # scaler's bookkeeping so its state machine stays consistent
+                # (reference: HybridParallelGradScaler wraps the same way)
+                scaler.update()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
+
         bsz = x.shape[0]
         mb = max(1, bsz // micro)
         total = None
@@ -329,7 +425,8 @@ class PipelineParallel(Layer):
                 scaler.scale(scaled).backward()
             else:
                 scaled.backward()
-            total = float(loss) if total is None else total + float(loss)
+            # accumulate on device; a single host read happens at the end
+            total = loss.detach() if total is None else (total + loss.detach())
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -338,7 +435,7 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return paddle.to_tensor(total / micro)
+        return total / micro
 
 
 class TensorParallel(Layer):

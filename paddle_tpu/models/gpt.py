@@ -190,6 +190,21 @@ class GPTForPretraining(nn.Layer):
         logits = paddle.matmul(h, w, transpose_y=True)
         return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
 
+    # pipeline-partition protocol (parallel/pipeline.py): homogeneous middle
+    # = the decoder stack; embedding/head replicated across pp stages
+    def pp_embed(self, input_ids):
+        return self.gpt.embeddings(input_ids)
+
+    @property
+    def pp_blocks(self):
+        return list(self.gpt.layers)
+
+    def pp_head(self, h):
+        h = self.gpt.final_ln(h)
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = paddle.matmul(h, w, transpose_y=True)
+        return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """reference: ParallelCrossEntropy (mp_layers.py:249) over shifted LM

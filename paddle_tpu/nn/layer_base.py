@@ -271,6 +271,11 @@ class Layer:
 
     # -- state dict ----------------------------------------------------------
     def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        # a compiled step may hold the authoritative (e.g. stage-stacked)
+        # weights; let it materialize them into the live params first
+        sync = getattr(self, "_lazy_param_sync", None)
+        if sync is not None:
+            sync()
         out = collections.OrderedDict() if destination is None else destination
         for name, p in self.named_parameters(include_sublayers=include_sublayers):
             out[name] = p

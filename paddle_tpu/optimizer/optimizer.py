@@ -161,6 +161,11 @@ class Optimizer:
 
     # -- checkpoint ----------------------------------------------------------
     def state_dict(self):
+        # a compiled (pipelined) step may hold authoritative stacked moments;
+        # let it write them back into _accumulators first
+        sync = getattr(self, "_lazy_state_sync", None)
+        if sync is not None:
+            sync()
         out = {"_step_count": self._step_count}
         params = self._param_list()
         for i, p in enumerate(params):

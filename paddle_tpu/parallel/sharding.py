@@ -81,12 +81,36 @@ def shard_params(model, mesh: Optional[Mesh] = None, zero_stage: int = 0):
     return model
 
 
+import threading as _threading
+
+_constraint_tls = _threading.local()
+
+
+class suppress_sharding_constraints:
+    """Scope that turns with_sharding_constraint into a no-op. Used by the
+    pipeline schedule: inside the shard_map-manual-over-pp region, GSPMD
+    constraints naming auto axes can crash XLA's partitioner (group-count
+    check in spmd_partitioner_util.cc); weight shardings alone propagate the
+    TP layout there."""
+
+    def __enter__(self):
+        self._prev = getattr(_constraint_tls, "off", False)
+        _constraint_tls.off = True
+        return self
+
+    def __exit__(self, *exc):
+        _constraint_tls.off = self._prev
+        return False
+
+
 def with_sharding_constraint(x, *spec):
     """Annotation helper usable inside layer forwards (no-op without a mesh).
     The TPU analogue of inserting a c_split/c_concat/c_identity op."""
     mesh = get_mesh()
     val = x._value if isinstance(x, Tensor) else x
     if mesh is None or isinstance(val, np.ndarray):
+        return x
+    if getattr(_constraint_tls, "off", False):
         return x
     try:
         out = jax.lax.with_sharding_constraint(val, NamedSharding(mesh, P(*spec)))

@@ -214,6 +214,14 @@ def get_mesh() -> Optional[Mesh]:
     return _global["mesh"]
 
 
+def axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    """Size of a named mesh axis (1 when absent or no mesh installed)."""
+    mesh = mesh or _global["mesh"]
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
+
+
 def get_hcg() -> Optional[HybridCommunicateGroup]:
     return _global["hcg"]
 
