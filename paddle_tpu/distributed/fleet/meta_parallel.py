@@ -332,24 +332,7 @@ class PipelineLayer(Layer):
         return self._run_items(self._built[hi:], h)
 
     def forward(self, x):
-        for item in self._built:
-            kind = item[0]
-            if kind == "own":
-                _, layer, desc = item
-                if isinstance(desc, SharedLayerDesc) and desc.forward_func is not None:
-                    x = desc.forward_func(layer, x)
-                else:
-                    x = layer(x)
-            elif kind == "shared":
-                _, desc = item
-                layer = self._shared[desc.layer_name]
-                if desc.forward_func is not None:
-                    x = desc.forward_func(layer, x)
-                else:
-                    x = layer(x)
-            else:
-                x = item[1](x)
-        return x
+        return self._run_items(self._built, x)
 
 
 class PipelineParallel(Layer):

@@ -6,3 +6,5 @@ fused ops) + fleet/utils/recompute.py.
 from . import recompute as _recompute_mod  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from . import nn  # noqa: F401
+from . import moe  # noqa: F401
+from . import distributed  # noqa: F401

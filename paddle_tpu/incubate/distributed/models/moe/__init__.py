@@ -1,0 +1,9 @@
+"""paddle.incubate.distributed.models.moe — parity path for the reference's
+MoE package (python/paddle/incubate/distributed/models/moe/)."""
+from ....moe import (  # noqa: F401
+    BaseGate,
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    SwitchGate,
+)

@@ -184,11 +184,12 @@ class GPTForPretraining(nn.Layer):
         self.gpt = GPTModel(cfg)
 
     def forward(self, input_ids):
-        h = self.gpt(input_ids)
-        # tied head: logits = h @ E^T (SharedLayerDesc semantics)
-        w = self.gpt.embeddings.word_embeddings.weight
-        logits = paddle.matmul(h, w, transpose_y=True)
-        return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
+        # the same three phases the pipeline schedule runs, so the eager and
+        # pipelined computations cannot diverge
+        h = self.pp_embed(input_ids)
+        for layer in self.gpt.layers:
+            h = layer(h)
+        return self.pp_head(h)
 
     # pipeline-partition protocol (parallel/pipeline.py): homogeneous middle
     # = the decoder stack; embedding/head replicated across pp stages

@@ -341,6 +341,8 @@ class PipelinedTrainStep:
                             if loss_fn is not None else out
                         )
                     lv = loss._value if isinstance(loss, Tensor) else loss
+                    if lv.ndim > 0:  # parity with the pp==1 path's loss.mean()
+                        lv = lv.mean()
                     return lv.astype(jnp.float32)
 
                 return gpipe_loss(

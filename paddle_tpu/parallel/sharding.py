@@ -246,8 +246,12 @@ class ShardedTrainStep:
                 for st, sh in zip(self._opt_state, st_sh)
             ]
             self._step = self._build(len(batch))
+        _, _, _, batch_sh = self._shardings()
         batch_vals = [
-            b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
+            jax.device_put(
+                b._value if isinstance(b, Tensor) else jnp.asarray(b), batch_sh
+            )
+            for b in batch
         ]
         p_vals = tuple(p._value for p in self._params)
         b_vals = tuple(b._value for b in self._buffers)

@@ -861,6 +861,17 @@ def dot(x, y, name=None):
     return apply(_la.dot, x, y, op_name="dot")
 
 
+def _einsum_op(*operands, equation):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    """paddle.einsum (reference: python/paddle/tensor/einsum.py — a ~1k-line
+    hand parser/planner; here XLA's einsum lowering does the planning, and
+    the MXU gets one fused contraction)."""
+    return apply(_einsum_op, *operands, equation=equation, op_name="einsum")
+
+
 def mm(input, mat2, name=None):
     return apply(_la.mm, input, mat2)
 

@@ -265,6 +265,24 @@ def test_pp_checkpoint_resume_uses_restored_moments():
     np.testing.assert_allclose(ref, got, rtol=3e-3, atol=1e-4)
 
 
+def test_pp_per_token_loss_fn_mean_reduced():
+    """A loss_fn returning per-token losses works under pp (parity with the
+    pp==1 fallback's loss.mean())."""
+    X = _batch()
+    _fleet_pp(dp=2, mp=1, pp=2)
+    model, _, opt = _make(7)
+    model = fleet.distributed_model(model)
+
+    def loss_fn(logits, y):
+        return F.cross_entropy(logits, y, reduction="none")  # [B, S]
+
+    step = fleet.distributed_train_step(model, loss_fn, opt)
+    x = paddle.to_tensor(X[0][:, :-1])
+    y = paddle.to_tensor(X[0][:, 1:].astype(np.int64))
+    loss = float(step(x, y))
+    assert np.isfinite(loss) and 3.0 < loss < 7.0
+
+
 def test_pp_rejects_buffered_models_and_bad_batch():
     _fleet_pp(dp=2, mp=1, pp=2)
     model = nn.Sequential(
