@@ -40,6 +40,13 @@ from .parallel import (  # noqa: F401
     init_parallel_env,
     spawn,
 )
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Engine,
+    ProcessMesh,
+    shard_op,
+    shard_tensor,
+)
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):

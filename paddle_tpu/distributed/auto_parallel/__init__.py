@@ -1,0 +1,318 @@
+"""Semi-automatic SPMD parallelism (auto-parallel).
+
+Reference analogue: python/paddle/distributed/auto_parallel/ (~17k LoC) —
+`ProcessMesh` (process_mesh.py), `shard_tensor`/`shard_op` annotations
+(interface.py:34,74), the `Completer` that propagates dist attrs over the
+program (completion.py:126), the `Partitioner` that rewrites it per rank
+(partitioner.py:37), the `Resharder` inserting comm ops (reshard.py:603),
+and the `Engine` fit/predict API (engine.py:50).
+
+TPU-native design: the reference implements attribute propagation, program
+partitioning and resharding by hand; XLA's GSPMD pass IS that pipeline
+(SURVEY.md §7.7 — the mapping is almost 1:1):
+  - ProcessMesh         → jax.sharding.Mesh over real devices
+  - shard_tensor        → a PartitionSpec pinned to the tensor (params: a
+                          `dist_spec` read by the compiled step; activations:
+                          an in-trace sharding constraint)
+  - shard_op            → sharding constraints on the op's outputs
+  - Completer/Partitioner/Resharder → GSPMD propagation + partitioning +
+                          collective insertion at compile time
+  - Engine              → mesh install + param sharding + the compiled
+                          hybrid train step (parallel/sharding.py)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "get_mesh"]
+
+_default_process_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """reference: process_mesh.py — N-d array of logical process ids.
+
+    On TPU the logical process ids index jax.devices(); the mesh directly
+    becomes a jax.sharding.Mesh with one axis name per dim ("d0", "d1", ...
+    or user-provided dim_names)."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 parent=None):
+        arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self.mesh = arr.tolist()
+        self.topology = list(arr.shape)
+        self.processes = [int(i) for i in arr.flatten()]
+        if len(set(self.processes)) != len(self.processes):
+            raise ValueError("ProcessMesh must not contain duplicate processes")
+        self.dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)
+        ]
+        if len(self.dim_names) != arr.ndim:
+            raise ValueError("dim_names length must match mesh ndim")
+        self._jax_mesh = None
+        # the most recently constructed mesh is the default for annotations
+        # that omit process_mesh (reference: default_dist_context) — latest
+        # wins, so a stale early mesh cannot shadow the one in use
+        global _default_process_mesh
+        _default_process_mesh = self
+
+    @property
+    def ndim(self):
+        return len(self.topology)
+
+    @property
+    def shape(self):
+        return list(self.topology)
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if max(self.processes) >= len(devs):
+                raise ValueError(
+                    f"ProcessMesh references process {max(self.processes)} "
+                    f"but only {len(devs)} devices are visible"
+                )
+            dev_arr = np.asarray([devs[i] for i in self.processes]).reshape(
+                self.topology
+            )
+            self._jax_mesh = Mesh(dev_arr, tuple(self.dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self.mesh == other.mesh
+            and self.dim_names == other.dim_names
+        )
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.topology}, dims={self.dim_names})"
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _default_process_mesh
+
+
+def _spec_from_dims_mapping(pm: ProcessMesh, dims_mapping: Sequence[int]) -> P:
+    entries = []
+    for d in dims_mapping:
+        entries.append(None if d == -1 else pm.dim_names[d])
+    return P(*entries)
+
+
+def _resolve(dist_attr, x=None):
+    """dist_attr dict → (ProcessMesh, dims_mapping). Accepts the reference's
+    raw nested-list process_mesh form."""
+    dist_attr = dist_attr or {}
+    pm = dist_attr.get("process_mesh") or _default_process_mesh
+    if pm is not None and not isinstance(pm, ProcessMesh):
+        pm = ProcessMesh(pm)
+    dm = dist_attr.get("dims_mapping")
+    if dm is None and x is not None:
+        dm = [-1] * x.ndim
+    return pm, dm
+
+
+def shard_tensor(x, dist_attr=None, process_mesh=None, shard_spec=None):
+    """reference: interface.py:34. Two accepted forms:
+      shard_tensor(x, {"process_mesh": pm, "dims_mapping": [0, -1]})
+      shard_tensor(x, process_mesh=pm, shard_spec=["dp", None])  (2.4 style)
+    Parameters get a pinned `dist_spec` (consumed by the compiled step's
+    GSPMD partitioning = the reference's Completer+Partitioner); activations
+    additionally get an in-trace sharding constraint."""
+    if process_mesh is not None:
+        pm = process_mesh if isinstance(process_mesh, ProcessMesh) else ProcessMesh(process_mesh)
+        spec = P(*[s for s in (shard_spec or [None] * x.ndim)])
+    else:
+        pm, dm = _resolve(dist_attr, x)
+        if pm is None:
+            raise ValueError("no ProcessMesh given or previously created")
+        spec = _spec_from_dims_mapping(pm, dm)
+    x.dist_spec = tuple(spec)
+    x.process_mesh = pm
+    if not getattr(x, "is_parameter", False):
+        from ...parallel.sharding import with_sharding_constraint
+
+        return with_sharding_constraint(x, *tuple(spec))
+    return x
+
+
+class _ShardedOp:
+    """reference: DistributedModule (dist_op.py) returned by shard_op."""
+
+    def __init__(self, op_fn, dist_attr=None):
+        self.op_fn = op_fn
+        self.dist_attr = dist_attr or {}
+
+    def __call__(self, *args, **kwargs):
+        from ...parallel.topology import use_mesh
+
+        pm, _ = _resolve(self.dist_attr)
+        out_attr = self.dist_attr.get("out") or self.dist_attr.get("outputs")
+        if out_attr is None or pm is None:
+            return self.op_fn(*args, **kwargs)
+        # run under the annotation's own mesh so the constraint binds even
+        # when no global mesh (or a different one) is installed
+        with use_mesh(pm.jax_mesh()):
+            from ...parallel.sharding import with_sharding_constraint
+
+            out = self.op_fn(*args, **kwargs)
+            spec = _spec_from_dims_mapping(pm, out_attr["dims_mapping"])
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            outs = [with_sharding_constraint(o, *tuple(spec)) for o in outs]
+            return type(out)(outs) if isinstance(out, (list, tuple)) else outs[0]
+
+
+def shard_op(op_fn, dist_attr=None):
+    """reference: interface.py:74."""
+    return _ShardedOp(op_fn, dist_attr)
+
+
+class Engine:
+    """reference: engine.py:50 — prepare/fit/evaluate/predict over the
+    annotated model. TPU-native: installs the ProcessMesh as the global
+    mesh, physically shards annotated parameters, and compiles ONE hybrid
+    SPMD train step (the _build/_plan/_parallel/_initialize pipeline
+    collapses into GSPMD compilation)."""
+
+    def __init__(self, model=None, inputs_spec=None, labels_spec=None,
+                 cluster=None, strategy=None, process_mesh=None,
+                 data_axis=None):
+        self.model = model
+        self.inputs_spec = inputs_spec
+        self.labels_spec = labels_spec
+        self.cluster = cluster
+        self.strategy = strategy
+        self.process_mesh = process_mesh or _default_process_mesh
+        # mesh axis the batch is sharded over; defaults to mesh dim 0 (the
+        # conventional data axis) — pass data_axis when your mesh orders
+        # model-parallel first
+        self.data_axis = data_axis
+        self._optimizer = None
+        self._loss = None
+        self._metrics = None
+        self._train_step = None
+        self._prepared = False
+        self.mode = "train"
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, mode="train",
+                all_ranks=False):
+        from ...parallel.topology import set_mesh
+        from ...parallel.sharding import shard_params
+
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics
+        self.mode = mode
+        if self.process_mesh is None:
+            self.process_mesh = _default_process_mesh
+        if self.process_mesh is not None:
+            # install as the global mesh; the hcg is cleared in the same
+            # call so topology queries cannot disagree with this mesh
+            set_mesh(self.process_mesh.jax_mesh())
+        if self.model is not None:
+            shard_params(self.model)
+        self._prepared = True
+        return self
+
+    def _ensure_step(self):
+        if not self._prepared:
+            raise RuntimeError(
+                "Engine.prepare(optimizer=..., loss=...) must be called "
+                "before fit/evaluate/predict"
+            )
+        if self._train_step is None:
+            from ...parallel.sharding import ShardedTrainStep
+
+            mesh = self.process_mesh.jax_mesh() if self.process_mesh else None
+            axis = self.data_axis or (
+                self.process_mesh.dim_names[0] if self.process_mesh else "dp"
+            )
+            self._train_step = ShardedTrainStep(
+                self.model, self._loss, self._optimizer, mesh=mesh,
+                batch_axes=(axis,),
+            )
+        return self._train_step
+
+    def _iter_batches(self, data, batch_size):
+        from ...io import DataLoader, Dataset
+
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size or 1)
+        return data  # already an iterable of ready batches
+
+    def fit(self, train_data, batch_size=1, epochs=1, steps_per_epoch=None,
+            verbose=0):
+        """train_data: a paddle.io.Dataset (batched via `batch_size`) or an
+        iterable of ready (inputs, labels) batches (batch_size ignored)."""
+        step = self._ensure_step()
+        loader = self._iter_batches(train_data, batch_size)
+        history = []
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                xs, ys = batch
+                loss = step(xs, ys)
+                history.append(float(loss))
+                if verbose:
+                    print(f"epoch {epoch} step {i}: loss {history[-1]:.4f}")
+        return history
+
+    def _eval_forward(self, xs):
+        from ...jit import functional_call
+
+        params = dict(self.model.named_parameters())
+        params.update(dict(self.model.named_buffers()))
+        return functional_call(self.model, params, xs)
+
+    def evaluate(self, valid_data, batch_size=1, steps=None):
+        if not self._prepared:
+            raise RuntimeError("call Engine.prepare(...) before evaluate")
+        total, n = 0.0, 0
+        for i, batch in enumerate(self._iter_batches(valid_data, batch_size)):
+            if steps is not None and i >= steps:
+                break
+            xs, ys = batch
+            out = self._eval_forward(xs)
+            loss = self._loss(out, ys) if self._loss else out
+            lv = loss.mean() if loss.ndim > 0 else loss
+            total += float(lv)
+            n += 1
+        return total / max(n, 1)
+
+    def predict(self, test_data, batch_size=1, steps=None):
+        if not self._prepared:
+            raise RuntimeError("call Engine.prepare(...) before predict")
+        outs = []
+        for i, batch in enumerate(self._iter_batches(test_data, batch_size)):
+            if steps is not None and i >= steps:
+                break
+            xs = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(self._eval_forward(xs))
+        return outs
+
+    def save(self, path, training=True, mode=None):
+        import paddle_tpu as paddle
+
+        paddle.save(self.model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True, mode=None):
+        import paddle_tpu as paddle
+
+        self.model.set_state_dict(paddle.load(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))

@@ -222,6 +222,32 @@ def axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
 
 
+def set_mesh(mesh: Optional[Mesh], hcg=None) -> None:
+    """Install a mesh (and matching hcg view, or clear it) atomically —
+    keeps get_mesh()/get_hcg() consistent when a non-topology mesh (e.g. an
+    auto-parallel ProcessMesh) takes over."""
+    _global["mesh"] = mesh
+    _global["hcg"] = hcg
+
+
+class use_mesh:
+    """Temporarily install `mesh` as the global mesh (hcg cleared), restoring
+    the previous mesh+hcg on exit."""
+
+    def __init__(self, mesh: Optional[Mesh], hcg=None):
+        self._mesh = mesh
+        self._hcg = hcg
+
+    def __enter__(self):
+        self._prev = (_global["mesh"], _global["hcg"])
+        set_mesh(self._mesh, self._hcg)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _global["mesh"], _global["hcg"] = self._prev
+        return False
+
+
 def get_hcg() -> Optional[HybridCommunicateGroup]:
     return _global["hcg"]
 

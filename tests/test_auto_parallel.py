@@ -1,0 +1,176 @@
+"""Auto-parallel tests (distributed/auto_parallel/).
+
+VERDICT done-criterion: annotate a model with shard_tensor instead of using
+the TP layer classes and get the same sharded step. Reference:
+auto_parallel/engine.py:50, interface.py:34.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import Engine, ProcessMesh, shard_op, shard_tensor
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_mesh():
+    from paddle_tpu.parallel import topology as topo
+    from paddle_tpu.distributed import auto_parallel as ap
+
+    prev = dict(topo._global)
+    prev_pm = ap._default_process_mesh
+    yield
+    topo._global.update(prev)
+    ap._default_process_mesh = prev_pm
+
+
+def test_process_mesh_topology():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert pm.shape == [2, 4]
+    assert pm.processes == list(range(8))
+    m = pm.jax_mesh()
+    assert m.axis_names == ("x", "y")
+    assert m.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        ProcessMesh([[0, 0]])
+
+
+def test_shard_tensor_sets_spec_and_mesh():
+    pm = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+    lin = nn.Linear(8, 16)
+    dist.shard_tensor(lin.weight, {"process_mesh": pm, "dims_mapping": [-1, 1]})
+    assert lin.weight.dist_spec == (None, "mp")
+    # 2.4-style keyword form
+    dist.shard_tensor(lin.bias, process_mesh=pm, shard_spec=["mp"])
+    assert lin.bias.dist_spec == ("mp",)
+
+
+class MLP(nn.Layer):
+    """Plain Linears — TP comes only from shard_tensor annotations."""
+
+    def __init__(self, d=16, hidden=32, nclass=4):
+        super().__init__()
+        self.fc1 = nn.Linear(d, hidden)
+        self.fc2 = nn.Linear(hidden, nclass)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _annotate(model, pm):
+    # megatron-style: column-parallel fc1, row-parallel fc2
+    shard_tensor(model.fc1.weight, {"process_mesh": pm, "dims_mapping": [-1, 1]})
+    shard_tensor(model.fc1.bias, {"process_mesh": pm, "dims_mapping": [1]})
+    shard_tensor(model.fc2.weight, {"process_mesh": pm, "dims_mapping": [1, -1]})
+
+
+def test_engine_annotated_model_matches_single_device():
+    X = np.random.default_rng(0).normal(size=(4, 16, 16)).astype(np.float32)
+    Y = np.random.default_rng(1).integers(0, 4, (4, 16)).astype(np.int64)
+
+    def run(annotate):
+        paddle.seed(5)
+        model = MLP()
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=model.parameters()
+        )
+        if annotate:
+            pm = ProcessMesh(
+                np.arange(8).reshape(2, 4).tolist(), dim_names=["dpx", "mpx"]
+            )
+            _annotate(model, pm)
+            eng = Engine(model, process_mesh=pm)
+            eng.prepare(optimizer=opt, loss=F.cross_entropy)
+            data = [
+                (paddle.to_tensor(X[i]), paddle.to_tensor(Y[i]))
+                for i in range(4)
+            ]
+            return eng.fit(data, epochs=2), model, eng
+        losses = []
+        for _ in range(2):
+            for i in range(4):
+                loss = F.cross_entropy(model(paddle.to_tensor(X[i])),
+                                       paddle.to_tensor(Y[i]))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+        return losses, model, None
+
+    ref, _, _ = run(False)
+    got, model, eng = run(True)
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-6)
+    # fc1 weight physically sharded over the annotated mp dim
+    shards = {s.data.shape for s in model.fc1.weight._value.addressable_shards}
+    assert shards == {(16, 32 // 4)}
+
+
+def test_shard_op_constrains_outputs():
+    # no global mesh installed: shard_op must bind under its own mesh
+    pm = ProcessMesh(np.arange(8).reshape(2, 4).tolist(), dim_names=["a", "b"])
+    f = shard_op(
+        paddle.add,
+        {"process_mesh": pm, "out": {"dims_mapping": [0, -1]}},
+    )
+    x = paddle.ones([4, 6])
+    y = paddle.ones([4, 6])
+    out = f(x, y)
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones((4, 6)))
+    shards = {s.data.shape for s in out._value.addressable_shards}
+    assert shards == {(2, 6)}  # dim 0 split over the 2-wide "a" axis
+
+
+def test_engine_fit_before_prepare_raises_and_dataset_batching():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4).tolist(), dim_names=["dp", "mp"])
+    paddle.seed(5)
+    model = MLP()
+    eng = Engine(model, process_mesh=pm)
+    with pytest.raises(RuntimeError, match="prepare"):
+        eng.fit([(paddle.randn([8, 16]), paddle.randint(0, 4, [8]))])
+
+    import paddle_tpu.io as io
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(i)
+            return (rng.normal(size=16).astype(np.float32),
+                    np.int64(rng.integers(0, 4)))
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=model.parameters())
+    eng.prepare(optimizer=opt, loss=F.cross_entropy)
+    hist = eng.fit(DS(), batch_size=8, epochs=1)
+    assert len(hist) == 4  # 32 samples / batch 8
+    assert all(np.isfinite(v) for v in hist)
+
+
+def test_engine_save_load_roundtrip(tmp_path):
+    pm = ProcessMesh(np.arange(8).reshape(2, 4).tolist(), dim_names=["p", "q"])
+    paddle.seed(5)
+    model = MLP()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=model.parameters())
+    eng = Engine(model, process_mesh=pm)
+    eng.prepare(optimizer=opt, loss=F.cross_entropy)
+    x = paddle.randn([8, 16])
+    y = paddle.randint(0, 4, [8])
+    eng.fit([(x, y)], epochs=1)
+    path = str(tmp_path / "auto")
+    eng.save(path)
+
+    paddle.seed(9)
+    model2 = MLP()
+    opt2 = paddle.optimizer.Adam(learning_rate=1e-2, parameters=model2.parameters())
+    eng2 = Engine(model2, process_mesh=pm)
+    eng2.prepare(optimizer=opt2, loss=F.cross_entropy)
+    eng2.load(path)
+    np.testing.assert_allclose(
+        model2.fc1.weight.numpy(), model.fc1.weight.numpy(), rtol=1e-6
+    )
+    assert eng2.evaluate([(x, y)]) == pytest.approx(
+        eng.evaluate([(x, y)]), rel=1e-5
+    )
