@@ -1,0 +1,522 @@
+"""paddle.distribution — probability distributions.
+
+Reference analogue: python/paddle/distribution/ (Distribution base
+distribution.py, Normal normal.py:30, Uniform uniform.py, Categorical
+categorical.py, Beta/Dirichlet/Multinomial, kl.py kl_divergence:32 +
+register_kl:64 dispatch table, Independent/TransformedDistribution).
+
+TPU-native: sampling draws typed keys from the global threefry generator
+(core/random.py) so samples are reproducible under paddle.seed and inside
+jit traces; densities are pure jnp math through the dispatch tape, so
+log_prob is differentiable for score-function / reparameterized losses.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core import random as _random
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Multinomial", "Independent",
+    "kl_divergence", "register_kl",
+]
+
+
+def _t(x, dtype="float32") -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(np.asarray(x, dtype=np.float32 if dtype == "float32" else dtype))
+
+
+def _key():
+    return _random.next_key()
+
+
+class Distribution:
+    """reference: distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return paddle.exp(self.log_prob(value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """reference: normal.py:30."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, loc, scale):
+            return loc + scale * jax.random.normal(key, shape)
+
+        return apply(f, _key(), self.loc, self.scale, differentiable=False,
+                     op_name="normal_sample")
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, loc, scale):
+            return loc + scale * jax.random.normal(key, shape)
+
+        return apply(f, _key(), self.loc, self.scale, op_name="normal_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale ** 2
+        return (
+            -((value - self.loc) ** 2) / (2.0 * var)
+            - paddle.log(self.scale)
+            - 0.5 * math.log(2.0 * math.pi)
+        )
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2.0 * math.pi) + paddle.log(
+            self.scale * paddle.ones(list(self.batch_shape))
+        )
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Normal):
+            return kl_divergence(self, other)
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1.0 - paddle.log(var_ratio))
+
+
+class Uniform(Distribution):
+    """reference: uniform.py — U[low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape))))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, low, high):
+            return low + (high - low) * jax.random.uniform(key, shape)
+
+        return apply(f, _key(), self.low, self.high, differentiable=False,
+                     op_name="uniform_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = paddle.logical_and(value >= self.low, value < self.high)
+        lp = -paddle.log(self.high - self.low)
+        return paddle.where(
+            inside, lp * paddle.ones_like(value),
+            paddle.full_like(value, -float("inf")),
+        )
+
+    def entropy(self):
+        return paddle.log(self.high - self.low)
+
+
+class Categorical(Distribution):
+    """reference: categorical.py — parameterized by (unnormalized) logits."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("either logits or probs must be given")
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = paddle.log(_t(probs).clip(min=1e-38))
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self.num_events = self.logits.shape[-1]
+
+    @property
+    def probs_param(self):
+        return paddle.nn.functional.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, logits):
+            return jax.random.categorical(key, logits, shape=shape)
+
+        return apply(f, _key(), self.logits, differentiable=False,
+                     op_name="categorical_sample")
+
+    def log_prob(self, value):
+        value = _t(value, dtype="int64").astype("int64")
+        logp = paddle.nn.functional.log_softmax(self.logits, axis=-1)
+        # broadcast sample dims against batch dims (torch/paddle semantics)
+        bshape = list(jnp.broadcast_shapes(
+            tuple(value.shape), tuple(logp.shape[:-1])
+        ))
+        logp = paddle.broadcast_to(logp, bshape + [self.num_events])
+        value = paddle.broadcast_to(value, bshape)
+        return paddle.take_along_axis(
+            logp, value.unsqueeze(-1), axis=-1
+        ).squeeze(-1)
+
+    def probs(self, value):
+        return paddle.exp(self.log_prob(value))
+
+    def entropy(self):
+        logp = paddle.nn.functional.log_softmax(self.logits, axis=-1)
+        return -(paddle.exp(logp) * logp).sum(axis=-1)
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            return kl_divergence(self, other)
+        logp = paddle.nn.functional.log_softmax(self.logits, axis=-1)
+        logq = paddle.nn.functional.log_softmax(other.logits, axis=-1)
+        return (paddle.exp(logp) * (logp - logq)).sum(axis=-1)
+
+
+class Bernoulli(Distribution):
+    """reference: 2.4+ bernoulli.py (probs parameterization)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _t(probs)
+        super().__init__(tuple(self.probs_.shape))
+
+    @property
+    def mean(self):
+        return self.probs_
+
+    @property
+    def variance(self):
+        return self.probs_ * (1 - self.probs_)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, p):
+            return jax.random.bernoulli(key, p, shape).astype(jnp.float32)
+
+        return apply(f, _key(), self.probs_, differentiable=False,
+                     op_name="bernoulli_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        p = self.probs_.clip(min=1e-7, max=1 - 1e-7)
+        return value * paddle.log(p) + (1 - value) * paddle.log(1 - p)
+
+    def entropy(self):
+        p = self.probs_.clip(min=1e-7, max=1 - 1e-7)
+        return -(p * paddle.log(p) + (1 - p) * paddle.log(1 - p))
+
+
+class Beta(Distribution):
+    """reference: beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape))))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, a, b):
+            return jax.random.beta(key, a, b, shape)
+
+        return apply(f, _key(), self.alpha, self.beta, differentiable=False,
+                     op_name="beta_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, a, b):
+            return (
+                (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b))
+            )
+
+        return apply(f, value, self.alpha, self.beta, op_name="beta_log_prob")
+
+    def entropy(self):
+        def f(a, b):
+            from jax.scipy.special import digamma, gammaln
+
+            s = a + b
+            logB = gammaln(a) + gammaln(b) - gammaln(s)
+            return (
+                logB - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (s - 2) * digamma(s)
+            )
+
+        return apply(f, self.alpha, self.beta, op_name="beta_entropy")
+
+
+class Dirichlet(Distribution):
+    """reference: dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(
+            tuple(self.concentration.shape[:-1]),
+            tuple(self.concentration.shape[-1:]),
+        )
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(axis=-1, keepdim=True)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+
+        def f(key, c):
+            return jax.random.dirichlet(key, c, shape)
+
+        return apply(f, _key(), self.concentration, differentiable=False,
+                     op_name="dirichlet_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, c):
+            from jax.scipy.special import gammaln
+
+            return (
+                ((c - 1) * jnp.log(v)).sum(-1)
+                + gammaln(c.sum(-1)) - gammaln(c).sum(-1)
+            )
+
+        return apply(f, value, self.concentration, op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(c):
+            from jax.scipy.special import digamma, gammaln
+
+            a0 = c.sum(-1)
+            k = c.shape[-1]
+            logB = gammaln(c).sum(-1) - gammaln(a0)
+            return (
+                logB + (a0 - k) * digamma(a0)
+                - ((c - 1) * digamma(c)).sum(-1)
+            )
+
+        return apply(f, self.concentration, op_name="dirichlet_entropy")
+
+
+class Multinomial(Distribution):
+    """reference: multinomial.py — total_count trials over probs."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_ = _t(probs)
+        super().__init__(
+            tuple(self.probs_.shape[:-1]), tuple(self.probs_.shape[-1:])
+        )
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        n = self.total_count
+
+        def f(key, p):
+            logits = jnp.log(jnp.clip(p, 1e-38))
+            draws = jax.random.categorical(
+                key, logits, shape=(n,) + shape
+            )  # [n, ...]
+            k = p.shape[-1]
+            return jax.nn.one_hot(draws, k).sum(0)
+
+        return apply(f, _key(), self.probs_, differentiable=False,
+                     op_name="multinomial_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, p):
+            from jax.scipy.special import gammaln
+
+            logp = jnp.log(jnp.clip(p, 1e-38))
+            return (
+                gammaln(v.sum(-1) + 1.0) - gammaln(v + 1.0).sum(-1)
+                + (v * logp).sum(-1)
+            )
+
+        return apply(f, value, self.probs_, op_name="multinomial_log_prob")
+
+
+class Independent(Distribution):
+    """reference: independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(
+            bs[: len(bs) - self.rank],
+            bs[len(bs) - self.rank:] + tuple(base.event_shape),
+        )
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = e.sum(axis=-1)
+        return e
+
+
+# ---------------------------------------------------------------------------
+# KL dispatch (reference: kl.py:29 _REGISTER_TABLE + register_kl:64)
+# ---------------------------------------------------------------------------
+_REGISTER_TABLE: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(f):
+        _REGISTER_TABLE[(cls_p, cls_q)] = f
+        return f
+
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """reference: kl.py:32 — dispatch on the most specific registered pair."""
+    matches = [
+        (cp, cq)
+        for (cp, cq) in _REGISTER_TABLE
+        if isinstance(p, cp) and isinstance(q, cq)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})"
+        )
+    # most specific pair = earliest in each type's MRO
+    best = min(
+        matches,
+        key=lambda pair: (
+            type(p).__mro__.index(pair[0]),
+            type(q).__mro__.index(pair[1]),
+        ),
+    )
+    return _REGISTER_TABLE[best](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return paddle.log(q.high - q.low) - paddle.log(p.high - p.low)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = p.probs_.clip(min=1e-7, max=1 - 1e-7)
+    qp = q.probs_.clip(min=1e-7, max=1 - 1e-7)
+    return pp * (paddle.log(pp) - paddle.log(qp)) + (1 - pp) * (
+        paddle.log(1 - pp) - paddle.log(1 - qp)
+    )
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def f(a1, b1, a2, b2):
+        from jax.scipy.special import digamma, gammaln
+
+        logB1 = gammaln(a1) + gammaln(b1) - gammaln(a1 + b1)
+        logB2 = gammaln(a2) + gammaln(b2) - gammaln(a2 + b2)
+        return (
+            logB2 - logB1
+            + (a1 - a2) * digamma(a1)
+            + (b1 - b2) * digamma(b1)
+            + (a2 - a1 + b2 - b1) * digamma(a1 + b1)
+        )
+
+    return apply(f, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def f(c1, c2):
+        from jax.scipy.special import digamma, gammaln
+
+        a0 = c1.sum(-1)
+        return (
+            gammaln(a0) - gammaln(c1).sum(-1)
+            - gammaln(c2.sum(-1)) + gammaln(c2).sum(-1)
+            + ((c1 - c2) * (digamma(c1) - digamma(a0)[..., None])).sum(-1)
+        )
+
+    return apply(f, p.concentration, q.concentration, op_name="kl_dirichlet")

@@ -1,0 +1,99 @@
+"""paddle.fft — discrete Fourier transforms.
+
+Reference analogue: python/paddle/fft.py (wraps phi fft kernels backed by
+cuFFT/onemkl). TPU-native: thin dispatch over jnp.fft — XLA lowers FFTs
+natively; all functions run through the autograd tape (jax.vjp supplies the
+adjoint transforms the reference registers by hand).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    # paddle uses "backward"/"forward"/"ortho" like numpy
+    return norm or "backward"
+
+
+def _wrap1(jfn, name):
+    def f(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return apply(
+            lambda v, n, axis, norm: jfn(v, n=n, axis=axis, norm=norm),
+            x, n=n, axis=axis, norm=_norm(norm), op_name=name,
+        )
+
+    f.__name__ = name
+    return f
+
+
+def _wrap2(jfn, name):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        return apply(
+            lambda v, s, axes, norm: jfn(v, s=s, axes=axes, norm=norm),
+            x, s=tuple(s) if s is not None else None,
+            axes=tuple(axes), norm=_norm(norm), op_name=name,
+        )
+
+    f.__name__ = name
+    return f
+
+
+def _wrapn(jfn, name):
+    def f(x, s=None, axes=None, norm="backward", name_arg=None):
+        return apply(
+            lambda v, s, axes, norm: jfn(v, s=s, axes=axes, norm=norm),
+            x, s=tuple(s) if s is not None else None,
+            axes=tuple(axes) if axes is not None else None,
+            norm=_norm(norm), op_name=name,
+        )
+
+    f.__name__ = name
+    return f
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(
+        lambda v, axes: jnp.fft.fftshift(v, axes=axes), x,
+        axes=tuple(axes) if axes is not None else None, op_name="fftshift",
+    )
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(
+        lambda v, axes: jnp.fft.ifftshift(v, axes=axes), x,
+        axes=tuple(axes) if axes is not None else None, op_name="ifftshift",
+    )

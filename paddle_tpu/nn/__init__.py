@@ -26,10 +26,14 @@ from .layer.loss import (  # noqa: F401
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
-    LocalResponseNorm, SyncBatchNorm,
+    LocalResponseNorm, SpectralNorm, SyncBatchNorm,
 )
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AvgPool2D, MaxPool1D, MaxPool2D,
+)
+from .layer.rnn import (  # noqa: F401
+    GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN,
+    SimpleRNNCell,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,

@@ -188,6 +188,52 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """reference: nn/layer/norm.py SpectralNorm (phi spectral_norm kernel) —
+    normalize `weight` by its largest singular value, estimated with
+    `power_iters` rounds of power iteration on persistent u/v vectors."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm planned for a later round")
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        self._shape = list(weight_shape)
+        h = self._shape[dim]
+        w = 1
+        for i, s in enumerate(self._shape):
+            if i != dim:
+                w *= s
+        import paddle_tpu as _paddle
+
+        # persistent estimation state, refined every forward (reference keeps
+        # u/v as non-trainable persistables updated in place) — seeded from
+        # the global generator so paddle.seed governs it
+        self.register_buffer("weight_u", _paddle.randn([h]))
+        self.register_buffer("weight_v", _paddle.randn([w]))
+
+    def forward(self, weight):
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.dispatch import apply, no_grad
+        from ...core.tensor import Tensor
+
+        def f(wt, u, v, dim, power_iters, eps):
+            mat = jnp.moveaxis(wt, dim, 0).reshape(wt.shape[dim], -1)
+            for _ in range(power_iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return wt / sigma, jax.lax.stop_gradient(u), jax.lax.stop_gradient(v)
+
+        out, u, v = apply(
+            f, weight, self.weight_u, self.weight_v, dim=self.dim,
+            power_iters=self.power_iters, eps=self.eps, op_name="spectral_norm",
+        )
+        # refine the persistent estimate so sigma converges across forwards
+        with no_grad():
+            self.weight_u._value = u._value
+            self.weight_v._value = v._value
+        return out

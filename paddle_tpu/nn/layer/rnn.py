@@ -1,0 +1,413 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells, RNN/BiRNN wrappers).
+
+Reference analogue: python/paddle/nn/layer/rnn.py — SimpleRNNCell:263,
+LSTMCell:399 (gate order i,f,c,o), GRUCell:556 (r,z,c with reset applied
+after the hidden matmul), RNN:707/BiRNN:782 wrappers, RNNBase:861 with
+num_layers/direction/time_major/dropout and `{weight,bias}_{ih,hh}_l{k}`
+parameter naming.
+
+TPU-native: the time loop is one `lax.scan` per (layer, direction) — a
+single compiled XLA while-loop with static shapes — instead of the
+reference's per-step op dispatch / cuDNN descriptor path. Variable-length
+sequences are masked inside the scan (and reversed within their valid
+region for the backward direction), matching the reference's semantics of
+carrying the last valid state forward.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ...core.dispatch import apply, no_grad
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    """reference: rnn.py:139."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, tuple) and shape and isinstance(shape[0], (tuple, list)):
+            return tuple(
+                paddle.full([batch] + list(s), init_value, dtype or "float32")
+                for s in shape
+            )
+        return paddle.full([batch] + list(shape), init_value, dtype or "float32")
+
+
+def _init_cell_params(cell, input_size, hidden_size, gates,
+                      weight_ih_attr=None, weight_hh_attr=None,
+                      bias_ih_attr=None, bias_hh_attr=None):
+    std = 1.0 / np.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    cell.weight_ih = cell.create_parameter(
+        [gates * hidden_size, input_size], attr=weight_ih_attr,
+        default_initializer=u)
+    cell.weight_hh = cell.create_parameter(
+        [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+        default_initializer=u)
+    cell.bias_ih = (
+        None if bias_ih_attr is False else cell.create_parameter(
+            [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+    )
+    cell.bias_hh = (
+        None if bias_hh_attr is False else cell.create_parameter(
+            [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+    )
+
+
+class SimpleRNNCell(RNNCellBase):
+    """reference: rnn.py:263 — h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _init_cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        i2h = paddle.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            i2h = i2h + self.bias_ih
+        h2h = paddle.matmul(states, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h2h = h2h + self.bias_hh
+        act = paddle.tanh if self.activation == "tanh" else F.relu
+        h = act(i2h + h2h)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    """reference: rnn.py:399 — gate order i, f, c, o."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _init_cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h, pre_c = states
+        gates = paddle.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + paddle.matmul(pre_h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        gi, gf, gc, go = paddle.split(gates, 4, axis=-1)
+        i = F.sigmoid(gi)
+        f = F.sigmoid(gf)
+        o = F.sigmoid(go)
+        c = f * pre_c + i * paddle.tanh(gc)
+        h = o * paddle.tanh(c)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    """reference: rnn.py:556 — r/z/c gates, reset applied after the hidden
+    matmul: c = tanh(x_c + r·h_c); h = (h_prev − c)·z + c."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _init_cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        x_g = paddle.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            x_g = x_g + self.bias_ih
+        h_g = paddle.matmul(pre_h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h_g = h_g + self.bias_hh
+        x_r, x_z, x_c = paddle.split(x_g, 3, axis=-1)
+        h_r, h_z, h_c = paddle.split(h_g, 3, axis=-1)
+        r = F.sigmoid(x_r + h_r)
+        z = F.sigmoid(x_z + h_z)
+        c = paddle.tanh(x_c + r * h_c)
+        h = (pre_h - c) * z + c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+# ---------------------------------------------------------------------------
+# scan machinery
+# ---------------------------------------------------------------------------
+def _flatten_states(states):
+    return list(states) if isinstance(states, (tuple, list)) else [states]
+
+
+def _pack_states(flat, is_tuple):
+    return tuple(flat) if is_tuple else flat[0]
+
+
+class RNN(Layer):
+    """reference: rnn.py:707 — scan `cell` over the time axis (one lax.scan,
+    compiled; not a Python loop of per-step ops)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        cell = self.cell
+        if initial_states is None:
+            ref = inputs if not self.time_major else inputs.transpose([1, 0, 2])
+            initial_states = cell.get_initial_states(ref, cell.state_shape)
+        states_is_tuple = isinstance(initial_states, (tuple, list))
+        init_flat = _flatten_states(initial_states)
+        t_objs = [p for _, p in sorted(cell.named_parameters(),
+                                       key=lambda kv: kv[0])]
+        n_states = len(init_flat)
+        time_major = self.time_major
+        reverse = self.is_reverse
+        has_len = sequence_length is not None
+
+        def scan_fn(*vals):
+            from ...jit import _bind_values
+
+            pvals = vals[:len(t_objs)]
+            x = vals[len(t_objs)]
+            inits = vals[len(t_objs) + 1:len(t_objs) + 1 + n_states]
+            seq_len = vals[-1] if has_len else None
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            T = xs.shape[0]
+
+            def step(carry, t):
+                # reverse = scan positions T-1..0; with sequence_length the
+                # padded tail is masked, so the walk effectively starts at
+                # len-1 (reverse within the valid region, paddle semantics)
+                tt = (T - 1 - t) if reverse else t
+                xt = xs[tt]
+                with _bind_values(t_objs, list(pvals)), no_grad():
+                    out, new = cell(
+                        Tensor(xt, stop_gradient=True),
+                        (
+                            tuple(Tensor(c, stop_gradient=True) for c in carry)
+                            if states_is_tuple
+                            else Tensor(carry[0], stop_gradient=True)
+                        ),
+                    )
+                new_flat = [s._value for s in _flatten_states(new)]
+                out_v = out._value
+                if seq_len is not None:
+                    valid = (tt < seq_len)[:, None]  # [B, 1]
+                    new_flat = [
+                        jnp.where(valid, nv, cv)
+                        for nv, cv in zip(new_flat, carry)
+                    ]
+                    out_v = jnp.where(valid, out_v, jnp.zeros_like(out_v))
+                return tuple(new_flat), out_v
+
+            carry, outs = jax.lax.scan(step, tuple(inits), jnp.arange(T))
+            if reverse:
+                outs = outs[::-1]
+            outs = outs if time_major else jnp.swapaxes(outs, 0, 1)
+            return (outs,) + carry
+
+        # args must line up with t_objs (name-sorted), not creation order
+        args = list(t_objs) + [inputs] + init_flat
+        if has_len:
+            args.append(sequence_length)
+        res = apply(scan_fn, *args, op_name=f"rnn_{type(cell).__name__}")
+        outs = res[0]
+        final = _pack_states(res[1:], states_is_tuple)
+        return outs, final
+
+
+class BiRNN(Layer):
+    """reference: rnn.py:782 — forward + backward cells, concat outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        outs = paddle.concat([out_fw, out_bw], axis=-1)
+        return outs, (fin_fw, fin_bw)
+
+
+class RNNBase(Layer):
+    """reference: rnn.py:861 — stacks layers × directions."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        bidi = direction in ("bidirectional", "bidirect")
+        if not bidi and direction != "forward":
+            raise ValueError(
+                f"direction should be forward or bidirect, got {direction}"
+            )
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_directions = 2 if bidi else 1
+        self.time_major = time_major
+        self.dropout = dropout
+        self.state_components = 2 if mode == "LSTM" else 1
+        kwargs = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell}.get(mode, SimpleRNNCell)
+        if mode not in ("LSTM", "GRU"):
+            kwargs["activation"] = getattr(self, "activation", "tanh")
+
+        self._layers_list = []
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * self.num_directions
+            if bidi:
+                wrap = BiRNN(cell_cls(in_sz, hidden_size, **kwargs),
+                             cell_cls(in_sz, hidden_size, **kwargs), time_major)
+            else:
+                wrap = RNN(cell_cls(in_sz, hidden_size, **kwargs),
+                           time_major=time_major)
+            self.add_sublayer(str(i), wrap)
+            self._layers_list.append(wrap)
+        # reference parameter aliases: weight_ih_l0, bias_hh_l1_reverse, ...
+        for li, wrap in enumerate(self._layers_list):
+            cells = (
+                [(wrap.cell_fw, ""), (wrap.cell_bw, "_reverse")]
+                if bidi else [(wrap.cell, "")]
+            )
+            for cell, suffix in cells:
+                for pname in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                    p = getattr(cell, pname)
+                    if p is not None:
+                        object.__setattr__(self, f"{pname}_l{li}{suffix}", p)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        D, L, C = self.num_directions, self.num_layers, self.state_components
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        if initial_states is None:
+            init = [
+                paddle.zeros([L * D, batch, self.hidden_size])
+                for _ in range(C)
+            ]
+            initial_states = init[0] if C == 1 else tuple(init)
+        states = (
+            [initial_states] if C == 1 else list(initial_states)
+        )  # C × [L*D, B, H]
+
+        h = inputs
+        finals = [[] for _ in range(C)]  # per component, L*D entries in order
+        for li, wrap in enumerate(self._layers_list):
+            if D == 2:
+                def st(d):
+                    idx = li * D + d
+                    comp = [s[idx] for s in states]
+                    return tuple(comp) if C > 1 else comp[0]
+
+                h, (fin_fw, fin_bw) = wrap(h, (st(0), st(1)), sequence_length)
+                for fin in (fin_fw, fin_bw):
+                    for ci, s in enumerate(_flatten_states(fin)):
+                        finals[ci].append(s)
+            else:
+                comp = [s[li] for s in states]
+                h, fin = wrap(h, tuple(comp) if C > 1 else comp[0],
+                              sequence_length)
+                for ci, s in enumerate(_flatten_states(fin)):
+                    finals[ci].append(s)
+            if self.dropout > 0.0 and li < L - 1 and self.training:
+                h = F.dropout(h, self.dropout)
+        final_states = [paddle.stack(f, axis=0) for f in finals]
+        return h, (final_states[0] if C == 1 else tuple(final_states))
+
+
+class SimpleRNN(RNNBase):
+    """reference: rnn.py:1105."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        self.activation = activation
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(RNNBase):
+    """reference: rnn.py:1215."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    """reference: rnn.py:1329."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

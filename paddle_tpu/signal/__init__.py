@@ -1,0 +1,156 @@
+"""paddle.signal — STFT / ISTFT.
+
+Reference analogue: python/paddle/signal.py (frame/overlap_add ops +
+fft composition). TPU-native: framing is one strided gather and the FFT
+batch rides the XLA FFT lowering; everything is tape-recorded.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """reference: signal.py frame — split last axis into overlapping frames."""
+
+    if axis not in (0, -1):
+        raise ValueError("frame: axis must be 0 or -1 (paddle contract)")
+
+    def f(v, frame_length, hop_length, axis):
+        n = v.shape[axis]
+        if n < frame_length:
+            raise ValueError(
+                f"frame: input length {n} < frame_length {frame_length}"
+            )
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        if axis == -1:
+            framed = v[..., idx]                     # [..., num, frame_length]
+            return jnp.swapaxes(framed, -1, -2)      # [..., frame_length, num]
+        framed = v[idx]                              # [num, frame_length, ...]
+        return jnp.swapaxes(framed, 0, 1)            # [frame_length, num, ...]
+
+    return apply(f, x, frame_length=frame_length, hop_length=hop_length,
+                 axis=axis, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """reference: signal.py overlap_add — inverse of frame."""
+
+    if axis not in (0, -1):
+        raise ValueError("overlap_add: axis must be 0 or -1 (paddle contract)")
+
+    def f(v, hop_length, axis):
+        if axis == 0:  # [frame_length, num, ...] → canonical [..., fl, num]
+            v = jnp.moveaxis(jnp.swapaxes(v, 0, 1), (0, 1), (-1, -2))
+        fl, num = v.shape[-2], v.shape[-1]
+        n = (num - 1) * hop_length + fl
+        # one scatter-add over all frames (duplicate indices accumulate),
+        # not an O(num_frames) op loop
+        idx = (jnp.arange(num)[:, None] * hop_length + jnp.arange(fl)[None, :])
+        flat = jnp.swapaxes(v, -1, -2).reshape(v.shape[:-2] + (num * fl,))
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        out = out.at[..., idx.reshape(-1)].add(flat)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply(f, x, hop_length=hop_length, axis=axis, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: signal.py stft."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window._value if isinstance(window, Tensor) else window
+
+    def f(v, w, n_fft, hop_length, win_length, center, pad_mode, normalized,
+          onesided):
+        if w is None:
+            w = jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        if center:
+            pads = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pads, mode=pad_mode)
+        n = v.shape[-1]
+        if n < n_fft:
+            raise ValueError(f"stft: input length {n} < n_fft {n_fft}")
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * w  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames) if onesided else jnp.fft.fft(frames)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+
+    return apply(
+        f, x, win, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+        center=center, pad_mode=pad_mode, normalized=normalized,
+        onesided=onesided, op_name="stft",
+    )
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: signal.py istft (overlap-add with window envelope norm)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window._value if isinstance(window, Tensor) else window
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex requires onesided=False (paddle contract)"
+        )
+
+    def f(v, w, n_fft, hop_length, win_length, center, normalized, onesided,
+          length, return_complex):
+        if w is None:
+            w = jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        spec = jnp.swapaxes(v, -1, -2)  # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft)
+        else:
+            frames = jnp.fft.ifft(spec, n=n_fft)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w
+        num = frames.shape[-2]
+        n = (num - 1) * hop_length + n_fft
+        # single scatter-add for signal and window envelope
+        idx = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., idx].add(
+            frames.reshape(frames.shape[:-2] + (num * n_fft,))
+        )
+        env = jnp.zeros((n,), w.dtype).at[idx].add(
+            jnp.broadcast_to(w * w, (num, n_fft)).reshape(-1)
+        )
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply(
+        f, x, win, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+        center=center, normalized=normalized, onesided=onesided,
+        length=length, return_complex=return_complex, op_name="istft",
+    )

