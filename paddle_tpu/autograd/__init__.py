@@ -14,7 +14,21 @@ from ..core import dispatch
 from ..core.dispatch import GradNode, enable_grad, is_grad_enabled, no_grad  # noqa: F401
 from ..core.tensor import Tensor
 
-__all__ = ["grad", "backward", "PyLayer", "PyLayerContext", "no_grad", "enable_grad", "vjp", "jvp"]
+__all__ = [
+    "grad",
+    "backward",
+    "PyLayer",
+    "PyLayerContext",
+    "no_grad",
+    "enable_grad",
+    "vjp",
+    "jvp",
+    "Jacobian",
+    "Hessian",
+    "jacobian",
+    "hessian",
+    "functional",
+]
 
 
 def grad(
@@ -29,19 +43,25 @@ def grad(
     name=None,
 ):
     """paddle.grad (reference: fluid/dygraph/base.py grad) — returns grads of
-    `outputs` w.r.t. `inputs` without touching .grad."""
-    if create_graph:
-        raise NotImplementedError("create_graph=True (double grad) not yet supported")
+    `outputs` w.r.t. `inputs` without touching .grad.
+
+    With create_graph=True the backward computation is itself recorded on the
+    tape (see dispatch.run_backward), so the returned grads can be
+    differentiated again — the reference's double-grad op path.
+    """
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     single = isinstance(inputs, Tensor)
     inputs = [inputs] if single else list(inputs)
     if grad_outputs is not None and isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
     got = dispatch.run_backward(
         outputs,
         grad_outputs,
         retain_graph=bool(retain_graph),
         inputs=inputs,
+        create_graph=create_graph,
     )
     results = []
     for t in inputs:
@@ -53,6 +73,8 @@ def grad(
                     "(pass allow_unused=True to return None for it)"
                 )
             results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g if create_graph else Tensor(g._value, stop_gradient=True))
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results[0] if single else results
@@ -150,42 +172,5 @@ class PyLayer(metaclass=PyLayerMeta):
         return wired if is_seq else wired[0]
 
 
-def vjp(func, xs, v=None):
-    """Functional vjp (reference: python/paddle/autograd/functional.py)."""
-    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
-    vals = [x._value for x in xs_list]
-
-    def f(*a):
-        outs = func(*[Tensor(x, stop_gradient=True) for x in a])
-        return outs._value if isinstance(outs, Tensor) else tuple(o._value for o in outs)
-
-    out, vjp_fn = jax.vjp(f, *vals)
-    if v is None:
-        v_val = jnp.ones_like(out)
-    else:
-        v_val = v._value if isinstance(v, Tensor) else v
-    grads = vjp_fn(v_val)
-    wrap = lambda g: Tensor(g, stop_gradient=True)  # noqa: E731
-    out_t = Tensor(out, stop_gradient=True) if not isinstance(out, tuple) else [wrap(o) for o in out]
-    gs = [wrap(g) for g in grads]
-    return out_t, gs if isinstance(xs, (tuple, list)) else gs[0]
-
-
-def jvp(func, xs, v=None):
-    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
-    vals = [x._value for x in xs_list]
-
-    def f(*a):
-        outs = func(*[Tensor(x, stop_gradient=True) for x in a])
-        return outs._value if isinstance(outs, Tensor) else tuple(o._value for o in outs)
-
-    if v is None:
-        tangents = [jnp.ones_like(x) for x in vals]
-    else:
-        v_list = v if isinstance(v, (tuple, list)) else [v]
-        tangents = [t._value if isinstance(t, Tensor) else t for t in v_list]
-    out, jv = jax.jvp(f, tuple(vals), tuple(tangents))
-    wrap = lambda g: Tensor(g, stop_gradient=True)  # noqa: E731
-    out_t = wrap(out) if not isinstance(out, tuple) else [wrap(o) for o in out]
-    jv_t = wrap(jv) if not isinstance(jv, tuple) else [wrap(o) for o in jv]
-    return out_t, jv_t
+from . import functional  # noqa: E402
+from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vjp  # noqa: E402,F401

@@ -160,6 +160,7 @@ class GradNode:
 
     __slots__ = (
         "vjp_fn",
+        "primal_fn",
         "inputs",
         "out_avals",
         "out_is_seq",
@@ -169,6 +170,10 @@ class GradNode:
 
     def __init__(self, vjp_fn, inputs, out_avals, op_name, out_is_seq=None):
         self.vjp_fn = vjp_fn
+        # pure fn of the differentiable input values; when present, the
+        # backward sweep can re-derive the vjp *as a recorded tape op* so
+        # that create_graph=True (double grad) composes naturally
+        self.primal_fn = None
         # List[Edge] — differentiable inputs in vjp order
         self.inputs = [a if isinstance(a, Edge) else Edge(a) for a in inputs]
         self.out_avals = out_avals  # [(shape, dtype)] per output
@@ -291,6 +296,10 @@ def apply(
         op_name or getattr(fn, "__name__", "op"),
         out_is_seq=is_seq,
     )
+    # AMP-recast nodes can't re-derive a clean vjp (the cast lives outside
+    # partial_fn's dtype contract); everything else supports double grad
+    if all(vals[i].dtype == od for i, od in zip(diff_idx, orig_dtypes)):
+        node.primal_fn = partial_fn
     outs = []
     for i, o in enumerate(flat_outs):
         t = Tensor(o, stop_gradient=not _is_float_array(o))
@@ -338,6 +347,7 @@ def run_backward(
     retain_graph: bool = False,
     accumulate_into_grad: bool = True,
     inputs: Optional[Sequence] = None,
+    create_graph: bool = False,
 ):
     """Dependency-counted reverse sweep over the GradNode graph.
 
@@ -347,12 +357,33 @@ def run_backward(
     accumulate into leaf `.grad` (backward()) or collect grads for `inputs`
     (paddle.grad / eager general_grad).
     Returns a dict id(tensor)->grad value when `inputs` is given.
+
+    With `create_graph=True` every node's backward is itself re-derived from
+    the node's pure primal fn and *recorded on the tape* (as an `<op>_grad`
+    op), so the returned grads carry grad nodes and a second sweep computes
+    higher-order derivatives — the role of the reference's registered
+    double-grad ops (e.g. matmul_double_grad) without writing any of them.
     """
     from .tensor import Tensor
 
     roots: List[Tensor] = list(tensors)
     if grad_tensors is None:
         grad_tensors = [None] * len(roots)
+    if create_graph:
+        retain_graph = True
+
+    def _raw(g):
+        return g._value if isinstance(g, Tensor) else g
+
+    def _acc(a, g):
+        # accumulate cotangents; under create_graph keep the result on-tape
+        if a is None or (isinstance(a, int) and a == 0):
+            return g
+        if create_graph and (isinstance(a, Tensor) or isinstance(g, Tensor)):
+            a = a if isinstance(a, Tensor) else Tensor(a, stop_gradient=True)
+            g = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+            return apply(jnp.add, a, g, op_name="grad_accumulate")
+        return _raw(a) + _raw(g)
 
     # cotangent accumulation keyed by (id(node), out_index)
     cotangents: Dict[Tuple[int, int], Any] = {}
@@ -370,15 +401,15 @@ def run_backward(
                     f"got shape {tuple(t._value.shape)}"
                 )
             g = jnp.ones_like(t._value)
-        elif isinstance(g, Tensor):
+        elif isinstance(g, Tensor) and not create_graph:
             g = g._value
         if t._grad_node is not None:
             # non-leaf: capture for paddle.grad(inputs=...) AND keep flowing
             if want_inputs is not None and id(t) in want_inputs:
-                leaf_grads[id(t)] = leaf_grads.get(id(t), 0) + g
+                leaf_grads[id(t)] = _acc(leaf_grads.get(id(t)), g)
             key = (id(t._grad_node), t._out_index)
             node_by_id[id(t._grad_node)] = t._grad_node
-            cotangents[key] = cotangents.get(key, 0) + g
+            cotangents[key] = _acc(cotangents.get(key), g)
         else:
             _store_leaf(t, g)
 
@@ -388,19 +419,27 @@ def run_backward(
         g = _apply_hooks(t, g)
         if want_inputs is not None:
             if id(t) in want_inputs:
-                leaf_grads[id(t)] = leaf_grads.get(id(t), 0) + g
+                leaf_grads[id(t)] = _acc(leaf_grads.get(id(t)), g)
             return
         if accumulate_into_grad:
             if t.grad is None:
-                t.grad = Tensor(g, stop_gradient=True)
+                if isinstance(g, Tensor):
+                    t.grad = g if create_graph else Tensor(g._value, stop_gradient=True)
+                else:
+                    t.grad = Tensor(g, stop_gradient=True)
+            elif create_graph:
+                t.grad = _acc(t.grad, g)
             else:
-                t.grad._value = t.grad._value + g
+                t.grad._value = t.grad._value + _raw(g)
 
     def _apply_hooks(t: Tensor, g):
         for hook in t._backward_hooks:
-            out = hook(Tensor(g, stop_gradient=True))
+            g_t = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+            out = hook(g_t)
             if out is not None:
-                g = out._value if isinstance(out, Tensor) else out
+                g = out if isinstance(out, Tensor) and create_graph else (
+                    out._value if isinstance(out, Tensor) else out
+                )
         return g
 
     # ---- pass 1: discover reachable graph, count consumer edges per node
@@ -424,6 +463,28 @@ def run_backward(
 
     for t, g in zip(roots, grad_tensors):
         seed(t, g)
+
+    def _recorded_vjp(node: GradNode, cts):
+        """Run node's backward as a *recorded* tape op (`<op>_grad`).
+
+        Re-derives the vjp from node.primal_fn over the live input tensors
+        (in-place-mutated inputs would use their current values — same caveat
+        the reference guards with inplace_version counters) so the grad
+        computation itself lands on the tape and supports another sweep.
+        """
+        in_ts = [e.tensor for e in node.inputs]
+        ct_ts = [c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True) for c in cts]
+        n_in = len(in_ts)
+        primal = node.primal_fn
+        out_is_seq = node.out_is_seq
+
+        def grad_op(*vals):
+            ivals, cvals = vals[:n_in], vals[n_in:]
+            _, vfn = jax.vjp(primal, *ivals)
+            return tuple(vfn(tuple(cvals) if out_is_seq else cvals[0]))
+
+        out = apply(grad_op, *in_ts, *ct_ts, op_name=node.op_name + "_grad")
+        return out if isinstance(out, list) else [out]
 
     # ---- pass 2: execute ready nodes
     ready = [
@@ -449,11 +510,35 @@ def run_backward(
                 "trying to backward through the graph a second time "
                 "(set retain_graph=True to allow this)"
             )
-        in_grads = node.vjp_fn(cts if node.out_is_seq else cts[0])
+        if create_graph and node.primal_fn is not None:
+            in_grads = _recorded_vjp(node, cts)
+        else:
+            raw_cts = tuple(_raw(c) for c in cts)
+            in_grads = node.vjp_fn(raw_cts if node.out_is_seq else raw_cts[0])
+            if create_graph:
+                # no primal fn (PyLayer / AMP-recast): grads are correct but
+                # constant w.r.t. further differentiation
+                import warnings
+
+                warnings.warn(
+                    f"create_graph=True through op '{node.op_name}' (no pure "
+                    "primal available): its first-order grads are correct but "
+                    "treated as constants by any further differentiation",
+                    stacklevel=2,
+                )
+                in_grads = tuple(
+                    Tensor(g, stop_gradient=True)
+                    if g is not None
+                    and not (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+                    else g
+                    for g in in_grads
+                )
         if not retain_graph:
             node.vjp_fn = None
+            node.primal_fn = None
         for edge, g in zip(node.inputs, in_grads):
-            skip = g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+            gv = g._value if isinstance(g, Tensor) else g
+            skip = gv is None or (hasattr(gv, "dtype") and gv.dtype == jax.dtypes.float0)
             prod = edge.node
             if prod is None:
                 if not skip:
@@ -464,11 +549,11 @@ def run_backward(
                     # capture grads of requested intermediates (paddle.grad
                     # w.r.t. non-leaf tensors) while still propagating
                     if want_inputs is not None and id(edge.tensor) in want_inputs:
-                        leaf_grads[id(edge.tensor)] = (
-                            leaf_grads.get(id(edge.tensor), 0) + g
+                        leaf_grads[id(edge.tensor)] = _acc(
+                            leaf_grads.get(id(edge.tensor)), g
                         )
                     key = (id(prod), edge.out_index)
-                    cotangents[key] = cotangents.get(key, 0) + g
+                    cotangents[key] = _acc(cotangents.get(key), g)
                 # edge consumed regardless of whether a cotangent flowed
                 pending[id(prod)] -= 1
                 if pending[id(prod)] == 0:

@@ -1,0 +1,108 @@
+"""Higher-order autograd: create_graph double/triple grad + functional API.
+
+Reference analogue: python/paddle/fluid/tests/unittests/test_imperative_double_grad.py
+and autograd/test_autograd_functional_dynamic.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import autograd
+
+
+def test_double_grad_cubic():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([1, 4, 9], np.float32), rtol=1e-6)
+    assert not g.stop_gradient
+    (gg,) = paddle.grad([g.sum()], [x])
+    np.testing.assert_allclose(gg.numpy(), 6 * np.array([1, 2, 3], np.float32), rtol=1e-6)
+
+
+def test_triple_grad():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x ** 4).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)       # 4x^3 = 32
+    (g2,) = paddle.grad([g1.sum()], [x], create_graph=True)  # 12x^2 = 48
+    (g3,) = paddle.grad([g2.sum()], [x])                   # 24x = 48
+    np.testing.assert_allclose(g1.numpy(), [32.0], rtol=1e-6)
+    np.testing.assert_allclose(g2.numpy(), [48.0], rtol=1e-6)
+    np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-6)
+
+
+def test_double_grad_matmul_chain():
+    # d/dx of sum((x @ w)^2) and its grad w.r.t. w through create_graph
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((3, 4)).astype(np.float32)
+    wv = rng.standard_normal((4, 2)).astype(np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    y = paddle.matmul(x, w)
+    loss = (y * y).sum()
+    (gx,) = paddle.grad([loss], [x], create_graph=True)
+    # analytic: gx = 2 (x w) w^T
+    np.testing.assert_allclose(gx.numpy(), 2 * (xv @ wv) @ wv.T, rtol=1e-5)
+    (gw,) = paddle.grad([gx.sum()], [w])
+    # d/dw sum(2 x w w^T) — compare against jax-free numeric diff
+    eps = 1e-3
+    num = np.zeros_like(wv)
+    for i in range(wv.shape[0]):
+        for j in range(wv.shape[1]):
+            wp, wm = wv.copy(), wv.copy()
+            wp[i, j] += eps
+            wm[i, j] -= eps
+            num[i, j] = ((2 * (xv @ wp) @ wp.T).sum() - (2 * (xv @ wm) @ wm.T).sum()) / (2 * eps)
+    np.testing.assert_allclose(gw.numpy(), num, rtol=1e-2, atol=1e-2)
+
+
+def test_backward_after_create_graph_accumulates_leaf():
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = paddle.grad([y], [x], create_graph=True)
+    gg_loss = (g * g).sum()  # (2x)^2 = 4x^2 -> d/dx = 8x
+    gg_loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [24.0], rtol=1e-6)
+
+
+def test_hessian_quadratic():
+    A = np.array([[2.0, 1.0], [0.0, 3.0]], np.float32)
+
+    def f(x):
+        return paddle.matmul(paddle.matmul(x.reshape([1, 2]), paddle.to_tensor(A)), x.reshape([2, 1])).sum()
+
+    x = paddle.to_tensor(np.array([1.0, -1.0], np.float32), stop_gradient=False)
+    h = autograd.Hessian(f, x)
+    np.testing.assert_allclose(h[:].numpy(), A + A.T, rtol=1e-5)
+
+
+def test_jacobian():
+    def f(x):
+        return paddle.matmul(x, paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)))
+
+    x = paddle.to_tensor(np.array([[1.0, 0.0]], np.float32), stop_gradient=False)
+    j = autograd.Jacobian(f, x)
+    assert j.shape == (2, 2)
+    np.testing.assert_allclose(j[:].numpy(), np.array([[1.0, 3.0], [2.0, 4.0]], np.float32))
+
+
+def test_vjp_jvp():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    out, g = autograd.vjp(f, x)
+    np.testing.assert_allclose(out.numpy(), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0], rtol=1e-6)
+    out2, jv = autograd.jvp(f, x)
+    np.testing.assert_allclose(jv.numpy(), 6.0, rtol=1e-6)  # sum(2x * 1)
+
+
+def test_first_order_unchanged():
+    # no create_graph: grads are constants, second sweep refuses
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = paddle.grad([y], [x])
+    assert g.stop_gradient
+    with pytest.raises(RuntimeError):
+        paddle.grad([g.sum()], [x])
