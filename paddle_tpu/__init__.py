@@ -96,6 +96,7 @@ for _pkg in (
     "fft",
     "signal",
     "onnx",
+    "inference",
 ):
     try:
         globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)

@@ -1,0 +1,117 @@
+"""Shared StableHLO deployment-artifact format.
+
+One writer/reader pair used by paddle.jit.save/load, static.save/
+load_inference_model, and paddle.inference.Predictor — the TPU-native
+analogue of the reference's __model__ + params serialization
+(python/paddle/static/io.py, fluid/dygraph/io.py). An artifact is:
+
+  <prefix>.stablehlo  — the serialized jax.export program (weights first,
+                        then user inputs, in a fixed order)
+  <prefix>.pdmodel    — pickled metadata: ordered state list, input/output
+                        names, declared input shapes/dtypes
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def export_artifact(
+    pure_fn: Callable,
+    path_prefix: str,
+    input_names: Sequence[str],
+    input_shapes: Sequence[Sequence[Optional[int]]],
+    input_dtypes: Sequence,
+    state: Sequence = (),
+    output_names: Optional[Sequence[str]] = None,
+):
+    """Export pure_fn(*state, *inputs) and write both artifact files.
+
+    Declared input dims that are None/-1 are exported shape-polymorphically
+    (dim 0 as a shared "batch" symbol). If symbolic export fails, falls back
+    to pinning those dims to 1 — with a warning, and the metadata records
+    the concrete shapes actually exported so the predictor reports the truth.
+    """
+    from jax import export as jax_export
+
+    from .io_utils import save as _save_state
+
+    state = list(state)
+    state_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in state]
+
+    def build_specs(symbolic: bool):
+        scope = jax_export.SymbolicScope() if symbolic else None
+        specs = []
+        for i, (sh, dt) in enumerate(zip(input_shapes, input_dtypes)):
+            dims = [
+                ("batch" if j == 0 else f"dyn_{i}_{j}") if (d is None or d < 0) else str(d)
+                for j, d in enumerate(sh)
+            ]
+            if symbolic and any(not d.isdigit() for d in dims):
+                shape = jax_export.symbolic_shape(",".join(dims), scope=scope)
+            else:
+                shape = tuple(1 if not d.isdigit() else int(d) for d in dims)
+            specs.append(jax.ShapeDtypeStruct(shape, np.dtype(dt)))
+        return specs
+
+    dynamic = any(d is None or (isinstance(d, int) and d < 0) for sh in input_shapes for d in sh)
+    meta_shapes = [list(sh) for sh in input_shapes]
+    if dynamic:
+        try:
+            exp = jax_export.export(jax.jit(pure_fn))(*state_specs, *build_specs(True))
+        except Exception as e:
+            warnings.warn(
+                f"shape-polymorphic export failed ({type(e).__name__}: {e}); "
+                "falling back to pinning dynamic dims to 1 — the artifact will "
+                "only accept that exact shape"
+            )
+            specs = build_specs(False)
+            exp = jax_export.export(jax.jit(pure_fn))(*state_specs, *specs)
+            meta_shapes = [list(s.shape) for s in specs]
+    else:
+        exp = jax_export.export(jax.jit(pure_fn))(*state_specs, *build_specs(False))
+
+    with open(path_prefix + ".stablehlo", "wb") as f:
+        f.write(exp.serialize())
+
+    from ..core.tensor import Tensor
+
+    _save_state(
+        {
+            "n_state": len(state),
+            "state": [Tensor(v) for v in state],
+            "input_names": list(input_names),
+            "input_dtypes": [str(np.dtype(dt)) for dt in input_dtypes],
+            "input_shapes": meta_shapes,
+            "output_names": list(output_names)
+            if output_names is not None
+            else [f"output_{i}" for i in range(len(exp.out_avals))],
+        },
+        path_prefix + ".pdmodel",
+    )
+    return exp
+
+
+def load_artifact(path_prefix: str):
+    """Read both artifact files; returns (exported, state_arrays, meta)."""
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from ..core.tensor import Tensor
+    from .io_utils import load as _load_state
+
+    with open(path_prefix + ".stablehlo", "rb") as f:
+        exp = jax_export.deserialize(f.read())
+    meta = _load_state(path_prefix + ".pdmodel")
+    state = [
+        v._value if isinstance(v, Tensor) else jnp.asarray(v) for v in meta["state"]
+    ]
+    n_inputs = len(exp.in_avals) - len(state)
+    meta.setdefault("input_names", [f"input_{i}" for i in range(n_inputs)])
+    meta.setdefault("output_names", [f"output_{i}" for i in range(len(exp.out_avals))])
+    meta.setdefault("input_dtypes", [None] * n_inputs)
+    meta.setdefault("input_shapes", [None] * n_inputs)
+    return exp, state, meta

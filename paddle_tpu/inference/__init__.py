@@ -1,0 +1,282 @@
+"""paddle.inference — the deployment predictor API.
+
+Reference analogue: paddle/fluid/inference/api/analysis_predictor.h:90
+(AnalysisPredictor), paddle_analysis_config.h (AnalysisConfig), and the
+ZeroCopyTensor get/set handles (paddle_tensor.h). The reference pipeline is:
+load proto program + params → run ~40 IR analysis/fusion passes → execute on
+a naive/graph executor, optionally carving TensorRT subgraphs.
+
+TPU-native design: the "analysis" stage IS XLA — paddle.jit.save already
+exported the model as one StableHLO program (every fusion pass the reference
+hand-writes is an XLA pass), so the predictor only deserializes the program,
+binds the saved weights, and jit-executes. Zero-copy handles hold device
+arrays directly; `copy_from_cpu`/`copy_to_cpu` are the only host boundaries.
+Shape-polymorphic artifacts (batch-symbolic dims from jit.save) run any batch
+size without recompiling the artifact — XLA compiles once per concrete shape
+and caches.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Config",
+    "Predictor",
+    "Tensor",
+    "create_predictor",
+    "PrecisionType",
+    "PlaceType",
+]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kTPU = 2
+
+
+class Config:
+    """AnalysisConfig analogue (reference: paddle_analysis_config.h).
+
+    Construct from a model path prefix (the `path` given to paddle.jit.save /
+    static.save_inference_model). GPU/TensorRT/MKLDNN toggles are accepted
+    for script parity; on TPU they either map to the XLA path or no-op with
+    a warning.
+    """
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # accept either Config(prefix) or Config(prefix+".pdmodel", prefix+".pdparams")
+        prefix = prog_file or ""
+        for suffix in (".stablehlo", ".pdmodel", ".pdparams"):
+            if prefix.endswith(suffix):
+                prefix = prefix[: -len(suffix)]
+                break
+        self._prefix = prefix
+        self._device = "tpu"
+        self._memory_optim = True
+        self._ir_optim = True
+        self._threads = 1
+
+    # --- model location -------------------------------------------------
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        """Update the model location; other toggles keep their values."""
+        prefix = prog_file
+        for suffix in (".stablehlo", ".pdmodel", ".pdparams"):
+            if prefix.endswith(suffix):
+                prefix = prefix[: -len(suffix)]
+                break
+        if params_file is not None:
+            p = params_file
+            for suffix in (".stablehlo", ".pdmodel", ".pdparams"):
+                if p.endswith(suffix):
+                    p = p[: -len(suffix)]
+                    break
+            if p != prefix:
+                warnings.warn(
+                    f"params_file prefix {p!r} differs from prog_file prefix "
+                    f"{prefix!r}; paddle_tpu artifacts keep program and params "
+                    "under one prefix — using the prog_file prefix"
+                )
+        self._prefix = prefix
+
+    def model_dir(self) -> str:
+        return self._prefix
+
+    def prog_file(self) -> str:
+        return self._prefix + ".stablehlo"
+
+    def params_file(self) -> str:
+        return self._prefix + ".pdmodel"
+
+    # --- device selection -------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100, device_id: int = 0):
+        warnings.warn("enable_use_gpu: no GPU on this platform; using the default accelerator")
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def use_gpu(self) -> bool:
+        return False
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = n
+
+    # --- optimization toggles (XLA always optimizes; kept for parity) ------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        warnings.warn("TensorRT is not applicable on TPU; the XLA program is already fused")
+
+    def enable_mkldnn(self, *a, **k):
+        pass
+
+    def switch_use_feed_fetch_ops(self, flag: bool):
+        pass
+
+    def switch_specify_input_names(self, flag: bool = True):
+        pass
+
+    def summary(self) -> str:
+        return (
+            f"Config(prefix={self._prefix!r}, device={self._device}, "
+            f"ir_optim={self._ir_optim}, memory_optim={self._memory_optim})"
+        )
+
+
+class Tensor:
+    """Zero-copy IO handle (reference: paddle_tensor.h ZeroCopyTensor).
+
+    Holds a device array; copy_from_cpu uploads once, copy_to_cpu is the
+    only host read. Distinct from paddle.Tensor on purpose, mirroring the
+    reference's separate inference tensor type.
+    """
+
+    def __init__(self, name: str, dtype=None, shape=None):
+        self._name = name
+        self._value = None
+        self._dtype = np.dtype(dtype) if dtype is not None else None
+        self._declared_shape = shape
+
+    def name(self) -> str:
+        return self._name
+
+    def reshape(self, shape):
+        """Declare the upcoming input shape (reference keeps explicit reshape
+        before copy_from_cpu; here the copy itself fixes the shape, so this
+        only validates against the artifact's signature)."""
+        self._declared_shape = list(shape)
+
+    def copy_from_cpu(self, data):
+        arr = np.asarray(data)
+        if self._dtype is not None and arr.dtype != self._dtype:
+            arr = arr.astype(self._dtype)
+        self._value = jnp.asarray(arr)
+
+    def share_external_data(self, data):
+        # device arrays pass through without copy
+        self._value = data._value if hasattr(data, "_value") else jnp.asarray(data)
+
+    def copy_to_cpu(self):
+        if self._value is None:
+            raise RuntimeError(f"output handle '{self._name}' has no data; call run() first")
+        return np.asarray(jax.device_get(self._value))
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else list(self._declared_shape or [])
+
+    def type(self):
+        v = self._value
+        return str(v.dtype) if v is not None else str(self._dtype)
+
+
+class Predictor:
+    """AnalysisPredictor analogue over a StableHLO artifact.
+
+    reference call path (§3.6): CreatePredictor → Analyzer::Run pass pipeline
+    → executor loop. Here: deserialize → jax.jit(exported.call) → one XLA
+    execution per run(), weights resident on device.
+    """
+
+    def __init__(self, config: Config):
+        from ..framework.artifact import load_artifact
+
+        self._config = config
+        self._exported, self._state, meta = load_artifact(config._prefix)
+        if config._device == "cpu" and jax.default_backend() != "cpu":
+            # the artifact is lowered for the platform that exported it; a
+            # cross-platform retarget would need re-export, not a device_put
+            warnings.warn(
+                "disable_gpu(): the artifact runs on the platform it was "
+                "exported for; re-export on the target platform to retarget"
+            )
+        self._input_names: List[str] = list(meta["input_names"])
+        self._output_names: List[str] = list(meta["output_names"])
+        in_dtypes = meta.get("input_dtypes") or [None] * len(self._input_names)
+        in_shapes = meta.get("input_shapes") or [None] * len(self._input_names)
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n, dt, sh) for n, dt, sh in zip(self._input_names, in_dtypes, in_shapes)
+        }
+        self._outputs: Dict[str, Tensor] = {n: Tensor(n) for n in self._output_names}
+        self._call = jax.jit(self._exported.call)
+
+    # --- handles ---------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    # --- execution ---------------------------------------------------------
+    def run(self, inputs=None):
+        """Execute the program. Either set input handles beforehand, or pass
+        a list of numpy arrays in input order (newer reference API)."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs; the model has "
+                    f"{len(self._input_names)}: {self._input_names}"
+                )
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        vals = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._value is None:
+                raise RuntimeError(f"input '{n}' not set; call copy_from_cpu first")
+            vals.append(h._value)
+        out = self._call(*self._state, *vals)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n]._value = o
+        if inputs is not None:
+            return [np.asarray(jax.device_get(o)) for o in outs]
+        return True
+
+    def clone(self) -> "Predictor":
+        """Share the deserialized program + weights; fresh IO handles
+        (reference: AnalysisPredictor::Clone shares the scope/engine)."""
+        p = object.__new__(Predictor)
+        p._config = self._config
+        p._exported = self._exported
+        p._state = self._state
+        p._input_names = list(self._input_names)
+        p._output_names = list(self._output_names)
+        p._inputs = {n: Tensor(n, h._dtype, h._declared_shape) for n, h in self._inputs.items()}
+        p._outputs = {n: Tensor(n) for n in self._output_names}
+        p._call = self._call
+        return p
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor (inference/api/paddle_inference_api.h)."""
+    return Predictor(config)

@@ -441,13 +441,6 @@ def save(layer, path, input_spec=None, **configs):
         if input_spec is None:
             raise ValueError("paddle.jit.save requires input_spec")
 
-        specs = []
-        for s in input_spec:
-            shape = tuple(1 if (d is None or d < 0) else d for d in s.shape)
-            specs.append(
-                jax.ShapeDtypeStruct(shape, np.dtype(getattr(s, "dtype", "float32")))
-            )
-
         def pure(*flat):
             n = len(params) + len(buffers)
             svals, ivals = flat[:n], flat[n:]
@@ -456,35 +449,31 @@ def save(layer, path, input_spec=None, **configs):
                 out = fn(*ins)
             return _unwrap(out)
 
-        from jax import export as jax_export
+        from ..framework.artifact import export_artifact
 
-        state_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in state]
-        exp = jax_export.export(jax.jit(pure))(*state_specs, *specs)
-        with open(path + ".stablehlo", "wb") as f:
-            f.write(exp.serialize())
-        _save_state(layer.state_dict(), path + ".pdparams")
-        # the exported program binds params + ALL buffers (including
-        # non-persistable ones that state_dict omits) — persist the exact
-        # ordered state list alongside the program
-        _save_state(
-            {"n_state": len(state), "state": [Tensor(v) for v in state]},
-            path + ".pdmodel",
+        # shape-polymorphic export: None dims stay symbolic so the predictor
+        # can run any batch size from one artifact; the exported program
+        # binds params + ALL buffers (including non-persistable ones that
+        # state_dict omits) — artifact metadata keeps the ordered state list
+        export_artifact(
+            pure,
+            path,
+            input_names=[
+                getattr(s, "name", None) or f"input_{i}"
+                for i, s in enumerate(input_spec)
+            ],
+            input_shapes=[list(s.shape) for s in input_spec],
+            input_dtypes=[getattr(s, "dtype", "float32") for s in input_spec],
+            state=state,
         )
+        _save_state(layer.state_dict(), path + ".pdparams")
     else:
         raise TypeError("paddle.jit.save expects a Layer")
 
 
 def load(path, **configs):
     """paddle.jit.load — rebuild a TranslatedLayer."""
-    from jax import export as jax_export
+    from ..framework.artifact import load_artifact
 
-    from ..framework.io_utils import load as _load_state
-
-    with open(path + ".stablehlo", "rb") as f:
-        exp = jax_export.deserialize(f.read())
-    model_meta = _load_state(path + ".pdmodel")
-    state = [
-        t._value if isinstance(t, Tensor) else jnp.asarray(t)
-        for t in model_meta["state"]
-    ]
+    exp, state, _meta = load_artifact(path)
     return TranslatedLayer(exp, state)

@@ -216,17 +216,82 @@ class CompiledProgram:
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None, **kwargs):
-    """Delegates to the StableHLO export path (paddle_tpu.jit.save)."""
-    raise NotImplementedError(
-        "save_inference_model for builder Programs lands with the inference "
-        "predictor; use paddle.jit.save on a Layer for deployment artifacts"
+    """Export a builder Program as a StableHLO inference artifact.
+
+    reference: python/paddle/static/io.py save_inference_model (prunes the
+    program to feed→fetch and serializes __model__ + params). Here the
+    builder is traced to one XLA program with the feed vars as (batch-
+    symbolic where the declared dim is None/-1) inputs; weights the builder
+    closes over are baked into the artifact as constants — the reference's
+    params-in-__model__ combined form.
+    """
+    from ..framework.artifact import export_artifact
+
+    program = program or default_main_program()
+    if program.builder is None:
+        raise RuntimeError("save_inference_model requires a Program with a builder")
+    feed_vars = [feed_vars] if isinstance(feed_vars, Variable) else list(feed_vars)
+    fetch_vars = (
+        [fetch_vars] if not isinstance(fetch_vars, (list, tuple)) else list(fetch_vars)
+    )
+    names = [v.name for v in feed_vars]
+    builder = program.builder
+
+    def pure(*feed_vals):
+        d = {k: Tensor(v, stop_gradient=True) for k, v in zip(names, feed_vals)}
+        with no_grad():
+            out = builder(d)
+        if isinstance(out, (list, tuple)):
+            out = tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        else:
+            out = (out._value if isinstance(out, Tensor) else out,)
+        if len(out) != len(fetch_vars):
+            raise ValueError(
+                f"builder produced {len(out)} outputs but fetch_vars names "
+                f"{len(fetch_vars)}; the builder must return exactly the "
+                "fetch targets (prune inside the builder)"
+            )
+        return out
+
+    output_names = [
+        getattr(v, "name", None) or f"output_{i}" for i, v in enumerate(fetch_vars)
+    ]
+    export_artifact(
+        pure,
+        path_prefix,
+        input_names=names,
+        input_shapes=[list(v.shape) for v in feed_vars],
+        input_dtypes=[v.dtype for v in feed_vars],
+        state=[],
+        output_names=output_names,
     )
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "use paddle.jit.load for StableHLO inference artifacts"
-    )
+    """Load a StableHLO inference artifact into a runnable Program.
+
+    Returns [program, feed_target_names, fetch_target_names] exactly like the
+    reference (static/io.py load_inference_model); run it with
+    Executor.run(program, feed={...}, fetch_list=fetch_targets).
+    """
+    from ..framework.artifact import load_artifact
+
+    exp, state, meta = load_artifact(path_prefix)
+    in_names = list(meta["input_names"])
+    out_names = list(meta["output_names"])
+    call = jax.jit(exp.call)
+
+    def builder(feed: Dict[str, Tensor]):
+        vals = [feed[k]._value for k in in_names]
+        out = call(*state, *vals)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return [Tensor(o, stop_gradient=True) for o in outs]
+
+    program = Program()
+    program.set_builder(builder)
+    for n, sh, dt in zip(in_names, meta.get("input_shapes", []), meta.get("input_dtypes", [])):
+        program.feed_vars[n] = Variable(n, sh or [], dt)
+    return [program, in_names, out_names]
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -1,0 +1,102 @@
+"""Inference predictor + save/load_inference_model.
+
+Reference analogue: paddle/fluid/inference/tests/api/ (AnalysisPredictor
+tests) and test_inference_model_io.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, static
+from paddle_tpu.jit import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return paddle.nn.functional.softmax(self.fc2(paddle.tanh(self.fc1(x))), axis=-1)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(7)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path_factory.mktemp("infer") / "smallnet")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32", name="x")])
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    expected = net(paddle.to_tensor(x)).numpy()
+    return path, x, expected
+
+
+def test_predictor_handles_roundtrip(saved_model):
+    path, x, expected = saved_model
+    config = inference.Config(path)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(x)
+    assert predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_batch_polymorphic(saved_model):
+    # the artifact was exported with a symbolic batch dim — a different
+    # batch size must run without re-export
+    path, _, _ = saved_model
+    predictor = inference.create_predictor(inference.Config(path))
+    x7 = np.random.default_rng(1).standard_normal((7, 8)).astype(np.float32)
+    outs = predictor.run([x7])
+    assert outs[0].shape == (7, 4)
+    np.testing.assert_allclose(outs[0].sum(axis=-1), np.ones(7), rtol=1e-5)
+
+
+def test_predictor_clone_independent_io(saved_model):
+    path, x, expected = saved_model
+    p1 = inference.create_predictor(inference.Config(path))
+    p2 = p1.clone()
+    p1.get_input_handle("x").copy_from_cpu(x)
+    p1.run()
+    # p2's handles are fresh
+    with pytest.raises(RuntimeError):
+        p2.run()
+    np.testing.assert_allclose(
+        p1.get_output_handle("output_0").copy_to_cpu(), expected, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_static_save_load_inference_model(tmp_path):
+    paddle.seed(3)
+    net = SmallNet()
+    net.eval()
+
+    prog = static.Program()
+    x_var = None
+    with static.program_guard(prog):
+        x_var = static.data("x", [None, 8], "float32")
+    prog.set_builder(lambda feed: net(feed["x"]))
+
+    exe = static.Executor()
+    path = str(tmp_path / "static_model")
+    static.save_inference_model(path, [x_var], [None], exe, program=prog)
+
+    loaded, feed_names, fetch_names = static.load_inference_model(path, exe)
+    assert feed_names == ["x"]
+    x = np.random.default_rng(2).standard_normal((5, 8)).astype(np.float32)
+    (out,) = exe.run(loaded, feed={"x": x}, fetch_list=fetch_names)
+    expected = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_artifact_loads_via_load_inference_model(saved_model):
+    path, x, expected = saved_model
+    exe = static.Executor()
+    loaded, feed_names, fetch_names = static.load_inference_model(path, exe)
+    (out,) = exe.run(loaded, feed={"x": x}, fetch_list=fetch_names)
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
