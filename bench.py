@@ -8,8 +8,14 @@ commonly reported reference-framework GPT-2 345M per-accelerator pretraining
 throughput on the A100-class hardware the reference targets. value/20000 > 1
 means this framework on one TPU v5e chip beats that proxy.
 
+Also measures (as '#'-prefixed stderr/commented stdout lines, keeping the
+one-JSON-line stdout contract):
+  - BASELINE config 2: ResNet-50 AMP-O2 imgs/sec/chip (synthetic data)
+  - BASELINE config 1: MNIST LeNet eager-dispatch steps/sec (per-op path)
+
 Env knobs: BENCH_STEPS (default 10), BENCH_BATCH (default 8),
-BENCH_SEQ (default 1024), BENCH_MODEL (345m|small|tiny).
+BENCH_SEQ (default 1024), BENCH_MODEL (345m|small|tiny),
+BENCH_EXTRA=0 to skip the ResNet/MNIST configs.
 """
 import json
 import os
@@ -17,6 +23,70 @@ import sys
 import time
 
 import numpy as np
+
+
+def bench_resnet50(steps=8, bsz=64):
+    """BASELINE config 2: ResNet-50, AMP O2 bf16, compiled train step."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = paddle.amp.decorate(resnet50(num_classes=1000), level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    step = paddle.jit.compile_train_step(
+        model, lambda out, y: loss_fn(out.astype("float32"), y), opt
+    )
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(rng.standard_normal((bsz, 3, 224, 224)), jnp.float32))
+    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, (bsz,)), jnp.int64))
+    xt = paddle.Tensor(x, stop_gradient=True)
+    yt = paddle.Tensor(y, stop_gradient=True)
+    float(step(xt, yt))  # compile
+    float(step(xt, yt))
+    t0 = time.time()
+    last = None
+    for _ in range(steps):
+        last = step(xt, yt)
+    float(last)
+    dt = time.time() - t0
+    return {"metric": "resnet50_amp_o2_imgs_per_sec_per_chip",
+            "value": round(bsz * steps / dt, 1), "unit": "imgs/s/chip"}
+
+
+def bench_mnist_eager(steps=30, bsz=64):
+    """BASELINE config 1: LeNet MNIST pure-eager — per-op dispatch overhead."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((bsz, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (bsz,)))
+    # warmup (per-op jit caches fill)
+    for _ in range(3):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    float(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    float(loss)
+    dt = time.time() - t0
+    return {"metric": "mnist_lenet_eager_steps_per_sec",
+            "value": round(steps / dt, 1), "unit": "steps/s"}
 
 
 def main():
@@ -93,7 +163,17 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps / baseline, 3),
     }
-    print(json.dumps(result))
+    # primary result first: a hard failure in the extra configs must not
+    # lose the main measurement (one-JSON-line stdout contract)
+    print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_EXTRA", "1") == "1":
+        for name, fn in (("resnet50", bench_resnet50), ("mnist", bench_mnist_eager)):
+            try:
+                extra = fn()
+                print(f"# config {name}: {json.dumps(extra)}", file=sys.stderr)
+            except Exception as e:
+                print(f"# config {name} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+
     print(
         f"# {which}: {steps} steps x {tokens_per_step} tok in {dt:.2f}s "
         f"({dt/steps*1000:.0f} ms/step); first loss {first_loss:.3f} -> "

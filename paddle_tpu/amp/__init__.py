@@ -30,7 +30,7 @@ __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "amp_guard", "is_b
 # low precision (matmul/conv heavy) vs ops that must stay fp32
 WHITE_LIST = {
     "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
-    "conv2d_transpose", "einsum", "sdpa",
+    "conv2d_transpose", "einsum", "sdpa", "flash_sdpa",
 }
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
@@ -111,8 +111,36 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
     if level == "O2":
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        def _wrap_forward(m):
+            orig = m.forward
+
+            def fwd(*args, **kw):
+                # pure-low-precision mode casts floating inputs at model
+                # entry (reference: amp O2 "pure fp16" input cast) — conv
+                # and other dtype-strict ops need input dtype == param dtype
+                def _cast(a):
+                    if (
+                        isinstance(a, Tensor)
+                        and jnp.issubdtype(a._value.dtype, jnp.floating)
+                        and str(a._value.dtype) != dtype
+                    ):
+                        return a.astype(dtype)
+                    return a
+
+                return orig(
+                    *[_cast(a) for a in args],
+                    **{k: _cast(v) for k, v in kw.items()},
+                )
+
+            m.forward = fwd
+
         for m in model_list:
             m.to(dtype=dtype)
+            _wrap_forward(m)
     if optimizers is None:
         return models if single else model_list
     return (models if single else model_list), optimizers

@@ -83,6 +83,12 @@ define_flag(
     "use_standalone_executor", True, "use the compiled whole-program executor path"
 )
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
+define_flag(
+    "use_flash_attention",
+    True,
+    "route scaled_dot_product_attention through the Pallas flash kernel "
+    "when shapes/mask allow (fused_attention_op.cu analogue)",
+)
 define_flag("init_allocated_mem", False, "compat: poison fresh allocations")
 define_flag(
     "allocator_strategy", "auto_growth", "compat: allocator strategy name (XLA owns HBM)"

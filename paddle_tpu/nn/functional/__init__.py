@@ -633,6 +633,28 @@ def scaled_dot_product_attention(
     dropout_key = (
         _random.next_key() if (dropout_p > 0.0 and training) else None
     )
+    # pick the lowering HERE (not inside the op) so the per-op jit cache
+    # keys on distinct function objects and FLAGS_use_flash_attention
+    # toggles take effect immediately
+    from ...core import flags as _flags
+    from ...parallel import topology as _topo
+
+    # a pallas_call has no GSPMD partitioning rule: under a >1-device mesh
+    # XLA would replicate q/k/v (all-gathering sharded batch/seq/heads), so
+    # sharded programs keep the dense einsum path, which GSPMD partitions.
+    _mesh = _topo.get_mesh()
+    _single_device = _mesh is None or _mesh.devices.size == 1
+    if (
+        _flags.flag("use_flash_attention")
+        and _single_device
+        and attn_mask is None
+        and dropout_key is None
+        and _nn.flash_attention_eligible(query.shape, key.shape, value.shape)
+    ):
+        return apply(
+            _nn.flash_scaled_dot_product_attention, query, key, value,
+            is_causal=is_causal, op_name="flash_sdpa",
+        )
     return apply(
         _nn.scaled_dot_product_attention, query, key, value, attn_mask,
         dropout_key, is_causal=is_causal, dropout_p=dropout_p, op_name="sdpa",

@@ -566,7 +566,12 @@ def scaled_dot_product_attention(
 ):
     """q,k,v: [batch, seq, heads, head_dim] (paddle fused_attention layout).
     Attention dropout applies to the probabilities when dropout_key is given
-    (the functional wrapper threads a key only in training)."""
+    (the functional wrapper threads a key only in training).
+
+    The flash hot path lives in flash_scaled_dot_product_attention below —
+    selection happens in the functional wrapper (nn/functional) so the
+    per-op jit cache never mixes the two lowerings.
+    """
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d**0.5)
     qf = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
@@ -585,6 +590,29 @@ def scaled_dot_product_attention(
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
     return jnp.swapaxes(out, 1, 2)
+
+
+def flash_scaled_dot_product_attention(q, k, v, *, scale=None, is_causal=False):
+    """Pallas flash kernel path (ops/pallas/flash_attention.py — the
+    fused_attention_op.cu replacement): O(S·D) memory instead of the O(S²)
+    probability matrix, which is what makes long-seq training fit in HBM.
+    No mask/dropout support — the functional wrapper falls back to the dense
+    path for those."""
+    from .pallas import flash_attention as _flash
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d**0.5)
+    return _flash(q, k, v, scale=s, causal=is_causal)
+
+
+def flash_attention_eligible(q_shape, k_shape, v_shape) -> bool:
+    from .pallas.flash_attention import supports as _supports
+
+    return (
+        tuple(q_shape) == tuple(k_shape) == tuple(v_shape)
+        and len(q_shape) == 4
+        and _supports(q_shape[1], q_shape[3])
+    )
 
 
 # ---------------------------------------------------------------------------

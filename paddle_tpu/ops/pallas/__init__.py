@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the hand-written hot ops XLA can't fuse well.
+
+Reference analogue: paddle/fluid/operators/fused/ (22k LoC of CUDA fused
+kernels: fused_attention_op.cu, fmha_ref.h, fused_feedforward). On TPU the
+bulk of that directory is unnecessary (XLA fuses elementwise chains into
+matmuls); what remains worth hand-writing is flash attention — the one op
+whose naive form materializes an O(S²) intermediate.
+"""
+from .flash_attention import flash_attention  # noqa: F401
