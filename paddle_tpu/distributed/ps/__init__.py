@@ -1,0 +1,218 @@
+"""Parameter-server sparse path: host-RAM embedding tables + TPU dense math.
+
+Reference analogue:
+  - paddle/fluid/distributed/ps/table/memory_sparse_table.cc — sharded
+    host-RAM embedding store with optimizer-on-push accessors (our C++
+    twin: csrc/memory_sparse_table.cc, built JIT via utils.cpp_extension);
+  - python/paddle/distributed/ps/the_one_ps.py:816 (TheOnePSRuntime) —
+    table lifecycle / init_server / init_worker;
+  - paddle/fluid/operators/pscore/distributed_lookup_table_op.cc — the
+    lookup op trainers call.
+
+TPU-native design: the reference shards tables across brpc PS server
+processes; here the table is an in-process C++ store (single-host worker
+first — the multi-host extension shards keys across hosts by the same
+shard hash and moves pull/push over the network). The TPU never sees the
+full table: each step pulls the minibatch's rows (host→device upload),
+computes densely, and pushes the touched-row grads back where the C++
+accessor applies SGD/AdaGrad — exactly the reference's split of labor.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ...core.dispatch import GradNode, is_grad_enabled, no_grad
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+
+__all__ = ["MemorySparseTable", "SparseEmbedding", "TheOnePSRuntime"]
+
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is None:
+        from ...utils import cpp_extension
+
+        src = os.path.join(os.path.dirname(__file__), "csrc", "memory_sparse_table.cc")
+        _lib = cpp_extension.load("ps_table", [src])
+        _lib.ps_table_create.restype = ctypes.c_void_p
+        _lib.ps_table_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+        ]
+        _lib.ps_table_destroy.argtypes = [ctypes.c_void_p]
+        _lib.ps_table_pull.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        _lib.ps_table_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        _lib.ps_table_size.restype = ctypes.c_int64
+        _lib.ps_table_size.argtypes = [ctypes.c_void_p]
+        _lib.ps_table_save.restype = ctypes.c_int
+        _lib.ps_table_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib.ps_table_load.restype = ctypes.c_int
+        _lib.ps_table_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib.ps_table_set_lr.argtypes = [ctypes.c_void_p, ctypes.c_float]
+    return _lib
+
+
+_OPT_IDS = {"sgd": 0, "adagrad": 1}
+
+
+class MemorySparseTable:
+    """ctypes facade over the C++ sharded table."""
+
+    def __init__(self, emb_dim: int, shard_num: int = 16, optimizer: str = "adagrad",
+                 learning_rate: float = 0.05, init_range: float = 0.01,
+                 seed: int = 0):
+        if optimizer not in _OPT_IDS:
+            raise ValueError(f"optimizer must be one of {sorted(_OPT_IDS)}")
+        self.emb_dim = emb_dim
+        self._lib = _load_lib()
+        self._h = self._lib.ps_table_create(
+            emb_dim, shard_num, _OPT_IDS[optimizer],
+            ctypes.c_float(learning_rate), ctypes.c_float(init_range),
+            ctypes.c_uint64(seed),
+        )
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ps_table_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def pull(self, keys: np.ndarray, create: bool = True) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        out = np.empty((keys.size, self.emb_dim), np.float32)
+        self._lib.ps_table_pull(
+            self._h, keys.ctypes.data, keys.size, out.ctypes.data,
+            1 if create else 0,
+        )
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(
+            keys.size, self.emb_dim
+        )
+        self._lib.ps_table_push(self._h, keys.ctypes.data, keys.size, grads.ctypes.data)
+
+    def set_lr(self, lr: float):
+        self._lib.ps_table_set_lr(self._h, ctypes.c_float(lr))
+
+    def __len__(self):
+        return int(self._lib.ps_table_size(self._h))
+
+    def save(self, path: str):
+        if self._lib.ps_table_save(self._h, path.encode()) != 0:
+            raise IOError(f"saving sparse table to {path} failed")
+
+    def load(self, path: str):
+        if self._lib.ps_table_load(self._h, path.encode()) != 0:
+            raise IOError(f"loading sparse table from {path} failed")
+
+
+class SparseEmbedding(Layer):
+    """Embedding whose weights live in the host PS table, not on the chip.
+
+    reference: paddle.static.nn.sparse_embedding lowering to
+    distributed_lookup_table / distributed_push_sparse ops. Forward pulls the
+    minibatch rows (create-on-miss) and uploads one [N, dim] block; backward
+    pushes the row grads straight into the table, where the C++ accessor
+    applies the per-feature optimizer — so `optimizer.step()` never sees
+    these weights (exactly the PS division of labor: trainer computes,
+    server updates).
+    """
+
+    def __init__(self, size, shard_num: int = 16, optimizer: str = "adagrad",
+                 learning_rate: float = 0.05, init_range: float = 0.01,
+                 seed: int = 0, table: Optional[MemorySparseTable] = None):
+        super().__init__()
+        # paddle signature: size = [vocab, emb_dim]; vocab is advisory (the
+        # table is a hash map — any int64 feature id works, like the ref)
+        self.emb_dim = int(size[1])
+        self.table = table or MemorySparseTable(
+            self.emb_dim, shard_num, optimizer, learning_rate, init_range, seed
+        )
+
+    def forward(self, ids: Tensor) -> Tensor:
+        ids_np = np.asarray(ids.numpy(), np.int64)
+        flat = ids_np.reshape(-1)
+        rows = self.table.pull(flat, create=self.training)
+        out_np = rows.reshape(*ids_np.shape, self.emb_dim)
+        out = Tensor(out_np, stop_gradient=True)
+        if not (is_grad_enabled() and self.training):
+            return out
+
+        table = self.table
+
+        def vjp_fn(ct):
+            # ct: device grad for the pulled block. Merge duplicate ids
+            # first (one optimizer update per feature per step — the
+            # trainer-side grad merge the reference does before push) then
+            # push to the host table; nothing flows further (ids are ints).
+            g = np.asarray(ct, np.float32).reshape(flat.size, table.emb_dim)
+            uniq, inv = np.unique(flat, return_inverse=True)
+            merged = np.zeros((uniq.size, table.emb_dim), np.float32)
+            np.add.at(merged, inv, g)
+            table.push(uniq, merged)
+            return ()
+
+        node = GradNode(
+            vjp_fn, [], [(tuple(out_np.shape), np.dtype(np.float32))],
+            "sparse_embedding_push",
+        )
+        out.stop_gradient = False
+        out._grad_node = node
+        out._out_index = 0
+        return out
+
+
+class TheOnePSRuntime:
+    """Single-host TheOnePS runtime (reference: ps/the_one_ps.py:816).
+
+    Owns the named tables; init_server/init_worker collapse to in-process
+    setup on one host. save/load persist every table to a directory —
+    the reference's save_persistables for sparse tables.
+    """
+
+    def __init__(self):
+        self._tables = {}
+
+    def create_table(self, name: str, emb_dim: int, **kwargs) -> MemorySparseTable:
+        if name in self._tables:
+            raise ValueError(f"table {name!r} already exists")
+        t = MemorySparseTable(emb_dim, **kwargs)
+        self._tables[name] = t
+        return t
+
+    def get_table(self, name: str) -> MemorySparseTable:
+        return self._tables[name]
+
+    def _init_server(self, *args, **kwargs):
+        pass  # in-process tables need no server bootstrap on one host
+
+    def _init_worker(self, *args, **kwargs):
+        pass
+
+    def _stop_worker(self):
+        pass
+
+    def save_persistables(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self._tables.items():
+            t.save(os.path.join(dirname, f"{name}.sparse"))
+
+    def load_persistables(self, dirname: str):
+        for name, t in self._tables.items():
+            t.load(os.path.join(dirname, f"{name}.sparse"))

@@ -295,10 +295,16 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def supports(seq_len: int, head_dim: int, block_q: int = 512, block_k: int = 1024) -> bool:
-    """Shapes the kernel accepts (everything else falls back to the XLA path)."""
+    """Shapes the kernel accepts (everything else falls back to the XLA path).
+
+    The kernel covers the sequence either with one full-array block
+    (seq <= block) or with an exact tiling — a seq that is neither would
+    leave tail rows unwritten, so it must be rejected here."""
+    bq = min(block_q, seq_len)
+    bk = min(block_k, seq_len)
     return (
-        seq_len % min(block_q, seq_len) == 0
-        and seq_len % min(block_k, seq_len) == 0
+        seq_len % bq == 0
+        and seq_len % bk == 0
         and seq_len >= 8
         and head_dim % 8 == 0
     )
@@ -311,6 +317,12 @@ def flash_attention(q, k, v, *, scale=None, causal=True, block_q=512, block_k=10
     b, s, h, d = q.shape
     bq = min(block_q, s)
     bk = min(block_k, s)
+    if s % bq != 0 or s % bk != 0:
+        raise ValueError(
+            f"flash_attention: seq_len {s} is not divisible by block sizes "
+            f"({bq}, {bk}) — tail rows would be left unwritten; pad the "
+            "sequence or use the dense path"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 

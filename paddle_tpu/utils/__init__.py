@@ -1,0 +1,42 @@
+"""paddle.utils — build toolchain + small utilities.
+
+Reference analogue: python/paddle/utils/ (cpp_extension JIT build, dlpack
+convert, deprecated decorator, download).
+"""
+from . import cpp_extension  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def deprecated(update_to="", since="", reason=""):
+    """reference: python/paddle/utils/deprecated.py — warn-once decorator."""
+    import functools
+    import warnings
+
+    def decorate(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not warned:
+                warned.append(True)
+                msg = f"API {fn.__qualname__} is deprecated"
+                if since:
+                    msg += f" since {since}"
+                if update_to:
+                    msg += f"; use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorate

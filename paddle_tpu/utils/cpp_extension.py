@@ -1,0 +1,74 @@
+"""JIT build toolchain for native (C++) framework components and user ops.
+
+Reference analogue: python/paddle/utils/cpp_extension/ (setup/load: compiles
+user C++/CUDA to a shared object and loads the ops). TPU-native design: the
+device code path is XLA/Pallas, so native extensions here are *host* C++
+(runtime components, PS tables, data pipelines, custom host ops) built with
+g++ and loaded over the C ABI via ctypes — no pybind11 dependency.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+__all__ = ["load", "get_build_directory"]
+
+_DEFAULT_CFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".extensions"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _source_digest(sources: Sequence[str], cflags: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(cflags).encode())
+    return h.hexdigest()[:16]
+
+
+def load(
+    name: str,
+    sources: Sequence[str],
+    extra_cflags: Optional[List[str]] = None,
+    extra_ldflags: Optional[List[str]] = None,
+    build_directory: Optional[str] = None,
+    verbose: bool = False,
+) -> ctypes.CDLL:
+    """Compile C++ sources to lib<name>.so (content-hash cached) and dlopen it.
+
+    reference: cpp_extension.load() — same contract minus nvcc; returns the
+    ctypes.CDLL through which C-ABI symbols are called.
+    """
+    build_dir = build_directory or get_build_directory()
+    cflags = _DEFAULT_CFLAGS + (extra_cflags or [])
+    ldflags = ["-lpthread"] + (extra_ldflags or [])
+    digest = _source_digest(sources, cflags + ldflags)
+    so_path = os.path.join(build_dir, f"lib{name}.{digest}.so")
+    if not os.path.exists(so_path):
+        # build to a per-pid temp path then atomically rename: concurrent
+        # processes racing on a cold cache must never dlopen a half-written .so
+        tmp_path = f"{so_path}.{os.getpid()}.tmp"
+        cmd = ["g++", *cflags, *sources, "-o", tmp_path, *ldflags]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=not verbose, text=True
+            )
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"building extension '{name}' failed:\n{e.stderr or e}"
+            ) from e
+        os.rename(tmp_path, so_path)
+    return ctypes.CDLL(so_path)
