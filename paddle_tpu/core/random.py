@@ -27,9 +27,14 @@ class Generator:
         self.manual_seed(seed)
 
     def manual_seed(self, seed: int):
-        self._seed = int(seed)
-        self._key = jax.random.key(int(seed))
-        self._counter = 0
+        with self._lock:
+            self._seed = int(seed)
+            # key creation is LAZY: building a jax key touches the device
+            # backend, and importing the framework must not initialize XLA
+            # (jax.distributed.initialize has to run first in multi-process
+            # jobs — reference: init_parallel_env before any device work)
+            self._key = None
+            self._counter = 0
         return self
 
     def initial_seed(self) -> int:
@@ -39,11 +44,14 @@ class Generator:
         return (self._seed, self._counter)
 
     def set_state(self, state):
-        self._seed, self._counter = state
-        self._key = jax.random.key(self._seed)
+        with self._lock:
+            self._seed, self._counter = state
+            self._key = None
 
     def get_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._counter += 1
             return jax.random.fold_in(self._key, self._counter)
 

@@ -1,0 +1,154 @@
+"""Elastic training manager — fault detection, relaunch, rescale.
+
+Reference analogue: python/paddle/distributed/fleet/elastic/manager.py:130
+(ElasticManager): pods register in etcd with TTL leases; watchers detect
+dead/new pods, rebuild endpoint lists within [np_min, np_max], kill local
+trainers and re-exec. Env contract kept: PADDLE_ELASTIC_JOB_ID,
+PADDLE_ELASTIC_NP, PADDLE_ELASTIC_TIMEOUT,
+PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL.
+
+TPU-native design: membership lives in a shared registry DIRECTORY (one
+heartbeat file per node) instead of etcd — the same lease semantics
+(mtime = TTL refresh) without an external service, which is also how
+single-host CI exercises it. A JAX collective job cannot re-admit a single
+process into a running coordination service, so fault recovery is
+whole-pod: on any worker death the manager stops the pod, rebuilds it (new
+endpoints if membership changed), and redeploys — the reference does the
+same for collective mode.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    RESTARTING = "restarting"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Watches a Pod of trainer Containers; relaunches on faults.
+
+    pod_builder: () -> Pod (fresh containers with current membership env);
+    called again on every relaunch so a changed node set produces new
+    endpoint lists.
+    """
+
+    def __init__(
+        self,
+        pod_builder: Callable,
+        job_id: Optional[str] = None,
+        np_min: int = 1,
+        np_max: Optional[int] = None,
+        max_restarts: int = 3,
+        watch_interval: float = 0.5,
+        registry_dir: Optional[str] = None,
+        heartbeat_ttl: float = 10.0,
+        fault_tolerance_level: Optional[int] = None,
+    ):
+        self.pod_builder = pod_builder
+        self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID", "default")
+        self.np_min = np_min
+        self.np_max = np_max or int(os.getenv("PADDLE_ELASTIC_NP", str(np_min)))
+        self.max_restarts = max_restarts
+        self.watch_interval = watch_interval
+        self.heartbeat_ttl = heartbeat_ttl
+        self.level = (
+            fault_tolerance_level
+            if fault_tolerance_level is not None
+            else int(os.getenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+        )
+        self.registry_dir = registry_dir
+        self.restarts = 0
+        self.pod = None
+        self._node_id = os.getenv("PADDLE_CURRENT_ENDPOINT", f"node-{os.getpid()}")
+
+    # --- membership registry (etcd replacement) -------------------------
+    def _beat_path(self):
+        return os.path.join(self.registry_dir, f"{self.job_id}.{self._node_id}.beat")
+
+    def register(self):
+        if self.registry_dir:
+            os.makedirs(self.registry_dir, exist_ok=True)
+            with open(self._beat_path(), "w") as f:
+                f.write(str(os.getpid()))
+
+    def heartbeat(self):
+        if self.registry_dir:
+            try:
+                os.utime(self._beat_path())
+            except FileNotFoundError:
+                self.register()
+
+    def deregister(self):
+        if self.registry_dir:
+            try:
+                os.remove(self._beat_path())
+            except FileNotFoundError:
+                pass
+
+    def alive_nodes(self):
+        """Nodes whose heartbeat file is fresher than the TTL."""
+        if not self.registry_dir or not os.path.isdir(self.registry_dir):
+            return []
+        now = time.time()
+        out = []
+        prefix = f"{self.job_id}."
+        for fn in os.listdir(self.registry_dir):
+            if fn.startswith(prefix) and fn.endswith(".beat"):
+                p = os.path.join(self.registry_dir, fn)
+                try:
+                    if now - os.path.getmtime(p) <= self.heartbeat_ttl:
+                        out.append(fn[len(prefix) : -len(".beat")])
+                except FileNotFoundError:
+                    pass
+        return sorted(out)
+
+    # --- fault watch loop ----------------------------------------------
+    def launch(self):
+        self.register()
+        self.pod = self.pod_builder()
+        self.pod.deploy()
+        return self.pod
+
+    def watch(self, timeout: Optional[float] = None) -> int:
+        """Run until the job completes (rc 0), fails permanently, or times
+        out. Dead workers trigger a whole-pod relaunch up to max_restarts
+        (fault-tolerance level >= 1; level 0 fails fast like the ref)."""
+        if self.pod is None:
+            self.launch()
+        t0 = time.time()
+        membership = self.alive_nodes()
+        while True:
+            if timeout is not None and time.time() - t0 > timeout:
+                self.pod.stop()
+                return 124
+            self.heartbeat()
+            codes = [c.exit_code for c in self.pod.containers]
+            if all(code == 0 for code in codes):
+                self.deregister()
+                return 0
+            failed = [code for code in codes if code not in (None, 0)]
+            now_members = self.alive_nodes()
+            rescale = self.registry_dir and now_members != membership and (
+                self.np_min <= max(len(now_members), 1) <= self.np_max
+            )
+            if failed or rescale:
+                if self.level == 0 and failed:
+                    self.pod.stop()
+                    return failed[0]
+                if self.restarts >= self.max_restarts:
+                    self.pod.stop()
+                    return failed[0] if failed else 1
+                self.restarts += 1
+                membership = now_members
+                self.pod.stop()
+                self.pod = self.pod_builder()
+                self.pod.deploy()
+            time.sleep(self.watch_interval)

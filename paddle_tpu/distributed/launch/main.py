@@ -118,6 +118,11 @@ def _parse_args(argv=None):
     p.add_argument("--job_id", default="default")
     p.add_argument("--devices", "--tpus", "--gpus", dest="devices", default=None)
     p.add_argument("--run_mode", default="collective", choices=["collective", "ps"])
+    p.add_argument("--max_restart", type=int,
+                   default=int(os.getenv("PADDLE_ELASTIC_MAX_RESTART", "0")),
+                   help=">0 enables elastic fault recovery (whole-pod relaunch)")
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.getenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1")))
     p.add_argument("--server_num", type=int, default=0)
     p.add_argument("--trainer_num", type=int, default=None)
     p.add_argument("training_script")
@@ -197,11 +202,33 @@ def _build_pod_ps(args) -> Pod:
 
 def launch(argv=None) -> int:
     args = _parse_args(argv)
-    pod = (
-        _build_pod_collective(args)
-        if args.run_mode == "collective"
-        else _build_pod_ps(args)
-    )
+
+    def build():
+        return (
+            _build_pod_collective(args)
+            if args.run_mode == "collective"
+            else _build_pod_ps(args)
+        )
+
+    if args.max_restart > 0:
+        from ..fleet.elastic import ElasticManager
+
+        mgr = ElasticManager(
+            build,
+            job_id=args.job_id,
+            max_restarts=args.max_restart,
+            fault_tolerance_level=args.elastic_level,
+        )
+        mgr.launch()
+
+        def _sig_e(*_):
+            mgr.pod.stop()
+            sys.exit(1)
+
+        signal.signal(signal.SIGTERM, _sig_e)
+        return mgr.watch()
+
+    pod = build()
     pod.deploy()
 
     def _sig(*_):

@@ -84,7 +84,18 @@ def init_parallel_env():
     service if a multi-host env contract is present, then install a
     data-parallel mesh over all visible devices."""
     env = ParallelEnv()
-    if env.world_size > 1 and os.getenv("PADDLE_MASTER") and jax.process_count() == 1:
+    # is_initialized() (not process_count()) — a backend-touching probe here
+    # would make the subsequent initialize() impossible
+    already = getattr(jax.distributed, "is_initialized", lambda: False)()
+    if env.world_size > 1 and os.getenv("PADDLE_MASTER") and not already:
+        # CPU cross-process collectives ride Gloo (the reference's CPU
+        # ProcessGroupGloo role); TPU rides ICI/DCN natively. Set it
+        # unconditionally (it only affects the cpu backend) and before the
+        # backend comes up.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
         # multi-host rendezvous: coordination service replaces TCPStore
         jax.distributed.initialize(
             coordinator_address=os.environ["PADDLE_MASTER"],

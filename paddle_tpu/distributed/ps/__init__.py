@@ -25,7 +25,7 @@ from typing import Optional
 
 import numpy as np
 
-from ...core.dispatch import GradNode, is_grad_enabled, no_grad
+from ...core.dispatch import GradNode, is_grad_enabled
 from ...core.tensor import Tensor
 from ...nn.layer_base import Layer
 

@@ -66,9 +66,13 @@ def load(
             subprocess.run(
                 cmd, check=True, capture_output=not verbose, text=True
             )
-        except subprocess.CalledProcessError as e:
+            os.rename(tmp_path, so_path)
+        except (subprocess.CalledProcessError, OSError) as e:
+            stderr = getattr(e, "stderr", None)
             raise RuntimeError(
-                f"building extension '{name}' failed:\n{e.stderr or e}"
+                f"building extension '{name}' failed:\n{stderr or e}"
             ) from e
-        os.rename(tmp_path, so_path)
+        finally:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
     return ctypes.CDLL(so_path)

@@ -31,3 +31,7 @@ def _seed_everything():
     paddle_tpu.seed(1234)
     np.random.seed(1234)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: spawns real subprocesses")

@@ -1,0 +1,175 @@
+"""Multi-process launch + elastic relaunch — real subprocesses on localhost.
+
+Reference analogue: test_fleet_launch_*.sh and
+test_collective_api_base.py:92 (spawn trainer subprocesses, compare
+results) and the elastic manager unit tests — SURVEY §4's
+multiprocess-on-localhost strategy.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def child_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # conftest forces an 8-device virtual CPU mesh for sharding tests; the
+    # multi-process workers must see ONE device each (one per "host")
+    env["XLA_FLAGS"] = " ".join(
+        p for p in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in p
+    )
+    return env
+
+
+TRAIN_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"world={world}"
+    # cross-process reduction: every process contributes rank+1
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
+    mesh = Mesh(jax.devices(), ("dp",))
+    local = jnp.ones((1,)) * (rank + 1)
+    arr = multihost_utils.host_local_array_to_global_array(local, mesh, P("dp"))
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    result = float(total.addressable_data(0))
+    out_dir = os.environ["TEST_OUT_DIR"]
+    with open(os.path.join(out_dir, f"rank{rank}.ok"), "w") as f:
+        f.write(str(result))
+    """
+)
+
+
+@pytest.mark.slow
+def test_launch_two_process_collective(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN_SCRIPT)
+    port = free_port()
+    env = child_env()
+    env["TEST_OUT_DIR"] = str(tmp_path)
+    rc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--master", f"127.0.0.1:{port}",
+            "--nproc_per_node", "2",
+            "--log_dir", str(tmp_path / "log"),
+            str(script),
+        ],
+        env=env, timeout=240,
+    ).returncode
+    if rc != 0:
+        for f in (tmp_path / "log").glob("workerlog.*"):
+            print(f, ":", f.read_text()[-2000:])
+    assert rc == 0
+    # both ranks computed the global sum 1+2=3 over the 2-process mesh
+    for r in (0, 1):
+        assert (tmp_path / f"rank{r}.ok").read_text() == "3.0"
+
+
+CRASH_ONCE_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    marker = os.path.join(os.environ["TEST_OUT_DIR"],
+                          "crashed." + os.environ["PADDLE_TRAINER_ID"])
+    if os.environ["PADDLE_TRAINER_ID"] == "0" and not os.path.exists(marker):
+        open(marker, "w").close()
+        sys.exit(17)  # simulated fault on first attempt
+    with open(os.path.join(os.environ["TEST_OUT_DIR"],
+                           "done." + os.environ["PADDLE_TRAINER_ID"]), "w") as f:
+        f.write("ok")
+    """
+)
+
+
+@pytest.mark.slow
+def test_elastic_relaunch_after_worker_death(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(CRASH_ONCE_SCRIPT)
+    env = child_env()
+    env["TEST_OUT_DIR"] = str(tmp_path)
+    rc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2",
+            "--max_restart", "2",
+            "--log_dir", str(tmp_path / "log"),
+            str(script),
+        ],
+        env=env, timeout=120,
+    ).returncode
+    assert rc == 0
+    assert (tmp_path / "crashed.0").exists()  # the fault really happened
+    assert (tmp_path / "done.0").exists() and (tmp_path / "done.1").exists()
+
+
+@pytest.mark.slow
+def test_elastic_level0_fails_fast(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    env = child_env()
+    rc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "1",
+            "--max_restart", "3",
+            "--elastic_level", "0",
+            "--log_dir", str(tmp_path / "log"),
+            str(script),
+        ],
+        env=env, timeout=60,
+    ).returncode
+    assert rc == 9
+
+
+def test_elastic_manager_membership(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    class FakePod:
+        def __init__(self):
+            self.containers = []
+
+        def deploy(self):
+            pass
+
+        def stop(self):
+            pass
+
+    m1 = ElasticManager(FakePod, job_id="j1", registry_dir=str(tmp_path))
+    m1._node_id = "hostA"
+    m1.register()
+    m2 = ElasticManager(FakePod, job_id="j1", registry_dir=str(tmp_path))
+    m2._node_id = "hostB"
+    m2.register()
+    assert m1.alive_nodes() == ["hostA", "hostB"]
+    m2.deregister()
+    assert m1.alive_nodes() == ["hostA"]
+    # stale heartbeat expires
+    old = os.path.join(str(tmp_path), "j1.hostA.beat")
+    past = 100.0
+    os.utime(old, (os.path.getmtime(old) - past, os.path.getmtime(old) - past))
+    assert m1.alive_nodes() == []
