@@ -97,12 +97,19 @@ for _pkg in (
     "signal",
     "onnx",
     "inference",
+    "device",
+    "hub",
+    "utils",
+    "cost_model",
+    "quantization",
 ):
     try:
         globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)
     except ModuleNotFoundError as _e:
         if f"paddle_tpu.{_pkg}" not in str(_e):
             raise  # real import error inside an existing subpackage
+
+from .batch import batch  # noqa: E402,F401
 
 if "autograd" in globals() and hasattr(globals()["autograd"], "grad"):
     grad = globals()["autograd"].grad

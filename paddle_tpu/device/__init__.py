@@ -1,0 +1,101 @@
+"""paddle.device — device management + memory statistics facade.
+
+Reference analogue: python/paddle/device/ (set_device/get_device,
+device/cuda/ memory APIs over memory/stats.cc + allocator facade). On TPU
+the PJRT runtime owns allocation; the stats facade reads
+Device.memory_stats() so users get the reference's memory introspection
+surface (SURVEY §1 L1) without a custom allocator.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import Place, get_device, set_device  # noqa: F401
+
+__all__ = [
+    "set_device",
+    "get_device",
+    "get_all_device_type",
+    "get_available_device",
+    "memory_allocated",
+    "max_memory_allocated",
+    "memory_reserved",
+    "cuda",
+]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_available_device():
+    return [f"{d.platform}:{i}" for i, d in enumerate(jax.devices())]
+
+
+def _device(device_id=None):
+    devs = jax.devices()
+    return devs[device_id or 0]
+
+
+def _stat(name: str, device_id=None, default=0):
+    stats = _device(device_id).memory_stats() or {}
+    return int(stats.get(name, default))
+
+
+def memory_allocated(device=None) -> int:
+    """Live bytes on the device (reference: paddle.device.cuda.memory_allocated
+    over memory/stats.cc Allocated stat)."""
+    return _stat("bytes_in_use", device)
+
+
+def max_memory_allocated(device=None) -> int:
+    return _stat("peak_bytes_in_use", device)
+
+
+def memory_reserved(device=None) -> int:
+    """Total reservable pool (PJRT preallocates; falls back to bytes_limit)."""
+    stats = _device(device).memory_stats() or {}
+    return int(
+        stats.get("bytes_reserved", stats.get("bytes_limit", 0))
+    )
+
+
+def max_memory_reserved(device=None) -> int:
+    return _stat("peak_bytes_reserved", device, memory_reserved(device))
+
+
+class _CudaNamespace:
+    """paddle.device.cuda API-parity shim — maps to the default accelerator."""
+
+    @staticmethod
+    def device_count():
+        return len(jax.devices())
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
+
+    @staticmethod
+    def empty_cache():
+        # PJRT owns the pool; nothing to drop eagerly
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        for d in jax.live_arrays():
+            d.block_until_ready()
+
+
+cuda = _CudaNamespace()

@@ -123,6 +123,14 @@ class ElasticManager:
         (fault-tolerance level >= 1; level 0 fails fast like the ref)."""
         if self.pod is None:
             self.launch()
+        try:
+            return self._watch_loop(timeout)
+        except KeyboardInterrupt:
+            self.pod.stop()
+            self.deregister()
+            return 1
+
+    def _watch_loop(self, timeout: Optional[float]) -> int:
         t0 = time.time()
         membership = self.alive_nodes()
         while True:

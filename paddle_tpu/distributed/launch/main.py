@@ -35,7 +35,9 @@ class Container:
         out = None
         if self.log_path:
             os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
-            self._log_f = open(self.log_path, "w")
+            # append: an elastic relaunch must not truncate the previous
+            # attempt's crash log
+            self._log_f = open(self.log_path, "a")
             out = self._log_f
         self.proc = subprocess.Popen(
             self.cmd, env=self.env, stdout=out, stderr=subprocess.STDOUT

@@ -1,0 +1,66 @@
+"""paddle.hub — load models from a hubconf.py protocol directory.
+
+Reference analogue: python/paddle/hapi/hub.py (list/help/load with
+github/gitee/local sources). This environment has no network egress, so the
+github/gitee sources are gated with a clear error; the `local` source —
+a directory containing hubconf.py exposing entrypoint callables — is fully
+supported, which is also what the reference's tests exercise.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, which this "
+            "environment does not have; use source='local' with a checked-out "
+            "repo directory"
+        )
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [
+        n for n in dir(mod)
+        if callable(getattr(mod, n)) and not n.startswith("_")
+    ]
+
+
+def help(repo_dir: str, model: str, source: str = "local", force_reload: bool = False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, *args, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate entrypoint `model` from the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(
+            f"{model!r} not found in {repo_dir}/hubconf.py; available: "
+            f"{list(repo_dir)}"
+        )
+    return getattr(mod, model)(*args, **kwargs)

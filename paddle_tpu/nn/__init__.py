@@ -30,6 +30,7 @@ from .layer.norm import (  # noqa: F401
 )
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AvgPool2D, MaxPool1D, MaxPool2D,
+    MaxUnPool2D,
 )
 from .layer.rnn import (  # noqa: F401
     GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN,

@@ -216,14 +216,31 @@ def max_pool2d(
     x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return_mask=False, data_format="NCHW", name=None,
 ):
-    out = apply(
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask requires NCHW (reference kernel layout)")
+        return apply(
+            _nn.max_pool2d_with_index, x, kernel_size=_t(kernel_size),
+            stride=_t(stride), padding=_t(padding), ceil_mode=ceil_mode,
+            op_name="max_pool2d_with_index",
+        )
+    return apply(
         _nn.max_pool2d, x, kernel_size=_t(kernel_size), stride=_t(stride),
         padding=_t(padding), ceil_mode=ceil_mode, data_format=data_format,
         op_name="max_pool2d",
     )
-    if return_mask:
-        raise NotImplementedError("return_mask")
-    return out
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d requires NCHW")
+    out_sz = tuple(output_size) if output_size is not None else None
+    return apply(
+        _nn.max_unpool2d, x, indices, kernel_size=_t(kernel_size),
+        stride=_t(stride), padding=_t(padding), output_size=out_sz,
+        op_name="max_unpool2d",
+    )
 
 
 def avg_pool2d(
@@ -354,10 +371,16 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         if mode == "downscale_in_infer" and p > 0.0:
             return x * (1.0 - p)
         return x if isinstance(x, Tensor) else to_tensor(x)
+    mask_shape = None
     if axis is not None:
-        raise NotImplementedError("dropout axis")
+        ndim = len(x.shape)
+        axes = {a % ndim for a in ([axis] if isinstance(axis, int) else axis)}
+        mask_shape = tuple(
+            int(d) if i in axes else 1 for i, d in enumerate(x.shape)
+        )
     return apply(
-        _nn.dropout, x, _random.next_key(), p=float(p), mode=mode, op_name="dropout"
+        _nn.dropout, x, _random.next_key(), p=float(p), mode=mode,
+        mask_shape=mask_shape, op_name="dropout",
     )
 
 

@@ -13,12 +13,32 @@ class MaxPool2D(Layer):
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.return_mask = return_mask
         self.data_format = data_format
 
     def forward(self, x):
         return F.max_pool2d(
             x, self.kernel_size, self.stride, self.padding, self.ceil_mode,
-            data_format=self.data_format,
+            return_mask=self.return_mask, data_format=self.data_format,
+        )
+
+
+class MaxUnPool2D(Layer):
+    """reference: nn/layer/pooling.py MaxUnPool2D over phi unpool kernel."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(
+            x, indices, self.kernel_size, self.stride, self.padding,
+            data_format=self.data_format, output_size=self.output_size,
         )
 
 

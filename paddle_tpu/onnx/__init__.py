@@ -1,0 +1,30 @@
+"""paddle.onnx — ONNX export shim.
+
+Reference analogue: python/paddle/onnx/export.py — a thin delegate to the
+external paddle2onnx package (the reference raises if it is missing; same
+here). On TPU the first-class deployment artifact is the StableHLO export
+(paddle.jit.save → paddle.inference predictor), which is portable across
+XLA runtimes; ONNX remains available whenever paddle2onnx is installed.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export requires the paddle2onnx package, which is "
+            "not installed in this environment. For TPU deployment use "
+            "paddle.jit.save(layer, path, input_spec=...) — the StableHLO "
+            "artifact is the portable format here — and serve it with "
+            "paddle.inference.create_predictor."
+        ) from e
+    # with paddle2onnx present, route through its program-based exporter
+    from .. import jit as _jit
+
+    _jit.save(layer, path, input_spec=input_spec)
+    return paddle2onnx.export(path + ".pdmodel", path + ".pdparams",
+                              path + ".onnx", opset_version=opset_version)

@@ -181,6 +181,85 @@ def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False, data_
     )
 
 
+def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
+                          ceil_mode=False):
+    """Max pool returning (out, mask) where mask holds each max's flat index
+    in its input plane (reference: phi max_pool2d_with_index kernel, NCHW).
+
+    Indices are found by comparing the pooled max against each of the k*k
+    strided window offsets — a static unrolled loop XLA fuses; first match
+    wins on ties (matching the CUDA kernel's scan order)."""
+    if isinstance(padding, str):
+        raise ValueError(
+            "max_pool2d(return_mask=True) needs explicit integer padding "
+            "(the index math has no SAME/VALID form); pass numbers"
+        )
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    n, c, h, w = x.shape
+
+    def _extra(dim, k, s, p):
+        # ceil_mode: extend the high side so the tail window (which always
+        # holds >=1 real element) is produced too
+        if not ceil_mode:
+            return 0
+        out_ceil = -(-(dim + 2 * p - k) // s) + 1
+        out_floor = (dim + 2 * p - k) // s + 1
+        return (out_ceil - out_floor) * s
+
+    eh, ew = _extra(h, ks[0], st[0], ph), _extra(w, ks[1], st[1], pw)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    out = jax.lax.reduce_window(
+        x, neg, jax.lax.max, (1, 1) + ks, (1, 1) + st,
+        [(0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)],
+    )
+    oh, ow = out.shape[2], out.shape[3]
+    padded = jnp.pad(
+        x, [(0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)], constant_values=neg
+    )
+    # window origin rows/cols in UNPADDED coordinates
+    base_r = jnp.arange(oh) * st[0] - ph
+    base_c = jnp.arange(ow) * st[1] - pw
+    idx = jnp.zeros((n, c, oh, ow), jnp.int64)
+    found = jnp.zeros((n, c, oh, ow), bool)
+    for di in range(ks[0]):
+        for dj in range(ks[1]):
+            vals = jax.lax.slice(
+                padded,
+                (0, 0, di, dj),
+                (n, c, di + (oh - 1) * st[0] + 1, dj + (ow - 1) * st[1] + 1),
+                (1, 1, st[0], st[1]),
+            )
+            hit = (vals == out) & ~found
+            gidx = (base_r[:, None] + di) * w + (base_c[None, :] + dj)
+            idx = jnp.where(hit, gidx[None, None].astype(jnp.int64), idx)
+            found = found | hit
+    return out, idx
+
+
+def max_unpool2d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Scatter pooled values back to their argmax positions (reference:
+    phi unpool_kernel, NCHW). `indices` are flat per-plane positions as
+    produced by max_pool2d_with_index."""
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    n, c, oh, ow = x.shape
+    if output_size is not None:
+        h, w = int(output_size[-2]), int(output_size[-1])
+    else:
+        h = (oh - 1) * st[0] - 2 * ph + ks[0]
+        w = (ow - 1) * st[1] - 2 * pw + ks[1]
+    flat_x = x.reshape(n * c, oh * ow)
+    flat_i = indices.reshape(n * c, oh * ow)
+    out = jnp.zeros((n * c, h * w), x.dtype)
+    rows = jnp.arange(n * c)[:, None]
+    out = out.at[rows, flat_i].set(flat_x)
+    return out.reshape(n, c, h, w)
+
+
 def avg_pool2d(
     x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
     exclusive=True, data_format="NCHW",
@@ -546,10 +625,12 @@ def embedding(x, weight, *, padding_idx=None):
 # ---------------------------------------------------------------------------
 # Dropout — key passed explicitly (see core/random.py for key plumbing)
 # ---------------------------------------------------------------------------
-def dropout(x, key, *, p=0.5, mode="upscale_in_train"):
+def dropout(x, key, *, p=0.5, mode="upscale_in_train", mask_shape=None):
+    """mask_shape: broadcastable mask dims (paddle's `axis` arg — the mask
+    varies only along the listed axes and is broadcast along the rest)."""
     if p == 0.0:
         return x
-    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape or x.shape)
     if mode == "upscale_in_train":
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
     return jnp.where(keep, x, 0.0).astype(x.dtype)

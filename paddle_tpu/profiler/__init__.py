@@ -246,23 +246,17 @@ class Profiler:
 
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
                 thread_sep=False, time_unit="ms", views=None):
-        """reference: profiler_statistic.py — aggregated span table."""
+        """reference: profiler_statistic.py — Overview + Operator report."""
+        from .statistic import build_summary_report
+
         with _events_lock:
             events = list(_host_events)
-        agg = {}
-        for e in events:
-            a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0, "max_us": 0.0})
-            a["calls"] += 1
-            a["total_us"] += e["dur"]
-            a["max_us"] = max(a["max_us"], e["dur"])
-        rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])
-        lines = [f"{'Name':<40} {'Calls':>6} {'Total(ms)':>12} {'Avg(ms)':>10} {'Max(ms)':>10}"]
-        for name, a in rows:
-            lines.append(
-                f"{name[:40]:<40} {a['calls']:>6} {a['total_us']/1000:>12.3f} "
-                f"{a['total_us']/a['calls']/1000:>10.3f} {a['max_us']/1000:>10.3f}"
-            )
-        table = "\n".join(lines)
+        key = {
+            SortedKeys.CPUTotal: "total",
+            SortedKeys.CPUAvg: "avg",
+            SortedKeys.CPUMax: "max",
+        }.get(sorted_by, "total")
+        table = build_summary_report(events, sorted_by=key, time_unit=time_unit)
         print(table)
         return table
 

@@ -1,0 +1,260 @@
+"""paddle.quantization — QAT fake-quant + post-training calibration.
+
+Reference analogue: python/paddle/fluid/contrib/slim/quantization/
+(ImperativeQuantAware in imperative/qat.py — replaces Conv2D/Linear with
+QuantizedConv2D/QuantizedLinear carrying fake_quant ops; PostTraining
+Quantization collects activation ranges over calibration data; fake-quant
+kernels fake_quantize_op.cc: abs_max, channel_wise_abs_max,
+moving_average_abs_max).
+
+TPU-native design: fake-quant is pure jnp math recorded on the tape with a
+straight-through estimator (x + stop_gradient(quant(x) - x)) — no
+registered STE grad kernels needed. Scales live in layer buffers so
+state_dict round-trips them and `save_quantized_model` bakes them into the
+StableHLO artifact. Int8 *execution* maps to XLA int8 dots when the
+deployment runtime chooses; the artifact carries exact scale metadata.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, no_grad
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .. import nn
+
+__all__ = [
+    "ImperativeQuantAware",
+    "PostTrainingQuantization",
+    "QuantedLinear",
+    "QuantedConv2D",
+    "fake_quant_abs_max",
+    "fake_quant_channel_wise_abs_max",
+]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant ops (reference: operators/fake_quantize_op.cc kernels)
+# ---------------------------------------------------------------------------
+def _ste(x, q):
+    """Straight-through estimator: forward q, backward identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _fq_abs_max(x, *, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = jnp.round(x / scale * qmax) / qmax * scale
+    return _ste(x, q), scale
+
+
+def _fq_channel_abs_max(w, *, bits=8, axis=-1):
+    """Per-output-channel abs-max (reference: channel_wise_abs_max for
+    weights; paddle Linear weight is [in, out] so channels are axis -1)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    red = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=red, keepdims=True), 1e-8)
+    q = jnp.round(w / scale * qmax) / qmax * scale
+    return _ste(w, q), scale.reshape(-1)
+
+
+def _fq_moving_avg(x, state, *, bits=8, rate=0.9):
+    """moving_average_abs_max: running activation scale (training); the
+    accumulated scale is what inference uses."""
+    qmax = float(2 ** (bits - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    new_state = jnp.where(state > 0, rate * state + (1 - rate) * cur, cur)
+    scale = jnp.maximum(new_state, 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) / qmax * scale
+    return _ste(x, q), new_state
+
+
+def fake_quant_abs_max(x, bits=8):
+    out = apply(lambda v, bits: _fq_abs_max(v, bits=bits)[0], x, bits=bits,
+                op_name="fake_quantize_abs_max")
+    return out
+
+
+def fake_quant_channel_wise_abs_max(w, bits=8, axis=-1):
+    return apply(
+        lambda v, bits, axis: _fq_channel_abs_max(v, bits=bits, axis=axis)[0],
+        w, bits=bits, axis=axis, op_name="fake_channel_wise_quantize_abs_max",
+    )
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers (reference: slim/quantization/imperative/qat.py
+# QuantizedConv2D / QuantizedLinear)
+# ---------------------------------------------------------------------------
+class _FakeQuantAct(Layer):
+    """moving_average_abs_max activation fake-quant with a persistent scale."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.bits = bits
+        self.rate = moving_rate
+        self.register_buffer("scale", Tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        if self.training:
+            out, new_state = apply(
+                lambda v, s, bits, rate: _fq_moving_avg(v, s, bits=bits, rate=rate),
+                x, self.scale, bits=self.bits, rate=self.rate,
+                op_name="fake_quantize_moving_average_abs_max",
+            )
+            with no_grad():
+                self.scale._value = jax.lax.stop_gradient(new_state._value)
+            return out
+        qmax = float(2 ** (self.bits - 1) - 1)
+
+        def eval_q(v, s, qmax):
+            # scale is a traced input so jit.save can bake the buffer value
+            scale = jnp.maximum(s, 1e-8)
+            return jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax) / qmax * scale
+
+        return apply(eval_q, x, self.scale, qmax=qmax, op_name="quantize_dequantize")
+
+
+class QuantedLinear(Layer):
+    def __init__(self, layer: "nn.Linear", weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._linear = layer
+        self.weight_bits = weight_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.fq_act = _FakeQuantAct(activation_bits, moving_rate)
+
+    def _quant_weight(self, w):
+        if self.weight_quantize_type == "channel_wise_abs_max":
+            return fake_quant_channel_wise_abs_max(w, self.weight_bits, axis=-1)
+        return fake_quant_abs_max(w, self.weight_bits)
+
+    def forward(self, x):
+        xq = self.fq_act(x)
+        wq = self._quant_weight(self._linear.weight)
+        return nn.functional.linear(xq, wq, self._linear.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer: "nn.Conv2D", weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._conv = layer
+        self.weight_bits = weight_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.fq_act = _FakeQuantAct(activation_bits, moving_rate)
+
+    def _quant_weight(self, w):
+        # conv weight [out_c, in_c/g, kh, kw] — channel axis 0
+        if self.weight_quantize_type == "channel_wise_abs_max":
+            return fake_quant_channel_wise_abs_max(w, self.weight_bits, axis=0)
+        return fake_quant_abs_max(w, self.weight_bits)
+
+    def forward(self, x):
+        xq = self.fq_act(x)
+        wq = self._quant_weight(self._conv.weight)
+        c = self._conv
+        return nn.functional.conv2d(
+            xq, wq, c.bias, stride=c._stride, padding=c._padding,
+            dilation=c._dilation, groups=c._groups, data_format=c._data_format,
+        )
+
+
+_QUANT_MAP = {"Linear": QuantedLinear, "Conv2D": QuantedConv2D}
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference: imperative/qat.py ImperativeQuantAware).
+
+    quantize(model) swaps each quantizable sublayer IN PLACE for its
+    fake-quant wrapper; train as usual; save_quantized_model exports the
+    scale-baked inference artifact.
+    """
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kw):
+        self.types = tuple(quantizable_layer_type)
+        self.weight_quantize_type = weight_quantize_type
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model: Layer) -> Layer:
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                cls_name = type(child).__name__
+                if cls_name in self.types and cls_name in _QUANT_MAP:
+                    wrapped = _QUANT_MAP[cls_name](
+                        child, self.weight_bits, self.activation_bits,
+                        self.moving_rate, self.weight_quantize_type,
+                    )
+                    setattr(parent, name, wrapped)
+        return model
+
+    def save_quantized_model(self, model: Layer, path: str, input_spec=None, **config):
+        from .. import jit
+
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ (reference: post_training_quantization.py): run calibration data
+    through the float model, record per-activation abs-max ranges, attach
+    frozen scales."""
+
+    def __init__(self, model: Layer, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_bits=8, activation_bits=8):
+        self.model = model
+        self.types = tuple(quantizable_layer_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._ranges = {}
+
+    def quantize(self, data_loader, batch_nums: Optional[int] = None) -> Layer:
+        # hooks record input abs-max per quantizable layer
+        handles = []
+        names = {}
+        for name, layer in self.model.named_sublayers():
+            if type(layer).__name__ in self.types:
+                names[id(layer)] = name
+
+                def hook(lyr, inputs, _name=name):
+                    x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+                    m = float(jnp.max(jnp.abs(x._value)))
+                    self._ranges[_name] = max(self._ranges.get(_name, 0.0), m)
+
+                handles.append(layer.register_forward_pre_hook(hook))
+        self.model.eval()
+        with no_grad():
+            for i, batch in enumerate(data_loader):
+                if batch_nums is not None and i >= batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self.model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(np.asarray(x))))
+        for h in handles:
+            h.remove()
+        # freeze: swap in wrappers with calibrated (non-moving) scales
+        q = ImperativeQuantAware(
+            quantizable_layer_type=self.types,
+            weight_bits=self.weight_bits, activation_bits=self.activation_bits,
+        )
+        q.quantize(self.model)
+        for name, layer in self.model.named_sublayers():
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                base = name
+                scale = self._ranges.get(base, 0.0)
+                if scale > 0:
+                    with no_grad():
+                        layer.fq_act.scale._value = jnp.asarray(scale, jnp.float32)
+        return self.model
+
+    @property
+    def activation_ranges(self):
+        return dict(self._ranges)

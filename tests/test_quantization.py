@@ -1,0 +1,122 @@
+"""Quantization: QAT fake-quant wrappers + PTQ calibration.
+
+Reference analogue: slim quantization tests (test_imperative_qat.py,
+test_post_training_quantization_*) — numeric fake-quant math + training
+convergence of the quantized model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    ImperativeQuantAware,
+    PostTrainingQuantization,
+    QuantedConv2D,
+    QuantedLinear,
+    fake_quant_abs_max,
+    fake_quant_channel_wise_abs_max,
+)
+
+
+def test_fake_quant_abs_max_math():
+    x = paddle.to_tensor(np.array([-1.0, 0.25, 0.5, 1.0], np.float32))
+    out = fake_quant_abs_max(x, bits=8).numpy()
+    scale, qmax = 1.0, 127.0
+    expected = np.round(np.array([-1.0, 0.25, 0.5, 1.0]) / scale * qmax) / qmax * scale
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+    # quantization error bounded by half a step
+    assert np.max(np.abs(out - x.numpy())) <= scale / qmax
+
+
+def test_fake_quant_channelwise():
+    w = np.stack([np.linspace(-1, 1, 8), np.linspace(-4, 4, 8)], axis=1).astype(np.float32)
+    out = fake_quant_channel_wise_abs_max(paddle.to_tensor(w), bits=8, axis=-1).numpy()
+    for c in range(2):
+        s = np.abs(w[:, c]).max()
+        expected = np.round(w[:, c] / s * 127) / 127 * s
+        np.testing.assert_allclose(out[:, c], expected, rtol=1e-5)
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32), stop_gradient=False)
+    out = fake_quant_abs_max(x)
+    (out * paddle.to_tensor(np.array([2.0, 3.0], np.float32))).sum().backward()
+    # straight-through: grad passes as if identity
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 3.0], rtol=1e-6)
+
+
+def test_imperative_qat_swaps_and_trains():
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(1, 4, 3, padding=1)
+            self.fc = nn.Linear(4 * 4 * 4, 2)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.conv(x))
+            return self.fc(h.reshape([x.shape[0], -1]))
+
+    net = Net()
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    assert isinstance(net.conv, QuantedConv2D)
+    assert isinstance(net.fc, QuantedLinear)
+
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 1, 4, 4)).astype(np.float32)
+    Y = (X.mean(axis=(1, 2, 3)) > 0).astype(np.int64)
+    losses = []
+    for _ in range(30):
+        loss = ce(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # activation scale buffer was learned
+    assert float(net.fc.fq_act.scale.numpy()) > 0
+
+    # eval path uses the frozen moving-average scale
+    net.eval()
+    out = net(paddle.to_tensor(X[:4]))
+    assert out.shape == [4, 2]
+
+
+def test_qat_save_quantized_model(tmp_path):
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    net(paddle.to_tensor(x))  # populate scales
+    path = str(tmp_path / "qmodel")
+    from paddle_tpu.jit import InputSpec
+
+    qat.save_quantized_model(net, path, input_spec=[InputSpec([None, 8], "float32", name="x")])
+    from paddle_tpu import inference
+
+    pred = inference.create_predictor(inference.Config(path))
+    out = pred.run([x])[0]
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_post_training_quantization():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    rng = np.random.default_rng(1)
+    data = [paddle.to_tensor(3.0 * rng.standard_normal((8, 4)).astype(np.float32)) for _ in range(4)]
+    float_out = net(data[0]).numpy()
+    ptq = PostTrainingQuantization(net)
+    ptq.quantize(data)
+    # calibrated scales recorded per layer, roughly the observed abs-max
+    assert len(ptq.activation_ranges) == 2
+    assert all(v > 0 for v in ptq.activation_ranges.values())
+    net.eval()
+    q_out = net(data[0]).numpy()
+    # int8 fake-quant stays close to the float model
+    assert np.max(np.abs(q_out - float_out)) < 0.2 * np.max(np.abs(float_out)) + 0.1

