@@ -46,6 +46,11 @@ class GPTConfig:
     attn_dropout: float = 0.1
     initializer_range: float = 0.02
     sequence_parallel: bool = False
+    # how seq-sharded attention is computed when sequence_parallel and the
+    # sep axis > 1: "gspmd" (compiler-inserted gathers), "ring" (ppermute KV
+    # rotation — O(S/P) memory, the long-context path), "ulysses" (alltoall
+    # heads<->seq). Reference has none of these (SURVEY §5 gap-fill).
+    sequence_parallel_mode: str = "gspmd"
     use_recompute: bool = False
     dtype: str = "float32"
 
@@ -81,6 +86,39 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)  # [b, s, 3h] sharded on mp
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unstack(axis=2)
+        ring_mode = cfg.sequence_parallel and cfg.sequence_parallel_mode in (
+            "ring", "ulysses"
+        )
+        if ring_mode:
+            from ..core.dispatch import apply as _apply
+            from ..ops import ring_attention as _ra
+            from ..parallel.topology import axis_size, get_mesh
+
+            if axis_size("sep") > 1:
+                if self.training and cfg.attn_dropout > 0.0:
+                    raise NotImplementedError(
+                        "ring/ulysses attention has no attention-dropout "
+                        "path; set attn_dropout=0.0 (hidden-state dropout "
+                        "still applies) or use sequence_parallel_mode='gspmd'"
+                    )
+                # KV stay seq-sharded: the ring/alltoall moves them, not GSPMD
+                q = _sp(q, cfg, ("dp", "sharding"), "sep", "mp", None)
+                k = _sp(k, cfg, ("dp", "sharding"), "sep", "mp", None)
+                v = _sp(v, cfg, ("dp", "sharding"), "sep", "mp", None)
+                fn = (
+                    _ra.ring_attention
+                    if cfg.sequence_parallel_mode == "ring"
+                    else _ra.ulysses_attention
+                )
+                # module-level fn + hashable static kwargs → per-op jit cache
+                # applies (a closure here would defeat it — dispatch refuses
+                # to cache closures)
+                out = _apply(
+                    fn, q, k, v, mesh=get_mesh(), causal=True,
+                    op_name=f"{cfg.sequence_parallel_mode}_attention",
+                )
+                out = out.reshape([b, s, self.num_heads * self.head_dim])
+                return self.out_proj(out)
         # heads axis is the mp-sharded axis (TP attention)
         q = _sp(q, cfg, ("dp", "sharding"), "sep", "mp", None)
         k = _sp(k, cfg, ("dp", "sharding"), None, "mp", None)

@@ -1,0 +1,184 @@
+"""Sequence/context parallel attention: ring attention + Ulysses.
+
+Reference gap-fill (SURVEY §5 long-context): the reference has NO sequence
+parallelism — its only long-seq levers are recompute and fused attention.
+TPU-native design, per the scaling-book recipe:
+
+  ring attention   q/k/v sharded on the sequence axis over the `sep` mesh
+                   axis; each device computes blockwise online-softmax
+                   against its resident KV block, then rotates KV around
+                   the ring with lax.ppermute P-1 times. KV transfer rides
+                   ICI and overlaps with the block matmuls XLA schedules;
+                   per-device memory is O(S/P · D).
+  Ulysses          lax.all_to_all swaps the sharded axis: seq-sharded
+                   activations become head-sharded with the FULL sequence
+                   local, dense (flash) attention runs per head group, and
+                   a second all_to_all restores seq sharding. Cheaper at
+                   moderate S (two all_to_alls vs P-1 permutes), but caps
+                   parallelism at num_heads.
+
+Both are exposed as functions over GLOBAL arrays [b, s, h, d]: internally
+they shard_map over the installed mesh, so they drop into jit-compiled
+training steps whose activations carry `sep` sharding constraints.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from jax import shard_map
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _full_spec(mesh, seq_axis):
+    """Partition spec for [b, s, h, d] under the hybrid mesh: batch rides
+    dp(+sharding), seq rides the sep axis, heads ride mp — whichever of
+    those axes the mesh actually has."""
+    names = set(mesh.axis_names)
+    batch = tuple(a for a in ("dp", "sharding") if a in names and a != seq_axis)
+    head = "mp" if "mp" in names and seq_axis != "mp" else None
+    return P(batch or None, seq_axis, head, None)
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One KV block's contribution: returns (m, l, acc) online-softmax stats.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: [sq, sk] bool or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, np.float32(-1e30))
+    m = jnp.max(s, axis=-1, keepdims=True)            # [b,h,sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return m, l, acc
+
+
+def _combine(m1, l1, a1, m2, l2, a2):
+    """Merge two online-softmax partial results."""
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(m1 - m)
+    c2 = jnp.exp(m2 - m)
+    return m, l1 * c1 + l2 * c2, a1 * c1 + a2 * c2  # c broadcasts over d
+
+
+def _ring_inner(q, k, v, *, axis, causal, scale):
+    p_size = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    sq = q.shape[1]
+    b, _, h, d = q.shape
+
+    rows = jnp.arange(sq)[:, None]
+    cols = jnp.arange(sq)[None, :]
+    within = rows >= cols  # causal mask for the diagonal block
+
+    def step(t, carry):
+        m, l, acc, kb, vb = carry
+        src = (my - t) % p_size  # which global block this KV is
+        if causal:
+            # src < my: fully visible; src == my: diagonal; src > my: hidden
+            full = jnp.broadcast_to(src < my, (sq, sq))
+            diag = jnp.broadcast_to(src == my, (sq, sq)) & within
+            mask = full | diag
+        else:
+            mask = None
+        bm, bl, bacc = _block_attn(
+            q, kb.astype(jnp.float32), vb.astype(jnp.float32), scale, mask
+        )
+        m, l, acc = _combine(m, l, acc, bm, bl, bacc)
+        # rotate KV to the next device (ring over ICI)
+        perm = [(r, (r + 1) % p_size) for r in range(p_size)]
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        return m, l, acc, kb, vb
+
+    neg = jnp.full((b, h, sq, 1), np.float32(-1e30), jnp.float32)
+    zero_l = jnp.zeros((b, h, sq, 1), jnp.float32)
+    zero_a = jnp.zeros((b, h, sq, d), jnp.float32)
+    # KV rotate in their input dtype (bf16 halves ICI bytes); stats are f32
+    carry = (neg, zero_l, zero_a, k, v)
+    m, l, acc, _, _ = jax.lax.fori_loop(0, p_size, step, carry, unroll=True)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l).astype(q.dtype)       # [b,h,sq,d]
+    return jnp.swapaxes(out, 1, 2)             # [b,sq,h,d]
+
+
+def ring_attention(q, k, v, mesh=None, axis: str = "sep", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Causal self-attention with seq-sharded q/k/v (global view [b,s,h,d])."""
+    from ..parallel.topology import get_mesh
+
+    mesh = mesh or get_mesh()
+    d = q.shape[-1]
+    scale = np.float32(scale if scale is not None else 1.0 / math.sqrt(d))
+    axis_sz = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    if axis_sz == 1:
+        m, l, acc = _block_attn(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            scale,
+            (jnp.arange(q.shape[1])[:, None] >= jnp.arange(k.shape[1])[None, :])
+            if causal else None,
+        )
+        return jnp.swapaxes((acc / l).astype(q.dtype), 1, 2)
+    spec = _full_spec(mesh, axis)
+    inner = functools.partial(_ring_inner, axis=axis, causal=causal,
+                              scale=scale)
+    fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def _ulysses_inner(q, k, v, *, axis, causal, scale):
+    # seq-sharded [b, s/P, h, d] → head-sharded [b, s, h/P, d]
+    def seq2head(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    s_full = qh.shape[1]
+    mask = (
+        jnp.arange(s_full)[:, None] >= jnp.arange(s_full)[None, :]
+        if causal else None
+    )
+    m, l, acc = _block_attn(
+        qh.astype(jnp.float32), kh.astype(jnp.float32), vh.astype(jnp.float32),
+        scale, mask,
+    )
+    out = jnp.swapaxes((acc / l).astype(q.dtype), 1, 2)  # [b, s, h/P, d]
+    return head2seq(out)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis: str = "sep",
+                      causal: bool = True, scale: Optional[float] = None):
+    """DeepSpeed-Ulysses-style seq parallelism: alltoall heads<->seq."""
+    from ..parallel.topology import get_mesh
+
+    mesh = mesh or get_mesh()
+    d = q.shape[-1]
+    scale = np.float32(scale if scale is not None else 1.0 / math.sqrt(d))
+    axis_sz = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    if axis_sz == 1:
+        return ring_attention(q, k, v, mesh, axis, causal, scale)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    local_heads = q.shape[2] // sizes.get("mp", 1)
+    if local_heads % axis_sz != 0:
+        raise ValueError(
+            f"ulysses needs per-shard head count ({local_heads} = "
+            f"{q.shape[2]} heads / mp {sizes.get('mp', 1)}) divisible by the "
+            f"'{axis}' axis size ({axis_sz}); use ring attention instead"
+        )
+    spec = _full_spec(mesh, axis)
+    inner = functools.partial(_ulysses_inner, axis=axis, causal=causal, scale=scale)
+    fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
