@@ -1,0 +1,173 @@
+"""FleetExecutor — C++ actor runtime driving task-DAG pipeline schedules.
+
+Reference analogue: paddle/fluid/distributed/fleet_executor/
+(fleet_executor.h:35 FleetExecutor, carrier.h:49, interceptor.h:43,
+task_node.h, dist_model.cc). The reference compiles a Program into TaskNodes
+and runs them as actors exchanging InterceptorMessages over brpc; here the
+carrier/interceptor core is the same design in csrc/fleet_executor.cc
+(threads + queues, C ABI), and the payload of each task is a Python
+callable — typically a jitted XLA program per pipeline stage, so the actor
+threads orchestrate while XLA computes.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["TaskNode", "FleetExecutor"]
+
+_lib = None
+_COMPUTE_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_int64, ctypes.c_int64)
+
+
+def _load_lib():
+    global _lib
+    if _lib is None:
+        import os
+
+        from ...utils import cpp_extension
+
+        src = os.path.join(os.path.dirname(__file__), "csrc", "fleet_executor.cc")
+        _lib = cpp_extension.load("fleet_executor", [src])
+        _lib.carrier_create.restype = ctypes.c_void_p
+        _lib.carrier_add_task.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _COMPUTE_FN, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _lib.carrier_start.argtypes = [ctypes.c_void_p]
+        _lib.carrier_stop.argtypes = [ctypes.c_void_p]
+        _lib.carrier_wait.restype = ctypes.c_int32
+        _lib.carrier_wait.argtypes = [ctypes.c_void_p]
+        _lib.carrier_destroy.argtypes = [ctypes.c_void_p]
+    return _lib
+
+
+class TaskNode:
+    """One DAG node (reference: task_node.h): a callable run once per
+    microbatch, gated on all upstream nodes having run that microbatch."""
+
+    def __init__(self, task_id: int, fn: Optional[Callable] = None,
+                 max_run_times: int = 1):
+        self.task_id = int(task_id)
+        self.fn = fn
+        self.max_run_times = int(max_run_times)
+        self.upstream: List[int] = []
+        self.downstream: List[int] = []
+
+    def add_upstream_task(self, task_id: int):
+        self.upstream.append(int(task_id))
+        return self
+
+    def add_downstream_task(self, task_id: int):
+        self.downstream.append(int(task_id))
+        return self
+
+
+class FleetExecutor:
+    """Build a carrier from TaskNodes and run the actor schedule.
+
+    Each interceptor is a native thread; Python callbacks run under the GIL
+    but jax dispatch releases it, so stage compute genuinely overlaps
+    (microbatch t on stage k runs while t+1 runs on stage k-1 — the 1F1B-
+    style host schedule the reference's SectionWorker/interceptors give).
+    """
+
+    def __init__(self, nodes: Sequence[TaskNode]):
+        self._nodes: Dict[int, TaskNode] = {n.task_id: n for n in nodes}
+        if len(self._nodes) != len(nodes):
+            raise ValueError("duplicate task ids")
+        # validate BOTH edge directions and their symmetry: an asymmetric
+        # edge would silently hang (upstream never fed) or silently drop
+        # messages (downstream unknown)
+        for n in nodes:
+            for u in n.upstream:
+                if u not in self._nodes:
+                    raise ValueError(f"task {n.task_id} upstream {u} unknown")
+                if n.task_id not in self._nodes[u].downstream:
+                    raise ValueError(
+                        f"task {n.task_id} lists {u} upstream but {u} does "
+                        f"not list {n.task_id} downstream (asymmetric edge)"
+                    )
+            for d in n.downstream:
+                if d not in self._nodes:
+                    raise ValueError(f"task {n.task_id} downstream {d} unknown")
+                if n.task_id not in self._nodes[d].upstream:
+                    raise ValueError(
+                        f"task {n.task_id} lists {d} downstream but {d} does "
+                        f"not list {n.task_id} upstream (asymmetric edge)"
+                    )
+        self._errors: Dict[int, BaseException] = {}
+        self._lock = threading.Lock()
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """Execute all microbatches; raises the first task exception.
+        On timeout the carrier is aborted (STOP broadcast) and TimeoutError
+        raised."""
+        lib = _load_lib()
+        carrier = lib.carrier_create()
+        with self._lock:
+            self._errors.clear()
+        thunks = []  # keep CFUNCTYPE objects alive for the whole run
+        try:
+            for n in self._nodes.values():
+                fn = n.fn
+
+                def thunk(task_id, scope, _fn=fn):
+                    if _fn is None:
+                        return 0
+                    try:
+                        _fn(scope)
+                        return 0
+                    except BaseException as e:  # propagate into carrier_wait
+                        with self._lock:
+                            self._errors[int(task_id)] = e
+                        return 1
+
+                cfn = _COMPUTE_FN(thunk)
+                thunks.append(cfn)
+                ups = (ctypes.c_int64 * len(n.upstream))(*n.upstream)
+                downs = (ctypes.c_int64 * len(n.downstream))(*n.downstream)
+                lib.carrier_add_task(
+                    carrier, n.task_id, cfn, n.max_run_times,
+                    ups, len(n.upstream), downs, len(n.downstream),
+                )
+            lib.carrier_start(carrier)
+            if timeout is None:
+                rc = lib.carrier_wait(carrier)
+            else:
+                result = {}
+                waiter = threading.Thread(
+                    target=lambda: result.update(rc=lib.carrier_wait(carrier))
+                )
+                waiter.start()
+                waiter.join(timeout)
+                if waiter.is_alive():
+                    lib.carrier_stop(carrier)
+                    waiter.join()
+                    raise TimeoutError(
+                        f"fleet executor did not finish within {timeout}s"
+                    )
+                rc = result["rc"]
+            if rc != 0:
+                with self._lock:
+                    err = next(iter(self._errors.values()), None)
+                if err is not None:
+                    raise err
+                raise RuntimeError(f"fleet executor failed rc={rc}")
+        finally:
+            lib.carrier_destroy(carrier)
+
+    @staticmethod
+    def pipeline(stages: Sequence[Callable], num_micro: int) -> "FleetExecutor":
+        """Linear pipeline sugar: stage k's microbatch t runs after stage
+        k-1's microbatch t (reference: the origin_scheduler task chain)."""
+        nodes = []
+        for i, fn in enumerate(stages):
+            n = TaskNode(i, fn, max_run_times=num_micro)
+            if i > 0:
+                n.add_upstream_task(i - 1)
+            if i < len(stages) - 1:
+                n.add_downstream_task(i + 1)
+            nodes.append(n)
+        return FleetExecutor(nodes)

@@ -1,0 +1,190 @@
+// FleetExecutor — actor-model pipeline runtime (carrier + interceptors).
+//
+// Reference analogue: paddle/fluid/distributed/fleet_executor/
+//   carrier.h:49      — Carrier owns interceptors, routes InterceptorMessage
+//   interceptor.h:43  — an actor: message queue + handler thread
+//   task_node.h       — DAG node: upstream/downstream edges, max_run_times
+//   message_bus.h:40  — inter-carrier transport (brpc); here single-process,
+//                       so the bus is the in-memory queue fabric.
+//
+// TPU-native role: the host-side orchestrator for multi-program pipeline
+// schedules (across-host DCN pipelines and async data/ckpt work), where the
+// in-XLA ppermute pipeline (parallel/pipeline.py) doesn't apply. Compute
+// callbacks are C function pointers (ctypes thunks into Python, which
+// acquire the GIL per call; heavy work should release it via jax dispatch).
+//
+// Build: via paddle_tpu.utils.cpp_extension (g++ -shared -fPIC).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum MsgType : int32_t { DATA = 0, STOP = 1 };
+
+struct InterceptorMessage {
+  int64_t src_id;
+  int64_t dst_id;
+  int32_t type;
+  int64_t scope_idx;  // microbatch index
+};
+
+// compute callback: fn(task_id, scope_idx) -> 0 ok / nonzero error
+typedef int32_t (*ComputeFn)(int64_t, int64_t);
+
+class Carrier;
+
+class Interceptor {
+ public:
+  Interceptor(Carrier* carrier, int64_t id, ComputeFn fn, int64_t max_runs,
+              std::vector<int64_t> ups, std::vector<int64_t> downs)
+      : carrier_(carrier),
+        id_(id),
+        fn_(fn),
+        max_runs_(max_runs),
+        ups_(std::move(ups)),
+        downs_(std::move(downs)) {}
+
+  void Start() { thread_ = std::thread([this] { Loop(); }); }
+
+  void Join() {
+    if (thread_.joinable()) thread_.join();
+  }
+
+  void Enqueue(const InterceptorMessage& msg) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.push_back(msg);
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void Loop();
+
+  Carrier* carrier_;
+  int64_t id_;
+  ComputeFn fn_;
+  int64_t max_runs_;
+  std::vector<int64_t> ups_;
+  std::vector<int64_t> downs_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<InterceptorMessage> queue_;
+  std::thread thread_;
+  // per-microbatch count of upstream DATA arrivals
+  std::unordered_map<int64_t, int64_t> ready_;
+
+  friend class Carrier;
+};
+
+class Carrier {
+ public:
+  ~Carrier() { Wait(); }
+
+  void AddTask(int64_t id, ComputeFn fn, int64_t max_runs,
+               const int64_t* ups, int64_t n_ups,
+               const int64_t* downs, int64_t n_downs) {
+    interceptors_[id] = std::unique_ptr<Interceptor>(new Interceptor(
+        this, id, fn, max_runs, std::vector<int64_t>(ups, ups + n_ups),
+        std::vector<int64_t>(downs, downs + n_downs)));
+  }
+
+  // route a message to its destination queue (the in-process MessageBus)
+  void Send(const InterceptorMessage& msg) {
+    auto it = interceptors_.find(msg.dst_id);
+    if (it != interceptors_.end()) it->second->Enqueue(msg);
+  }
+
+  void Start() {
+    error_.store(0);
+    for (auto& kv : interceptors_) kv.second->Start();
+    // kick sources: one DATA per microbatch from the virtual source (-1)
+    for (auto& kv : interceptors_) {
+      if (kv.second->ups_.empty()) {
+        for (int64_t s = 0; s < kv.second->max_runs_; ++s) {
+          Send({-1, kv.first, DATA, s});
+        }
+      }
+    }
+  }
+
+  void Wait() {
+    for (auto& kv : interceptors_) kv.second->Join();
+  }
+
+  // record the error AND wake every interceptor with STOP — a failed stage
+  // must not leave downstream actors blocked on queues that will never fill
+  void SetError(int32_t e) {
+    error_.store(e);
+    for (auto& kv : interceptors_) Send({-1, kv.first, STOP, 0});
+  }
+  int32_t GetError() const { return error_.load(); }
+
+ private:
+  std::unordered_map<int64_t, std::unique_ptr<Interceptor>> interceptors_;
+  std::atomic<int32_t> error_{0};
+};
+
+void Interceptor::Loop() {
+  int64_t done = 0;
+  int64_t n_need = ups_.empty() ? 1 : static_cast<int64_t>(ups_.size());
+  while (done < max_runs_) {
+    InterceptorMessage msg;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return !queue_.empty(); });
+      msg = queue_.front();
+      queue_.pop_front();
+    }
+    if (msg.type == STOP) break;
+    if (carrier_->GetError() != 0) break;
+    int64_t scope = msg.scope_idx;
+    if (++ready_[scope] < n_need) continue;  // wait for all upstreams
+    ready_.erase(scope);
+    if (fn_ != nullptr) {
+      int32_t rc = fn_(id_, scope);  // ctypes thunk: grabs the GIL
+      if (rc != 0) {
+        carrier_->SetError(rc);
+        break;
+      }
+    }
+    for (int64_t d : downs_) carrier_->Send({id_, d, DATA, scope});
+    ++done;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* carrier_create() { return new Carrier(); }
+
+void carrier_add_task(void* h, int64_t id, ComputeFn fn, int64_t max_runs,
+                      const int64_t* ups, int64_t n_ups,
+                      const int64_t* downs, int64_t n_downs) {
+  static_cast<Carrier*>(h)->AddTask(id, fn, max_runs, ups, n_ups, downs,
+                                    n_downs);
+}
+
+void carrier_start(void* h) { static_cast<Carrier*>(h)->Start(); }
+
+// abort: wake every interceptor with STOP so Wait() returns promptly
+void carrier_stop(void* h) { static_cast<Carrier*>(h)->SetError(-2); }
+
+int32_t carrier_wait(void* h) {
+  Carrier* c = static_cast<Carrier*>(h);
+  c->Wait();
+  return c->GetError();
+}
+
+void carrier_destroy(void* h) { delete static_cast<Carrier*>(h); }
+
+}  // extern "C"

@@ -44,11 +44,15 @@ def load(
     extra_ldflags: Optional[List[str]] = None,
     build_directory: Optional[str] = None,
     verbose: bool = False,
-) -> ctypes.CDLL:
+    ops: Optional[Sequence[str]] = None,
+):
     """Compile C++ sources to lib<name>.so (content-hash cached) and dlopen it.
 
-    reference: cpp_extension.load() — same contract minus nvcc; returns the
-    ctypes.CDLL through which C-ABI symbols are called.
+    reference: cpp_extension.load() — same contract minus nvcc. Returns the
+    ctypes.CDLL for raw C-ABI use, or — when `ops` names custom kernels
+    following the documented elementwise ABI (see utils/custom_op.py) — a
+    namespace of framework ops usable on Tensors with tape autograd (the
+    reference's `custom_ops = load(...)` surface).
     """
     build_dir = build_directory or get_build_directory()
     cflags = _DEFAULT_CFLAGS + (extra_cflags or [])
@@ -75,4 +79,9 @@ def load(
         finally:
             if os.path.exists(tmp_path):
                 os.unlink(tmp_path)
-    return ctypes.CDLL(so_path)
+    lib = ctypes.CDLL(so_path)
+    if ops is not None:
+        from .custom_op import build_cpp_ops
+
+        return build_cpp_ops(lib, ops)
+    return lib

@@ -1,0 +1,157 @@
+"""Custom operator registration — the plug-a-kernel path.
+
+Reference analogue: paddle/fluid/framework/custom_operator.cc:675
+(RegisterOperatorWithMetaInfo: load a user .so, register op + grad kernels
+into the global registry) and python/paddle/utils/cpp_extension (the JIT
+build + `custom_ops = load(...)` module surface).
+
+TPU-native design: a custom op is (a) a pure jax/Pallas function — the
+natural "kernel" here, dispatched through the tape like any built-in op,
+with an optional hand-written vjp; or (b) a host C++ kernel exposed over
+the C ABI, bridged into XLA programs with jax.pure_callback (host callback
+op) — the analogue of a CPU-only custom kernel in the reference. Both
+register under paddle.utils.custom_op.get_op(name).
+"""
+from __future__ import annotations
+
+import ctypes
+from types import SimpleNamespace
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["register_op", "get_op", "build_cpp_ops"]
+
+_registry: Dict[str, Callable] = {}
+
+
+def register_op(name: str, fn: Callable, grad_fn: Optional[Callable] = None,
+                differentiable: bool = True):
+    """Register a jax-traceable function as a framework op.
+
+    fn(*arrays, **static) -> array(s). grad_fn, if given, overrides the
+    autodiff rule: grad_fn(inputs: tuple, outputs, cotangents) -> tuple of
+    input grads (the reference's registered backward kernel). Returns a
+    user-facing callable over paddle Tensors, recorded on the tape.
+    differentiable=False marks a forward-only op (a reference op with no
+    grad kernel): outputs carry stop_gradient=True.
+    """
+    import functools
+    import warnings
+
+    from ..core.dispatch import apply
+
+    if name in _registry:
+        warnings.warn(
+            f"custom op {name!r} is already registered; the new kernel "
+            "replaces it for get_op() lookups"
+        )
+
+    if grad_fn is None:
+        def op(*tensors, **static):
+            return apply(
+                fn, *tensors, op_name=name, differentiable=differentiable,
+                **static,
+            )
+    else:
+        # jax.custom_vjp can't route kwargs — bake static kwargs into the
+        # primal/backward with partial, one cached kernel per static combo
+        _kernels = {}
+
+        def _kernel_for(static_items):
+            k = _kernels.get(static_items)
+            if k is None:
+                primal = functools.partial(fn, **dict(static_items))
+
+                @jax.custom_vjp
+                def kernel(*args):
+                    return primal(*args)
+
+                def fwd(*args):
+                    out = primal(*args)
+                    return out, (args, out)
+
+                def bwd(res, ct):
+                    args, out = res
+                    return tuple(grad_fn(args, out, ct))
+
+                kernel.defvjp(fwd, bwd)
+                _kernels[static_items] = k = kernel
+            return k
+
+        def op(*tensors, **static):
+            kernel = _kernel_for(tuple(sorted(static.items())))
+            return apply(
+                kernel, *tensors, op_name=name, differentiable=differentiable
+            )
+
+    op.__name__ = name
+    _registry[name] = op
+    return op
+
+
+def get_op(name: str) -> Callable:
+    return _registry[name]
+
+
+# ---------------------------------------------------------------------------
+# C++ kernels over the C ABI (elementwise f32 contract)
+# ---------------------------------------------------------------------------
+# Kernel ABI (documented contract, replacing PD_BUILD_OP macros):
+#   void <name>(const float* x, float* y, int64_t n);
+#   void <name>_grad(const float* x, const float* gy, float* gx, int64_t n);
+# The grad symbol is optional; without it the op is forward-only
+# (stop_gradient outputs), mirroring a reference op with no grad kernel.
+def build_cpp_ops(lib: ctypes.CDLL, op_names: Sequence[str]) -> SimpleNamespace:
+    ns = {}
+    for opname in op_names:
+        cfun = getattr(lib, opname)
+        cfun.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        cfun.restype = None
+        try:
+            gfun = getattr(lib, opname + "_grad")
+            gfun.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_void_p, ctypes.c_int64]
+            gfun.restype = None
+        except AttributeError:
+            gfun = None
+        ns[opname] = _make_cpp_op(opname, cfun, gfun)
+    return SimpleNamespace(**ns)
+
+
+def _make_cpp_op(opname, cfun, gfun):
+    def host_fwd(x):
+        x = np.ascontiguousarray(x, np.float32)
+        out = np.empty_like(x)
+        cfun(x.ctypes.data, out.ctypes.data, x.size)
+        return out
+
+    def jax_fwd(x):
+        x = x.astype(jnp.float32)
+        return jax.pure_callback(
+            host_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+            vmap_method="sequential",
+        )
+
+    if gfun is None:
+        # no <name>_grad symbol: forward-only (pure_callback has no JVP)
+        return register_op(opname, jax_fwd, differentiable=False)
+
+    def host_bwd(x, gy):
+        x = np.ascontiguousarray(x, np.float32)
+        gy = np.ascontiguousarray(gy, np.float32)
+        gx = np.empty_like(x)
+        gfun(x.ctypes.data, gy.ctypes.data, gx.ctypes.data, x.size)
+        return gx
+
+    def grad_fn(inputs, out, ct):
+        (x,) = inputs
+        gx = jax.pure_callback(
+            host_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, ct,
+            vmap_method="sequential",
+        )
+        return (gx,)
+
+    return register_op(opname, jax_fwd, grad_fn)
