@@ -58,6 +58,73 @@ def bench_resnet50(steps=8, bsz=64):
             "value": round(bsz * steps / dt, 1), "unit": "imgs/s/chip"}
 
 
+def bench_bert(steps=6, bsz=8, seq=512):
+    """BASELINE config 3: BERT-base pretraining (MLM+NSP), AMP O2."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import (
+        BertConfig,
+        BertForPretraining,
+        BertPretrainingCriterion,
+    )
+
+    paddle.seed(0)
+    cfg = BertConfig(max_seq_len=seq, dropout=0.0, attn_dropout=0.0)
+    model = paddle.amp.decorate(BertForPretraining(cfg), level="O2", dtype="bfloat16")
+    crit = BertPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(out, packed):
+        mlm_logits, nsp_logits = out
+        return crit(
+            mlm_logits.astype("float32"), nsp_logits.astype("float32"),
+            packed[:, :-1], packed[:, -1],
+        )
+
+    step = paddle.jit.compile_train_step(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(jnp.asarray(rng.integers(0, cfg.vocab_size, (bsz, seq)), jnp.int32))
+    packed = jax.device_put(jnp.asarray(
+        np.concatenate(
+            [rng.integers(0, cfg.vocab_size, (bsz, seq)), rng.integers(0, 2, (bsz, 1))],
+            axis=1,
+        ), jnp.int64,
+    ))
+    x = paddle.Tensor(ids, stop_gradient=True)
+    y = paddle.Tensor(packed, stop_gradient=True)
+    float(step(x, y))
+    float(step(x, y))
+    t0 = time.time()
+    last = None
+    for _ in range(steps):
+        last = step(x, y)
+    float(last)
+    dt = time.time() - t0
+    return {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+            "value": round(bsz * seq * steps / dt, 1), "unit": "tokens/s/chip"}
+
+
+def bench_ps_table(iters=10, batch=65536, dim=64):
+    """BASELINE config 5 slice: host sparse-table pull+push throughput."""
+    from paddle_tpu.distributed.ps import MemorySparseTable
+
+    t = MemorySparseTable(dim, shard_num=32, init_range=0.01)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 10_000_000, batch)
+    grads = rng.standard_normal((batch, dim)).astype(np.float32)
+    t.pull(keys)  # warm (creates entries)
+    t0 = time.time()
+    for _ in range(iters):
+        t.pull(keys)
+        t.push(keys, grads)
+    dt = time.time() - t0
+    return {"metric": "ps_sparse_pull_push_m_lookups_per_sec",
+            "value": round(batch * iters * 2 / dt / 1e6, 2), "unit": "M lookups/s"}
+
+
 def bench_mnist_eager(steps=30, bsz=64):
     """BASELINE config 1: LeNet MNIST pure-eager — per-op dispatch overhead."""
     import paddle_tpu as paddle
@@ -167,7 +234,12 @@ def main():
     # lose the main measurement (one-JSON-line stdout contract)
     print(json.dumps(result), flush=True)
     if os.environ.get("BENCH_EXTRA", "1") == "1":
-        for name, fn in (("resnet50", bench_resnet50), ("mnist", bench_mnist_eager)):
+        for name, fn in (
+            ("resnet50", bench_resnet50),
+            ("bert", bench_bert),
+            ("mnist", bench_mnist_eager),
+            ("ps_table", bench_ps_table),
+        ):
             try:
                 extra = fn()
                 print(f"# config {name}: {json.dumps(extra)}", file=sys.stderr)

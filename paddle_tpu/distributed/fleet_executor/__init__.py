@@ -97,6 +97,12 @@ class FleetExecutor:
                         f"task {n.task_id} lists {d} downstream but {d} does "
                         f"not list {n.task_id} upstream (asymmetric edge)"
                     )
+                if self._nodes[d].max_run_times > n.max_run_times:
+                    raise ValueError(
+                        f"task {d} expects {self._nodes[d].max_run_times} "
+                        f"microbatches but upstream {n.task_id} only emits "
+                        f"{n.max_run_times} — the extra scopes would hang"
+                    )
         self._errors: Dict[int, BaseException] = {}
         self._lock = threading.Lock()
 
@@ -144,7 +150,13 @@ class FleetExecutor:
                 waiter.join(timeout)
                 if waiter.is_alive():
                     lib.carrier_stop(carrier)
-                    waiter.join()
+                    # STOP only lands between messages; a callback stuck
+                    # inside a stage can't be interrupted — bound this join
+                    # and, if still stuck, leak the carrier (destroying it
+                    # would join the stuck thread forever)
+                    waiter.join(10.0)
+                    if waiter.is_alive():
+                        carrier = None
                     raise TimeoutError(
                         f"fleet executor did not finish within {timeout}s"
                     )
@@ -156,7 +168,8 @@ class FleetExecutor:
                     raise err
                 raise RuntimeError(f"fleet executor failed rc={rc}")
         finally:
-            lib.carrier_destroy(carrier)
+            if carrier is not None:
+                lib.carrier_destroy(carrier)
 
     @staticmethod
     def pipeline(stages: Sequence[Callable], num_micro: int) -> "FleetExecutor":

@@ -8,3 +8,5 @@ from .recompute import recompute  # noqa: F401
 from . import nn  # noqa: F401
 from . import moe  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401

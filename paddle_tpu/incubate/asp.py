@@ -1,0 +1,113 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference analogue: python/paddle/fluid/contrib/sparsity/asp.py
+(ASPHelper: prune_model computes 2:4 masks per supported layer,
+decorate(optimizer) re-applies masks after every step so pruned weights
+stay zero) and utils.py (mask_1d / check_sparsity).
+
+TPU note: the reference targets Ampere sparse tensor cores; the MXU has no
+2:4 sparse mode, so here ASP is a *training technique* (masked weights,
+mask-preserving updates) whose artifacts deploy to sparse-capable
+backends. Masks are plain arrays multiplied in, so the compiled train step
+path can fold them too.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "prune_model",
+    "decorate",
+    "compute_mask",
+    "check_sparsity",
+    "reset_asp_state",
+]
+
+# id -> (weakref to the param, mask). The weakref guards against CPython
+# id reuse: a dead entry whose id was recycled must never mask an unrelated
+# parameter, and dead entries are dropped on lookup.
+_masks: Dict[int, Tuple["weakref.ref", jnp.ndarray]] = {}
+
+
+def _mask_for(p) -> jnp.ndarray:
+    entry = _masks.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:
+        del _masks[id(p)]  # stale id-reuse entry
+        return None
+    return mask
+
+_SUPPORTED = ("Linear", "Conv2D")
+
+
+def compute_mask(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the last axis: keep the n largest |w| of every m
+    (reference: sparsity/utils.py get_mask_1d)."""
+    flat = np.asarray(w, np.float32)
+    shape = flat.shape
+    if shape[-1] % m != 0:
+        raise ValueError(f"last dim {shape[-1]} not divisible by m={m}")
+    groups = np.abs(flat).reshape(-1, m)
+    # indices of the n largest per group
+    keep = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(shape)
+
+
+def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(w._value if isinstance(w, Tensor) else w)
+    nz = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Apply n:m pruning to supported layer weights; registers masks so
+    decorate()d optimizers keep them."""
+    pruned = {}
+    for name, layer in model.named_sublayers(include_self=True):
+        if type(layer).__name__ not in _SUPPORTED:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or w._value.ndim < 2 or w._value.shape[-1] % m != 0:
+            continue
+        mask = compute_mask(np.asarray(w._value), n, m)
+        with no_grad():
+            w._value = w._value * jnp.asarray(mask, w._value.dtype)
+        if with_mask:
+            _masks[id(w)] = (weakref.ref(w), jnp.asarray(mask))
+        pruned[name or type(layer).__name__] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-mask pruned params after every update
+    (reference: ASPHelper.decorate → OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        with no_grad():
+            for p in optimizer._parameters:
+                mask = _mask_for(p)
+                if mask is not None:
+                    p._value = p._value * mask.astype(p._value.dtype)
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_asp_state():
+    _masks.clear()

@@ -1,0 +1,65 @@
+"""paddle.incubate.autograd — primitive-operator autodiff surface.
+
+Reference analogue: python/paddle/incubate/autograd/ (enable_prim lowers
+ops to primitive ops — add_p/mul_p/matmul_p in operators/prim_ops/ — so
+higher-order transforms compose). TPU-native: jax IS a primitive-op
+autodiff system, so "prim mode" is always on; the toggles are kept for
+script parity and the functional transforms re-export the real
+implementations in paddle.autograd.functional.
+"""
+from __future__ import annotations
+
+from ..autograd.functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    hessian,
+    jacobian,
+    jvp,
+    vjp,
+)
+
+__all__ = [
+    "vjp",
+    "jvp",
+    "Jacobian",
+    "Hessian",
+    "jacobian",
+    "hessian",
+    "enable_prim",
+    "disable_prim",
+    "prim_enabled",
+    "forward_grad",
+    "grad",
+]
+
+_prim = {"enabled": True}
+
+
+def enable_prim():
+    _prim["enabled"] = True
+
+
+def disable_prim():
+    # everything here is already primitive-based; the flag is advisory
+    _prim["enabled"] = True
+
+
+def prim_enabled() -> bool:
+    return _prim["enabled"]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grads (reference: incubate/autograd/primapi.py
+    forward_grad) — jvp over the traced function is the jax-native form;
+    here exposed for Tensor graphs via double-vjp trick is unnecessary:
+    use paddle.autograd.jvp on a function instead."""
+    raise NotImplementedError(
+        "forward_grad over recorded graphs: express the computation as a "
+        "function and use paddle.autograd.jvp(func, xs)"
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ..autograd import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs, create_graph=True)

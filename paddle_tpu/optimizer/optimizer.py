@@ -121,18 +121,21 @@ class Optimizer:
         if gval.dtype != p._value.dtype:
             gval = gval.astype(p._value.dtype)
         # the key must cover EVERY value the traced rule reads off self —
-        # _hyper() plus the base-class weight decay — or a second optimizer
-        # instance would silently reuse a stale compiled update
+        # _hyper(), per-param overrides (AdamW decay exclusion, Lars
+        # exclude list), and the base-class weight decay — or a second
+        # optimizer instance would silently reuse a stale compiled update
+        per = self._per_param_hyper(p)
         key = (
             type(self),
             tuple(sorted(self._hyper().items())),
+            tuple(sorted(per.items())),
             self._weight_decay,
             p._value.shape,
             str(p._value.dtype),
         )
         fn = _jit_update_cache.get(key)
         if fn is None:
-            hyper = self._hyper()
+            hyper = dict(self._hyper(), **per)
             rule = type(self)._update
 
             def pure(pv, gv, lr, st, _self=self):
@@ -473,3 +476,50 @@ class Lamb(Optimizer):
         return p - lr.astype(p.dtype) * trust * r, {
             "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
         }
+
+
+class Lars(Optimizer):
+    """LARS — layer-wise adaptive rate scaling for large-batch SGD.
+
+    reference: operators/optimizers/lars_momentum_op.cc + the
+    LarsOptimizer meta-optimizer (fleet/meta_optimizers/lars_optimizer.py):
+    local_lr = lr * coeff * ||w|| / (||g|| + lambda*||w|| + eps), momentum
+    applied on the rescaled gradient."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, exclude_from_weight_decay=None,
+                 epsilon=0.0, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._eps = epsilon
+        # name fragments excluded from weight decay (reference: lars
+        # meta-optimizer's exclude_from_weight_decay list — biases/norms)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _hyper(self):
+        return {"mu": self._momentum, "coeff": self._coeff, "wd": self._wd,
+                "eps": self._eps}
+
+    def _per_param_hyper(self, p):
+        name = getattr(p, "name", "") or ""
+        if any(frag in name for frag in self._exclude):
+            return {"wd": 0.0}
+        return {}
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update(self, p, g, lr, state, *, mu, coeff, wd, eps):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            coeff * w_norm / (g_norm + wd * w_norm + eps),
+            1.0,
+        ).astype(p.dtype)
+        step = g + wd * p
+        v = mu * state["velocity"] + (lr.astype(p.dtype) * local_lr) * step
+        return p - v, {"velocity": v}

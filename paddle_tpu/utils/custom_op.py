@@ -60,10 +60,10 @@ def register_op(name: str, fn: Callable, grad_fn: Optional[Callable] = None,
         # primal/backward with partial, one cached kernel per static combo
         _kernels = {}
 
-        def _kernel_for(static_items):
+        def _kernel_for(static_items, static):
             k = _kernels.get(static_items)
             if k is None:
-                primal = functools.partial(fn, **dict(static_items))
+                primal = functools.partial(fn, **static)
 
                 @jax.custom_vjp
                 def kernel(*args):
@@ -82,7 +82,12 @@ def register_op(name: str, fn: Callable, grad_fn: Optional[Callable] = None,
             return k
 
         def op(*tensors, **static):
-            kernel = _kernel_for(tuple(sorted(static.items())))
+            from ..core.dispatch import _hashable
+
+            kernel = _kernel_for(
+                tuple(sorted((k, _hashable(v)) for k, v in static.items())),
+                static,
+            )
             return apply(
                 kernel, *tensors, op_name=name, differentiable=differentiable
             )
@@ -129,11 +134,14 @@ def _make_cpp_op(opname, cfun, gfun):
         return out
 
     def jax_fwd(x):
-        x = x.astype(jnp.float32)
-        return jax.pure_callback(
-            host_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
-            vmap_method="sequential",
+        # the C ABI contract is f32; preserve the caller's dtype (bf16
+        # under AMP O2) across the host round-trip
+        orig = x.dtype
+        out = jax.pure_callback(
+            host_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+            x.astype(jnp.float32), vmap_method="sequential",
         )
+        return out.astype(orig)
 
     if gfun is None:
         # no <name>_grad symbol: forward-only (pure_callback has no JVP)
@@ -149,9 +157,10 @@ def _make_cpp_op(opname, cfun, gfun):
     def grad_fn(inputs, out, ct):
         (x,) = inputs
         gx = jax.pure_callback(
-            host_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, ct,
+            host_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+            x.astype(jnp.float32), ct.astype(jnp.float32),
             vmap_method="sequential",
         )
-        return (gx,)
+        return (gx.astype(x.dtype),)
 
     return register_op(opname, jax_fwd, grad_fn)

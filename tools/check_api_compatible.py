@@ -1,0 +1,37 @@
+"""Compare two API.spec files; exit nonzero on removed/changed signatures.
+
+Reference analogue: tools/check_api_compatible.py (the CI gate on
+API.spec). Additions are allowed; removals and signature changes fail.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def load(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            name, _, sig = line.partition(" ")
+            out[name] = sig
+    return out
+
+
+def main(old_path, new_path):
+    old, new = load(old_path), load(new_path)
+    removed = sorted(set(old) - set(new))
+    changed = sorted(n for n in set(old) & set(new) if old[n] != new[n])
+    for n in removed:
+        print(f"REMOVED: {n} {old[n]}")
+    for n in changed:
+        print(f"CHANGED: {n} {old[n]} -> {new[n]}")
+    added = len(set(new) - set(old))
+    print(f"# {len(removed)} removed, {len(changed)} changed, {added} added")
+    return 1 if (removed or changed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

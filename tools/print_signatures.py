@@ -1,0 +1,86 @@
+"""Generate the public-API signature spec.
+
+Reference analogue: tools/print_signatures.py → paddle/fluid/API.spec and
+tools/check_api_compatible.py — the CI gate that makes public-API signature
+changes explicit. Usage:
+
+    python tools/print_signatures.py > API.spec
+    python tools/check_api_compatible.py API.spec <new.spec>
+"""
+from __future__ import annotations
+
+import inspect
+import sys
+
+SUBMODULES = [
+    "",
+    "nn",
+    "nn.functional",
+    "nn.initializer",
+    "optimizer",
+    "optimizer.lr",
+    "autograd",
+    "amp",
+    "io",
+    "jit",
+    "static",
+    "linalg",
+    "metric",
+    "distributed",
+    "distributed.fleet",
+    "distribution",
+    "sparse",
+    "fft",
+    "signal",
+    "text",
+    "vision",
+    "vision.transforms",
+    "vision.models",
+    "inference",
+    "device",
+    "profiler",
+    "quantization",
+    "incubate",
+    "utils",
+    "hub",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(*args, **kwargs)"
+
+
+def collect(root_name: str = "paddle_tpu"):
+    import importlib
+
+    lines = []
+    for sub in SUBMODULES:
+        mod_name = root_name if not sub else f"{root_name}.{sub}"
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError:
+            continue
+        public = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")
+        ]
+        for name in sorted(set(public)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            qual = f"paddle.{sub + '.' if sub else ''}{name}"
+            if inspect.isclass(obj):
+                lines.append(f"{qual} (class{_sig(obj.__init__)})")
+            elif callable(obj):
+                lines.append(f"{qual} ({_sig(obj)})")
+            else:
+                lines.append(f"{qual} (attribute)")
+    return sorted(set(lines))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    for line in collect():
+        print(line)
