@@ -32,6 +32,9 @@ from .meta_parallel import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import DataGenerator, InMemoryDataset, QueueDataset  # noqa: F401
+from . import elastic  # noqa: F401
 
 __all__ = [
     "init",
@@ -48,6 +51,9 @@ __all__ = [
     "barrier_worker",
     "PaddleCloudRoleMaker",
     "UserDefinedRoleMaker",
+    "DataGenerator",
+    "InMemoryDataset",
+    "QueueDataset",
 ]
 
 _state = {"strategy": None, "hcg": None, "initialized": False}

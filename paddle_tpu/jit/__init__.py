@@ -337,6 +337,12 @@ class CompiledTrainStep:
         # apply_decay_param_fun excluding biases from weight decay)
         per_hyper = [dict(hyper, **opt._per_param_hyper(p)) for p in params]
         grad_clip = opt._grad_clip
+        # ASP masks (incubate/asp.py): pruned params must stay n:m sparse
+        # through the compiled update too — fold the mask into the new
+        # param value (mask is a traced constant; prune BEFORE building)
+        from ..incubate import asp as _asp
+
+        asp_masks = [_asp._mask_for(p) for p in params]
 
         def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
             def loss_of(p_vals):
@@ -364,10 +370,14 @@ class CompiledTrainStep:
                 )
                 grads = [g._value for _, g in pairs]
             new_p, new_s = [], []
-            for pv, gv, st, h in zip(p_vals, grads, opt_states, per_hyper):
+            for pv, gv, st, h, mask in zip(
+                p_vals, grads, opt_states, per_hyper, asp_masks
+            ):
                 if gv.dtype != pv.dtype:
                     gv = gv.astype(pv.dtype)
                 np_, ns_ = rule(opt, pv, gv, lr, st, **h)
+                if mask is not None:
+                    np_ = np_ * mask.astype(np_.dtype)
                 new_p.append(np_)
                 new_s.append(ns_)
             return loss, tuple(new_p), tuple(new_s), new_b

@@ -286,22 +286,12 @@ class AdamW(Adam):
                          None, grad_clip)
         self._wd_coeff = float(weight_decay) if not hasattr(weight_decay, "_coeff") else float(weight_decay._coeff)
         self._apply_decay_param_fun = apply_decay_param_fun
-        self._skip_decay_ids = None
 
     def _hyper(self):
         return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
                 "wd": self._wd_coeff}
 
     @no_grad()
-    def step(self):
-        if self._apply_decay_param_fun is not None and self._skip_decay_ids is None:
-            self._skip_decay_ids = {
-                id(p)
-                for p in self._param_list()
-                if not self._apply_decay_param_fun(p.name)
-            }
-        super().step()
-
     def _update(self, p, g, lr, state, *, b1, b2, eps, wd):
         m = b1 * state["moment1"] + (1 - b1) * g
         v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
@@ -314,22 +304,13 @@ class AdamW(Adam):
         }
 
     def _per_param_hyper(self, p):
+        # single decay-exclusion path, merged identically by the eager
+        # _apply_one and the compiled train step
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(
             p.name
         ):
             return {"wd": 0.0}
         return {}
-
-    def _apply_one(self, p, g):
-        if self._skip_decay_ids and id(p) in self._skip_decay_ids:
-            saved = self._wd_coeff
-            self._wd_coeff = 0.0
-            try:
-                super()._apply_one(p, g)
-            finally:
-                self._wd_coeff = saved
-        else:
-            super()._apply_one(p, g)
 
 
 class Adamax(Optimizer):

@@ -110,3 +110,22 @@ def test_incubate_autograd_surface():
     np.testing.assert_allclose(h[:].numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
     out, g = iag.vjp(f, x)
     np.testing.assert_allclose(g.numpy(), [3.0, 12.0], rtol=1e-6)
+
+
+def test_asp_survives_compiled_train_step():
+    """Masks must hold through compile_train_step (the docstring's claim)."""
+    paddle.seed(3)
+    asp.reset_asp_state()
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    asp.prune_model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    loss_fn = lambda out, y: ((out - y) ** 2).mean()  # noqa: E731
+    step = paddle.jit.compile_train_step(net, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 4)).astype(np.float32))
+    for _ in range(3):
+        float(step(x, y))
+    for _, layer in net.named_sublayers():
+        if isinstance(layer, nn.Linear):
+            assert asp.check_sparsity(layer.weight)
