@@ -136,6 +136,46 @@ def _hashable(v):
 
 
 # ---------------------------------------------------------------------------
+# Cached forward+vjp programs: jax.vjp re-linearizes the op on EVERY eager
+# call (the dominant per-op dispatch cost — SURVEY §7 hard part 5). A jax
+# vjp closure is a pytree, so `lambda *a: jax.vjp(f, *a)` can be jit-cached:
+# the linearization happens once per (op, static-args, diff-positions,
+# shapes) and later calls replay one compiled program. The closure's
+# application is likewise jitted (_apply_vjp), cached by residual structure.
+# ---------------------------------------------------------------------------
+_vjp_cache: Dict[Tuple, Callable] = {}
+
+
+def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token):
+    key = (token, kw_items, diff_idx)
+    try:
+        cached = _vjp_cache.get(key)
+    except TypeError:
+        return None
+    if cached is None:
+        kw = dict(kw_items)
+
+        def run(*all_vals):
+            def partial_fn(*dv):
+                full = list(all_vals)
+                for i, v in zip(diff_idx, dv):
+                    full[i] = v
+                res = fn(*full, **kw)
+                return tuple(res) if isinstance(res, list) else res
+
+            return jax.vjp(partial_fn, *[all_vals[i] for i in diff_idx])
+
+        cached = jax.jit(run)
+        _vjp_cache[key] = cached
+    return cached
+
+
+@jax.jit
+def _apply_vjp(vjp_fn, cts):
+    return vjp_fn(cts)
+
+
+# ---------------------------------------------------------------------------
 # Autograd graph
 # ---------------------------------------------------------------------------
 class Edge:
@@ -161,6 +201,7 @@ class GradNode:
     __slots__ = (
         "vjp_fn",
         "primal_fn",
+        "jit_vjp",
         "inputs",
         "out_avals",
         "out_is_seq",
@@ -174,6 +215,10 @@ class GradNode:
         # backward sweep can re-derive the vjp *as a recorded tape op* so
         # that create_graph=True (double grad) composes naturally
         self.primal_fn = None
+        # True when vjp_fn is a jax-pytree closure safe to run through the
+        # jitted applier (_apply_vjp); python-closure vjps (PyLayer, AMP
+        # recast, host ops) stay on the direct-call path
+        self.jit_vjp = False
         # List[Edge] — differentiable inputs in vjp order
         self.inputs = [a if isinstance(a, Edge) else Edge(a) for a in inputs]
         self.out_avals = out_avals  # [(shape, dtype)] per output
@@ -245,12 +290,20 @@ def apply(
         for i, a in enumerate(args)
         if isinstance(a, Tensor) and not a.stop_gradient and _is_float_array(a._value)
     ]
-    diff_set = set(diff_idx)
 
-    # run the recorded primal through the jitted op as well: jax.vjp of a
-    # jit-wrapped fn stages the whole primal (residuals included) into one
-    # compiled XLA call, cached by fn identity — this is what makes a
-    # to_static forward a single fused program even under the tape
+    # run the recorded primal through a CACHED forward+vjp program when the
+    # op is cacheable: linearization is staged once per (op, statics, diff
+    # positions, shapes) instead of on every eager call — this is what
+    # keeps per-op dispatch overhead near one compiled-call dispatch
+    token = _cache_token(fn)
+    jitted_vjp = (
+        _jitted_vjp(fn, kw_items, tuple(diff_idx), token)
+        if (flags.flag("eager_op_jit") and token is not None)
+        else None
+    )
+    # partial_fn still routes through the jitted op: the first-order vjp
+    # uses jitted_vjp, but create_graph's re-derivation replays partial_fn
+    # and must keep the one-compiled-call primal
     jfn = _jitted(fn, kw_items) if flags.flag("eager_op_jit") else None
 
     def partial_fn(*diff_vals):
@@ -264,7 +317,12 @@ def apply(
         # normalize list outputs to tuple so cotangent pytree structure is fixed
         return tuple(res) if isinstance(res, list) else res
 
-    out_vals, vjp_fn = jax.vjp(partial_fn, *[vals[i] for i in diff_idx])
+    if jitted_vjp is not None:
+        out_vals, vjp_fn = jitted_vjp(*vals)
+        is_jit_vjp = True
+    else:
+        out_vals, vjp_fn = jax.vjp(partial_fn, *[vals[i] for i in diff_idx])
+        is_jit_vjp = False
 
     # AMP O1 casts inputs (e.g. fp32 weight → bf16) before the op; the
     # reference records the cast op so its backward restores fp32 grads
@@ -275,6 +333,7 @@ def apply(
         vals[i].dtype != od for i, od in zip(diff_idx, orig_dtypes)
     ):
         inner_vjp = vjp_fn
+        is_jit_vjp = False  # wrapped in a python closure below
 
         def vjp_fn(cts, _inner=inner_vjp, _dts=orig_dtypes):
             gs = _inner(cts)
@@ -300,6 +359,7 @@ def apply(
     # partial_fn's dtype contract); everything else supports double grad
     if all(vals[i].dtype == od for i, od in zip(diff_idx, orig_dtypes)):
         node.primal_fn = partial_fn
+    node.jit_vjp = is_jit_vjp
     outs = []
     for i, o in enumerate(flat_outs):
         t = Tensor(o, stop_gradient=not _is_float_array(o))
@@ -514,7 +574,13 @@ def run_backward(
             in_grads = _recorded_vjp(node, cts)
         else:
             raw_cts = tuple(_raw(c) for c in cts)
-            in_grads = node.vjp_fn(raw_cts if node.out_is_seq else raw_cts[0])
+            packed = raw_cts if node.out_is_seq else raw_cts[0]
+            if node.jit_vjp:
+                # jitted application of the pytree vjp closure — the
+                # transpose is compiled once per residual structure
+                in_grads = _apply_vjp(node.vjp_fn, packed)
+            else:
+                in_grads = node.vjp_fn(packed)
             if create_graph:
                 # no primal fn (PyLayer / AMP-recast): grads are correct but
                 # constant w.r.t. further differentiation
