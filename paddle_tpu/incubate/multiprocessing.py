@@ -1,0 +1,97 @@
+"""paddle.incubate.multiprocessing — share Tensors across processes.
+
+Reference analogue: python/paddle/incubate/multiprocessing/ (reductions.py
+registers ForkingPickler reducers; CPU tensors ride mmap_allocator.cc
+shared memory, CUDA tensors ride IPC handles). TPU-native: device buffers
+belong to PJRT and have no cross-process handle, so sharing happens at the
+host layer — POSIX shared memory via multiprocessing.shared_memory — which
+is exactly the reference's CPU path. Dataloader workers are the intended
+user (zero-copy batch hand-off).
+"""
+from __future__ import annotations
+
+import multiprocessing.reduction as _reduction
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["set_sharing_strategy", "get_sharing_strategy"]
+
+_strategy = {"value": "file_system"}
+
+
+def set_sharing_strategy(strategy: str):
+    if strategy == "file_system":
+        _strategy["value"] = strategy
+        return
+    if strategy == "file_descriptor":
+        raise NotImplementedError(
+            "file_descriptor sharing (SCM_RIGHTS fd passing) is not "
+            "implemented; only the named file_system strategy exists"
+        )
+    raise ValueError("strategy must be file_system or file_descriptor")
+
+
+def get_sharing_strategy() -> str:
+    return _strategy["value"]
+
+
+# One-shot hand-off protocol: the receiver unlinks after rebuild. Two
+# failure modes are handled explicitly:
+#   - payload pickled but never unpickled (queue drained after a worker
+#     died): the segment would leak for the sender's lifetime — the sender
+#     tracks its live segments and unlinks leftovers at exit;
+#   - sender exits while the receiver still holds queued payloads: the
+#     unlink above (or the resource tracker) removes the segment first and
+#     rebuild raises — surfaced as a clear RuntimeError, not a bare
+#     FileNotFoundError from inside unpickling.
+_pending_segments = set()
+
+
+def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
+    try:
+        shm = shared_memory.SharedMemory(name=shm_name)
+    except FileNotFoundError as e:
+        raise RuntimeError(
+            f"shared tensor segment {shm_name!r} is gone — the sending "
+            "process exited (or cleaned up) before this payload was "
+            "consumed; keep the sender alive until receivers drain the queue"
+        ) from e
+    try:
+        arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
+    finally:
+        shm.close()
+        try:
+            shm.unlink()  # receiver owns cleanup (one-shot hand-off)
+        except FileNotFoundError:
+            pass
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def _reduce_tensor(t: Tensor):
+    arr = np.asarray(t.numpy())
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)[...] = arr
+    name = shm.name
+    shm.close()
+    _pending_segments.add(name)
+    return _rebuild_tensor, (name, arr.shape, arr.dtype, t.stop_gradient)
+
+
+def _cleanup_pending():
+    for name in list(_pending_segments):
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass  # receiver already consumed it
+    _pending_segments.clear()
+
+
+import atexit  # noqa: E402
+
+atexit.register(_cleanup_pending)
+_reduction.ForkingPickler.register(Tensor, _reduce_tensor)

@@ -1,0 +1,52 @@
+"""Tensor sharing across processes (incubate.multiprocessing reducers).
+
+Reference analogue: test_paddle_multiprocessing.py — queue round-trip of
+tensors between real processes over shared memory.
+"""
+import multiprocessing as mp
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.multiprocessing as pmp  # registers reducers
+
+
+def _child(q_in, q_out):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    t = q_in.get(timeout=60)
+    q_out.put(float(t.sum()))
+
+
+def test_tensor_queue_roundtrip():
+    ctx = mp.get_context("spawn")
+    q_in, q_out = ctx.Queue(), ctx.Queue()
+    p = ctx.Process(target=_child, args=(q_in, q_out))
+    p.start()
+    try:
+        q_in.put(paddle.to_tensor(np.arange(10, dtype=np.float32)))
+        assert q_out.get(timeout=120) == 45.0
+    finally:
+        p.join(30)
+        if p.is_alive():
+            p.terminate()
+
+
+def test_strategy_api():
+    import pytest
+
+    assert pmp.get_sharing_strategy() == "file_system"
+    with pytest.raises(NotImplementedError):
+        pmp.set_sharing_strategy("file_descriptor")
+    pmp.set_sharing_strategy("file_system")
+
+
+def test_unconsumed_payload_cleanup():
+    import multiprocessing.reduction as red
+
+    t = paddle.to_tensor(np.ones((8,), np.float32))
+    red.ForkingPickler.dumps(t)  # pickled, never consumed
+    assert pmp._pending_segments
+    pmp._cleanup_pending()
+    assert not pmp._pending_segments
