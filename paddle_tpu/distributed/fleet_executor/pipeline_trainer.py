@@ -1,0 +1,153 @@
+"""Host-driven multi-program pipeline training over the actor runtime.
+
+Reference analogue: the PipelineTrainer/SectionWorker stack
+(framework/trainer.h:303, device_worker.h:615) and FleetExecutor's
+dist-model pipelines — each pipeline section is its own program run by a
+worker, activations/gradients hop between sections over the wire.
+
+TPU-native role: the COMPILED pipeline (parallel/pipeline.py, ppermute
+inside one XLA program) is the right mode within an ICI slice. This module
+is the OTHER mode: each stage is an independent jitted program placed on
+its own device (standing in for another host across DCN), and the C++
+carrier/interceptor actors (fleet_executor.cc) drive the microbatch
+schedule — forward activations flow stage k → k+1, backward cotangents
+flow k+1 → k through the saved vjp closures, and each stage applies its
+own SGD update from microbatch-accumulated grads. Device-to-device
+`jax.device_put` is the transfer; across real hosts the same schedule
+rides the coordination-service transports.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import FleetExecutor, TaskNode
+
+__all__ = ["HostPipelineTrainer"]
+
+
+class HostPipelineTrainer:
+    """Train stage_fns(params, x)->y chained stages with actor scheduling.
+
+    stage_fns: per-stage pure functions; params: per-stage pytrees (placed
+    on devices[k]); loss_fn(y, label)->scalar runs on the last stage.
+    """
+
+    def __init__(
+        self,
+        stage_fns: Sequence[Callable],
+        params: Sequence,
+        loss_fn: Callable,
+        learning_rate: float = 0.01,
+        devices: Optional[Sequence] = None,
+    ):
+        n = len(stage_fns)
+        if len(params) != n:
+            raise ValueError("one params pytree per stage")
+        devs = list(devices) if devices is not None else jax.devices()[:n]
+        if len(devs) < n:
+            raise ValueError(f"need {n} devices, have {len(devs)}")
+        self.n_stages = n
+        self.devices = devs[:n]
+        self.loss_fn = loss_fn
+        self.lr = learning_rate
+        self.params = [
+            jax.device_put(p, d) for p, d in zip(params, self.devices)
+        ]
+
+        # per-stage compiled programs, pinned to the stage device:
+        #   fwd: (params, x) -> (y, vjp_closure)   [vjp closures are pytrees]
+        #   bwd: (vjp_closure, ct_y) -> (d_params, d_x)
+        self._fwd = []
+        self._bwd = []
+        for k, fn in enumerate(stage_fns):
+            if k == n - 1:
+                def wrapped(p, x, lbl, _fn=fn):
+                    y = _fn(p, x)
+                    return self.loss_fn(y, lbl)
+
+                self._fwd.append(
+                    jax.jit(lambda p, x, lbl, _w=wrapped: jax.vjp(_w, p, x, lbl))
+                )
+            else:
+                self._fwd.append(
+                    jax.jit(lambda p, x, _fn=fn: jax.vjp(_fn, p, x))
+                )
+            self._bwd.append(jax.jit(lambda vjp, ct: vjp(ct)))
+        # placement follows the committed operands: params/activations are
+        # device_put onto each stage's device, so every program runs there
+        self._sgd = jax.jit(
+            lambda p, g, lr: jax.tree_util.tree_map(
+                lambda pv, gv: pv - lr * gv, p, g
+            )
+        )
+
+    def train_batch(self, micro_xs: Sequence, micro_labels: Sequence) -> float:
+        """One step over num_micro microbatches; returns the mean loss.
+
+        Schedule: forward task chain (stage k gated on k-1 per microbatch,
+        pipelined by the actors) then backward chain in reverse — GPipe
+        order, the reference's origin_scheduler."""
+        num_micro = len(micro_xs)
+        n = self.n_stages
+        acts = [[None] * num_micro for _ in range(n + 1)]   # stage inputs
+        vjps = [[None] * num_micro for _ in range(n)]
+        cts = [[None] * num_micro for _ in range(n + 1)]    # cotangents
+        losses = [None] * num_micro
+        grads = [[None] * num_micro for _ in range(n)]
+        for t, x in enumerate(micro_xs):
+            acts[0][t] = jax.device_put(x, self.devices[0])
+
+        def fwd_task(k):
+            def run(t):
+                x = jax.device_put(acts[k][t], self.devices[k])
+                if k == n - 1:
+                    lbl = jax.device_put(micro_labels[t], self.devices[k])
+                    loss, vjp = self._fwd[k](self.params[k], x, lbl)
+                    losses[t] = loss
+                    cts[k + 1][t] = jnp.ones_like(loss)
+                else:
+                    y, vjp = self._fwd[k](self.params[k], x)
+                    acts[k + 1][t] = y
+                vjps[k][t] = vjp
+
+            return run
+
+        def bwd_task(k):
+            def run(t):
+                ct = jax.device_put(cts[k + 1][t], self.devices[k])
+                out = self._bwd[k](vjps[k][t], ct)
+                grads[k][t] = out[0]
+                cts[k][t] = out[1]
+                vjps[k][t] = None  # free residuals early
+
+            return run
+
+        # task ids: fwd stage k = k (chain 0→…→n-1); i-th bwd node handles
+        # stage n-1-i with id n+i (chain n-1 → n → … → 2n-1)
+        nodes = []
+        for k in range(n):
+            f = TaskNode(k, fwd_task(k), max_run_times=num_micro)
+            if k > 0:
+                f.add_upstream_task(k - 1)
+            f.add_downstream_task(k + 1)  # next fwd, or the first bwd at id n
+            nodes.append(f)
+        for i in range(n):
+            b = TaskNode(n + i, bwd_task(n - 1 - i), max_run_times=num_micro)
+            b.add_upstream_task(n + i - 1)
+            if i < n - 1:
+                b.add_downstream_task(n + i + 1)
+            nodes.append(b)
+
+        FleetExecutor(nodes).run()
+
+        # microbatch-accumulated grads -> per-stage SGD
+        for k in range(n):
+            total = grads[k][0]
+            for t in range(1, num_micro):
+                total = jax.tree_util.tree_map(jnp.add, total, grads[k][t])
+            total = jax.tree_util.tree_map(lambda g: g / num_micro, total)
+            self.params[k] = self._sgd(self.params[k], total, self.lr)
+        return float(sum(jax.device_get(l) for l in losses) / num_micro)

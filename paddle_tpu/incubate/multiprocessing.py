@@ -6,7 +6,8 @@ shared memory, CUDA tensors ride IPC handles). TPU-native: device buffers
 belong to PJRT and have no cross-process handle, so sharing happens at the
 host layer — POSIX shared memory via multiprocessing.shared_memory — which
 is exactly the reference's CPU path. Dataloader workers are the intended
-user (zero-copy batch hand-off).
+user: one serialization per hand-off through the shared segment (each end
+copies across the shm boundary; the pickle byte-stream itself stays tiny).
 """
 from __future__ import annotations
 
@@ -81,13 +82,21 @@ def _reduce_tensor(t: Tensor):
 
 
 def _cleanup_pending():
+    from multiprocessing import resource_tracker
+
     for name in list(_pending_segments):
         try:
             seg = shared_memory.SharedMemory(name=name)
             seg.close()
             seg.unlink()
         except FileNotFoundError:
-            pass  # receiver already consumed it
+            # receiver consumed + unlinked it — drop the sender-side
+            # resource_tracker registration too, or interpreter exit emits
+            # a bogus 'leaked shared_memory objects' warning per tensor
+            try:
+                resource_tracker.unregister(f"/{name}", "shared_memory")
+            except Exception:
+                pass
     _pending_segments.clear()
 
 

@@ -6,6 +6,7 @@ tensors between real processes over shared memory.
 import multiprocessing as mp
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.incubate.multiprocessing as pmp  # registers reducers
@@ -19,6 +20,7 @@ def _child(q_in, q_out):
     q_out.put(float(t.sum()))
 
 
+@pytest.mark.slow
 def test_tensor_queue_roundtrip():
     ctx = mp.get_context("spawn")
     q_in, q_out = ctx.Queue(), ctx.Queue()
