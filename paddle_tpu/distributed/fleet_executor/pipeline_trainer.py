@@ -91,6 +91,12 @@ class HostPipelineTrainer:
         pipelined by the actors) then backward chain in reverse — GPipe
         order, the reference's origin_scheduler."""
         num_micro = len(micro_xs)
+        if num_micro == 0:
+            raise ValueError("train_batch needs at least one microbatch")
+        if len(micro_labels) != num_micro:
+            raise ValueError(
+                f"{num_micro} microbatches but {len(micro_labels)} label sets"
+            )
         n = self.n_stages
         acts = [[None] * num_micro for _ in range(n + 1)]   # stage inputs
         vjps = [[None] * num_micro for _ in range(n)]
@@ -125,23 +131,10 @@ class HostPipelineTrainer:
 
             return run
 
-        # task ids: fwd stage k = k (chain 0→…→n-1); i-th bwd node handles
-        # stage n-1-i with id n+i (chain n-1 → n → … → 2n-1)
-        nodes = []
-        for k in range(n):
-            f = TaskNode(k, fwd_task(k), max_run_times=num_micro)
-            if k > 0:
-                f.add_upstream_task(k - 1)
-            f.add_downstream_task(k + 1)  # next fwd, or the first bwd at id n
-            nodes.append(f)
-        for i in range(n):
-            b = TaskNode(n + i, bwd_task(n - 1 - i), max_run_times=num_micro)
-            b.add_upstream_task(n + i - 1)
-            if i < n - 1:
-                b.add_downstream_task(n + i + 1)
-            nodes.append(b)
-
-        FleetExecutor(nodes).run()
+        # one linear chain: fwd stages 0..n-1 then bwd stages n-1..0 —
+        # exactly FleetExecutor.pipeline's wiring
+        chain = [fwd_task(k) for k in range(n)] + [bwd_task(k) for k in reversed(range(n))]
+        FleetExecutor.pipeline(chain, num_micro).run()
 
         # microbatch-accumulated grads -> per-stage SGD
         for k in range(n):

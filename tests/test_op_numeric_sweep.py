@@ -1,0 +1,155 @@
+"""OpTest-style numeric sweep: forward parity vs numpy + finite-difference
+gradient checks across the tensor-op surface.
+
+Reference analogue: unittests/op_test.py (check_output against numpy,
+check_grad against numeric finite differences) — SURVEY §4 calls this the
+workhorse mechanism; this file applies it broadly in parametrized form.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(42)
+
+# (op name, paddle fn, numpy reference, input specs)
+# specs: list of shapes; values drawn uniform(0.2, 2) unless 'signed'
+UNARY = [
+    ("exp", lambda x: paddle.exp(x), np.exp, False),
+    ("log", lambda x: paddle.log(x), np.log, False),
+    ("sqrt", lambda x: paddle.sqrt(x), np.sqrt, False),
+    ("rsqrt", lambda x: paddle.rsqrt(x), lambda v: 1 / np.sqrt(v), False),
+    ("abs", lambda x: paddle.abs(x), np.abs, True),
+    ("sin", lambda x: paddle.sin(x), np.sin, True),
+    ("cos", lambda x: paddle.cos(x), np.cos, True),
+    ("tanh", lambda x: paddle.tanh(x), np.tanh, True),
+    ("sigmoid", lambda x: paddle.nn.functional.sigmoid(x), lambda v: 1 / (1 + np.exp(-v)), True),
+    ("floor", lambda x: paddle.floor(x), np.floor, True),
+    ("ceil", lambda x: paddle.ceil(x), np.ceil, True),
+    ("round", lambda x: paddle.round(x), np.round, True),
+    ("square", lambda x: paddle.square(x), np.square, True),
+    ("reciprocal", lambda x: paddle.reciprocal(x), lambda v: 1 / v, False),
+    ("erf", lambda x: paddle.erf(x),
+     np.vectorize(__import__("math").erf, otypes=[np.float32]), True),
+    ("log1p", lambda x: paddle.log1p(x), np.log1p, False),
+    ("expm1", lambda x: paddle.expm1(x), np.expm1, True),
+    ("sign", lambda x: paddle.sign(x), np.sign, True),
+]
+
+BINARY = [
+    ("add", lambda a, b: a + b, np.add),
+    ("subtract", lambda a, b: a - b, np.subtract),
+    ("multiply", lambda a, b: a * b, np.multiply),
+    ("divide", lambda a, b: a / b, np.divide),
+    ("pow", lambda a, b: paddle.pow(a, b), np.power),
+    ("maximum", lambda a, b: paddle.maximum(a, b), np.maximum),
+    ("minimum", lambda a, b: paddle.minimum(a, b), np.minimum),
+    ("mod", lambda a, b: paddle.mod(a, b), np.mod),
+    ("atan2", lambda a, b: paddle.atan2(a, b), np.arctan2),
+    ("fmax", lambda a, b: paddle.fmax(a, b), np.fmax),
+]
+
+REDUCE = [
+    ("sum", lambda x, ax: paddle.sum(x, axis=ax), np.sum),
+    ("mean", lambda x, ax: paddle.mean(x, axis=ax), np.mean),
+    ("max", lambda x, ax: paddle.max(x, axis=ax), np.max),
+    ("min", lambda x, ax: paddle.min(x, axis=ax), np.min),
+    ("prod", lambda x, ax: paddle.prod(x, axis=ax), np.prod),
+    ("std", lambda x, ax: paddle.std(x, axis=ax), lambda v, axis: np.std(v, axis=axis, ddof=1)),
+    ("var", lambda x, ax: paddle.var(x, axis=ax), lambda v, axis: np.var(v, axis=axis, ddof=1)),
+    ("logsumexp", lambda x, ax: paddle.logsumexp(x, axis=ax),
+     lambda v, axis: np.log(np.exp(v).sum(axis=axis))),
+]
+
+
+def _input(signed, shape=(3, 4)):
+    if signed:
+        return (RNG.standard_normal(shape)).astype(np.float32)
+    return RNG.uniform(0.2, 2.0, shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("name,fn,ref,signed", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_forward(name, fn, ref, signed):
+    x_np = _input(signed)
+    out = fn(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(out, ref(x_np), rtol=1e-5, atol=1e-6)
+    assert out.shape == x_np.shape
+
+
+@pytest.mark.parametrize("name,fn,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_forward_and_broadcast(name, fn, ref):
+    a_np = RNG.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    b_np = RNG.uniform(0.5, 2.0, (4,)).astype(np.float32)
+    out = fn(paddle.to_tensor(a_np), paddle.to_tensor(b_np)).numpy()
+    np.testing.assert_allclose(out, ref(a_np, b_np), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,fn,ref", REDUCE, ids=[r[0] for r in REDUCE])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_reduce_forward(name, fn, ref, axis):
+    x_np = RNG.standard_normal((3, 5)).astype(np.float32)
+    out = fn(paddle.to_tensor(x_np), axis).numpy()
+    np.testing.assert_allclose(
+        out, np.asarray(ref(x_np, axis=axis), np.float32), rtol=1e-5, atol=1e-5
+    )
+
+
+GRAD_OPS = [
+    ("exp", lambda x: paddle.exp(x).sum(), False),
+    ("log", lambda x: paddle.log(x).sum(), False),
+    ("tanh", lambda x: paddle.tanh(x).sum(), True),
+    ("sigmoid", lambda x: paddle.nn.functional.sigmoid(x).sum(), True),
+    ("sqrt", lambda x: paddle.sqrt(x).sum(), False),
+    ("square", lambda x: paddle.square(x).sum(), True),
+    ("softmax", lambda x: (paddle.nn.functional.softmax(x, axis=-1) ** 2).sum(), True),
+    ("logsumexp", lambda x: paddle.logsumexp(x), True),
+    ("matmul_self", lambda x: paddle.matmul(x, x.t()).sum(), True),
+    ("norm", lambda x: paddle.linalg.norm(x.reshape([-1]), p=2), True),
+]
+
+
+@pytest.mark.parametrize("name,loss,signed", GRAD_OPS, ids=[g[0] for g in GRAD_OPS])
+def test_grad_matches_finite_difference(name, loss, signed):
+    """check_grad analogue: analytic tape grad vs central differences."""
+    x_np = _input(signed, (3, 3))
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    loss(x).backward()
+    analytic = x.grad.numpy()
+
+    eps = 1e-3
+    numeric = np.zeros_like(x_np)
+    for i in range(x_np.shape[0]):
+        for j in range(x_np.shape[1]):
+            xp, xm = x_np.copy(), x_np.copy()
+            xp[i, j] += eps
+            xm[i, j] -= eps
+            lp = float(loss(paddle.to_tensor(xp)).numpy())
+            lm = float(loss(paddle.to_tensor(xm)).numpy())
+            numeric[i, j] = (lp - lm) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
+
+
+MANIP = [
+    ("reshape", lambda x: paddle.reshape(x, [4, 3]), lambda v: v.reshape(4, 3)),
+    ("transpose", lambda x: paddle.transpose(x, [1, 0]), lambda v: v.T),
+    ("concat_self", lambda x: paddle.concat([x, x], axis=0), lambda v: np.concatenate([v, v], 0)),
+    ("split0", lambda x: paddle.split(x, 3, axis=0)[1], lambda v: np.split(v, 3, 0)[1]),
+    ("squeeze", lambda x: paddle.unsqueeze(x, 0).squeeze(0), lambda v: v),
+    ("flip", lambda x: paddle.flip(x, axis=[1]), lambda v: v[:, ::-1]),
+    ("roll", lambda x: paddle.roll(x, 1, axis=0), lambda v: np.roll(v, 1, 0)),
+    ("tile", lambda x: paddle.tile(x, [2, 1]), lambda v: np.tile(v, (2, 1))),
+    ("cumsum", lambda x: paddle.cumsum(x, axis=1), lambda v: np.cumsum(v, 1)),
+    ("clip", lambda x: paddle.clip(x, -0.5, 0.5), lambda v: np.clip(v, -0.5, 0.5)),
+    ("sort", lambda x: paddle.sort(x, axis=1), lambda v: np.sort(v, 1)),
+    ("argsort", lambda x: paddle.argsort(x, axis=1), lambda v: np.argsort(v, 1)),
+    ("topk_vals", lambda x: paddle.topk(x, 2, axis=1)[0], lambda v: -np.sort(-v, 1)[:, :2]),
+    ("where", lambda x: paddle.where(x > 0, x, paddle.zeros_like(x)), lambda v: np.where(v > 0, v, 0)),
+    ("gather", lambda x: paddle.gather(x, paddle.to_tensor(np.array([2, 0])), axis=0), lambda v: v[[2, 0]]),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", MANIP, ids=[m[0] for m in MANIP])
+def test_manipulation_forward(name, fn, ref):
+    x_np = RNG.standard_normal((3, 4)).astype(np.float32)
+    out = fn(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(out, np.asarray(ref(x_np)), rtol=1e-6)
