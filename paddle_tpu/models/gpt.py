@@ -244,6 +244,83 @@ class GPTForPretraining(nn.Layer):
         logits = paddle.matmul(h, w, transpose_y=True)
         return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
 
+    @paddle.no_grad()
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 eos_token_id: Optional[int] = None):
+        """Autoregressive decoding (greedy, or top-k sampling when top_k set).
+
+        Fixed-shape incremental decode: the sequence buffer is padded to
+        prompt+max_new_tokens once, so every step re-runs ONE compiled
+        forward (causal masking makes the not-yet-written tail irrelevant to
+        the current position's logits). O(T·forward) — flash attention keeps
+        that cheap; a KV-cache decode path is the optimization on top, not a
+        correctness requirement. Reference analogue: generation loops live
+        upstream (PaddleNLP) — provided here so the flagship model is usable
+        end to end.
+        """
+        import numpy as np
+
+        was_training = self.training
+        self.eval()
+        try:
+            ids = np.asarray(
+                input_ids.numpy() if isinstance(input_ids, paddle.Tensor) else input_ids,
+                np.int64,
+            )
+            if ids.ndim == 1:
+                ids = ids[None, :]
+            b, prompt_len = ids.shape
+            if prompt_len >= self.cfg.max_seq_len:
+                raise ValueError(
+                    f"prompt length {prompt_len} leaves no room to generate "
+                    f"within max_seq_len={self.cfg.max_seq_len}; truncate the "
+                    "prompt (keep its most recent tokens) before calling"
+                )
+            total = min(prompt_len + max_new_tokens, self.cfg.max_seq_len)
+            buf = np.zeros((b, total), np.int64)
+            buf[:, :prompt_len] = ids[:, :total]
+            done = np.zeros((b,), bool)
+            for cur in range(prompt_len, total):
+                logits = self(paddle.to_tensor(buf))  # [b, total, vocab]
+                step_logits = logits.numpy()[:, cur - 1, :]
+                if top_k is not None:
+                    t = max(float(temperature), 1e-6)
+                    step_logits = step_logits / t
+                    k_eff = min(int(top_k), step_logits.shape[-1])
+                    kth = np.sort(step_logits, axis=-1)[:, -k_eff][:, None]
+                    masked = np.where(step_logits < kth, -np.inf, step_logits)
+                    p = np.exp(masked - masked.max(-1, keepdims=True))
+                    p = p / p.sum(-1, keepdims=True)
+                    # draw through the framework generator: advances the
+                    # global RNG so successive generate() calls yield
+                    # DIFFERENT samples while paddle.seed keeps runs
+                    # reproducible
+                    import jax as _jax
+
+                    from ..core import random as _rand
+
+                    draw = _jax.random.randint(
+                        _rand.next_key(), (), 0, np.iinfo(np.int32).max
+                    )
+                    nprng = np.random.default_rng(int(draw))
+                    nxt = np.array(
+                        [nprng.choice(p.shape[-1], p=p[i]) for i in range(b)]
+                    )
+                else:
+                    nxt = step_logits.argmax(-1)
+                nxt = np.where(done, buf[:, cur - 1], nxt)
+                buf[:, cur] = nxt
+                if eos_token_id is not None:
+                    done |= nxt == eos_token_id
+                    if done.all():
+                        buf = buf[:, : cur + 1]
+                        break
+            return paddle.to_tensor(buf)
+        finally:
+            if was_training:
+                self.train()
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """reference: ParallelCrossEntropy (mp_layers.py:249) over shifted LM

@@ -27,6 +27,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = np.float32(-1e30)
+
+
+def _default_block_q(seq_len: int) -> int:
+    """Measured on v5e: full-row q blocks win at moderate seq; 512 keeps
+    Mosaic compile fast at long seq. Shared by flash_attention and
+    supports() so eligibility always mirrors the kernel."""
+    return 1024 if seq_len <= 2048 else 512
 _0 = np.int32(0)  # index-map literal; Python ints trace to i64 under x64
 
 
@@ -294,12 +301,14 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
-def supports(seq_len: int, head_dim: int, block_q: int = 512, block_k: int = 1024) -> bool:
+def supports(seq_len: int, head_dim: int, block_q: int = None, block_k: int = 1024) -> bool:
     """Shapes the kernel accepts (everything else falls back to the XLA path).
 
     The kernel covers the sequence either with one full-array block
     (seq <= block) or with an exact tiling — a seq that is neither would
     leave tail rows unwritten, so it must be rejected here."""
+    if block_q is None:
+        block_q = _default_block_q(seq_len)
     bq = min(block_q, seq_len)
     bk = min(block_k, seq_len)
     return (
@@ -310,11 +319,17 @@ def supports(seq_len: int, head_dim: int, block_q: int = 512, block_k: int = 102
     )
 
 
-def flash_attention(q, k, v, *, scale=None, causal=True, block_q=512, block_k=1024):
+def flash_attention(q, k, v, *, scale=None, causal=True, block_q=None, block_k=1024):
     """Streaming attention over [batch, seq, heads, head_dim] inputs
     (paddle fused_attention layout, matching scaled_dot_product_attention).
+
+    Default blocks are shape-adaptive (measured on v5e): at seq <= 2048 a
+    full-row q block (1024) is ~25% faster; longer sequences use bq=512,
+    whose Mosaic compile is ~50x faster at equal runtime.
     """
     b, s, h, d = q.shape
+    if block_q is None:
+        block_q = _default_block_q(s)
     bq = min(block_q, s)
     bk = min(block_k, s)
     if s % bq != 0 or s % bk != 0:

@@ -65,17 +65,18 @@ def test_functional_selects_flash_and_falls_back():
     out_masked = F.scaled_dot_product_attention(q, q, q, attn_mask=mask, is_causal=True)
     np.testing.assert_allclose(out_masked.numpy(), out_dense.numpy(), atol=2e-5)
 
-    # ineligible shape (seq 600 > block but not divisible) -> fallback path
-    assert not nn_ops.flash_attention_eligible((1, 600, 2, 24), (1, 600, 2, 24), (1, 600, 2, 24))
+    # seq 600 <= 2048: a single full-row block covers it — eligible AND
+    # numerically correct through the flash path
+    assert nn_ops.flash_attention_eligible((1, 600, 2, 24), (1, 600, 2, 24), (1, 600, 2, 24))
     x2 = rng.standard_normal((1, 600, 2, 24)).astype(np.float32)
     q2 = paddle.to_tensor(x2)
     out2 = F.scaled_dot_product_attention(q2, q2, q2, is_causal=True)
     ref2 = dense_ref(jnp.asarray(x2), jnp.asarray(x2), jnp.asarray(x2), True)
     np.testing.assert_allclose(out2.numpy(), np.asarray(ref2), atol=2e-5)
 
-    # direct kernel call with non-divisible seq raises instead of
-    # returning garbage tail rows
-    bad = jnp.asarray(rng.standard_normal((1, 600, 2, 24)), jnp.float32)
+    # ineligible: seq 3000 > 2048 and not divisible by the 512/1024 blocks
+    assert not nn_ops.flash_attention_eligible((1, 3000, 2, 24), (1, 3000, 2, 24), (1, 3000, 2, 24))
+    bad = jnp.asarray(rng.standard_normal((1, 3000, 2, 24)), jnp.float32)
     with pytest.raises(ValueError):
         flash_attention(bad, bad, bad, causal=True)
 
