@@ -1,0 +1,44 @@
+"""QAT-train a classifier, export it, and serve with the predictor.
+
+Usage: python examples/quantize_and_deploy.py
+"""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.quantization import ImperativeQuantAware
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((512, 16)).astype(np.float32)
+    Y = (X[:, :4].argmax(-1)).astype(np.int64)
+    for step in range(100):
+        loss = ce(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    print("train loss:", float(loss))
+
+    path = tempfile.mkdtemp() + "/qmodel"
+    qat.save_quantized_model(net, path, input_spec=[InputSpec([None, 16], "float32", name="x")])
+
+    predictor = inference.create_predictor(inference.Config(path))
+    out = predictor.run([X[:32]])[0]
+    acc = (out.argmax(-1) == Y[:32]).mean()
+    print(f"deployed int8-fake-quant model accuracy: {acc:.2f}")
+
+
+if __name__ == "__main__":
+    main()

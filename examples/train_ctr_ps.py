@@ -1,0 +1,68 @@
+"""CTR model with the parameter-server sparse path: host C++ table +
+dense math on the chip, fed from text files through the fleet dataset.
+
+Usage: python examples/train_ctr_ps.py
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import SparseEmbedding, TheOnePSRuntime
+
+
+class CTRGen(fleet.DataGenerator):
+    def generate_sample(self, line):
+        p = line.split()
+
+        def g():
+            yield [("label", [int(p[0])]), ("ids", [int(v) for v in p[1:]])]
+
+        return g()
+
+
+def main():
+    # synthesize a training file (billion-scale id space — hash table, no vocab)
+    rng = np.random.default_rng(0)
+    f = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
+    for _ in range(500):
+        sid = rng.integers(0, 10**9, 3)
+        f.write(f"{int(sid.sum() % 2)} " + " ".join(map(str, sid)) + "\n")
+    f.close()
+
+    ds = fleet.InMemoryDataset()
+    ds.init(batch_size=64, use_var=["label", "ids"])
+    ds.set_filelist([f.name])
+    ds.set_generator(CTRGen())
+    ds.load_into_memory()
+    ds.local_shuffle(seed=0)
+
+    paddle.seed(0)
+    rt = TheOnePSRuntime()
+    emb = SparseEmbedding([10**9, 16], optimizer="adagrad",
+                          learning_rate=0.05, init_range=0.01)
+    rt._tables["emb"] = emb.table
+    fc = nn.Sequential(nn.Linear(48, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = paddle.optimizer.Adagrad(learning_rate=0.05,
+                                   parameters=fc.parameters())
+    for epoch in range(4):
+        for batch in ds:
+            x = emb(paddle.to_tensor(batch["ids"])).reshape([-1, 48])
+            y = paddle.to_tensor(batch["label"].astype(np.float32))
+            prob = paddle.nn.functional.sigmoid(fc(x))
+            loss = -(y * prob.log() + (1 - y) * (1 - prob + 1e-7).log()).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        print(f"epoch {epoch}: loss {float(loss):.4f}  table rows {len(emb.table)}")
+    with tempfile.TemporaryDirectory() as d:
+        rt.save_persistables(d)
+        print("saved sparse tables to", os.listdir(d))
+    os.unlink(f.name)
+
+
+if __name__ == "__main__":
+    main()

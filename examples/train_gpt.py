@@ -1,0 +1,69 @@
+"""Train a small GPT on synthetic data — single chip or any hybrid mesh.
+
+Usage:
+  python examples/train_gpt.py                       # single device
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python examples/train_gpt.py --dp 2 --mp 2 --sharding 2   # 8-way hybrid
+"""
+import argparse
+import os
+
+# honor JAX_PLATFORMS=cpu even when a site plugin pins another platform
+# (env alone is not enough once the plugin runs — see tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--sharding", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": args.dp, "mp_degree": args.mp,
+        "sharding_degree": args.sharding,
+    }
+    if args.sharding > 1:
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                    num_heads=8, max_seq_len=args.seq,
+                    dropout=0.0, attn_dropout=0.0)
+    model = fleet.distributed_model(GPTForPretraining(cfg))
+    criterion = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    step = fleet.distributed_train_step(model, criterion, opt)
+
+    rng = np.random.default_rng(0)
+    for it in range(args.steps):
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (args.batch, args.seq + 1))
+        )
+        loss = step(ids[:, :-1], ids[:, 1:])
+        if it % 5 == 0:
+            print(f"step {it}: loss {float(loss):.4f}")
+    # sample from the model
+    out = model.generate(paddle.to_tensor(ids.numpy()[:1, :8]), max_new_tokens=16)
+    print("generated ids:", out.numpy()[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

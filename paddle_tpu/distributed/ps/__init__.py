@@ -136,25 +136,41 @@ class SparseEmbedding(Layer):
 
     def __init__(self, size, shard_num: int = 16, optimizer: str = "adagrad",
                  learning_rate: float = 0.05, init_range: float = 0.01,
-                 seed: int = 0, table: Optional[MemorySparseTable] = None):
+                 seed: int = 0, table: Optional[MemorySparseTable] = None,
+                 padding_idx: Optional[int] = None):
         super().__init__()
         # paddle signature: size = [vocab, emb_dim]; vocab is advisory (the
         # table is a hash map — any int64 feature id works, like the ref)
         self.emb_dim = int(size[1])
+        self.padding_idx = padding_idx
         self.table = table or MemorySparseTable(
             self.emb_dim, shard_num, optimizer, learning_rate, init_range, seed
         )
 
     def forward(self, ids: Tensor) -> Tensor:
+        import jax as _jax
+
+        if isinstance(ids._value, _jax.core.Tracer):
+            raise NotImplementedError(
+                "SparseEmbedding pulls rows from the host C++ table and "
+                "cannot run under a jit trace; keep the sparse lookup in "
+                "eager code (the PS division of labor) and compile only the "
+                "dense tail"
+            )
         ids_np = np.asarray(ids.numpy(), np.int64)
         flat = ids_np.reshape(-1)
         rows = self.table.pull(flat, create=self.training)
+        if self.padding_idx is not None:
+            # padding rows embed to zeros and never train (reference
+            # sparse_embedding padding_idx contract)
+            rows = np.where((flat == self.padding_idx)[:, None], 0.0, rows)
         out_np = rows.reshape(*ids_np.shape, self.emb_dim)
         out = Tensor(out_np, stop_gradient=True)
         if not (is_grad_enabled() and self.training):
             return out
 
         table = self.table
+        pad_idx = self.padding_idx
 
         def vjp_fn(ct):
             # ct: device grad for the pulled block. Merge duplicate ids
@@ -162,9 +178,15 @@ class SparseEmbedding(Layer):
             # trainer-side grad merge the reference does before push) then
             # push to the host table; nothing flows further (ids are ints).
             g = np.asarray(ct, np.float32).reshape(flat.size, table.emb_dim)
-            uniq, inv = np.unique(flat, return_inverse=True)
+            keys, grads_rows = flat, g
+            if pad_idx is not None:
+                keep = keys != pad_idx
+                keys, grads_rows = keys[keep], grads_rows[keep]
+            if keys.size == 0:
+                return ()
+            uniq, inv = np.unique(keys, return_inverse=True)
             merged = np.zeros((uniq.size, table.emb_dim), np.float32)
-            np.add.at(merged, inv, g)
+            np.add.at(merged, inv, grads_rows)
             table.push(uniq, merged)
             return ()
 

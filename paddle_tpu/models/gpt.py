@@ -281,34 +281,43 @@ class GPTForPretraining(nn.Layer):
             buf = np.zeros((b, total), np.int64)
             buf[:, :prompt_len] = ids[:, :total]
             done = np.zeros((b,), bool)
+
+            from ..parallel.topology import get_mesh
+
+            mesh = get_mesh()
+
+            def _feed(arr):
+                # under a live mesh the params are sharded: feed ids
+                # replicated so GSPMD can re-shard activations per layer
+                if mesh is not None and mesh.devices.size > 1:
+                    import jax as _jax
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    return paddle.Tensor(
+                        _jax.device_put(arr, NamedSharding(mesh, PartitionSpec())),
+                        stop_gradient=True,
+                    )
+                return paddle.to_tensor(arr)
+
             for cur in range(prompt_len, total):
-                logits = self(paddle.to_tensor(buf))  # [b, total, vocab]
-                step_logits = logits.numpy()[:, cur - 1, :]
+                logits = self(_feed(buf))  # [b, total, vocab]
+                # slice the current position ON DEVICE before the host copy
+                # (a full [b, total, vocab] D2H per step would dominate)
+                step_t = logits[:, cur - 1, :]
                 if top_k is not None:
                     t = max(float(temperature), 1e-6)
-                    step_logits = step_logits / t
-                    k_eff = min(int(top_k), step_logits.shape[-1])
-                    kth = np.sort(step_logits, axis=-1)[:, -k_eff][:, None]
-                    masked = np.where(step_logits < kth, -np.inf, step_logits)
-                    p = np.exp(masked - masked.max(-1, keepdims=True))
-                    p = p / p.sum(-1, keepdims=True)
-                    # draw through the framework generator: advances the
-                    # global RNG so successive generate() calls yield
-                    # DIFFERENT samples while paddle.seed keeps runs
-                    # reproducible
-                    import jax as _jax
-
-                    from ..core import random as _rand
-
-                    draw = _jax.random.randint(
-                        _rand.next_key(), (), 0, np.iinfo(np.int32).max
-                    )
-                    nprng = np.random.default_rng(int(draw))
-                    nxt = np.array(
-                        [nprng.choice(p.shape[-1], p=p[i]) for i in range(b)]
-                    )
+                    k_eff = min(int(top_k), step_t.shape[-1])
+                    vals, idx = paddle.topk(step_t / t, k_eff, axis=-1)
+                    probs = F.softmax(vals, axis=-1)
+                    # multinomial draws through the framework generator, so
+                    # paddle.seed reproduces runs while successive calls
+                    # yield different samples
+                    choice = paddle.multinomial(probs, num_samples=1)
+                    nxt = np.take_along_axis(
+                        idx.numpy(), choice.numpy().astype(np.int64), axis=-1
+                    )[:, 0]
                 else:
-                    nxt = step_logits.argmax(-1)
+                    nxt = step_t.numpy().argmax(-1)
                 nxt = np.where(done, buf[:, cur - 1], nxt)
                 buf[:, cur] = nxt
                 if eos_token_id is not None:

@@ -308,6 +308,47 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
 
 
 # nn sub-namespace for static layers parity (maps to dygraph layers)
+import types as _types  # noqa: E402
+
 from .. import nn as _nn  # noqa: E402
 
-nn = _nn
+
+_sparse_layers = {}
+
+
+def _sparse_embedding(input, size, param_attr=None, is_test=False,
+                      padding_idx=None, name=None, **kwargs):
+    """reference: paddle.static.nn.sparse_embedding — the PS-backed lookup
+    (distributed_lookup_table). The host C++ MemorySparseTable owns the rows
+    (distributed/ps).
+
+    Table identity: the reference keys the persistent table by the op's
+    parameter name; here `name` (or param_attr's name) is REQUIRED so
+    repeated calls hit the SAME table — an anonymous call would silently
+    train a fresh throwaway table per step. The lookup runs eagerly (host
+    table); compile only the dense tail (see distributed/ps docstring).
+    """
+    from ..distributed.ps import SparseEmbedding
+
+    key = name or getattr(param_attr, "name", None)
+    if not key:
+        raise ValueError(
+            "sparse_embedding needs a stable identity: pass name=... (or a "
+            "param_attr with a name) so every call reuses one persistent "
+            "table — otherwise each call would train a fresh table"
+        )
+    layer = _sparse_layers.get(key)
+    if layer is None:
+        layer = SparseEmbedding(size, padding_idx=padding_idx, **kwargs)
+        _sparse_layers[key] = layer
+    if is_test:
+        layer.eval()
+    else:
+        layer.train()
+    return layer(input)
+
+
+nn = _types.SimpleNamespace(
+    **{k: getattr(_nn, k) for k in dir(_nn) if not k.startswith("_")},
+    sparse_embedding=_sparse_embedding,
+)

@@ -1,0 +1,96 @@
+"""Checkpoint/resume: async sharded saves + train_epoch_range recovery.
+
+Reference analogue: test_auto_checkpoint.py (epoch-range resume after a
+simulated failure) and the fleet save/load tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.checkpoint import (
+    AsyncCheckpointer,
+    load_state_dict,
+    save_state_dict,
+    train_epoch_range,
+)
+
+
+def _make(seed=0):
+    paddle.seed(seed)
+    net = nn.Linear(4, 3)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    return net, opt
+
+
+def test_save_load_state_dict_roundtrip(tmp_path):
+    net, _ = _make()
+    path = str(tmp_path / "sd")
+    save_state_dict(net.state_dict(), path)
+
+    net2, _ = _make(seed=123)
+    before = net2.weight.numpy().copy()
+    sd2 = net2.state_dict()
+    load_state_dict(sd2, path)
+    net2.set_state_dict(sd2)
+    assert not np.allclose(net2.weight.numpy(), before)
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+
+def test_train_epoch_range_resumes_after_crash(tmp_path):
+    """Run 2 of 5 epochs, 'crash', restart: resumes at epoch 2 with state."""
+    rng = np.random.default_rng(0)
+    X = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    Y = paddle.to_tensor(rng.standard_normal((16, 3)).astype(np.float32))
+
+    def epoch_step(net, opt):
+        loss = ((net(X) - Y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    # ---- first attempt: epoch 0 completes (and snapshots); the crash in
+    # epoch 1's body lands BEFORE epoch 1's post-body snapshot, so the
+    # durable state is end-of-epoch-0 — exactly what resume must see
+    net, opt = _make()
+    ckpt = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    state = net.state_dict()
+    seen = []
+    w_after_epoch0 = None
+    try:
+        for epoch in train_epoch_range(5, ckpt, state):
+            seen.append(epoch)
+            epoch_step(net, opt)
+            if epoch == 0:
+                w_after_epoch0 = net.weight.numpy().copy()
+            if epoch == 1:
+                raise RuntimeError("simulated preemption")
+    except RuntimeError:
+        pass
+    ckpt.wait()
+    assert seen == [0, 1]
+
+    # ---- relaunch: fresh model, resumes from the epoch-0 snapshot
+    net2, opt2 = _make(seed=999)  # different init — must be overwritten
+    ckpt2 = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    state2 = net2.state_dict()
+    resumed = []
+    for epoch in train_epoch_range(5, ckpt2, state2):
+        if not resumed:
+            # restore happened before the first yielded epoch
+            np.testing.assert_allclose(net2.weight.numpy(), w_after_epoch0, rtol=1e-6)
+        resumed.append(epoch)
+        epoch_step(net2, opt2)
+    ckpt2.wait()
+    assert resumed == [1, 2, 3, 4]
+
+
+def test_checkpointer_retention(tmp_path):
+    net, _ = _make()
+    ck = AsyncCheckpointer(str(tmp_path / "r"), max_to_keep=2)
+    state = net.state_dict()
+    for step in range(4):
+        ck.save(step, state)
+    ck.wait()
+    assert ck.restore_latest(net.state_dict()) == 3

@@ -160,3 +160,45 @@ def test_the_one_ps_runtime(tmp_path):
     rt2.create_table("embedding_0", 4, init_range=0.0)
     rt2.load_persistables(str(tmp_path))
     np.testing.assert_allclose(rt2.get_table("embedding_0").pull(np.array([1])), v)
+
+
+def test_static_sparse_embedding_persistent_and_padding():
+    import paddle_tpu.static as static
+
+    ids = paddle.to_tensor(np.array([[1, 0, 2]]))
+    out1 = static.nn.sparse_embedding(ids, [100, 4], name="emb_a",
+                                      init_range=0.1, padding_idx=0)
+    out2 = static.nn.sparse_embedding(ids, [100, 4], name="emb_a")
+    # same named call -> same persistent table -> identical rows
+    np.testing.assert_allclose(out1.numpy(), out2.numpy())
+    # padding_idx row embeds to zeros
+    np.testing.assert_allclose(out1.numpy()[0, 1], np.zeros(4))
+    # anonymous call is rejected (would train a throwaway table)
+    with pytest.raises(ValueError, match="name"):
+        static.nn.sparse_embedding(ids, [100, 4])
+
+
+def test_sparse_embedding_padding_idx_no_train():
+    emb = SparseEmbedding([50, 4], init_range=0.0, learning_rate=1.0,
+                          optimizer="sgd", padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 3]]))
+    out = emb(ids)
+    (out.sum()).backward()
+    rows = emb.table.pull(np.array([0, 3]))
+    np.testing.assert_allclose(rows[0], np.zeros(4))   # padding never trained
+    assert not np.allclose(rows[1], np.zeros(4))       # real id trained
+
+
+def test_sparse_embedding_rejects_traced_ids():
+    import paddle_tpu.jit  # noqa: F401
+
+    emb = SparseEmbedding([50, 4], init_range=0.0)
+
+    import jax
+    import jax.numpy as jnp
+
+    def f(v):
+        return emb(paddle.Tensor(v, stop_gradient=True))._value
+
+    with pytest.raises(NotImplementedError, match="jit trace"):
+        jax.jit(f)(jnp.array([[1, 2]]))
