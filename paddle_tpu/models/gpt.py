@@ -80,12 +80,47 @@ class GPTAttention(nn.Layer):
             input_is_parallel=True,
         )
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         cfg = self.cfg
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [b, s, 3h] sharded on mp
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unstack(axis=2)
+        if cache is not None:
+            # incremental decode over a PREALLOCATED fixed-shape cache:
+            # every step reuses one compiled program (ops/nn_ops.py
+            # cached_attention), with a prefix+causal mask that stays
+            # correct for multi-token chunks too.
+            import numpy as _np
+
+            from ..core.dispatch import apply as _apply
+            from ..ops import nn_ops as _ops
+
+            if cache.get("k") is None:
+                cache["k"] = paddle.zeros(
+                    [b, cfg.max_seq_len, self.num_heads, self.head_dim],
+                    dtype=str(k._value.dtype),
+                )
+                cache["v"] = paddle.zeros(
+                    [b, cfg.max_seq_len, self.num_heads, self.head_dim],
+                    dtype=str(v._value.dtype),
+                )
+                cache["len"] = 0
+            if cache["len"] + s > cfg.max_seq_len:
+                raise ValueError(
+                    f"KV cache overflow: {cache['len']} + {s} > "
+                    f"max_seq_len {cfg.max_seq_len}"
+                )
+            cur = paddle.Tensor(_np.int32(cache["len"]), stop_gradient=True)
+            out, nk, nv = _apply(
+                _ops.cached_attention, q, cache["k"], cache["v"], k, v, cur,
+                scale=1.0 / math.sqrt(self.head_dim),
+                op_name="cached_attention",
+            )
+            cache["k"], cache["v"] = nk, nv
+            cache["len"] += s
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.out_proj(out)
         ring_mode = cfg.sequence_parallel and cfg.sequence_parallel_mode in (
             "ring", "ulysses"
         )
@@ -162,17 +197,17 @@ class GPTDecoderLayer(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def _block(self, x):
-        x = x + self.dropout(self.attn(self.ln1(x)))
+    def _block(self, x, cache=None):
+        x = x + self.dropout(self.attn(self.ln1(x), cache=cache))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return _sp(x, self.cfg, ("dp", "sharding"), "sep", None)
 
-    def forward(self, x):
-        if self.cfg.use_recompute:
+    def forward(self, x, cache=None):
+        if self.cfg.use_recompute and cache is None:
             from ..incubate.recompute import recompute
 
             return recompute(self._block, x)
-        return self._block(x)
+        return self._block(x, cache=cache)
 
 
 class GPTEmbeddings(nn.Layer):
@@ -188,9 +223,9 @@ class GPTEmbeddings(nn.Layer):
         )
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, pos_offset: int = 0):
         s = input_ids.shape[1]
-        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0) + pos_offset
         h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         h = _sp(h, self.cfg, ("dp", "sharding"), "sep", None)
         return self.dropout(h)
@@ -206,10 +241,10 @@ class GPTModel(nn.Layer):
         self.layers = nn.LayerList([GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
         self.final_ln = nn.LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids):
-        h = self.embeddings(input_ids)
-        for layer in self.layers:
-            h = layer(h)
+    def forward(self, input_ids, caches=None, pos_offset: int = 0):
+        h = self.embeddings(input_ids, pos_offset=pos_offset)
+        for i, layer in enumerate(self.layers):
+            h = layer(h, cache=None if caches is None else caches[i])
         return self.final_ln(h)
 
 
@@ -221,13 +256,21 @@ class GPTForPretraining(nn.Layer):
         self.cfg = cfg
         self.gpt = GPTModel(cfg)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos_offset: int = 0):
         # the same three phases the pipeline schedule runs, so the eager and
         # pipelined computations cannot diverge
+        if caches is not None:
+            h = self.gpt(input_ids, caches=caches, pos_offset=pos_offset)
+            return self._tied_head(h)
         h = self.pp_embed(input_ids)
         for layer in self.gpt.layers:
             h = layer(h)
         return self.pp_head(h)
+
+    def _tied_head(self, h):
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = paddle.matmul(h, w, transpose_y=True)
+        return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
 
     # pipeline-partition protocol (parallel/pipeline.py): homogeneous middle
     # = the decoder stack; embedding/head replicated across pp stages
@@ -239,10 +282,7 @@ class GPTForPretraining(nn.Layer):
         return list(self.gpt.layers)
 
     def pp_head(self, h):
-        h = self.gpt.final_ln(h)
-        w = self.gpt.embeddings.word_embeddings.weight
-        logits = paddle.matmul(h, w, transpose_y=True)
-        return _sp(logits, self.cfg, ("dp", "sharding"), "sep", "mp")
+        return self._tied_head(self.gpt.final_ln(h))
 
     @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens: int = 32,
@@ -285,11 +325,20 @@ class GPTForPretraining(nn.Layer):
             from ..parallel.topology import get_mesh
 
             mesh = get_mesh()
+            sharded = mesh is not None and mesh.devices.size > 1
+            # KV-cache incremental decode: prefill once over the prompt,
+            # then one single-token forward per step — O(T) tokens instead
+            # of O(T) full-sequence forwards. Sharded meshes keep the
+            # fixed-shape path (growing cache shapes fight GSPMD layouts).
+            caches = (
+                None if sharded
+                else [{"k": None, "v": None} for _ in self.gpt.layers]
+            )
 
             def _feed(arr):
                 # under a live mesh the params are sharded: feed ids
                 # replicated so GSPMD can re-shard activations per layer
-                if mesh is not None and mesh.devices.size > 1:
+                if sharded:
                     import jax as _jax
                     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -300,10 +349,23 @@ class GPTForPretraining(nn.Layer):
                 return paddle.to_tensor(arr)
 
             for cur in range(prompt_len, total):
-                logits = self(_feed(buf))  # [b, total, vocab]
-                # slice the current position ON DEVICE before the host copy
-                # (a full [b, total, vocab] D2H per step would dominate)
-                step_t = logits[:, cur - 1, :]
+                if caches is not None:
+                    if cur == prompt_len:  # prefill the whole prompt
+                        logits = self(
+                            _feed(buf[:, :prompt_len]), caches=caches, pos_offset=0
+                        )
+                        step_t = logits[:, -1, :]
+                    else:  # one new token
+                        logits = self(
+                            _feed(buf[:, cur - 1 : cur]), caches=caches,
+                            pos_offset=cur - 1,
+                        )
+                        step_t = logits[:, 0, :]
+                else:
+                    logits = self(_feed(buf))  # [b, total, vocab]
+                    # slice the current position ON DEVICE before the host
+                    # copy (full [b, total, vocab] D2H would dominate)
+                    step_t = logits[:, cur - 1, :]
                 if top_k is not None:
                     t = max(float(temperature), 1e-6)
                     k_eff = min(int(top_k), step_t.shape[-1])

@@ -12,6 +12,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _pair(v, n=2):
@@ -671,6 +672,35 @@ def scaled_dot_product_attention(
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
     return jnp.swapaxes(out, 1, 2)
+
+
+def cached_attention(q, k_cache, v_cache, k_new, v_new, cur_len, *, scale):
+    """Fixed-shape KV-cache attention step (reference: fused attention's
+    CacheKV path). Writes the new K/V at position cur_len into the
+    PREALLOCATED [b, max_len, h, d] caches via dynamic_update_slice and
+    attends with a prefix+causal mask — every decode step has identical
+    shapes, so ONE compiled program serves the whole generation (no
+    per-length retraces). cur_len is a traced int32 scalar.
+
+    Returns (out [b, s_new, h, d], k_cache, v_cache).
+    """
+    zero = jnp.int32(0)
+    cur = cur_len.astype(jnp.int32)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype),
+                                           (zero, cur, zero, zero))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype),
+                                           (zero, cur, zero, zero))
+    s_new = q.shape[1]
+    L = k_cache.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * np.float32(scale)
+    # token i of the new chunk may attend cache positions j <= cur_len + i
+    allowed = (
+        jnp.arange(L)[None, :] <= (cur + jnp.arange(s_new))[:, None]
+    )  # [s_new, L]
+    logits = jnp.where(allowed[None, None], logits, np.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    return out.astype(q.dtype), k_cache, v_cache
 
 
 def flash_scaled_dot_product_attention(q, k, v, *, scale=None, is_causal=False):
