@@ -317,3 +317,18 @@ class WarmupCosine(LRScheduler):
         return self.end_lr + (self.base_lr - self.end_lr) * 0.5 * (
             1 + math.cos(math.pi * pct)
         )
+
+
+class MultiplicativeDecay(LRScheduler):
+    """reference: optimizer/lr.py MultiplicativeDecay — lr multiplied by
+    lr_lambda(epoch) each step (cumulatively)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            lr = lr * self.lr_lambda(e)
+        return lr

@@ -2,7 +2,7 @@
 
 Reference analogue: python/paddle/vision/ (11k LoC).
 """
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, vgg16  # noqa: F401
 
 

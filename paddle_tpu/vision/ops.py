@@ -1,0 +1,204 @@
+"""paddle.vision.ops — detection operators.
+
+Reference analogue: python/paddle/vision/ops.py over the phi detection
+kernels (nms_kernel, roi_align_kernel, yolo_box_op). TPU-native notes:
+  - roi_align / yolo_box are pure jnp math (differentiable, jit-friendly);
+  - nms has inherently dynamic output size, so it runs as a host-side
+    post-processing op (exactly where detection pipelines run it) and
+    returns kept indices as a Tensor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "yolo_box", "deform_conv2d", "roi_pool"]
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference: vision/ops.py nms / phi nms_kernel).
+
+    boxes [N,4] (x1,y1,x2,y2); returns kept indices sorted by score
+    (input order when scores is None). Category-aware when category_idxs
+    given. Host-side: output length is data-dependent.
+    """
+    b = _np(boxes).astype(np.float64)
+    n = b.shape[0]
+    s = _np(scores).astype(np.float64) if scores is not None else None
+    order = np.argsort(-s) if s is not None else np.arange(n)
+
+    def _iou(a, rest):
+        x1 = np.maximum(a[0], rest[:, 0])
+        y1 = np.maximum(a[1], rest[:, 1])
+        x2 = np.minimum(a[2], rest[:, 2])
+        y2 = np.minimum(a[3], rest[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_r = (rest[:, 2] - rest[:, 0]) * (rest[:, 3] - rest[:, 1])
+        return inter / np.maximum(area_a + area_r - inter, 1e-10)
+
+    cats = _np(category_idxs) if category_idxs is not None else None
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for pos, idx in enumerate(order):
+        if suppressed[idx]:
+            continue
+        keep.append(idx)
+        # only LOWER-scored boxes can still be suppressed by idx
+        rest = order[pos + 1 :]
+        rest = rest[~suppressed[rest]]
+        if rest.size == 0:
+            continue
+        same_cat = rest if cats is None else rest[cats[rest] == cats[idx]]
+        if same_cat.size:
+            ious = _iou(b[idx], b[same_cat])
+            suppressed[same_cat[ious > iou_threshold]] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[: int(top_k)]
+    return Tensor(keep, stop_gradient=True)
+
+
+def _roi_align_impl(x, boxes, box_batch_idx, *, output_size, spatial_scale,
+                    sampling_ratio, aligned):
+    """Bilinear ROI align (differentiable). x: [N,C,H,W]; boxes: [R,4]."""
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    offset = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale - offset
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    roi_w = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
+    roi_h = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+    # XLA needs a static sampling grid; adaptive (-1) uses 2 points per bin
+    # (the reference's common configuration) — noted in the docstring
+    ns = sampling_ratio if sampling_ratio > 0 else 2
+
+    iy = (jnp.arange(ns) + 0.5) / ns                    # [ns] in-bin fractions
+    py = jnp.arange(ph)
+    px = jnp.arange(pw)
+    # sample coords per roi: [r, ph, ns]
+    ys = y1[:, None, None] + (py[None, :, None] + iy[None, None, :]) * bin_h[:, None, None]
+    xs = x1[:, None, None] + (px[None, :, None] + iy[None, None, :]) * bin_w[:, None, None]
+
+    def bilinear(img, yy, xx):
+        # img [C,H,W]. Reference kernel semantics: samples strictly outside
+        # [-1, size] contribute ZERO (not border replication); inside that
+        # band coords clamp to [0, size-1] for the 4-point interpolation.
+        valid = (yy >= -1.0) & (yy <= h) & (xx >= -1.0) & (xx <= w)
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, h - 1)
+        x1_ = jnp.minimum(x0 + 1, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1_]
+        v10 = img[:, y1_, x0]
+        v11 = img[:, y1_, x1_]
+        out = (
+            v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx
+        )
+        return out * valid[None]
+
+    imgs = x[box_batch_idx]                              # [r, C, H, W]
+    # full grid per roi: [r, ph*ns] x [r, pw*ns]
+    yy = ys.reshape(r, ph * ns)
+    xx = xs.reshape(r, pw * ns)
+    grid_y = jnp.broadcast_to(yy[:, :, None], (r, ph * ns, pw * ns))
+    grid_x = jnp.broadcast_to(xx[:, None, :], (r, ph * ns, pw * ns))
+    vals = jax.vmap(bilinear)(imgs, grid_y, grid_x)      # [r, C, ph*ns, pw*ns]
+    vals = vals.reshape(r, c, ph, ns, pw, ns)
+    return vals.mean(axis=(3, 5))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align. boxes: [R,4] concatenated across
+    the batch; boxes_num: rois per image. sampling_ratio=-1 samples a fixed
+    2x2 grid per bin (static shapes; the reference adapts per-ROI)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = _np(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(bn.size), bn)
+    return apply(
+        _roi_align_impl, x, boxes, Tensor(batch_idx, stop_gradient=True),
+        output_size=tuple(output_size), spatial_scale=float(spatial_scale),
+        sampling_ratio=int(sampling_ratio), aligned=bool(aligned),
+        op_name="roi_align",
+    )
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    raise NotImplementedError(
+        "roi_pool's quantized integer bins are per-ROI dynamic shapes; use "
+        "roi_align (the accuracy-preferred op the reference docs recommend)"
+    )
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d needs a gather-heavy custom kernel; register one via "
+        "paddle.utils.cpp_extension / register_op if required"
+    )
+
+
+def _yolo_box_impl(x, img_size, *, anchors, class_num, conf_thresh,
+                   downsample_ratio, clip_bbox, scale_x_y):
+    """reference: phi yolo_box kernel — decode YOLOv3 head outputs."""
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(np.array(anchors, np.float32).reshape(na, 2))
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(x[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0) + grid_x) / w
+    by = (sig(x[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0) + grid_y) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (w * downsample_ratio)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (h * downsample_ratio)
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+    mask = (conf.reshape(n, -1) > conf_thresh)[..., None]
+    return boxes * mask, scores * mask
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    if iou_aware:
+        raise NotImplementedError("iou_aware yolo_box")
+    out = apply(
+        _yolo_box_impl, x, img_size, anchors=tuple(anchors),
+        class_num=int(class_num), conf_thresh=float(conf_thresh),
+        downsample_ratio=int(downsample_ratio), clip_bbox=bool(clip_bbox),
+        scale_x_y=float(scale_x_y), op_name="yolo_box",
+    )
+    return out[0], out[1]

@@ -120,3 +120,80 @@ def test_profiler_statistics_report():
     table = p.summary()
     assert "Overview Summary" in table and "Operator Summary" in table
     assert "my_region" in table
+
+
+def test_vision_nms():
+    from paddle_tpu.vision import ops as vops
+
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30], [0, 0, 9, 9]],
+        np.float32,
+    )
+    scores = np.array([0.9, 0.8, 0.95, 0.5], np.float32)
+    keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                    scores=paddle.to_tensor(scores)).numpy()
+    # highest-score box per cluster survives: 2 (isolated), 0; 1 and 3 suppressed
+    assert list(keep) == [2, 0]
+    # category-aware: same boxes, different classes -> no suppression
+    keep2 = vops.nms(paddle.to_tensor(boxes), 0.5, scores=paddle.to_tensor(scores),
+                     category_idxs=paddle.to_tensor(np.array([0, 1, 0, 2])),
+                     categories=[0, 1, 2]).numpy()
+    assert len(keep2) == 4
+
+
+def test_vision_roi_align_known_values():
+    from paddle_tpu.vision import ops as vops
+
+    # 1x1 output over an exactly-covering ROI with a 2x2 sampling grid:
+    # samples land at (1,1),(1,3),(3,1),(3,3) -> mean of 5,7,13,15 = 10
+    # (matches the reference kernel's bilinear sampling, not the full mean)
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = vops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1])), output_size=1, sampling_ratio=2,
+        aligned=False,
+    )
+    assert out.shape == [1, 1, 1, 1]
+    np.testing.assert_allclose(out.numpy().ravel()[0], 10.0, rtol=1e-5)
+    # constant map: any sampling returns the constant (sanity of weights)
+    c = np.full((1, 1, 4, 4), 3.25, np.float32)
+    outc = vops.roi_align(
+        paddle.to_tensor(c), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1])), output_size=2, sampling_ratio=2,
+        aligned=True,
+    )
+    np.testing.assert_allclose(outc.numpy(), np.full((1, 1, 2, 2), 3.25), rtol=1e-6)
+    # gradient flows to the feature map
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out2 = vops.roi_align(xt, paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1])), output_size=2,
+                          sampling_ratio=2, aligned=False)
+    out2.sum().backward()
+    assert float(abs(xt.grad).sum()) > 0
+
+
+def test_vision_yolo_box_shapes():
+    from paddle_tpu.vision import ops as vops
+
+    n, na, cls, h = 2, 3, 5, 4
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((n, na * (5 + cls), h, h)).astype(np.float32)
+    )
+    img = paddle.to_tensor(np.array([[416, 416], [320, 480]], np.int64))
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=cls, conf_thresh=0.0)
+    assert boxes.shape == [n, na * h * h, 4]
+    assert scores.shape == [n, na * h * h, cls]
+    # boxes are clipped into the image
+    b = boxes.numpy()
+    assert b[0].max() <= 416 and b.min() >= 0
+
+
+def test_multiplicative_decay():
+    sched = paddle.optimizer.lr.MultiplicativeDecay(1.0, lambda e: 0.5)
+    vals = []
+    for _ in range(3):
+        vals.append(sched())
+        sched.step()
+    np.testing.assert_allclose(vals, [1.0, 0.5, 0.25])

@@ -36,6 +36,7 @@ SUBMODULES = [
     "vision",
     "vision.transforms",
     "vision.models",
+    "vision.ops",
     "inference",
     "device",
     "profiler",
