@@ -262,6 +262,28 @@ class Tensor:
         t.stop_gradient = self.stop_gradient
         return t
 
+    def cuda(self, device_id=None, blocking=True):
+        """Compat: move to the default accelerator (TPU here)."""
+        t = self.detach()
+        t._value = jax.device_put(self._value, jax.devices()[device_id or 0])
+        t.stop_gradient = self.stop_gradient
+        return t
+
+    def pin_memory(self):
+        return self  # PJRT stages H2D transfers itself; no pinned-pool API
+
+    def element_size(self) -> int:
+        return int(np.dtype(self._value.dtype).itemsize)
+
+    def ndimension(self) -> int:
+        return int(self._value.ndim)
+
+    def is_contiguous(self) -> bool:
+        return True  # XLA arrays have no user-visible strides
+
+    def contiguous(self):
+        return self
+
     def to(self, *args, **kwargs):
         device = kwargs.get("device")
         dtype = kwargs.get("dtype")

@@ -35,6 +35,7 @@ from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DataGenerator, InMemoryDataset, QueueDataset  # noqa: F401
 from . import elastic  # noqa: F401
+from .localsgd import LocalSGDOptimizer  # noqa: F401
 
 __all__ = [
     "init",
@@ -54,6 +55,7 @@ __all__ = [
     "DataGenerator",
     "InMemoryDataset",
     "QueueDataset",
+    "LocalSGDOptimizer",
 ]
 
 _state = {"strategy": None, "hcg": None, "initialized": False}

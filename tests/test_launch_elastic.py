@@ -173,3 +173,64 @@ def test_elastic_manager_membership(tmp_path):
     past = 100.0
     os.utime(old, (os.path.getmtime(old) - past, os.path.getmtime(old) - past))
     assert m1.alive_nodes() == []
+
+
+LOCALSGD_SCRIPT = textwrap.dedent(
+    """
+    import os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet import LocalSGDOptimizer
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    paddle.seed(0)  # same init on both ranks
+    net = nn.Linear(4, 2)
+    opt = LocalSGDOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        k_steps=2,
+    )
+    rng = np.random.default_rng(rank)  # DIFFERENT data per rank
+    for step in range(4):
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after step 4 (a sync step), params must be IDENTICAL across ranks
+    w = net.weight.numpy()
+    out = os.path.join(os.environ["TEST_OUT_DIR"], f"w{rank}.npy")
+    np.save(out, w)
+    """
+)
+
+
+@pytest.mark.slow
+def test_localsgd_synchronizes_across_processes(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(LOCALSGD_SCRIPT)
+    port = free_port()
+    env = child_env()
+    env["TEST_OUT_DIR"] = str(tmp_path)
+    rc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--master", f"127.0.0.1:{port}",
+            "--nproc_per_node", "2",
+            "--log_dir", str(tmp_path / "log"),
+            str(script),
+        ],
+        env=env, timeout=240,
+    ).returncode
+    if rc != 0:
+        for f in (tmp_path / "log").glob("workerlog.*"):
+            print(f, ":", f.read_text()[-2000:])
+    assert rc == 0
+    w0 = np.load(tmp_path / "w0.npy")
+    w1 = np.load(tmp_path / "w1.npy")
+    np.testing.assert_allclose(w0, w1, rtol=1e-6)
+    assert np.abs(w0).sum() > 0

@@ -213,3 +213,16 @@ def test_flags():
             (x / paddle.zeros([1])).backward()
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_tensor_compat_methods():
+    import numpy as np
+
+    t = paddle.ones([2, 3])
+    assert t.element_size() == 4
+    assert t.ndimension() == 2
+    assert t.is_contiguous()
+    assert t.contiguous() is t
+    assert t.pin_memory() is t
+    c = t.cuda()
+    np.testing.assert_allclose(c.numpy(), t.numpy())
