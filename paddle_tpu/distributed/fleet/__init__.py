@@ -36,6 +36,7 @@ from . import dataset  # noqa: F401
 from .dataset import DataGenerator, InMemoryDataset, QueueDataset  # noqa: F401
 from . import elastic  # noqa: F401
 from .localsgd import LocalSGDOptimizer  # noqa: F401
+from .dgc import DGCMomentumOptimizer  # noqa: F401
 
 __all__ = [
     "init",
@@ -56,6 +57,7 @@ __all__ = [
     "InMemoryDataset",
     "QueueDataset",
     "LocalSGDOptimizer",
+    "DGCMomentumOptimizer",
 ]
 
 _state = {"strategy": None, "hcg": None, "initialized": False}

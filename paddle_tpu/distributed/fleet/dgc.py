@@ -1,0 +1,170 @@
+"""DGC — Deep Gradient Compression momentum optimizer.
+
+Reference analogue: fleet/meta_optimizers/dgc_optimizer.py +
+python/paddle/fluid/optimizer.py DGCMomentumOptimizer over the dgc_op CUDA
+kernels: momentum correction + residual accumulation locally, top-k
+sparsification with momentum-factor masking, and exchange of only the
+selected (index, value) pairs — orders of magnitude less gradient traffic
+for bandwidth-bound (DCN) data parallelism.
+
+TPU-native: the local math (momentum, residual, static top-k) is jnp; the
+exchange allgathers ONE batched payload of all parameters' indices+values
+per step (the compressed bytes the reference sends) and scatter-adds into
+dense synchronized gradients. Every process applies the same aggregate, so
+replicas stay identical like per-step DP.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import no_grad
+from ...core.tensor import Tensor
+
+__all__ = ["DGCMomentumOptimizer"]
+
+
+def _topk_sparsify(v, k):
+    """Select top-k |v| entries: returns (idx [k], vals [k], v_residual)."""
+    flat = v.reshape(-1)
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    vals = flat[idx]
+    residual = flat.at[idx].set(0.0).reshape(v.shape)
+    return idx, vals, residual
+
+
+class DGCMomentumOptimizer:
+    """Momentum SGD with top-k compressed gradient synchronization.
+
+    Reference signature semantics: `sparsity` is a rampup schedule of DROP
+    fractions (e.g. [0.75, 0.9375, 0.984, 0.996, 0.999] keeps 25% -> 0.1%);
+    each schedule stage lasts `rampup_step` steps after `rampup_begin_step`
+    dense steps. A bare float is accepted as a one-stage schedule.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 rampup_begin_step: int = 0, rampup_step: int = 1,
+                 sparsity: Union[float, Sequence[float]] = (0.999,),
+                 parameters=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._mu = momentum
+        self._parameters = list(parameters or [])
+        self._sched = [float(s) for s in (
+            [sparsity] if isinstance(sparsity, (int, float)) else sparsity
+        )]
+        if not all(0.0 <= s < 1.0 for s in self._sched):
+            raise ValueError("sparsity entries are DROP fractions in [0, 1)")
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._grad_clip = grad_clip
+        self._count = 0
+        # per-param DGC state: momentum-corrected accumulation u, residual v
+        self._u = {}
+        self._v = {}
+
+    # --- schedule --------------------------------------------------------
+    def _drop_ratio(self) -> Optional[float]:
+        """None during the dense warmup; else the scheduled drop fraction."""
+        if self._count <= self._rampup_begin:
+            return None
+        stage = (self._count - self._rampup_begin - 1) // self._rampup_step
+        return self._sched[min(stage, len(self._sched) - 1)]
+
+    def _lr_value(self):
+        return float(self._lr() if callable(self._lr) else self._lr)
+
+    def clear_grad(self):
+        for p in self._parameters:
+            p.grad = None
+
+    @no_grad()
+    def step(self):
+        self._count += 1
+        params_grads = [
+            (p, p.grad) for p in self._parameters
+            if not p.stop_gradient and p.grad is not None
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self._lr_value()
+        world = jax.process_count()
+        drop = self._drop_ratio()
+
+        sparse_payload = []   # (param, idx, vals) for one batched exchange
+        dense_payload = []    # (param, v) during warmup
+        for p, g in params_grads:
+            gv = (g._value if isinstance(g, Tensor) else g).astype(jnp.float32)
+            u = self._u.get(id(p))
+            v = self._v.get(id(p))
+            if u is None:
+                u = jnp.zeros_like(gv)
+                v = jnp.zeros_like(gv)
+            # momentum correction then residual accumulation (DGC paper eq. 4)
+            u = self._mu * u + gv
+            v = v + u
+            if drop is None or gv.size < 2:
+                dense_payload.append((p, v))
+                v = jnp.zeros_like(v)
+            else:
+                # from the DROP fraction directly (1-drop in float would
+                # truncate: int((1-0.8)*10) == 1, not 2)
+                k = max(1, gv.size - int(drop * gv.size))
+                idx, vals, v = _topk_sparsify(v, k)
+                # momentum-factor masking (DGC paper alg. 2): clear the
+                # momentum history of SENT coordinates — keeping it
+                # double-counts their contribution and destabilizes training
+                u = u.reshape(-1).at[idx].set(0.0).reshape(u.shape)
+                sparse_payload.append((p, idx, vals))
+            self._u[id(p)] = u
+            self._v[id(p)] = v
+
+        # ---- ONE cross-process exchange for everything this step
+        if world > 1 and (sparse_payload or dense_payload):
+            from jax.experimental import multihost_utils
+
+            packet = [
+                [(idx, vals) for _, idx, vals in sparse_payload],
+                [v for _, v in dense_payload],
+            ]
+            gathered = multihost_utils.process_allgather(packet)
+            g_sparse, g_dense = gathered
+        else:
+            g_sparse = [(idx[None], vals[None]) for _, idx, vals in sparse_payload]
+            g_dense = [v[None] for _, v in dense_payload]
+
+        for (p, _, _), (all_idx, all_vals) in zip(sparse_payload, g_sparse):
+            dense = jnp.zeros((p._value.size,), jnp.float32)
+            dense = dense.at[jnp.asarray(all_idx).reshape(-1)].add(
+                jnp.asarray(all_vals).reshape(-1)
+            ) / max(world, 1)
+            p._value = p._value - lr * dense.reshape(p._value.shape).astype(
+                p._value.dtype
+            )
+        for (p, _), v_all in zip(dense_payload, g_dense):
+            sync = jnp.mean(jnp.asarray(v_all), axis=0)
+            p._value = p._value - lr * sync.astype(p._value.dtype)
+
+    # --- checkpointing ----------------------------------------------------
+    def state_dict(self):
+        """u/v accumulators + step count, keyed by parameter position (id()
+        keys don't survive a process restart)."""
+        out = {"count": self._count}
+        for i, p in enumerate(self._parameters):
+            if id(p) in self._u:
+                out[f"u_{i}"] = Tensor(self._u[id(p)], stop_gradient=True)
+                out[f"v_{i}"] = Tensor(self._v[id(p)], stop_gradient=True)
+        return out
+
+    def set_state_dict(self, state):
+        self._count = int(state.get("count", 0))
+        for i, p in enumerate(self._parameters):
+            if f"u_{i}" in state:
+                u = state[f"u_{i}"]
+                v = state[f"v_{i}"]
+                self._u[id(p)] = u._value if isinstance(u, Tensor) else jnp.asarray(u)
+                self._v[id(p)] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+    def get_lr(self):
+        return self._lr_value()

@@ -28,11 +28,12 @@ class LocalSGDOptimizer:
     def step(self):
         self._inner.step()
         self._count += 1
-        if (
-            self._count > self._begin
-            and self._count % self._k == 0
-            and jax.process_count() > 1
-        ):
+        if jax.process_count() <= 1:
+            return
+        # reference warmup: DENSE per-step sync until begin_step, so the
+        # replicas never diverge before local stepping starts; afterwards
+        # average only every k steps
+        if self._count <= self._begin or self._count % self._k == 0:
             self.sync_params()
 
     def sync_params(self):

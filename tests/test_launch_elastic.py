@@ -234,3 +234,87 @@ def test_localsgd_synchronizes_across_processes(tmp_path):
     w1 = np.load(tmp_path / "w1.npy")
     np.testing.assert_allclose(w0, w1, rtol=1e-6)
     assert np.abs(w0).sum() > 0
+
+
+def test_dgc_single_process_math():
+    """DGC local math: momentum correction, residual, top-k selection."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import DGCMomentumOptimizer
+
+    p = paddle.to_tensor(np.zeros(10, np.float32), stop_gradient=False)
+    opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               parameters=[p], sparsity=[0.8])  # drop 80% -> keep 2
+    g = np.array([5, -4, 0.1, 0.2, 0.3, 0.1, 0.2, 0.1, 0.1, 0.1], np.float32)
+    p.grad = paddle.to_tensor(g)
+    opt.step()
+    # only the top-2 |v| entries (5, -4) applied; rest held in residual
+    w = p.numpy()
+    np.testing.assert_allclose(w[:2], [-5.0, 4.0], rtol=1e-6)
+    np.testing.assert_allclose(w[2:], np.zeros(8))
+    # second step with zero grad: the RESIDUAL drives the update — its two
+    # largest held entries (0.3 at idx 4, then the first 0.2) get applied
+    p.grad = paddle.to_tensor(np.zeros(10, np.float32))
+    opt.step()
+    w2 = p.numpy()
+    assert w2[4] != 0 and (w2[2:] != 0).sum() == 2
+    np.testing.assert_allclose(w2[:2], w[:2])  # no new mass at old indices
+
+
+DGC_SCRIPT = textwrap.dedent(
+    """
+    import os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet import DGCMomentumOptimizer
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    paddle.seed(0)
+    net = nn.Linear(6, 2)
+    opt = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                               parameters=net.parameters(), sparsity=[0.75])
+    rng = np.random.default_rng(rank)
+    losses = []
+    for step in range(12):
+        x = paddle.to_tensor(rng.standard_normal((16, 6)).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    # same aggregated sparse grads -> replicas stay identical
+    np.save(os.path.join(os.environ["TEST_OUT_DIR"], f"dgc{rank}.npy"),
+            net.weight.numpy())
+    assert losses[-1] < losses[0]
+    """
+)
+
+
+@pytest.mark.slow
+def test_dgc_two_process_sync(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(DGC_SCRIPT)
+    port = free_port()
+    env = child_env()
+    env["TEST_OUT_DIR"] = str(tmp_path)
+    rc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--master", f"127.0.0.1:{port}",
+            "--nproc_per_node", "2",
+            "--log_dir", str(tmp_path / "log"),
+            str(script),
+        ],
+        env=env, timeout=240,
+    ).returncode
+    if rc != 0:
+        for f in (tmp_path / "log").glob("workerlog.*"):
+            print(f, ":", f.read_text()[-2000:])
+    assert rc == 0
+    w0 = np.load(tmp_path / "dgc0.npy")
+    w1 = np.load(tmp_path / "dgc1.npy")
+    np.testing.assert_allclose(w0, w1, rtol=1e-6)
