@@ -117,11 +117,61 @@ def distributed_model(model: Layer):
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     """reference: fleet_base.py:875 — meta-optimizer selection. The TP/ZeRO
-    behavior lives in sharding specs; the optimizer passes through with the
-    strategy recorded (amp/recompute handled by their own modules)."""
+    behavior lives in sharding specs; amp/recompute are handled by their own
+    modules; the comms-reducing meta-optimizers (LocalSGD, DGC) wrap here
+    exactly as the reference's StrategyCompiler chains them."""
     if strategy is not None:
         _state["strategy"] = strategy
-    optimizer._fleet_strategy = _strategy()
+    st = _strategy()
+    optimizer._fleet_strategy = st
+    if getattr(st, "localsgd", False) and getattr(st, "dgc", False):
+        raise ValueError(
+            "strategy.localsgd and strategy.dgc are mutually exclusive "
+            "(both reduce DP communication; pick one)"
+        )
+    if getattr(st, "localsgd", False):
+        from .localsgd import LocalSGDOptimizer
+
+        if getattr(optimizer, "_parameters", None) is None:
+            raise ValueError("LocalSGD needs an optimizer with a parameter list")
+        cfg = getattr(st, "localsgd_configs", {}) or {}
+        optimizer = LocalSGDOptimizer(
+            optimizer,
+            k_steps=cfg.get("k_steps", 1),
+            begin_step=cfg.get("begin_step", 0),
+        )
+    elif getattr(st, "dgc", False):
+        import warnings
+
+        from ...optimizer import Momentum
+        from .dgc import DGCMomentumOptimizer
+
+        # the reference's DGC meta-optimizer _can_apply gates on Momentum —
+        # silently turning Adam into momentum SGD would change training
+        if not isinstance(optimizer, Momentum):
+            warnings.warn(
+                "strategy.dgc applies only to Momentum (reference _can_apply "
+                f"rule); {type(optimizer).__name__} left unwrapped"
+            )
+            return optimizer
+        if getattr(optimizer, "_nesterov", False):
+            warnings.warn(
+                "DGC has no Nesterov variant; momentum applies non-Nesterov"
+            )
+        if optimizer._parameters is None:
+            raise ValueError("DGC needs an optimizer with a parameter list")
+        cfg = getattr(st, "dgc_configs", {}) or {}
+        optimizer = DGCMomentumOptimizer(
+            learning_rate=optimizer._learning_rate
+            if hasattr(optimizer, "_learning_rate") else optimizer.get_lr(),
+            momentum=optimizer._momentum,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1),
+            sparsity=cfg.get("sparsity", (0.999,)),
+            parameters=optimizer._parameters,
+            grad_clip=optimizer._grad_clip,
+            weight_decay=getattr(optimizer, "_weight_decay", None),
+        )
     return optimizer
 
 
@@ -133,6 +183,17 @@ def distributed_train_step(model, loss_fn, optimizer):
     from ...parallel.sharding import sharded_train_step
     from ...parallel.topology import axis_size
 
+    from .dgc import DGCMomentumOptimizer
+    from .localsgd import LocalSGDOptimizer
+
+    if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
+        raise ValueError(
+            "LocalSGD/DGC are EAGER multi-process meta-optimizers (their "
+            "value is skipping/compressing cross-host sync, which a compiled "
+            "dp-sharded step already schedules optimally); call "
+            "loss.backward(); opt.step() directly instead of "
+            "distributed_train_step"
+        )
     strategy = _strategy()
     pp = axis_size("pp")
     if pp > 1:

@@ -47,7 +47,8 @@ class DGCMomentumOptimizer:
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  rampup_begin_step: int = 0, rampup_step: int = 1,
                  sparsity: Union[float, Sequence[float]] = (0.999,),
-                 parameters=None, grad_clip=None, name=None):
+                 parameters=None, grad_clip=None, weight_decay=None,
+                 name=None):
         self._lr = learning_rate
         self._mu = momentum
         self._parameters = list(parameters or [])
@@ -59,6 +60,11 @@ class DGCMomentumOptimizer:
         self._rampup_begin = int(rampup_begin_step)
         self._rampup_step = max(1, int(rampup_step))
         self._grad_clip = grad_clip
+        self._wd = (
+            float(weight_decay) if isinstance(weight_decay, (int, float))
+            else getattr(weight_decay, "_coeff", None) if weight_decay is not None
+            else None
+        )
         self._count = 0
         # per-param DGC state: momentum-corrected accumulation u, residual v
         self._u = {}
@@ -96,6 +102,8 @@ class DGCMomentumOptimizer:
         dense_payload = []    # (param, v) during warmup
         for p, g in params_grads:
             gv = (g._value if isinstance(g, Tensor) else g).astype(jnp.float32)
+            if self._wd:
+                gv = gv + self._wd * p._value.astype(jnp.float32)
             u = self._u.get(id(p))
             v = self._v.get(id(p))
             if u is None:

@@ -32,7 +32,9 @@ class DistributedStrategy:
         self.lars = False
         self.lars_configs = {}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1, "sparsity": [0.999]}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 0}
         self.fp16_allreduce = False
         self.sharding = False
         self.sharding_configs: Dict[str, Any] = {

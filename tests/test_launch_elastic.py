@@ -318,3 +318,29 @@ def test_dgc_two_process_sync(tmp_path):
     w0 = np.load(tmp_path / "dgc0.npy")
     w1 = np.load(tmp_path / "dgc1.npy")
     np.testing.assert_allclose(w0, w1, rtol=1e-6)
+
+
+def test_strategy_selects_localsgd_and_dgc():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DGCMomentumOptimizer, LocalSGDOptimizer
+
+    net_p = [paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)]
+
+    s = fleet.DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 3}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=net_p), strategy=s
+    )
+    assert isinstance(opt, LocalSGDOptimizer) and opt._k == 3
+
+    s2 = fleet.DistributedStrategy()
+    s2.dgc = True
+    s2.dgc_configs = {"sparsity": [0.9]}
+    opt2 = fleet.distributed_optimizer(
+        paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.8, parameters=net_p),
+        strategy=s2,
+    )
+    assert isinstance(opt2, DGCMomentumOptimizer)
+    assert opt2._mu == 0.8 and opt2._sched == [0.9]
