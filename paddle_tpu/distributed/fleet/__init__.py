@@ -123,6 +123,8 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     if strategy is not None:
         _state["strategy"] = strategy
     st = _strategy()
+    if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
+        return optimizer  # idempotent: already wrapped
     optimizer._fleet_strategy = st
     if getattr(st, "localsgd", False) and getattr(st, "dgc", False):
         raise ValueError(
@@ -130,8 +132,6 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
             "(both reduce DP communication; pick one)"
         )
     if getattr(st, "localsgd", False):
-        from .localsgd import LocalSGDOptimizer
-
         if getattr(optimizer, "_parameters", None) is None:
             raise ValueError("LocalSGD needs an optimizer with a parameter list")
         cfg = getattr(st, "localsgd_configs", {}) or {}
@@ -144,7 +144,6 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
         import warnings
 
         from ...optimizer import Momentum
-        from .dgc import DGCMomentumOptimizer
 
         # the reference's DGC meta-optimizer _can_apply gates on Momentum —
         # silently turning Adam into momentum SGD would change training
@@ -182,9 +181,6 @@ def distributed_train_step(model, loss_fn, optimizer):
     With pp_degree > 1 this is the pipelined (GPipe-over-ppermute) step."""
     from ...parallel.sharding import sharded_train_step
     from ...parallel.topology import axis_size
-
-    from .dgc import DGCMomentumOptimizer
-    from .localsgd import LocalSGDOptimizer
 
     if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
         raise ValueError(

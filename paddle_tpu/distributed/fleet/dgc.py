@@ -50,6 +50,9 @@ class DGCMomentumOptimizer:
                  parameters=None, grad_clip=None, weight_decay=None,
                  name=None):
         self._lr = learning_rate
+        # alias the base-Optimizer attribute name so LR-scheduler plumbing
+        # (hapi LRSchedulerCallback) finds the schedule through wrappers
+        self._learning_rate = learning_rate
         self._mu = momentum
         self._parameters = list(parameters or [])
         self._sched = [float(s) for s in (

@@ -20,6 +20,8 @@ class LocalSGDOptimizer:
     """Wrap any optimizer; every k_steps, average params across processes."""
 
     def __init__(self, optimizer, k_steps: int = 1, begin_step: int = 0):
+        if int(k_steps) < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
         self._inner = optimizer
         self._k = int(k_steps)
         self._begin = int(begin_step)
@@ -52,6 +54,9 @@ class LocalSGDOptimizer:
                 p._value = jnp.mean(s, axis=0)
 
     def __getattr__(self, name):
-        if name.startswith("_"):  # avoid recursion before __init__ ran
+        # recursion guard: _inner itself missing means __init__ never ran
+        # (deepcopy/pickle protocols); everything else — including the
+        # underscore internals hapi's LRSchedulerCallback reads — delegates
+        if name == "_inner":
             raise AttributeError(name)
         return getattr(self._inner, name)

@@ -132,6 +132,7 @@ class EarlyStopping(Callback):
         self.min_delta = abs(min_delta)
         self.wait = 0
         self.best = None
+        self.stopped_epoch = 0  # reference attr: epoch training halted at
         if mode == "auto":
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
@@ -153,6 +154,7 @@ class EarlyStopping(Callback):
         else:
             self.wait += 1
             if self.wait >= self.patience:
+                self.stopped_epoch = epoch
                 self.model.stop_training = True
 
 

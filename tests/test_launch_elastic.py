@@ -344,3 +344,12 @@ def test_strategy_selects_localsgd_and_dgc():
     )
     assert isinstance(opt2, DGCMomentumOptimizer)
     assert opt2._mu == 0.8 and opt2._sched == [0.9]
+
+    # wrapping is idempotent
+    assert fleet.distributed_optimizer(opt2) is opt2
+
+    # reset the module-global strategy so later tests aren't DGC-wrapped
+    fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=net_p),
+        strategy=fleet.DistributedStrategy(),
+    )
