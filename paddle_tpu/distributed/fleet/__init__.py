@@ -120,11 +120,20 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     behavior lives in sharding specs; amp/recompute are handled by their own
     modules; the comms-reducing meta-optimizers (LocalSGD, DGC) wrap here
     exactly as the reference's StrategyCompiler chains them."""
+    if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
+        # idempotent: already wrapped. Refuse a conflicting re-wrap rather
+        # than storing a strategy the existing wrapper doesn't reflect.
+        if strategy is not None and strategy is not _state["strategy"]:
+            raise ValueError(
+                "optimizer is already wrapped by "
+                f"{type(optimizer).__name__}; call distributed_optimizer "
+                "with a new strategy on the UNWRAPPED optimizer (the "
+                "wrapper's config cannot be changed in place)"
+            )
+        return optimizer
     if strategy is not None:
         _state["strategy"] = strategy
     st = _strategy()
-    if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
-        return optimizer  # idempotent: already wrapped
     optimizer._fleet_strategy = st
     if getattr(st, "localsgd", False) and getattr(st, "dgc", False):
         raise ValueError(

@@ -137,6 +137,14 @@ class EarlyStopping(Callback):
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
 
+    def on_train_begin(self, logs=None):
+        # fresh state per fit() so the callback instance is reusable
+        # (the reference resets here too)
+        self.wait = 0
+        self.best = None
+        self.stopped_epoch = 0
+        self.model.stop_training = False
+
     def _better(self, cur):
         if self.best is None:
             return True

@@ -120,6 +120,7 @@ class Model:
                 "metrics": ["loss"] + [m.name() for m in self._metrics],
             }
         )
+        self.stop_training = False  # stale stop from a previous fit()
         cbks.on_train_begin()
         for epoch in range(epochs):
             if self.stop_training:

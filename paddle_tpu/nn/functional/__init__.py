@@ -250,7 +250,8 @@ def avg_pool2d(
     return apply(
         _nn.avg_pool2d, x, kernel_size=_t(kernel_size), stride=_t(stride),
         padding=_t(padding), ceil_mode=ceil_mode, exclusive=exclusive,
-        data_format=data_format, op_name="avg_pool2d",
+        divisor_override=divisor_override, data_format=data_format,
+        op_name="avg_pool2d",
     )
 
 

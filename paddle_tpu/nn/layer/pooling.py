@@ -51,12 +51,14 @@ class AvgPool2D(Layer):
         self.padding = padding
         self.ceil_mode = ceil_mode
         self.exclusive = exclusive
+        self.divisor_override = divisor_override
         self.data_format = data_format
 
     def forward(self, x):
         return F.avg_pool2d(
             x, self.kernel_size, self.stride, self.padding, self.ceil_mode,
-            self.exclusive, data_format=self.data_format,
+            self.exclusive, self.divisor_override,
+            data_format=self.data_format,
         )
 
 

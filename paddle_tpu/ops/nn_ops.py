@@ -162,6 +162,32 @@ def linear(x, weight, bias=None):
 # ---------------------------------------------------------------------------
 # Pooling — reference: phi/kernels/pool_kernel.h
 # ---------------------------------------------------------------------------
+def _ceil_extra(dim, k, s, p_lo, p_hi):
+    """High-side padding extension so ceil_mode emits the tail window.
+
+    The reference PoolOutputSize (funcs/pooling.h:372) is a pure ceil; a
+    window starting at/beyond input+pad would hold zero real elements
+    (division by zero in the reference kernel), so such windows are
+    dropped — every emitted window holds >=1 real element."""
+    out_ceil = -(-(dim + p_lo + p_hi - k) // s) + 1
+    if (out_ceil - 1) * s >= dim + p_lo:
+        out_ceil -= 1
+    out_floor = (dim + p_lo + p_hi - k) // s + 1
+    return (out_ceil - out_floor) * s
+
+
+def _apply_ceil_mode(pads, spatial, ks, st, data_format):
+    """Extend the high side of the two spatial pad pairs for ceil_mode."""
+    lo = 2 if data_format == "NCHW" else 1
+    pads = list(pads)
+    for i in range(2):
+        p_lo, p_hi = pads[lo + i]
+        pads[lo + i] = (
+            p_lo, p_hi + _ceil_extra(spatial[i], ks[i], st[i], p_lo, p_hi)
+        )
+    return pads
+
+
 def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
     ks = _pair(kernel_size)
     st = _pair(stride if stride is not None else kernel_size)
@@ -170,12 +196,16 @@ def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False, data_
         window = (1, 1) + ks
         strides = (1, 1) + st
         pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * 2)
+        spatial = (x.shape[2], x.shape[3])
     else:
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
         pads = [(0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * 2) + [(0, 0)]
+        spatial = (x.shape[1], x.shape[2])
     if pad == "SAME" or pad == "VALID":
         pads = pad
+    elif ceil_mode:
+        pads = _apply_ceil_mode(pads, spatial, ks, st, data_format)
     return jax.lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
         jax.lax.max, window, strides, pads,
@@ -201,13 +231,7 @@ def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
     n, c, h, w = x.shape
 
     def _extra(dim, k, s, p):
-        # ceil_mode: extend the high side so the tail window (which always
-        # holds >=1 real element) is produced too
-        if not ceil_mode:
-            return 0
-        out_ceil = -(-(dim + 2 * p - k) // s) + 1
-        out_floor = (dim + 2 * p - k) // s + 1
-        return (out_ceil - out_floor) * s
+        return _ceil_extra(dim, k, s, p, p) if ceil_mode else 0
 
     eh, ew = _extra(h, ks[0], st[0], ph), _extra(w, ks[1], st[1], pw)
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
@@ -263,7 +287,7 @@ def max_unpool2d(x, indices, *, kernel_size, stride=None, padding=0,
 
 def avg_pool2d(
     x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
-    exclusive=True, data_format="NCHW",
+    exclusive=True, divisor_override=None, data_format="NCHW",
 ):
     ks = _pair(kernel_size)
     st = _pair(stride if stride is not None else kernel_size)
@@ -272,17 +296,57 @@ def avg_pool2d(
         window = (1, 1) + ks
         strides = (1, 1) + st
         pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [])
+        spatial = (x.shape[2], x.shape[3])
     else:
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
         pads = [(0, 0)] + (pad if isinstance(pad, list) else []) + [(0, 0)]
+        spatial = (x.shape[1], x.shape[2])
     if pad in ("SAME", "VALID"):
         pads = pad
+    else:
+        base_pads = pads
+        if ceil_mode:
+            pads = _apply_ceil_mode(pads, spatial, ks, st, data_format)
     summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
-    if exclusive and pads not in ("SAME", "VALID"):
-        ones = jnp.ones_like(x)
-        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
-        return summed / counts
+    if divisor_override is not None:
+        if divisor_override <= 0:
+            raise ValueError(
+                f"divisor_override must be > 0, got {divisor_override}"
+            )
+        return summed / divisor_override
+    if pads in ("SAME", "VALID"):
+        return summed / (ks[0] * ks[1])
+
+    def _counts(extent, count_pads):
+        # window counts depend only on the spatial dims: compute them on a
+        # [1,1,H,W]-extent ones tensor (broadcasts over batch/channels) so
+        # XLA constant-folds a tiny array, not the full activation shape
+        if data_format == "NCHW":
+            ones = jnp.ones((1, 1) + extent, x.dtype)
+        else:
+            ones = jnp.ones((1,) + extent + (1,), x.dtype)
+        return jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, window, strides, count_pads
+        )
+
+    if exclusive:
+        # divisor = real (non-pad) elements per window
+        if any(p != (0, 0) for p in pads):
+            return summed / _counts(spatial, pads)
+        return summed / (ks[0] * ks[1])
+    # inclusive: padding counts, but the ceil-mode extension never does —
+    # windows are clamped to the padded extent (reference pool kernel /
+    # torch count_include_pad=True semantics)
+    if ceil_mode and pads != base_pads:
+        lo = 2 if data_format == "NCHW" else 1
+        padded = tuple(spatial[i] + sum(base_pads[lo + i]) for i in range(2))
+        ext = [(0, 0)] * lo + [
+            (0, pads[lo + i][1] - base_pads[lo + i][1]) for i in range(2)
+        ]
+        if data_format != "NCHW":
+            ext.append((0, 0))
+        return summed / _counts(padded, ext)
     return summed / (ks[0] * ks[1])
 
 

@@ -366,3 +366,14 @@ class AlexNet(nn.Layer):
 
 def alexnet(pretrained=False, **kwargs):
     return AlexNet(**kwargs)
+
+
+# part 2 of the zoo: the remaining reference families (models_extra.py)
+from .models_extra import (  # noqa: E402,F401
+    DenseNet, GoogLeNet, InceptionV3, MobileNetV1, MobileNetV3, ShuffleNetV2,
+    SqueezeNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264, googlenet, inception_v3, mobilenet_v1, mobilenet_v3_large,
+    mobilenet_v3_small, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish, shufflenet_v2_x0_33, squeezenet1_0, squeezenet1_1,
+)

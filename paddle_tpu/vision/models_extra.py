@@ -1,0 +1,740 @@
+"""Vision model zoo, part 2 — the remaining reference model families.
+
+Reference analogue: python/paddle/vision/models/{mobilenetv1,mobilenetv3,
+shufflenetv2,squeezenet,densenet,googlenet,inceptionv3}.py. Architectures
+re-built from their published papers; all convs run NCHW through
+lax.conv_general_dilated (MXU path), NO code ported from the reference.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from .. import nn
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV1  (ref: python/paddle/vision/models/mobilenetv1.py)
+# ---------------------------------------------------------------------------
+
+class _ConvBNReLU(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = {
+            "relu": nn.ReLU(), "relu6": nn.ReLU6(),
+            "hardswish": nn.Hardswish(), "swish": nn.Swish(), None: None,
+        }[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.dw = _ConvBNReLU(in_c, in_c, 3, stride=stride, padding=1,
+                              groups=in_c)
+        self.pw = _ConvBNReLU(in_c, out_c, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    """reference: python/paddle/vision/models/mobilenetv1.py MobileNetV1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [  # (out_c, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+            (1024, 2), (1024, 1),
+        ]
+        layers = [_ConvBNReLU(3, c(32), 3, stride=2, padding=1)]
+        in_c = c(32)
+        for out_c, s in cfg:
+            layers.append(_DepthwiseSeparable(in_c, c(out_c), s))
+            in_c = c(out_c)
+        self.features = nn.Sequential(*layers)
+        self.out_c = in_c
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(in_c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3  (ref: python/paddle/vision/models/mobilenetv3.py)
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MV3Block(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(_ConvBNReLU(in_c, exp_c, 1, act=act))
+        layers.append(_ConvBNReLU(exp_c, exp_c, k, stride=stride,
+                                  padding=k // 2, groups=exp_c, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(exp_c, _make_divisible(exp_c // 4)))
+        layers.append(_ConvBNReLU(exp_c, out_c, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MV3_LARGE = [  # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    """reference: python/paddle/vision/models/mobilenetv3.py."""
+
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _make_divisible(ch * scale)
+
+        layers = [_ConvBNReLU(3, c(16), 3, stride=2, padding=1,
+                              act="hardswish")]
+        in_c = c(16)
+        for k, exp, out, se, act, s in cfg:
+            layers.append(_MV3Block(in_c, c(exp), c(out), k, s, se, act))
+            in_c = c(out)
+        last_conv = c(cfg[-1][1])
+        layers.append(_ConvBNReLU(in_c, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_c),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_c, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_MV3_LARGE, 1280, scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_MV3_SMALL, 1024, scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2  (ref: python/paddle/vision/models/shufflenetv2.py)
+# ---------------------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            main_in = in_c // 2
+        else:
+            main_in = in_c
+            self.branch1 = nn.Sequential(
+                _ConvBNReLU(in_c, in_c, 3, stride=stride, padding=1,
+                            groups=in_c, act=None),
+                _ConvBNReLU(in_c, branch_c, 1, act=act),
+            )
+        self.branch2 = nn.Sequential(
+            _ConvBNReLU(main_in, branch_c, 1, act=act),
+            _ConvBNReLU(branch_c, branch_c, 3, stride=stride, padding=1,
+                        groups=branch_c, act=None),
+            _ConvBNReLU(branch_c, branch_c, 1, act=act),
+        )
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFGS = {
+    0.25: (24, (24, 48, 96), 512), 0.33: (24, (32, 64, 128), 512),
+    0.5: (24, (48, 96, 192), 1024), 1.0: (24, (116, 232, 464), 1024),
+    1.5: (24, (176, 352, 704), 1024), 2.0: (24, (244, 488, 976), 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference: python/paddle/vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stem_c, stage_cs, last_c = _SHUFFLE_CFGS[scale]
+        repeats = (4, 8, 4)
+        self.conv1 = _ConvBNReLU(3, stem_c, 3, stride=2, padding=1, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        in_c = stem_c
+        stages = []
+        for c_out, n in zip(stage_cs, repeats):
+            units = [_ShuffleUnit(in_c, c_out, 2, act=act)]
+            for _ in range(n - 1):
+                units.append(_ShuffleUnit(c_out, c_out, 1, act=act))
+            stages.append(nn.Sequential(*units))
+            in_c = c_out
+        self.stages = nn.LayerList(stages)
+        self.conv_last = _ConvBNReLU(in_c, last_c, 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(last_c, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet  (ref: python/paddle/vision/models/squeezenet.py)
+# ---------------------------------------------------------------------------
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.relu = nn.ReLU()
+        self.expand1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return paddle.concat(
+            [self.relu(self.expand1(x)), self.relu(self.expand3(x))], axis=1
+        )
+
+
+class SqueezeNet(nn.Layer):
+    """reference: python/paddle/vision/models/squeezenet.py."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256),
+            )
+        else:  # 1.1
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5),
+                nn.Conv2D(512, num_classes, 1),
+                nn.ReLU(),
+            )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet  (ref: python/paddle/vision/models/densenet.py)
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(drop_rate) if drop_rate > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return paddle.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFGS = {
+    121: (32, (6, 12, 24, 16)), 161: (48, (6, 12, 36, 24)),
+    169: (32, (6, 12, 32, 32)), 201: (32, (6, 12, 48, 32)),
+    264: (32, (6, 12, 64, 48)),
+}
+
+
+class DenseNet(nn.Layer):
+    """reference: python/paddle/vision/models/densenet.py."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        growth_rate, block_cfg = _DENSE_CFGS[layers]
+        init_c = 2 * growth_rate
+        feats = [
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        ]
+        ch = init_c
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size, dropout))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet  (ref: python/paddle/vision/models/googlenet.py)
+# ---------------------------------------------------------------------------
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(
+            nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+            nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU(),
+        )
+        self.b3 = nn.Sequential(
+            nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+            nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU(),
+        )
+        self.b4 = nn.Sequential(
+            nn.MaxPool2D(3, stride=1, padding=1),
+            nn.Conv2D(in_c, proj, 1), nn.ReLU(),
+        )
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1
+        )
+
+
+class _GoogLeNetAux(nn.Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((4, 4))
+        self.conv = nn.Sequential(nn.Conv2D(in_c, 128, 1), nn.ReLU())
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x)).flatten(1)
+        x = self.dropout(self.relu(self.fc1(x)))
+        return self.fc2(x)
+
+
+class GoogLeNet(nn.Layer):
+    """reference: python/paddle/vision/models/googlenet.py GoogLeNet.
+
+    Like the reference, forward returns (out, aux1, aux2)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _GoogLeNetAux(512, num_classes)
+            self.aux2 = _GoogLeNetAux(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4c(self.i4b(x))
+        x = self.i4d(x)
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3  (ref: python/paddle/vision/models/inceptionv3.py)
+# ---------------------------------------------------------------------------
+
+# conv+BN+ReLU is the same building block as the MobileNet stack's
+_BNConv = _ConvBNReLU
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BNConv(in_c, 48, 1),
+                                _BNConv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BNConv(in_c, 64, 1),
+                                _BNConv(64, 96, 3, padding=1),
+                                _BNConv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BNConv(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1
+        )
+
+
+class _InceptionB(nn.Layer):  # grid reduction 35->17
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BNConv(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_BNConv(in_c, 64, 1),
+                                 _BNConv(64, 96, 3, padding=1),
+                                 _BNConv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, ch7):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _BNConv(in_c, ch7, 1),
+            _BNConv(ch7, ch7, (1, 7), padding=(0, 3)),
+            _BNConv(ch7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b7d = nn.Sequential(
+            _BNConv(in_c, ch7, 1),
+            _BNConv(ch7, ch7, (7, 1), padding=(3, 0)),
+            _BNConv(ch7, ch7, (1, 7), padding=(0, 3)),
+            _BNConv(ch7, ch7, (7, 1), padding=(3, 0)),
+            _BNConv(ch7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BNConv(in_c, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1
+        )
+
+
+class _InceptionD(nn.Layer):  # grid reduction 17->8
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BNConv(in_c, 192, 1),
+                                _BNConv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _BNConv(in_c, 192, 1),
+            _BNConv(192, 192, (1, 7), padding=(0, 3)),
+            _BNConv(192, 192, (7, 1), padding=(3, 0)),
+            _BNConv(192, 192, 3, stride=2),
+        )
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 320, 1)
+        self.b3_stem = _BNConv(in_c, 384, 1)
+        self.b3_a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_BNConv(in_c, 448, 1),
+                                      _BNConv(448, 384, 3, padding=1))
+        self.b3d_a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BNConv(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return paddle.concat(
+            [
+                self.b1(x),
+                paddle.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+                paddle.concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+                self.bp(x),
+            ],
+            axis=1,
+        )
+
+
+class InceptionV3(nn.Layer):
+    """reference: python/paddle/vision/models/inceptionv3.py."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BNConv(3, 32, 3, stride=2),
+            _BNConv(32, 32, 3),
+            _BNConv(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _BNConv(64, 80, 1),
+            _BNConv(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

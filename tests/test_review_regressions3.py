@@ -1,0 +1,108 @@
+"""Regressions from the round-2 code review (pooling ceil_mode /
+divisor_override, EarlyStopping reuse, fleet rewrap guard)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _out_size(d, k, s, p, ceil):
+    if ceil:
+        o = -(-(d + 2 * p - k) // s) + 1
+        if (o - 1) * s >= d + p:
+            o -= 1
+        return o
+    return (d - k + 2 * p) // s + 1
+
+
+def _ref_avg(x, k, s, p, ceil, excl):
+    """NumPy port of the reference kernel (funcs/pooling.cc:60-101)."""
+    n, c, h, w = x.shape
+    oh, ow = _out_size(h, k, s, p, ceil), _out_size(w, k, s, p, ceil)
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for ph in range(oh):
+        for pw in range(ow):
+            hs, ws = ph * s - p, pw * s - p
+            he, we = min(hs + k, h + p), min(ws + k, w + p)
+            size = (he - hs) * (we - ws)
+            hs2, ws2 = max(hs, 0), max(ws, 0)
+            he2, we2 = min(he, h), min(we, w)
+            vals = x[:, :, hs2:he2, ws2:we2].sum((2, 3))
+            if excl:
+                size = (he2 - hs2) * (we2 - ws2)
+            out[:, :, ph, pw] = vals / size
+    return out
+
+
+@pytest.mark.parametrize("k,s,p", [(2, 2, 1), (3, 2, 1), (3, 3, 1), (2, 3, 0)])
+@pytest.mark.parametrize("excl", [True, False])
+def test_avg_pool2d_ceil_mode(k, s, p, excl):
+    x = np.random.default_rng(0).standard_normal((2, 3, 7, 9)).astype(np.float32)
+    got = F.avg_pool2d(
+        paddle.to_tensor(x), k, s, p, ceil_mode=True, exclusive=excl
+    ).numpy()
+    want = _ref_avg(x, k, s, p, True, excl)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_max_pool2d_ceil_mode_shape_and_tail():
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    # floor mode drops the tail: (6-3)//2+1 = 2; ceil emits it: 3
+    assert F.max_pool2d(paddle.to_tensor(x), 3, 2, 0).numpy().shape == (1, 1, 2, 2)
+    got = F.max_pool2d(paddle.to_tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    assert got.shape == (1, 1, 3, 3)
+    # tail window covers rows/cols 4..5 -> max = x[5, 5]
+    assert got[0, 0, 2, 2] == 35.0
+
+
+def test_avg_pool2d_divisor_override():
+    x = np.ones((1, 1, 4, 4), np.float32)
+    got = F.avg_pool2d(paddle.to_tensor(x), 2, 2, 0, divisor_override=8).numpy()
+    np.testing.assert_allclose(got, np.full((1, 1, 2, 2), 4.0 / 8.0))
+
+
+def test_early_stopping_reusable_across_fits():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = rng.integers(0, 2, (16, 1))
+
+    net = paddle.nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(
+        paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+    )
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0)
+    ds = [(x[i], y[i]) for i in range(16)]
+    # lr=0: loss never improves after the first epoch -> stops at epoch 1
+    model.fit(ds, epochs=5, batch_size=8, verbose=0, callbacks=[es])
+    assert model.stop_training
+    # a second fit must start fresh, not exit at epoch 0
+    model.fit(ds, epochs=2, batch_size=8, verbose=0, callbacks=[es])
+    assert es.best is not None
+
+
+def test_distributed_optimizer_rejects_conflicting_rewrap():
+    from paddle_tpu.distributed import fleet
+
+    fleet.init(is_collective=True)
+    st = fleet.DistributedStrategy()
+    st.localsgd = True
+    st.localsgd_configs = {"k_steps": 2}
+    net = paddle.nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    wrapped = fleet.distributed_optimizer(opt, strategy=st)
+    # same strategy: idempotent
+    assert fleet.distributed_optimizer(wrapped, strategy=st) is wrapped
+    assert fleet.distributed_optimizer(wrapped) is wrapped
+    # conflicting new strategy on the wrapper: refused loudly
+    st2 = fleet.DistributedStrategy()
+    st2.localsgd = True
+    st2.localsgd_configs = {"k_steps": 8}
+    with pytest.raises(ValueError, match="already wrapped"):
+        fleet.distributed_optimizer(wrapped, strategy=st2)

@@ -1,0 +1,90 @@
+"""Forward-shape tests for the part-2 vision zoo (models_extra.py).
+
+Reference analogue: the per-model tests in
+python/paddle/fluid/tests/unittests/test_vision_models.py (shape checks
+through each family's forward).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _img(b, size):
+    rng = np.random.default_rng(0)
+    return paddle.to_tensor(
+        rng.standard_normal((b, 3, size, size)).astype(np.float32)
+    )
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        M.mobilenet_v1,
+        M.mobilenet_v3_small,
+        M.shufflenet_v2_x0_25,
+        M.squeezenet1_1,
+        M.densenet121,
+    ],
+)
+def test_small_families_forward(factory):
+    m = factory(num_classes=10)
+    m.eval()
+    with paddle.no_grad():
+        out = m(_img(2, 64))
+    assert tuple(out.shape) == (2, 10)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_mobilenet_v1_scale_and_no_head():
+    m = M.mobilenet_v1(scale=0.5, num_classes=0, with_pool=True)
+    m.eval()
+    with paddle.no_grad():
+        out = m(_img(1, 64))
+    # headless: pooled features [b, c, 1, 1]
+    assert out.shape[0] == 1 and out.shape[2] == 1 and out.shape[3] == 1
+
+
+def test_mobilenet_v3_large_trains():
+    m = M.mobilenet_v3_large(num_classes=4)
+    m.train()
+    x = _img(2, 64)
+    y = paddle.to_tensor(np.array([0, 3], np.int64))
+    loss = paddle.nn.CrossEntropyLoss()(m(x), y)
+    loss.backward()
+    grads = [p.grad for p in m.parameters() if p.grad is not None]
+    assert grads, "backward produced no grads"
+    assert np.isfinite(float(loss))
+
+
+def test_googlenet_aux_heads():
+    m = M.googlenet(num_classes=7)
+    m.eval()
+    with paddle.no_grad():
+        out, aux1, aux2 = m(_img(1, 224))
+    for t in (out, aux1, aux2):
+        assert tuple(t.shape) == (1, 7)
+
+
+def test_inception_v3_forward():
+    m = M.inception_v3(num_classes=5)
+    m.eval()
+    with paddle.no_grad():
+        out = m(_img(1, 299))
+    assert tuple(out.shape) == (1, 5)
+
+
+def test_densenet_variants_constructible():
+    for f in (M.densenet161, M.densenet169, M.densenet201, M.densenet264):
+        m = f(num_classes=2)
+        assert len(m.parameters()) > 100
+
+
+def test_shufflenet_channel_shuffle_permutes():
+    from paddle_tpu.vision.models_extra import _channel_shuffle
+
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1))
+    y = _channel_shuffle(x, 2).numpy().reshape(-1)
+    # groups=2 interleave: [0,4,1,5,2,6,3,7]
+    np.testing.assert_array_equal(y, [0, 4, 1, 5, 2, 6, 3, 7])
