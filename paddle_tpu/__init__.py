@@ -76,6 +76,9 @@ import importlib as _importlib
 
 for _pkg in (
     "nn",
+    "regularizer",
+    "sysconfig",
+    "callbacks",
     "optimizer",
     "autograd",
     "amp",
@@ -130,3 +133,25 @@ in_dynamic_mode = _tensor_api.in_dynamic_mode
 
 def is_grad_enabled():
     return core.is_grad_enabled()
+
+
+# remaining top-level reference names (python/paddle/__init__.py __all__)
+from .core.place import (  # noqa: E402,F401
+    CUDAPlace,
+    CustomPlace,
+    IPUPlace,
+    MLUPlace,
+    NPUPlace,
+    XPUPlace,
+)
+
+bool = bool_  # noqa: A001 — paddle.bool is the dtype (reference parity)
+dtype = DType
+if "nn" in globals():
+    ParamAttr = globals()["nn"].ParamAttr
+if "hapi" in globals():
+    from .hapi.dynamic_flops import flops  # noqa: E402,F401
+
+# the accelerator generator state IS the cuda one on this build
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state

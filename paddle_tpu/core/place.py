@@ -60,6 +60,35 @@ class CUDAPinnedPlace(CPUPlace):
     """Compatibility alias — on TPU pinned host memory is just host memory."""
 
 
+class CUDAPlace(TPUPlace):
+    """Compatibility alias (reference: phi/common/place.h:117 GPUPlace):
+    scripts written for the reference's accelerator land on this build's
+    accelerator. Device-id semantics carry over unchanged."""
+
+
+class NPUPlace(TPUPlace):
+    """Compatibility alias (reference: place.h:146 NPUPlace)."""
+
+
+class XPUPlace(TPUPlace):
+    """Compatibility alias (reference: place.h XPUPlace)."""
+
+
+class MLUPlace(TPUPlace):
+    """Compatibility alias (reference: place.h MLUPlace)."""
+
+
+class IPUPlace(TPUPlace):
+    """Compatibility alias (reference: place.h IPUPlace)."""
+
+
+class CustomPlace(TPUPlace):
+    """Compatibility alias (reference: place.h:185 CustomPlace)."""
+
+    def __init__(self, device_type="tpu", device_id=0):
+        super().__init__(device_id)
+
+
 def _devices_of_type(kind: str):
     try:
         if kind == "cpu":

@@ -10,7 +10,15 @@ from __future__ import annotations
 
 import jax
 
-from ..core.place import Place, get_device, set_device  # noqa: F401
+from ..core.place import (  # noqa: F401
+    CUDAPlace,
+    IPUPlace,
+    MLUPlace,
+    Place,
+    XPUPlace,
+    get_device,
+    set_device,
+)
 
 __all__ = [
     "set_device",
@@ -24,6 +32,58 @@ __all__ = [
 ]
 
 
+# "compiled with" probes (reference: python/paddle/device/__init__.py) —
+# this is an XLA/TPU build, so every vendor-specific probe answers False
+# honestly rather than raising.
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def get_cudnn_version():
+    """None — no cuDNN in an XLA/TPU build (reference returns None when
+    CUDA is absent)."""
+    return None
+
+
+def get_all_custom_device_type():
+    """Non-(cpu|gpu) PJRT platforms play the CustomDevice role here."""
+    return sorted(
+        {d.platform for d in jax.devices()} - {"cpu", "gpu", "cuda"}
+    )
+
+
+def get_available_custom_device():
+    return [
+        f"{d.platform}:{d.id}"
+        for d in jax.devices()
+        if d.platform not in ("cpu", "gpu", "cuda")
+    ]
+
+
 def get_all_device_type():
     return sorted({d.platform for d in jax.devices()} | {"cpu"})
 
@@ -32,9 +92,21 @@ def get_available_device():
     return [f"{d.platform}:{i}" for i, d in enumerate(jax.devices())]
 
 
+def _device_index(device):
+    """Accept int, 'platform:idx' string, or Place-like with _device_id."""
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str):
+        tail = device.rsplit(":", 1)[-1]
+        return int(tail) if tail.isdigit() else 0
+    return int(getattr(device, "_device_id", 0))
+
+
 def _device(device_id=None):
     devs = jax.devices()
-    return devs[device_id or 0]
+    return devs[_device_index(device_id)]
 
 
 def _stat(name: str, device_id=None, default=0):
@@ -64,8 +136,110 @@ def max_memory_reserved(device=None) -> int:
     return _stat("peak_bytes_reserved", device, memory_reserved(device))
 
 
+class Stream:
+    """API-parity stream object (reference: device/cuda/streams.py Stream).
+
+    XLA owns scheduling on TPU — there is one logical compute stream per
+    device — so streams are identity objects: recordable, waitable,
+    synchronizable, but not reorderable."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def synchronize(self):
+        _CudaNamespace.synchronize(self.device)
+
+    def query(self):
+        return True
+
+
+class Event:
+    """API-parity event (reference: device/cuda/streams.py Event)."""
+
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        _CudaNamespace.synchronize()
+
+
+_default_stream = Stream()
+
+
+def current_stream(device=None):
+    return _default_stream
+
+
+class stream_guard:
+    """Context manager selecting a stream (no-op under XLA scheduling)."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_device_name(device=None):
+    d = _device(device)
+    return getattr(d, "device_kind", d.platform)
+
+
+def get_device_capability(device=None):
+    """No CUDA compute capability on TPU; report (0, 0) like non-CUDA builds."""
+    return (0, 0)
+
+
+def get_device_properties(device=None):
+    d = _device(device)
+    stats = d.memory_stats() or {}
+
+    class _Props:
+        name = getattr(d, "device_kind", d.platform)
+        major, minor = 0, 0
+        total_memory = int(stats.get("bytes_limit", 0))
+        multi_processor_count = 1
+
+        def __repr__(self):
+            return (
+                f"_CudaDeviceProperties(name='{self.name}', "
+                f"total_memory={self.total_memory})"
+            )
+
+    return _Props()
+
+
 class _CudaNamespace:
     """paddle.device.cuda API-parity shim — maps to the default accelerator."""
+
+    Stream = Stream
+    Event = Event
+    current_stream = staticmethod(current_stream)
+    stream_guard = stream_guard
+    get_device_name = staticmethod(get_device_name)
+    get_device_capability = staticmethod(get_device_capability)
+    get_device_properties = staticmethod(get_device_properties)
 
     @staticmethod
     def device_count():

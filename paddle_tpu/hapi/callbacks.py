@@ -196,3 +196,69 @@ class VisualDL(Callback):
         os.makedirs(self.log_dir, exist_ok=True)
         with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
             f.write(json.dumps({"epoch": epoch, **(logs or {})}) + "\n")
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a metric plateaus (reference:
+    callbacks.py ReduceLROnPlateau). Works on optimizers with a float LR
+    or a ReduceOnPlateau-style scheduler."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            lr = opt._learning_rate
+            if hasattr(lr, "last_lr"):
+                # scheduler: scale base AND current lr by factor, so future
+                # step() calls (which recompute from base_lr) carry the
+                # reduction without re-applying accumulated decay
+                lr.base_lr = max(float(lr.base_lr) * self.factor, self.min_lr)
+                new = max(float(lr.last_lr) * self.factor, self.min_lr)
+                lr.last_lr = new
+            else:
+                new = max(float(lr) * self.factor, self.min_lr)
+                opt.set_lr(new)
+            if self.verbose:
+                print(f"\nEpoch {epoch}: reducing learning rate to {new}.")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0

@@ -1,0 +1,119 @@
+"""paddle.flops — per-layer FLOPs/params report.
+
+Reference analogue: python/paddle/hapi/dynamic_flops.py:25 flops() — runs a
+forward over a zeros input with per-layer-type counting hooks. Same counting
+conventions (multiply-add counted once; conv counts kernel MACs; norm/act
+count elementwise passes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from .. import nn
+
+__all__ = ["flops"]
+
+
+def _prod(xs):
+    out = 1
+    for v in xs:
+        out *= int(v)
+    return out
+
+
+def _count_conv(layer, x, y):
+    # kernel MACs per output element x output elements (+bias)
+    kh_kw_cin = _prod(layer.weight.shape[1:])
+    out_elems = _prod(y.shape)
+    total = out_elems * kh_kw_cin
+    if getattr(layer, "bias", None) is not None:
+        total += out_elems
+    return total
+
+
+def _count_linear(layer, x, y):
+    total = _prod(y.shape) * layer.weight.shape[0]
+    if getattr(layer, "bias", None) is not None:
+        total += _prod(y.shape)
+    return total
+
+
+def _count_norm(layer, x, y):
+    return 2 * _prod(x.shape)
+
+
+def _count_act(layer, x, y):
+    return _prod(x.shape)
+
+
+def _count_pool(layer, x, y):
+    return _prod(y.shape)
+
+
+_DEFAULT_COUNTERS = [
+    ((nn.Conv1D, nn.Conv2D, nn.Conv3D, nn.Conv2DTranspose), _count_conv),
+    ((nn.Linear,), _count_linear),
+    ((nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D, nn.LayerNorm,
+      nn.GroupNorm, nn.InstanceNorm2D), _count_norm),
+    ((nn.ReLU, nn.ReLU6, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Hardswish,
+      nn.Hardsigmoid, nn.Swish, nn.Silu, nn.LeakyReLU, nn.Softmax), _count_act),
+    ((nn.MaxPool2D, nn.AvgPool2D, nn.AdaptiveAvgPool2D), _count_pool),
+]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total FLOPs of one forward at `input_size` (reference:
+    hapi/dynamic_flops.py flops). custom_ops: {LayerType: fn(layer, x, y)}."""
+    rows = []
+    total = [0]
+    params_total = [0]
+    handles = []
+
+    def _counter_for(layer):
+        if custom_ops:
+            for cls, fn in custom_ops.items():
+                if isinstance(layer, cls):
+                    return fn
+        for classes, fn in _DEFAULT_COUNTERS:
+            # tolerate layer classes absent from some builds
+            real = tuple(c for c in classes if isinstance(c, type))
+            if isinstance(layer, real):
+                return fn
+        return None
+
+    def _hook(layer, inputs, output):
+        fn = _counter_for(layer)
+        if fn is None:
+            return
+        x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+        y = output[0] if isinstance(output, (tuple, list)) else output
+        n = int(fn(layer, x, y))
+        p = sum(_prod(q.shape) for q in layer.parameters(include_sublayers=False))
+        total[0] += n
+        params_total[0] += p
+        rows.append((type(layer).__name__, tuple(x.shape), tuple(y.shape), p, n))
+
+    for sub in net.sublayers(include_self=True):
+        if not sub._sub_layers:  # leaves only: avoid double counting
+            handles.append(sub.register_forward_post_hook(_hook))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.to_tensor(np.zeros(input_size, np.float32))
+        with paddle.no_grad():
+            net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    if print_detail:
+        print(f"{'Layer':<24}{'Input':<20}{'Output':<20}{'Params':>10}{'FLOPs':>14}")
+        for name, xs, ys, p, n in rows:
+            print(f"{name:<24}{str(xs):<20}{str(ys):<20}{p:>10}{n:>14}")
+    print(f"Total Flops: {total[0]}     Total Params: {params_total[0]}")
+    return total[0]

@@ -205,6 +205,25 @@ class StaticFunction:
             pure._meta = None
             pure.__name__ = f"to_static:{getattr(fn, '__name__', 'fn')}"
             self._compiled[cfg] = pure
+            if _verbosity > 0:
+                print(
+                    f"[to_static] new static configuration for "
+                    f"{pure.__name__}: template={template} "
+                    f"kwargs={kw_static} training={training}"
+                )
+            if _code_level is not None and _code_level > 0:
+                # the traced program IS the transformed code here: print its
+                # jaxpr (reference set_code_level prints transformed source)
+                try:
+                    flat_spec = (
+                        [p._value for p in params]
+                        + [b._value for b in buffers]
+                        + [_random.next_key()]
+                        + [t._value for t in tensor_args]
+                    )
+                    print(jax.make_jaxpr(pure)(*flat_spec))
+                except Exception as e:  # debugging aid must never break a run
+                    print(f"[to_static] jaxpr dump failed: {e}")
 
         key_arr = _random.next_key()
         outs = apply(
@@ -487,3 +506,54 @@ def load(path, **configs):
 
     exp, state, _meta = load_artifact(path)
     return TranslatedLayer(exp, state)
+
+
+# ---------------------------------------------------------------------------
+# dy2static debugging knobs + legacy TracedLayer
+# ---------------------------------------------------------------------------
+
+_verbosity = 0
+_code_level = None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit/dy2static logging_utils.set_verbosity — controls how
+    chatty the trace pipeline is (this build traces directly, so the knob
+    gates the dispatcher's op-level logging)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: logging_utils.set_code_level — print transformed code. The
+    trace-based pipeline has no AST stages; at any level >0 StaticFunction
+    prints the jaxpr of the traced program when first compiled."""
+    global _code_level
+    _code_level = int(level)
+
+
+class TracedLayer:
+    """reference: fluid/dygraph/jit.py TracedLayer — trace a dygraph layer
+    once, then run/save the traced program."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (eager_outputs, traced_layer)."""
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        out = layer(*inputs)
+        fn = to_static(layer.forward)
+        return out, TracedLayer(layer, fn, inputs)
+
+    def __call__(self, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self._fn(*inputs)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._layer, path, input_spec=self._example_inputs)
+        return path

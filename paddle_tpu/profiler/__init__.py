@@ -293,3 +293,29 @@ benchmark_timer = _Timer()
 
 def benchmark():
     return benchmark_timer
+
+
+def export_protobuf(dir_name: str, worker_name=None):
+    """on_trace_ready factory writing the raw trace as a protobuf-style
+    binary blob (reference: profiler/profiler.py export_protobuf). The
+    modern artifact here is the chrome-trace JSON; this wraps it in a
+    length-prefixed binary container for API parity."""
+    import json
+    import os
+    import struct
+    import time as _time
+
+    def _handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(_time.time())}.pb")
+        tmp = path + ".json"
+        prof.export(tmp, "json")
+        with open(tmp) as f:
+            payload = f.read().encode()
+        os.remove(tmp)
+        with open(path, "wb") as f:
+            f.write(b"PDTRACE1" + struct.pack("<Q", len(payload)) + payload)
+        return path
+
+    return _handler

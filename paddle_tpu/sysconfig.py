@@ -1,0 +1,21 @@
+"""paddle.sysconfig — header/library install paths.
+
+Reference analogue: python/paddle/sysconfig.py (get_include/get_lib point
+at the shipped C++ headers and core libs). Here they point at the package
+root and its native csrc components.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the package's C headers (csrc components)."""
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    """Directory containing the package's built native libraries."""
+    return os.path.join(_ROOT, "lib")

@@ -1046,4 +1046,164 @@ def _patch_tensor_methods():
 
 _patch_tensor_methods()
 
+
+# ---------------------------------------------------------------------------
+# top-level surface completion (reference: python/paddle/__init__.py __all__)
+# ---------------------------------------------------------------------------
+
+def add_n(inputs, name=None):
+    """Element-wise sum of a list of tensors (reference: tensor/math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = add(out, t)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add of a scalar (reference: tensor/math.py increment)."""
+    out = apply(lambda v: v + value, x, op_name="increment")
+    x.set_value(out)
+    return x
+
+
+def is_complex(x):
+    return jnp.issubdtype(x._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._value.dtype, jnp.integer)
+
+
+def rank(x):
+    """Rank (ndim) as a 0-D int32 tensor (reference: tensor/attribute.py)."""
+    return to_tensor(np.int32(x.ndim if hasattr(x, "ndim") else np.ndim(x)))
+
+
+def shape(x):
+    """Runtime shape as a 1-D int32 tensor (reference: fluid shape op)."""
+    return to_tensor(np.asarray(x.shape, np.int32))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = axis if axis is None else tuple(np.atleast_1d(axis).tolist())
+    return apply(
+        lambda v: jnp.nanquantile(v.astype(jnp.float64) if v.dtype != jnp.float64
+                                  else v, jnp.asarray(q), axis=ax,
+                                  keepdims=keepdim).astype(v.dtype
+                                  if jnp.issubdtype(v.dtype, jnp.floating)
+                                  else jnp.float32),
+        x, op_name="nanquantile",
+    )
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clip each slice along `axis` to p-norm <= max_norm (reference:
+    tensor/math.py renorm)."""
+
+    def _renorm(v):
+        ax = axis if axis >= 0 else axis + v.ndim
+        red = tuple(i for i in range(v.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return apply(_renorm, x, op_name="renorm")
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Recode a global index into a shard-local one (reference:
+    operators/shard_index_op.h — the PS-era vocab-shard helper)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range [0, {nshards})"
+        )
+    size = (index_num + nshards - 1) // nshards
+    return apply(
+        lambda v: jnp.where(v // size == shard_id, v % size, ignore_value),
+        input, op_name="shard_index",
+    )
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+def unbind(input, axis=0):
+    """Split along `axis` into a list of (axis-removed) tensors."""
+    n = input.shape[axis]
+    return [squeeze(s, axis=axis) for s in split(input, n, axis=axis)]
+
+
+def squeeze_(x, axis=None, name=None):
+    # shape-changing in-place rebind: bypass set_value's same-shape guard
+    x._value = squeeze(x, axis=axis)._value
+    x._bump_version()
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    x._value = unsqueeze(x, axis=axis)._value
+    x._bump_version()
+    return x
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: tensor/to_string.py set_printoptions — numpy-backed here."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: fluid/layers/utils.py:373)."""
+    if isinstance(shape, Tensor):
+        return
+    for item in shape:
+        if isinstance(item, Tensor):
+            continue
+        if not isinstance(item, (int, np.integer)):
+            raise TypeError(f"shape entries must be int, got {type(item)}")
+        if item < -1:
+            raise ValueError(f"shape entries must be >= -1, got {item}")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable Parameter (reference: paddle.create_parameter)."""
+    from .nn.layer_base import Parameter
+    from .nn import initializer as I
+
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    value = init._generate(tuple(int(s) for s in shape), dtype)
+    return Parameter(value, name=name)
+
+
+def disable_signal_handler():
+    """reference: paddle.disable_signal_handler — no custom handlers here."""
+
+
 __all__ = [n for n in dir() if not n.startswith("_")]

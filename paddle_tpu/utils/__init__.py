@@ -40,3 +40,42 @@ def deprecated(update_to="", since="", reason=""):
         return wrapper
 
     return decorate
+
+
+from . import dlpack, download, unique_name  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is in [min, max]
+    (reference: python/paddle/utils/install_check.py require_version)."""
+    from .. import __version__
+
+    def _tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = _tup(__version__)
+    if _tup(min_version) > cur:
+        raise RuntimeError(
+            f"installed version {__version__} < required {min_version}"
+        )
+    if max_version is not None and _tup(max_version) < cur:
+        raise RuntimeError(
+            f"installed version {__version__} > allowed {max_version}"
+        )
+
+
+def run_check():
+    """Smoke-check the install: run a tiny compiled matmul on the default
+    device (reference: paddle.utils.run_check trains a 2-layer net)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = paddle.matmul(x, x)
+    assert np.allclose(y.numpy(), np.full((2, 2), 2.0))
+    n = len(jax.devices())
+    print(f"PaddleTPU works! Found {n} device(s) on "
+          f"platform '{jax.devices()[0].platform}'.")

@@ -85,3 +85,42 @@ def load(
 
         return build_cpp_ops(lib, ops)
     return lib
+
+
+# setuptools-style entry points (reference: utils/cpp_extension/cpp_extension.py
+# CppExtension/CUDAExtension/setup). The JIT `load()` above is the primary
+# path in this environment; these wrap setuptools for offline builds.
+def CppExtension(sources, *args, **kwargs):
+    """Build description for a C++ custom-op extension."""
+    from setuptools import Extension
+
+    name = kwargs.pop("name", "paddle_tpu_custom_ext")
+    kwargs.setdefault("language", "c++")
+    include_dirs = list(kwargs.pop("include_dirs", []) or [])
+    if args:
+        # positional form Extension(name, sources, include_dirs, ...):
+        # fold the positional include_dirs into ours to avoid a collision
+        include_dirs += list(args[0] or [])
+        args = args[1:]
+    return Extension(name, sources, include_dirs, *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """CUDA extensions have no TPU build path; accepted for API parity and
+    built as plain C++ (the .cu sources are rejected with a clear error)."""
+    bad = [s for s in sources if str(s).endswith((".cu", ".cuh"))]
+    if bad:
+        raise RuntimeError(
+            f"CUDAExtension cannot build CUDA sources on a TPU/XLA stack: "
+            f"{bad}; write kernels in C++ (pure_callback path) or Pallas"
+        )
+    return CppExtension(sources, *args, **kwargs)
+
+
+def setup(**attrs):
+    """setuptools.setup wrapper that understands ext_modules from
+    CppExtension (reference: cpp_extension.setup)."""
+    import setuptools
+
+    attrs.setdefault("cmdclass", {})
+    return setuptools.setup(**attrs)

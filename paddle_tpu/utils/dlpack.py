@@ -1,0 +1,42 @@
+"""paddle.utils.dlpack — zero-copy tensor exchange via the DLPack protocol.
+
+Reference analogue: python/paddle/utils/dlpack.py (to_dlpack/from_dlpack
+over pybind dlpack converters); here backed by jax.dlpack.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack capsule."""
+    if isinstance(x, Tensor):
+        x = x._value
+    return x.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack-capable object (anything with __dlpack__: numpy,
+    torch, jax arrays, paddle Tensors) or a legacy DLPack capsule."""
+    if isinstance(dlpack, Tensor):
+        dlpack = dlpack._value
+    if hasattr(dlpack, "__dlpack__"):
+        arr = jax.dlpack.from_dlpack(dlpack)
+    else:
+        # legacy PyCapsule: modern jax only speaks the provider protocol;
+        # route the capsule through torch (which still consumes capsules)
+        # to obtain a provider object
+        try:
+            import torch.utils.dlpack as _tdl
+        except ImportError as e:
+            raise RuntimeError(
+                "from_dlpack got a raw DLPack capsule; converting it needs "
+                "torch on this jax version — pass an object implementing "
+                "__dlpack__ (numpy/torch/jax array, paddle Tensor) instead"
+            ) from e
+        arr = jax.dlpack.from_dlpack(_tdl.from_dlpack(dlpack))
+    return Tensor(arr, stop_gradient=True)

@@ -1,0 +1,46 @@
+"""paddle.utils.download — cached weight-file fetch.
+
+Reference analogue: python/paddle/utils/download.py
+(get_weights_path_from_url with ~/.cache/paddle/hapi/weights cache + md5).
+This environment has no egress, so the cache is the source of truth: a
+cached file is returned, a missing one raises with a clear message.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _md5check(path, md5sum):
+    if md5sum is None:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve a weights URL to a local cached path (download if possible)."""
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path) and _md5check(path, md5sum):
+        return path
+    try:
+        import urllib.request
+
+        os.makedirs(WEIGHTS_HOME, exist_ok=True)
+        urllib.request.urlretrieve(url, path)  # noqa: S310
+    except Exception as e:
+        raise RuntimeError(
+            f"weights '{fname}' not in cache ({WEIGHTS_HOME}) and download "
+            f"failed ({e}); place the file there manually"
+        ) from e
+    if not _md5check(path, md5sum):
+        raise RuntimeError(f"md5 mismatch for downloaded file {path}")
+    return path

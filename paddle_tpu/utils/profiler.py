@@ -1,0 +1,127 @@
+"""paddle.utils.profiler — legacy profiler API facade.
+
+Reference analogue: python/paddle/utils/profiler.py (the old
+fluid/profiler.py surface kept for compatibility). Delegates to the modern
+paddle.profiler implementation.
+"""
+from __future__ import annotations
+
+import warnings
+
+from ..profiler import Profiler as _ModernProfiler
+
+__all__ = [
+    "Profiler",
+    "ProfilerOptions",
+    "cuda_profiler",
+    "get_profiler",
+    "profiler",
+    "reset_profiler",
+    "start_profiler",
+    "stop_profiler",
+]
+
+
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All",
+            "sorted_key": "default",
+            "tracer_level": "Default",
+            "batch_range": [0, 100],
+            "output_thread_detail": False,
+            "profile_path": "none",
+            "timeline_path": "none",
+            "op_summary_path": "none",
+        }
+        if options:
+            self.options.update(options)
+
+    def __getitem__(self, name):
+        return self.options[name]
+
+
+class Profiler:
+    """Legacy wrapper driving the modern profiler underneath."""
+
+    def __init__(self, enabled=True, options=None):
+        self.enabled = enabled
+        self.profiler_options = ProfilerOptions(options)
+        self._p = _ModernProfiler()
+
+    def __enter__(self):
+        if self.enabled:
+            self._p.start()
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            self._p.stop()
+        return False
+
+    def start(self):
+        if self.enabled:
+            self._p.start()
+
+    def stop(self):
+        if self.enabled:
+            self._p.stop()
+
+    def reset(self):
+        pass
+
+
+_active = None
+
+
+def get_profiler():
+    global _active
+    if _active is None:
+        _active = Profiler()
+    return _active
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    get_profiler().start()
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    get_profiler().stop()
+
+
+def reset_profiler():
+    global _active
+    if _active is not None:
+        try:
+            _active.stop()  # never orphan a running device trace
+        except Exception:
+            pass
+    _active = None
+
+
+def cuda_profiler(*args, **kwargs):
+    warnings.warn("cuda_profiler is CUDA-only; use paddle.profiler instead")
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _noop():
+        yield
+
+    return _noop()
+
+
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    """Context manager form (legacy fluid.profiler.profiler)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        start_profiler(state, tracer_option)
+        try:
+            yield
+        finally:
+            stop_profiler(sorted_key, profile_path)
+
+    return _ctx()

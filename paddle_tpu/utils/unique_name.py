@@ -1,0 +1,51 @@
+"""paddle.utils.unique_name — process-wide unique name generator.
+
+Reference analogue: python/paddle/fluid/unique_name.py (generate/guard/
+switch over a per-scope counter map).
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+        self.prefix = ""
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{self.prefix}{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    """`key` -> `key_0`, `key_1`, ... (fresh per scope)."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the active scope; returns the previous one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh naming scope for the with-block (reference: unique_name.guard)."""
+    if isinstance(new_generator, str):
+        g = _Generator()
+        g.prefix = new_generator
+        new_generator = g
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)
