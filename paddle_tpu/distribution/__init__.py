@@ -520,3 +520,110 @@ def _kl_dirichlet_dirichlet(p, q):
         )
 
     return apply(f, p.concentration, q.concentration, op_name="kl_dirichlet")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    distribution/exponential_family.py:20): entropy via the Bregman
+    divergence of the log-normalizer, computed with autograd."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_parameters):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """-H(p) = E[log p]; uses dF/dη · η - F (reference method)."""
+        from ..autograd import grad as _grad
+
+        nparams = [
+            p.detach().clone() if hasattr(p, "detach") else _t(p)
+            for p in self._natural_parameters
+        ]
+        for p in nparams:
+            p.stop_gradient = False
+        log_norm = self._log_normalizer(*nparams)
+        grads = _grad(
+            log_norm.sum(), nparams, create_graph=False, allow_unused=False
+        )
+        result = log_norm - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            result = result - p * g
+        return result
+
+
+class TransformedDistribution(Distribution):
+    """Base distribution pushed through a chain of transforms (reference:
+    distribution/transformed_distribution.py:22)."""
+
+    def __init__(self, base, transforms):
+        from .transform import ChainTransform, Transform
+
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self._base = base
+        self._transforms = list(transforms)
+        chain = ChainTransform(self._transforms)
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out_shape = chain.forward_shape(base_shape)
+        super().__init__(tuple(out_shape))
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = (
+            self._base.rsample(shape)
+            if hasattr(self._base, "rsample")
+            else self._base.sample(shape)
+        )
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        """log p(y) = log p_base(x) - sum log|det J_t(x)| walking inverse."""
+        log_prob = None
+        y = value
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            log_prob = (-ldj) if log_prob is None else (log_prob - ldj)
+            y = x
+        base_lp = self._base.log_prob(y)
+        return base_lp if log_prob is None else base_lp + log_prob
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+from . import kl  # noqa: E402,F401

@@ -1,0 +1,9 @@
+"""paddle.distribution.kl — KL divergence registry submodule.
+
+Reference analogue: python/paddle/distribution/kl.py (kl_divergence +
+register_kl dispatch table). The registry itself lives in the package
+__init__; this module re-exports it under the reference path.
+"""
+from . import kl_divergence, register_kl  # noqa: F401
+
+__all__ = ["kl_divergence", "register_kl"]

@@ -1,0 +1,400 @@
+"""paddle.distribution.transform — invertible variable transforms.
+
+Reference analogue: python/paddle/distribution/transform.py (Transform base
+with forward/inverse/log-det protocol; Abs/Affine/Chain/Exp/Independent/
+Power/Reshape/Sigmoid/Softmax/Stack/StickBreaking/Tanh transforms).
+Rebuilt on the framework tensor API; each transform provides
+forward, inverse, forward_log_det_jacobian and (where the reference does)
+inverse_log_det_jacobian.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+__all__ = [
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        from . import Distribution, TransformedDistribution
+
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(input)
+
+    def forward(self, x):
+        return self._forward(paddle.to_tensor(x) if not hasattr(x, "_value") else x)
+
+    def inverse(self, y):
+        return self._inverse(paddle.to_tensor(y) if not hasattr(y, "_value") else y)
+
+    def forward_log_det_jacobian(self, x):
+        x = paddle.to_tensor(x) if not hasattr(x, "_value") else x
+        return self._forward_log_det_jacobian(x)
+
+    def inverse_log_det_jacobian(self, y):
+        y = paddle.to_tensor(y) if not hasattr(y, "_value") else y
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        return -self._forward_log_det_jacobian(self._inverse(y))
+
+    def forward_shape(self, shape):
+        return shape
+
+    def inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference: transform.py:327)."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return x.abs()
+
+    def _inverse(self, y):
+        return -y, y
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference: transform.py:399)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = paddle.to_tensor(loc) if not hasattr(loc, "_value") else loc
+        self._scale = (
+            paddle.to_tensor(scale) if not hasattr(scale, "_value") else scale
+        )
+
+    @property
+    def loc(self):
+        return self._loc
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return paddle.log(self._scale.abs()).expand(x.shape)
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (reference: transform.py:476)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @classmethod
+    def _is_injective(cls):
+        return True
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference: transform.py:600)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return paddle.exp(x)
+
+    def _inverse(self, y):
+        return paddle.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class IndependentTransform(Transform):
+    """Reinterpret rightmost dims as event dims (reference: transform.py:649)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self._base.forward(x)
+
+    def _inverse(self, y):
+        return self._base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        j = self._base.forward_log_det_jacobian(x)
+        axes = list(range(j.ndim - self._reinterpreted_batch_rank, j.ndim))
+        return j.sum(axis=axes)
+
+
+class PowerTransform(Transform):
+    """y = x ** power (reference: transform.py:740)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = (
+            paddle.to_tensor(power) if not hasattr(power, "_value") else power
+        )
+
+    @property
+    def power(self):
+        return self._power
+
+    def _forward(self, x):
+        return x.pow(self._power)
+
+    def _inverse(self, y):
+        return y.pow(1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return paddle.log((self._power * x.pow(self._power - 1.0)).abs())
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part (reference: transform.py:803)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(in_event_shape)
+        self._out = tuple(out_event_shape)
+        if int(np.prod(self._in)) != int(np.prod(self._out)):
+            raise ValueError("in/out event sizes differ")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _batch(self, shape, event):
+        n = len(shape) - len(event)
+        if n < 0 or tuple(shape[n:]) != tuple(event):
+            raise ValueError(f"shape {shape} does not end with {event}")
+        return tuple(shape[:n])
+
+    def _forward(self, x):
+        batch = self._batch(tuple(x.shape), self._in)
+        return x.reshape(list(batch) + list(self._out))
+
+    def _inverse(self, y):
+        batch = self._batch(tuple(y.shape), self._out)
+        return y.reshape(list(batch) + list(self._in))
+
+    def _forward_log_det_jacobian(self, x):
+        batch = self._batch(tuple(x.shape), self._in)
+        return paddle.zeros(list(batch) if batch else [1])
+
+    def forward_shape(self, shape):
+        return self._batch(shape, self._in) + self._out
+
+    def inverse_shape(self, shape):
+        return self._batch(shape, self._out) + self._in
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference: transform.py:910)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return paddle.nn.functional.sigmoid(x)
+
+    def _inverse(self, y):
+        return paddle.log(y) - paddle.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return -F.softplus(-x) - F.softplus(x)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (reference: transform.py:953)."""
+
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return paddle.nn.functional.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return paddle.log(y)
+
+
+class StackTransform(Transform):
+    """Apply one transform per slice along an axis (reference:
+    transform.py:1009)."""
+
+    def __init__(self, transforms, axis=0):
+        self._transforms = list(transforms)
+        self._axis = axis
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _slices(self, x):
+        return [
+            x.squeeze(self._axis)
+            for x in paddle.split(x, len(self._transforms), axis=self._axis)
+        ]
+
+    def _forward(self, x):
+        return paddle.stack(
+            [t.forward(s) for t, s in zip(self._transforms, self._slices(x))],
+            axis=self._axis,
+        )
+
+    def _inverse(self, y):
+        return paddle.stack(
+            [t.inverse(s) for t, s in zip(self._transforms, self._slices(y))],
+            axis=self._axis,
+        )
+
+    def _forward_log_det_jacobian(self, x):
+        return paddle.stack(
+            [
+                t.forward_log_det_jacobian(s)
+                for t, s in zip(self._transforms, self._slices(x))
+            ],
+            axis=self._axis,
+        )
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> k+1 simplex via stick breaking (reference:
+    transform.py:1114)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+
+        def _sb(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1])
+            z = 1.0 / (1.0 + jnp.exp(-(v - jnp.log(offset))))
+            zc = jnp.cumprod(1.0 - z, axis=-1)
+            ones = jnp.ones(v.shape[:-1] + (1,), v.dtype)
+            return jnp.concatenate([z, ones], -1) * jnp.concatenate(
+                [ones, zc], -1
+            )
+
+        return apply(_sb, x, op_name="stick_breaking_fwd")
+
+    def _inverse(self, y):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+
+        def _isb(w):
+            cum = jnp.cumsum(w[..., :-1], axis=-1)
+            z = w[..., :-1] / (1.0 - jnp.concatenate(
+                [jnp.zeros(w.shape[:-1] + (1,), w.dtype), cum[..., :-1]], -1
+            ))
+            offset = w.shape[-1] - 1 - jnp.arange(w.shape[-1] - 1)
+            return jnp.log(z / (1.0 - z)) + jnp.log(offset.astype(w.dtype))
+
+        return apply(_isb, y, op_name="stick_breaking_inv")
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference: transform.py:1178)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return paddle.tanh(x)
+
+    def _inverse(self, y):
+        return paddle.atanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        import paddle_tpu.nn.functional as F
+
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - F.softplus(-2.0 * x))

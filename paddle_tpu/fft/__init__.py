@@ -97,3 +97,53 @@ def ifftshift(x, axes=None, name=None):
         lambda v, axes: jnp.fft.ifftshift(v, axes=axes), x,
         axes=tuple(axes) if axes is not None else None, op_name="ifftshift",
     )
+
+
+def _hermitian_nd(x, s, axes, norm, name, inverse):
+    """hfft2/hfftn-style transforms (reference: fft.py hfftn/ihfftn).
+
+    hfftn: complex Hermitian in -> real out: fft over the leading axes,
+    then a 1-D hfft over the last. ihfftn is its exact inverse, so it runs
+    the mirror composition: ihfft over the last axis (real input), then
+    ifft over the leading axes."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    if axes is None:
+        axes = tuple(range(x.ndim)) if s is None else tuple(
+            range(x.ndim - len(s), x.ndim)
+        )
+    axes = tuple(a % x.ndim for a in axes)
+    sizes = list(s) if s is not None else [None] * len(axes)
+
+    def _run(v):
+        if inverse:
+            v = jnp.fft.ihfft(v, n=sizes[-1], axis=axes[-1], norm=_norm(norm))
+            for a, n in zip(axes[:-1], sizes[:-1]):
+                v = jnp.fft.ifft(v, n=n, axis=a, norm=_norm(norm))
+            return v
+        for a, n in zip(axes[:-1], sizes[:-1]):
+            v = jnp.fft.fft(v, n=n, axis=a, norm=_norm(norm))
+        return jnp.fft.hfft(v, n=sizes[-1], axis=axes[-1], norm=_norm(norm))
+
+    return apply(_run, x, op_name=name)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hermitian_nd(x, s, axes, norm, "hfft2", inverse=False)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hermitian_nd(x, s, axes, norm, "hfftn", inverse=False)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hermitian_nd(x, s, axes, norm, "ihfft2", inverse=True)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hermitian_nd(x, s, axes, norm, "ihfftn", inverse=True)
+
+
+__all__ += ["hfft2", "hfftn", "ihfft2", "ihfftn"]

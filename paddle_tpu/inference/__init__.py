@@ -280,3 +280,58 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """reference: paddle_infer::CreatePredictor (inference/api/paddle_inference_api.h)."""
     return Predictor(config)
+
+
+class DataType:
+    """reference: paddle_infer.DataType enum."""
+
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+_DTYPE_BYTES = {
+    DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT8: 1,
+    DataType.INT32: 4, DataType.INT64: 8, DataType.UINT8: 1, DataType.BOOL: 1,
+}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return _DTYPE_BYTES[dtype]
+
+
+def get_version() -> str:
+    from .. import __version__
+
+    return f"paddle_tpu inference {__version__} (StableHLO/XLA)"
+
+
+def get_trt_compile_version():
+    """No TensorRT in an XLA/TPU build (reference returns the linked TRT
+    version; the portable artifact here is StableHLO)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+class PredictorPool:
+    """Pool of cloned predictors for concurrent serving (reference:
+    paddle_infer.PredictorPool over AnalysisPredictor::Clone)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        first = create_predictor(config)
+        self._predictors = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+    def __len__(self):
+        return len(self._predictors)

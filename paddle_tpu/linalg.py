@@ -100,8 +100,18 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization; pivots are 1-based sequential row swaps (reference:
+    tensor/linalg.py lu — LAPACK getrf convention)."""
     out = apply(_la.lu, x, differentiable=False)
-    return out[0], out[1]
+    lu_mat, piv = out[0], out[1] + 1
+    if get_infos:
+        import numpy as _np
+
+        from .core.tensor import to_tensor
+
+        info = to_tensor(_np.zeros(tuple(lu_mat.shape[:-2]), _np.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
 
 
 def multi_dot(x, name=None):
@@ -122,3 +132,47 @@ def corrcoef(x, rowvar=True, name=None):
 
 def histogram(x, bins=100, min=0, max=0, name=None):
     return apply(_la.histogram, x, bins=bins, min=min, max=max, differentiable=False)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack jnp.linalg-style LU factorization into (P, L, U) (reference:
+    tensor/linalg.py lu_unpack)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .core.dispatch import apply
+
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+
+    def _unpack(lu, piv):
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        perm = jnp.broadcast_to(jnp.arange(m), piv.shape[:-1] + (m,))
+
+        def swap(perm, i):
+            j = piv[..., i] - 1
+            pi = perm[..., i]
+            pj = jnp.take_along_axis(perm, j[..., None], axis=-1)[..., 0]
+            perm = perm.at[..., i].set(pj)
+            return jnp.put_along_axis(perm, j[..., None], pi[..., None],
+                                      axis=-1, inplace=False), None
+
+        for i in range(piv.shape[-1]):
+            perm, _ = swap(perm, i)
+        P = jax.nn.one_hot(perm, m, dtype=lu.dtype)
+        # rows permuted: P[perm[i], i] = 1 so that A = P @ L @ U
+        return jnp.swapaxes(P, -1, -2), L, U
+
+    import jax
+
+    P, L, U = apply(_unpack, lu_data, lu_pivots, differentiable=False,
+                    op_name="lu_unpack")
+    # reference flag semantics: un-requested outputs come back as None
+    if not unpack_pivots:
+        P = None
+    if not unpack_ludata:
+        L = U = None
+    return P, L, U

@@ -3,6 +3,7 @@
 Reference analogue: python/paddle/nn/ (25.2k LoC).
 """
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer_base import Layer, Parameter  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
@@ -14,14 +15,19 @@ from .layer.activation import (  # noqa: F401
     Tanhshrink, ThresholdedReLU,
 )
 from .layer.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Embedding,
-    Flatten, Identity, LayerDict, LayerList, Linear, Pad1D, Pad2D,
-    ParameterList, Sequential, Upsample,
+    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+    Embedding, Flatten, Fold, Identity, LayerDict, LayerList, Linear, Pad1D,
+    Pad2D, Pad3D, PairwiseDistance, ParameterList, PixelShuffle,
+    PixelUnshuffle, Sequential, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, ZeroPad2D,
 )
-from .layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
+)
 from .layer.loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss,
-    KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+    HSigmoidLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
+    SmoothL1Loss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
@@ -29,12 +35,14 @@ from .layer.norm import (  # noqa: F401
     LocalResponseNorm, SpectralNorm, SyncBatchNorm,
 )
 from .layer.pooling import (  # noqa: F401
-    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AvgPool2D, MaxPool1D, MaxPool2D,
-    MaxUnPool2D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.rnn import (  # noqa: F401
-    GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN,
-    SimpleRNNCell,
+    GRU, LSTM, RNN, BeamSearchDecoder, BiRNN, GRUCell, LSTMCell, RNNCellBase,
+    SimpleRNN, SimpleRNNCell, dynamic_decode,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,

@@ -203,6 +203,14 @@ def conv2d_transpose(
     x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
     dilation=1, data_format="NCHW", output_size=None, name=None,
 ):
+    if output_size is not None:
+        spatial = (
+            tuple(x.shape[2:4]) if data_format == "NCHW" else tuple(x.shape[1:3])
+        )
+        output_padding = _transpose_out_padding(
+            output_size, spatial, tuple(weight.shape[-2:]), stride, padding,
+            dilation, output_padding, 2,
+        )
     args = (x, weight) if bias is None else (x, weight, bias)
     return apply(
         _nn.conv2d_transpose, *args, stride=_t(stride), padding=_t(padding),
@@ -683,6 +691,382 @@ def scaled_dot_product_attention(
         _nn.scaled_dot_product_attention, query, key, value, attn_mask,
         dropout_key, is_causal=is_causal, dropout_p=dropout_p, op_name="sdpa",
     )
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]
+
+
+# ---------------------------------------------------------------------------
+# N-d pooling / conv-transpose / fold + misc surface completion
+# (reference: nn/functional/{pooling,conv,common,loss,extension}.py)
+# ---------------------------------------------------------------------------
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return apply(
+        _nn.avg_pool1d, x, kernel_size=_t(kernel_size), stride=_t(stride),
+        padding=_t(padding), ceil_mode=ceil_mode, exclusive=exclusive,
+        op_name="avg_pool1d",
+    )
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return apply(
+        _nn.avg_pool3d, x, kernel_size=_t(kernel_size), stride=_t(stride),
+        padding=_t(padding), ceil_mode=ceil_mode, exclusive=exclusive,
+        divisor_override=divisor_override, data_format=data_format,
+        op_name="avg_pool3d",
+    )
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool3d(return_mask=True): 3-D argmax masks are not "
+            "implemented; use max_pool2d(return_mask=True) per-slice"
+        )
+    return apply(
+        _nn.max_pool3d, x, kernel_size=_t(kernel_size), stride=_t(stride),
+        padding=_t(padding), ceil_mode=ceil_mode, data_format=data_format,
+        op_name="max_pool3d",
+    )
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return apply(
+        _nn.adaptive_avg_pool3d, x, output_size=_t(output_size),
+        data_format=data_format, op_name="adaptive_avg_pool3d",
+    )
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d(return_mask=True)")
+    return apply(
+        _nn.adaptive_max_pool1d, x, output_size=_t(output_size),
+        op_name="adaptive_max_pool1d",
+    )
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool2d(return_mask=True)")
+    return apply(
+        _nn.adaptive_max_pool2d, x, output_size=_t(output_size),
+        op_name="adaptive_max_pool2d",
+    )
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d(return_mask=True)")
+    return apply(
+        _nn.adaptive_max_pool3d, x, output_size=_t(output_size),
+        op_name="adaptive_max_pool3d",
+    )
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    if data_format != "NCL":
+        raise ValueError(
+            f"max_unpool1d supports NCL only (reference unpool kernel "
+            f"layout), got {data_format}"
+        )
+    return apply(
+        _nn.max_unpool1d, x, indices, kernel_size=_t(kernel_size),
+        stride=_t(stride), padding=_t(padding),
+        output_size=None if output_size is None else tuple(output_size),
+        op_name="max_unpool1d",
+    )
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    if data_format != "NCDHW":
+        raise ValueError(
+            f"max_unpool3d supports NCDHW only (reference unpool kernel "
+            f"layout), got {data_format}"
+        )
+    return apply(
+        _nn.max_unpool3d, x, indices, kernel_size=_t(kernel_size),
+        stride=_t(stride), padding=_t(padding),
+        output_size=None if output_size is None else tuple(output_size),
+        op_name="max_unpool3d",
+    )
+
+
+def _transpose_out_padding(output_size, in_spatial, k, stride, padding,
+                           dilation, output_padding, nd):
+    """Derive output_padding from a requested output_size (reference:
+    conv_transpose output_size semantics: out = (in-1)*s - 2p + d*(k-1) + 1
+    + output_padding, with 0 <= output_padding < stride)."""
+    def tup(v):
+        return tuple(v) if isinstance(v, (tuple, list)) else (v,) * nd
+
+    if output_size is None:
+        return _t(output_padding)
+    if hasattr(output_size, "numpy"):
+        output_size = [int(v) for v in output_size.numpy()]
+    want = tuple(int(v) for v in output_size)[-nd:]
+    s, p, d = tup(stride), tup(padding), tup(dilation)
+    out_pad = []
+    for i in range(nd):
+        base = (in_spatial[i] - 1) * s[i] - 2 * p[i] + d[i] * (k[i] - 1) + 1
+        extra = want[i] - base
+        # valid range mirrors the reference: 0 <= output_padding < max(s, d)
+        if not (0 <= extra < max(s[i], d[i], 1)):
+            raise ValueError(
+                f"output_size {want} unreachable from input spatial "
+                f"{tuple(in_spatial)} (base {base}, stride {s[i]})"
+            )
+        out_pad.append(extra)
+    return tuple(out_pad)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    if output_size is not None:
+        output_padding = _transpose_out_padding(
+            output_size, (x.shape[2] if data_format == "NCL" else x.shape[1],),
+            (weight.shape[-1],), stride, padding, dilation, output_padding, 1,
+        )
+    return apply(
+        _nn.conv1d_transpose, x, weight, bias, stride=_t(stride),
+        padding=_t(padding), output_padding=_t(output_padding),
+        dilation=_t(dilation), groups=groups, data_format=data_format,
+        op_name="conv1d_transpose",
+    )
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    if output_size is not None:
+        spatial = (
+            tuple(x.shape[2:5]) if data_format == "NCDHW" else tuple(x.shape[1:4])
+        )
+        output_padding = _transpose_out_padding(
+            output_size, spatial, tuple(weight.shape[-3:]), stride, padding,
+            dilation, output_padding, 3,
+        )
+    return apply(
+        _nn.conv3d_transpose, x, weight, bias, stride=_t(stride),
+        padding=_t(padding), output_padding=_t(output_padding),
+        dilation=_t(dilation), groups=groups, data_format=data_format,
+        op_name="conv3d_transpose",
+    )
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    return apply(
+        _nn.fold, x, output_sizes=_t(output_sizes),
+        kernel_sizes=_t(kernel_sizes), strides=_t(strides),
+        paddings=_t(paddings), dilations=_t(dilations), op_name="fold",
+    )
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    return apply(
+        _nn.diag_embed, x, offset=offset, dim1=dim1, dim2=dim2,
+        op_name="diag_embed",
+    )
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        maxlen = int(np.asarray(x.numpy()).max())
+    return apply(
+        _nn.sequence_mask, x, maxlen=int(maxlen), dtype=str(dtype),
+        differentiable=False, op_name="sequence_mask",
+    )
+
+
+def gather_tree(ids, parents):
+    return apply(_nn.gather_tree, ids, parents, differentiable=False,
+                 op_name="gather_tree")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    return apply(
+        _nn.temporal_shift, x, seg_num=int(seg_num),
+        shift_ratio=float(shift_ratio), data_format=data_format,
+        op_name="temporal_shift",
+    )
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    return apply(
+        _nn.affine_grid, theta, out_shape=tuple(int(v) for v in out_shape),
+        align_corners=align_corners, op_name="affine_grid",
+    )
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return apply(_nn.bilinear, x1, x2, weight, bias, op_name="bilinear")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply(
+        _nn.pixel_unshuffle, x, downscale_factor=int(downscale_factor),
+        data_format=data_format, op_name="pixel_unshuffle",
+    )
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Drop whole 3-D channel volumes (reference: nn/functional/common.py
+    dropout3d)."""
+    if not training or p == 0.0:
+        return x
+    import jax
+    import jax.numpy as jnp
+
+    def _d3(v, key, *, p, data_format):
+        if data_format == "NCDHW":
+            shape = (v.shape[0], v.shape[1], 1, 1, 1)
+        else:
+            shape = (v.shape[0], 1, 1, 1, v.shape[4])
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+
+    return apply(
+        _d3, x, _random.next_key(), p=float(p), data_format=data_format,
+        op_name="dropout3d",
+    )
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+# in-place activation variants (reference: *_ in nn/functional/activation.py)
+def _make_inplace(fn):
+    def inner(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        if out._grad_node is not None:
+            x._grad_node = out._grad_node
+            x._out_index = out._out_index
+            x.stop_gradient = out.stop_gradient
+        x._bump_version()
+        return x
+
+    return inner
+
+
+elu_ = _make_inplace(elu)
+tanh_ = _make_inplace(tanh)
+softmax_ = _make_inplace(softmax)
+
+
+# losses
+def square_error_cost(input, label):
+    return apply(_nn.square_error_cost, input, label,
+                 op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(_nn.log_loss, input, label, epsilon=float(epsilon),
+                 op_name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return apply(_nn.dice_loss, input, label, epsilon=float(epsilon),
+                 op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return apply(_nn.npair_loss, anchor, positive, labels,
+                 l2_reg=float(l2_reg), op_name="npair_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss over [T, B, C] logits (reference: nn/functional/loss.py
+    ctc_loss → warpctc, which softmaxes internally — so raw logits in)."""
+    lp = log_softmax(log_probs, axis=-1)
+    loss = apply(
+        _nn.ctc_loss_per_sample, lp, labels, input_lengths, label_lengths,
+        blank=int(blank), op_name="ctc_loss",
+    )
+    if norm_by_times:
+        loss = loss / input_lengths.astype(loss.dtype)
+    if reduction == "mean":
+        # reference divides each sample by its label length before averaging
+        denom = label_lengths.astype(loss.dtype).clip(min=1.0)
+        return (loss / denom).mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    return apply(
+        _nn.hsigmoid_loss_op, input, label, weight, bias,
+        path_table, path_code, num_classes=int(num_classes),
+        op_name="hsigmoid_loss",
+    )
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    loss, sm = apply(
+        _nn.margin_cross_entropy_op, logits, label, margin1=float(margin1),
+        margin2=float(margin2), margin3=float(margin3), scale=float(scale),
+        op_name="margin_cross_entropy",
+    )
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, sm) if return_softmax else loss
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    if key_padding_mask is not None or attn_mask is not None:
+        raise NotImplementedError(
+            "sparse_attention masks beyond the CSR pattern"
+        )
+    return apply(
+        _nn.sparse_attention_op, query, key, value, sparse_csr_offset,
+        sparse_csr_columns, op_name="sparse_attention",
+    )
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference:
+    operators/class_center_sample_op.cu): keep all positive classes, fill
+    with sampled negatives up to num_samples; remap labels into the sampled
+    index space. Data-dependent sizes → host-side op."""
+    lab = np.asarray(label.numpy()).reshape(-1)
+    pos = np.unique(lab)
+    rest = num_samples - len(pos)
+    if rest > 0:
+        import jax as _jax
+
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        # draw through the framework generator so paddle.seed reproduces runs
+        seed = int(_jax.random.randint(_random.next_key(), (), 0, 2**31 - 1))
+        rng = np.random.default_rng(seed)
+        sampled = np.concatenate([pos, rng.permutation(neg_pool)[:rest]])
+    else:
+        sampled = pos
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return to_tensor(remap[lab]), to_tensor(sampled.astype(np.int64))
 
 
 __all__ = [n for n in dir() if not n.startswith("_")]

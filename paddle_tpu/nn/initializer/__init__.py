@@ -229,3 +229,42 @@ TruncatedNormalInitializer = TruncatedNormal
 XavierInitializer = XavierNormal
 MSRAInitializer = KaimingNormal
 NumpyArrayInitializer = Assign
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for conv_transpose weights (reference:
+    fluid/initializer.py BilinearInitializer — the deconv upsampling init)."""
+
+    def _generate(self, shape, dtype):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f_h - c_h)) * (1 - abs(og[1] / f_w - c_w))
+        w = np.zeros(shape, np.float64)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        import jax.numpy as jnp
+
+        from ...core.dtype import to_np_dtype
+
+        return jnp.asarray(w, to_np_dtype(dtype))
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set process-wide default initializers used when a parameter has no
+    explicit attr (reference: fluid/initializer.py set_global_initializer).
+    Pass (None, None) to restore framework defaults."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init

@@ -108,5 +108,66 @@ class Conv2DTranspose(Layer):
     def forward(self, x, output_size=None):
         return F.conv2d_transpose(
             x, self.weight, self.bias, self._stride, self._padding,
-            self._output_padding, self._groups, self._dilation, self._data_format,
+            self._output_padding, self._groups, self._dilation,
+            self._data_format, output_size,
+        )
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self._stride = stride
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[in_channels, out_channels // groups, k], attr=weight_attr,
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter(shape=[out_channels], attr=bias_attr,
+                                       is_bias=True)
+        )
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation,
+            output_size, self._data_format,
+        )
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self._stride = stride
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[in_channels, out_channels // groups, *kernel_size],
+            attr=weight_attr,
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter(shape=[out_channels], attr=bias_attr,
+                                       is_bias=True)
+        )
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation,
+            output_size, self._data_format,
         )

@@ -115,3 +115,43 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    """reference: nn/layer/loss.py CTCLoss over F.ctc_loss (warpctc role)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss (hierarchical softmax)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.weight = self.create_parameter(
+            shape=[num_classes - 1, feature_size], attr=weight_attr,
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter(shape=[num_classes - 1], attr=bias_attr,
+                                       is_bias=True)
+        )
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, self.bias,
+            path_table, path_code,
+        )

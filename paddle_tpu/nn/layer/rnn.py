@@ -411,3 +411,106 @@ class GRU(RNNBase):
         super().__init__("GRU", input_size, hidden_size, num_layers,
                          direction, time_major, dropout, weight_ih_attr,
                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding over an RNN cell (reference: nn/layer/rnn.py
+    BeamSearchDecoder / fluid layers beam search). Host-driven eager loop via
+    `dynamic_decode` — decode lengths are data-dependent, which is the one
+    place the reference also runs a dynamic loop.
+
+    Protocol: `step(time, inputs, states) -> (outputs, states)` where
+    outputs are per-step logits [batch*beam, vocab]."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, tok, states):
+        inp = paddle.to_tensor(np.asarray(tok, np.int64))
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        out, new_states = self.cell(inp, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def _gather_states(states, idx):
+    if isinstance(states, (tuple, list)):
+        return type(states)(_gather_states(s, idx) for s in states)
+    return paddle.to_tensor(states.numpy()[idx])
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run a decoder until all beams emit end_token or max_step_num
+    (reference: nn/layer/rnn.py dynamic_decode). Returns
+    (ids [B, beam, T] scores [B, beam]) (+ lengths)."""
+    if max_step_num is None:
+        max_step_num = 64
+    beam = decoder.beam_size
+    # bootstrap: single start token per batch item
+    if inits is None:
+        raise ValueError("dynamic_decode needs initial states (inits)")
+    states = inits
+    # infer batch from states leaf
+    leaf = states
+    while isinstance(leaf, (tuple, list)):
+        leaf = leaf[0]
+    batch = leaf.shape[0]
+
+    logits, states = decoder._logits(
+        np.full((batch,), decoder.start_token), states
+    )
+    logp = np.asarray(F.log_softmax(logits, axis=-1).numpy())
+    vocab = logp.shape[-1]
+    top = np.argsort(-logp, axis=-1)[:, :beam]              # [B, beam]
+    scores = np.take_along_axis(logp, top, axis=-1)          # [B, beam]
+    seqs = top[:, :, None]                                   # [B, beam, 1]
+    finished = top == decoder.end_token
+    # tile states to beams: [B, ...] -> [B*beam, ...]
+    rep = np.repeat(np.arange(batch), beam)
+    states = _gather_states(states, rep)
+    lengths = np.ones((batch, beam), np.int64)
+
+    for _ in range(1, max_step_num):
+        if finished.all():
+            break
+        flat_tok = seqs[:, :, -1].reshape(-1)
+        logits, new_states = decoder._logits(flat_tok, states)
+        logp = np.asarray(F.log_softmax(logits, axis=-1).numpy())
+        logp = logp.reshape(batch, beam, vocab)
+        # finished beams only extend with end_token at no cost
+        fin_mask = np.full((vocab,), -1e9, logp.dtype)
+        fin_mask[decoder.end_token] = 0.0
+        logp = np.where(finished[:, :, None], fin_mask[None, None, :], logp)
+        total = scores[:, :, None] + logp                    # [B, beam, V]
+        flat = total.reshape(batch, -1)
+        pick = np.argsort(-flat, axis=-1)[:, :beam]          # [B, beam]
+        scores = np.take_along_axis(flat, pick, axis=-1)
+        src_beam = pick // vocab
+        tok = pick % vocab
+        seqs = np.concatenate(
+            [np.take_along_axis(seqs, src_beam[:, :, None], axis=1),
+             tok[:, :, None]], axis=2,
+        )
+        was_fin = np.take_along_axis(finished, src_beam, axis=1)
+        lengths = np.take_along_axis(lengths, src_beam, axis=1) + (~was_fin)
+        finished = was_fin | (tok == decoder.end_token)
+        gather_idx = (np.arange(batch)[:, None] * beam + src_beam).reshape(-1)
+        states = _gather_states(new_states, gather_idx)
+
+    ids = paddle.to_tensor(seqs)
+    sc = paddle.to_tensor(scores)
+    if output_time_major:
+        ids = paddle.to_tensor(np.transpose(seqs, (2, 0, 1)))
+    if return_length:
+        return ids, sc, paddle.to_tensor(lengths)
+    return ids, sc

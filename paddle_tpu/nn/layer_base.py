@@ -97,6 +97,14 @@ class Layer:
                 name = attr.name
             elif isinstance(attr, I.Initializer):
                 init = attr
+        # set_global_initializer overrides layer defaults (but never an
+        # explicit ParamAttr initializer) — reference fluid/initializer.py
+        g = I._global_bias_init if is_bias else I._global_weight_init
+        attr_init = init is not default_initializer or (
+            attr is not None and getattr(attr, "initializer", None) is not None
+        )
+        if g is not None and not attr_init:
+            init = g
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init._generate(tuple(int(s) for s in shape), dtype)

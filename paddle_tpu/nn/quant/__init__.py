@@ -1,0 +1,2 @@
+"""paddle.nn.quant — quantization-aware layers (reference surface)."""
+from . import quant_layers  # noqa: F401

@@ -1,0 +1,185 @@
+"""paddle.nn.quant.quant_layers — the reference's QAT layer names.
+
+Reference analogue: python/paddle/nn/quant/quant_layers.py. The working
+implementations live in paddle_tpu.quantization (fake-quant STE ops +
+quantized Linear/Conv2D); this module maps the reference class names onto
+them and provides the thin observer/stub layers the reference also exports.
+"""
+from __future__ import annotations
+
+from ...quantization import (  # noqa: F401
+    QuantedConv2D as QuantizedConv2D,
+    QuantedLinear as QuantizedLinear,
+    fake_quant_abs_max,
+    fake_quant_channel_wise_abs_max,
+)
+from ..layer_base import Layer
+
+__all__ = [
+    "FakeQuantAbsMax",
+    "FakeQuantChannelWiseAbsMax",
+    "FakeQuantMovingAverageAbsMax",
+    "FakeQuantMAOutputScaleLayer",
+    "MAOutputScaleLayer",
+    "MovingAverageAbsMaxScale",
+    "QuantStub",
+    "QuantizedConv2D",
+    "QuantizedConv2DTranspose",
+    "QuantizedLinear",
+]
+
+
+class FakeQuantAbsMax(Layer):
+    """reference: quant_layers.py FakeQuantAbsMax."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32",
+                 quant_on_weight=False):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        return fake_quant_abs_max(x, bits=self.quant_bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 dtype="float32", quant_on_weight=False, quant_axis=0):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        return fake_quant_channel_wise_abs_max(
+            x, bits=self.quant_bits, axis=self.quant_axis
+        )
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """reference: quant_layers.py FakeQuantMovingAverageAbsMax — activation
+    fake-quant with EMA-tracked scale."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        import paddle_tpu as paddle
+
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self.register_buffer("scale", paddle.to_tensor(0.0))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        from ...quantization import _fq_moving_avg
+        from ...core.dispatch import apply
+
+        out, new_scale = apply(
+            _fq_moving_avg, x, self.scale, bits=self.quant_bits,
+            rate=self.moving_rate, op_name="fake_quant_moving_avg",
+        )
+        if self.training:
+            with paddle.no_grad():
+                self.scale.set_value(new_scale._value)
+        return out
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Observer: track abs-max scale without quantizing (reference:
+    quant_layers.py MovingAverageAbsMaxScale)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        import paddle_tpu as paddle
+
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", paddle.to_tensor(0.0))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.training:
+            with paddle.no_grad():
+                cur = float(x.abs().max())
+                prev = float(self.scale)
+                new = cur if prev == 0.0 else (
+                    self.moving_rate * prev + (1 - self.moving_rate) * cur
+                )
+                self.scale.set_value(
+                    paddle.to_tensor(new, dtype=str(self.scale.dtype))._value
+                )
+        return x
+
+
+class MAOutputScaleLayer(Layer):
+    """Wrap a layer, observing its output scale (reference:
+    quant_layers.py MAOutputScaleLayer)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, name=None, dtype="float32"):
+        super().__init__()
+        self._layer = layer
+        self._ma_output_scale = MovingAverageAbsMaxScale(
+            moving_rate=moving_rate, dtype=dtype
+        )
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return out
+        return self._ma_output_scale(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer, fake-quantizing its output (reference:
+    quant_layers.py FakeQuantMAOutputScaleLayer)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, name=None, *args, **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._fake_quant_output = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits,
+        )
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return out
+        return self._fake_quant_output(out)
+
+
+class QuantStub(Layer):
+    """Identity marker where quantization begins (reference:
+    quant_layers.py QuantStub)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class QuantizedConv2DTranspose(Layer):
+    """QAT wrapper over Conv2DTranspose (reference: quant_layers.py
+    QuantizedConv2DTranspose): fake-quant input + weight, then the float op."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, *args, **kwargs):
+        super().__init__()
+        self._conv = layer
+        self._fake_quant_input = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits,
+        )
+        self._weight_bits = weight_bits
+
+    def forward(self, x, output_size=None):
+        import paddle_tpu.nn.functional as F
+
+        x = self._fake_quant_input(x)
+        w = fake_quant_channel_wise_abs_max(
+            self._conv.weight, bits=self._weight_bits, axis=0
+        )
+        return F.conv2d_transpose(
+            x, w, self._conv.bias, self._conv._stride, self._conv._padding,
+            self._conv._output_padding, self._conv._groups,
+            self._conv._dilation, self._conv._data_format,
+        )

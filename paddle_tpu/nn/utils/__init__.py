@@ -1,0 +1,162 @@
+"""paddle.nn.utils — parametrization helpers + parameter/vector utilities.
+
+Reference analogue: python/paddle/nn/utils/ (weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py, clip_grad_norm_.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils_fns import (  # noqa: F401
+    clip_grad_norm_,
+    clip_grad_value_,
+    parameters_to_vector,
+    vector_to_parameters,
+)
+
+__all__ = [
+    "weight_norm",
+    "remove_weight_norm",
+    "spectral_norm",
+    "parameters_to_vector",
+    "vector_to_parameters",
+    "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def _norm_except_dim(v, dim):
+    import paddle_tpu as paddle
+
+    if dim is None or v.ndim == 1:
+        return paddle.sqrt((v * v).sum())
+    axes = [i for i in range(v.ndim) if i != dim]
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return paddle.sqrt((v * v).sum(axis=axes)).reshape(shape)
+
+
+class _WeightNormHook:
+    """reference: nn/utils/weight_norm_hook.py WeightNorm — reparameterize
+    `name` as g * v / ||v|| recomputed on every forward."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute_weight(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        return g * (v / _norm_except_dim(v, self.dim))
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name, self.compute_weight(layer))
+        return inputs
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to `layer.name` (reference:
+    nn/utils/weight_norm_hook.py weight_norm)."""
+    import paddle_tpu as paddle
+
+    w = getattr(layer, name)
+    if dim is not None and dim < 0:
+        dim += w.ndim
+    hook = _WeightNormHook(name, dim)
+    with paddle.no_grad():
+        g0 = _norm_except_dim(w, dim)
+    # replace the parameter with (g, v) and keep `name` a plain attribute
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", paddle.nn.Parameter(g0._value))
+    layer.add_parameter(name + "_v", paddle.nn.Parameter(w._value))
+    setattr(layer, name, hook.compute_weight(layer))
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a single parameter (reference:
+    weight_norm_hook.py remove_weight_norm)."""
+    import paddle_tpu as paddle
+
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm of '{name}' not found on {layer}")
+    hook, handle = hooks.pop(name)
+    with paddle.no_grad():
+        w = hook.compute_weight(layer)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(name, paddle.nn.Parameter(w._value))
+    return layer
+
+
+class _SpectralNormHook:
+    """reference: nn/utils/spectral_norm_hook.py SpectralNorm — divide the
+    weight by its top singular value, estimated by power iteration on a
+    persistent u buffer."""
+
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.power_iters = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def _mat(self, w):
+        import paddle_tpu as paddle
+
+        if self.dim != 0:
+            perm = [self.dim] + [i for i in range(w.ndim) if i != self.dim]
+            w = w.transpose(perm)
+        return w.reshape([w.shape[0], -1])
+
+    def compute_weight(self, layer):
+        import paddle_tpu as paddle
+
+        w_orig = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        mat = self._mat(w_orig)
+        with paddle.no_grad():
+            v = None
+            for _ in range(max(1, self.power_iters)):
+                v = paddle.matmul(mat, u, transpose_x=True)
+                v = v / (paddle.norm(v) + self.eps)
+                u_new = paddle.matmul(mat, v)
+                u_new = u_new / (paddle.norm(u_new) + self.eps)
+                u.set_value(u_new._value)
+        sigma = paddle.matmul(u.detach().unsqueeze(0),
+                              paddle.matmul(mat, v.detach().unsqueeze(1)))
+        return w_orig / sigma.reshape([])
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name, self.compute_weight(layer))
+        return inputs
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to `layer.name` (reference:
+    nn/utils/spectral_norm_hook.py spectral_norm)."""
+    import paddle_tpu as paddle
+
+    w = getattr(layer, name)
+    if dim is None:
+        # reference default: dim 1 for Linear (in,out layout), else 0
+        dim = 1 if type(layer).__name__ in ("Linear",) else 0
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    h = w.shape[dim]
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", paddle.nn.Parameter(w._value))
+    u0 = paddle.randn([h])
+    u0 = u0 / (paddle.norm(u0) + eps)
+    layer.register_buffer(name + "_u", u0)
+    setattr(layer, name, hook.compute_weight(layer))
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hooks = getattr(layer, "_spectral_norm_hooks", {})
+    layer._spectral_norm_hooks[name] = (hook, handle)
+    return layer

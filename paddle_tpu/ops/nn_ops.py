@@ -891,3 +891,580 @@ def label_smooth(label, *, epsilon=0.1):
 def npair_normalize(x, *, axis=1, epsilon=1e-12):
     norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
     return x / jnp.maximum(norm, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# N-d pooling generalization (1d rides on 2d; 3d implemented directly) —
+# reference: phi/kernels/pool_kernel.h Pool3D / funcs/pooling.cc Pool3dFunctor
+# ---------------------------------------------------------------------------
+def _tuple3(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in (list(v) + [v[-1]] * 3)[:3])
+    return (int(v),) * 3
+
+
+def _pool3d_geometry(x, kernel_size, stride, padding, ceil_mode, data_format):
+    ks = _tuple3(kernel_size)
+    st = _tuple3(stride if stride is not None else kernel_size)
+    pd = _tuple3(padding)
+    lo = 2 if data_format == "NCDHW" else 1
+    spatial = tuple(x.shape[lo + i] for i in range(3))
+    pads = [(0, 0)] * x.ndim
+    for i in range(3):
+        extra = _ceil_extra(spatial[i], ks[i], st[i], pd[i], pd[i]) if ceil_mode else 0
+        pads[lo + i] = (pd[i], pd[i] + extra)
+    if data_format == "NCDHW":
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+    return ks, st, pads, window, strides, spatial, lo
+
+
+def max_pool3d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    ks, st, pads, window, strides, _, _ = _pool3d_geometry(
+        x, kernel_size, stride, padding, ceil_mode, data_format
+    )
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, neg, jax.lax.max, window, strides, pads)
+
+
+def avg_pool3d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    ks, st, pads, window, strides, spatial, lo = _pool3d_geometry(
+        x, kernel_size, stride, padding, ceil_mode, data_format
+    )
+    pd = _tuple3(padding)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if divisor_override is not None:
+        if divisor_override <= 0:
+            raise ValueError(f"divisor_override must be > 0, got {divisor_override}")
+        return summed / divisor_override
+
+    def _counts(extent, count_pads):
+        shape = [1] * x.ndim
+        for i in range(3):
+            shape[lo + i] = extent[i]
+        ones = jnp.ones(shape, x.dtype)
+        return jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, window, strides, count_pads
+        )
+
+    if exclusive:
+        if any(p != (0, 0) for p in pads):
+            return summed / _counts(spatial, pads)
+        return summed / (ks[0] * ks[1] * ks[2])
+    # inclusive: padding counts but the ceil-mode extension never does
+    # (windows clamp to the padded extent — funcs/pooling.cc Pool3dFunctor)
+    extras = [pads[lo + i][1] - pd[i] for i in range(3)]
+    if ceil_mode and any(extras):
+        padded = tuple(spatial[i] + 2 * pd[i] for i in range(3))
+        ext = [(0, 0)] * x.ndim
+        for i in range(3):
+            ext[lo + i] = (0, extras[i])
+        return summed / _counts(padded, ext)
+    return summed / (ks[0] * ks[1] * ks[2])
+
+
+def avg_pool1d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = (stride if isinstance(stride, int) else (stride[0] if stride else k)) or k
+    p = padding if isinstance(padding, int) else padding[0]
+    out = avg_pool2d(
+        x[..., None], kernel_size=(k, 1), stride=(s, 1), padding=(p, 0),
+        ceil_mode=ceil_mode, exclusive=exclusive,
+    )
+    return out[..., 0]
+
+
+# adaptive pooling — reference: phi adaptive pool kernels (AdaptStartIndex/
+# AdaptEndIndex window math, funcs/pooling.cc:68)
+def _adaptive_axis_reduce(x, axis, out_size, reducer):
+    """Reduce variable [start,end) windows along one axis."""
+    n = x.shape[axis]
+    starts = [(i * n) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * n) // out_size) for i in range(out_size)]
+    slices = []
+    for s, e in zip(starts, ends):
+        seg = jax.lax.slice_in_dim(x, s, e, axis=axis)
+        slices.append(reducer(seg, axis=axis, keepdims=True))
+    return jnp.concatenate(slices, axis=axis)
+
+
+def adaptive_pool_nd(x, *, output_size, nd, kind, data_format="channels_first"):
+    lo = 2 if data_format == "channels_first" else 1
+    os = output_size if isinstance(output_size, (tuple, list)) else (output_size,) * nd
+    reducer = jnp.max if kind == "max" else jnp.mean
+    out = x
+    for i in range(nd):
+        if os[i] is None:
+            continue
+        out = _adaptive_axis_reduce(out, lo + i, int(os[i]), reducer)
+    return out
+
+
+def adaptive_max_pool1d(x, *, output_size):
+    return adaptive_pool_nd(x, output_size=output_size, nd=1, kind="max")
+
+
+def adaptive_max_pool2d(x, *, output_size, data_format="NCHW"):
+    return adaptive_pool_nd(
+        x, output_size=output_size, nd=2, kind="max",
+        data_format="channels_first" if data_format == "NCHW" else "channels_last",
+    )
+
+
+def adaptive_max_pool3d(x, *, output_size, data_format="NCDHW"):
+    return adaptive_pool_nd(
+        x, output_size=output_size, nd=3, kind="max",
+        data_format="channels_first" if data_format == "NCDHW" else "channels_last",
+    )
+
+
+def adaptive_avg_pool3d(x, *, output_size, data_format="NCDHW"):
+    return adaptive_pool_nd(
+        x, output_size=output_size, nd=3, kind="avg",
+        data_format="channels_first" if data_format == "NCDHW" else "channels_last",
+    )
+
+
+def max_unpool1d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    os2 = None if output_size is None else tuple(output_size) + (1,)
+    out = max_unpool2d(
+        x[..., None], indices[..., None], kernel_size=(k, 1), stride=(s, 1),
+        padding=(p, 0), output_size=os2,
+    )
+    return out[..., 0]
+
+
+def max_unpool3d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Scatter pooled values to their argmax positions in the DHW volume."""
+    ks = _tuple3(kernel_size)
+    st = _tuple3(stride if stride is not None else kernel_size)
+    pd = _tuple3(padding)
+    n, c, od, oh, ow = x.shape
+    if output_size is not None:
+        d, h, w = (int(v) for v in output_size[-3:])
+    else:
+        d = (od - 1) * st[0] - 2 * pd[0] + ks[0]
+        h = (oh - 1) * st[1] - 2 * pd[1] + ks[1]
+        w = (ow - 1) * st[2] - 2 * pd[2] + ks[2]
+    flat_x = x.reshape(n * c, -1)
+    flat_i = indices.reshape(n * c, -1)
+    out = jnp.zeros((n * c, d * h * w), x.dtype)
+    rows = jnp.arange(n * c)[:, None]
+    out = out.at[rows, flat_i].set(flat_x)
+    return out.reshape(n, c, d, h, w)
+
+
+# ---------------------------------------------------------------------------
+# transposed convolutions (1d rides on 2d; 3d direct) — reference:
+# phi/kernels/conv_transpose_kernel.h
+# ---------------------------------------------------------------------------
+def conv1d_transpose(x, weight, bias=None, *, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv1d_transpose")
+
+    def one(v):
+        return v if isinstance(v, int) else v[0]
+
+    out = conv2d_transpose(
+        x[..., None], weight[..., None],
+        None if bias is None else bias,
+        stride=(one(stride), 1), padding=(one(padding), 0),
+        output_padding=(one(output_padding), 0), dilation=(one(dilation), 1),
+        groups=groups, data_format="NCHW",
+    )
+    return out[..., 0]
+
+
+def conv3d_transpose(x, weight, bias=None, *, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    stride = _tuple3(stride)
+    dilation = _tuple3(dilation)
+    output_padding = _tuple3(output_padding)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv3d_transpose")
+    padding = _conv_padding(padding, 3, weight.shape[-3:], stride, dilation)
+    kd, kh, kw = weight.shape[-3:]
+    pad_t = [
+        (
+            dilation[i] * (k - 1) - padding[i][0],
+            dilation[i] * (k - 1) - padding[i][1] + output_padding[i],
+        )
+        for i, k in enumerate((kd, kh, kw))
+    ]
+    w = jnp.flip(weight, axis=(-3, -2, -1))
+    if groups > 1:
+        ci = w.shape[0]
+        w = w.reshape(groups, ci // groups, *w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2).reshape(-1, ci // groups, kd, kh, kw)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = (data_format, "OIDHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad_t, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        out = out + bias.reshape(shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fold (col2im) — reference: phi/kernels/fold_kernel.h (inverse of unfold)
+# ---------------------------------------------------------------------------
+def fold(x, *, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    if isinstance(output_sizes, int):
+        output_sizes = (output_sizes, output_sizes)
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = (kernel_sizes, kernel_sizes)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+    if isinstance(paddings, int):
+        paddings = (paddings, paddings, paddings, paddings)
+    elif len(paddings) == 2:
+        paddings = (paddings[0], paddings[1], paddings[0], paddings[1])
+    if isinstance(dilations, int):
+        dilations = (dilations, dilations)
+    n, ckk, L = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    ph = oh + paddings[0] + paddings[2]
+    pw = ow + paddings[1] + paddings[3]
+    nh = (ph - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    nw = (pw - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+    if nh * nw != L:
+        raise ValueError(
+            f"fold: {L} columns inconsistent with output_sizes {output_sizes} "
+            f"(expected {nh}*{nw})"
+        )
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    # scatter-add each kernel offset's plane (static k*k unrolled loop)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dilations[0]
+            wj = j * dilations[1]
+            out = out.at[
+                :, :,
+                hi : hi + nh * strides[0] : strides[0],
+                wj : wj + nw * strides[1] : strides[1],
+            ].add(cols[:, :, i, j])
+    return out[:, :, paddings[0] : ph - paddings[2], paddings[1] : pw - paddings[3]]
+
+
+# ---------------------------------------------------------------------------
+# misc tensor/nn ops (reference files inline)
+# ---------------------------------------------------------------------------
+def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
+    """reference: nn/functional/extension.py diag_embed → phi diag_embed."""
+    nd = x.ndim + 1
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    if d1 == d2:
+        raise ValueError("diag_embed dims must differ")
+    m = x.shape[-1] + abs(offset)
+    # build in canonical (..., d1, d2) order then move axes into place
+    idx = jnp.arange(x.shape[-1])
+    row = idx + max(-offset, 0)
+    base = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    col = idx + max(offset, 0)
+    base = base.at[..., row, col].set(x)
+    lo, hi = sorted((d1, d2))
+    out = jnp.moveaxis(base, -2, lo)
+    out = jnp.moveaxis(out, -1, hi)
+    if d1 > d2:
+        out = jnp.swapaxes(out, d1, d2)
+    return out
+
+
+def sequence_mask(lengths, *, maxlen=None, dtype="int64"):
+    """reference: nn/functional/extension.py sequence_mask."""
+    from ..core.dtype import to_np_dtype
+
+    if maxlen is None:
+        raise ValueError(
+            "maxlen must be given under jit (dynamic maxlen would make the "
+            "output shape data-dependent); pass int(lengths.max())"
+        )
+    mask = jnp.arange(maxlen)[None, :] < jnp.asarray(lengths).reshape(-1, 1)
+    shape = tuple(jnp.asarray(lengths).shape) + (maxlen,)
+    return mask.reshape(shape).astype(to_np_dtype(dtype))
+
+
+def gather_tree(ids, parents):
+    """Trace beam-search ancestry bottom-up (reference:
+    operators/gather_tree_op.cc; ids/parents: [T, B, beam])."""
+    def step(cur_parents, xs):
+        t_ids, t_parents = xs
+        sel = jnp.take_along_axis(t_ids, cur_parents, axis=-1)
+        new_parents = jnp.take_along_axis(t_parents, cur_parents, axis=-1)
+        return new_parents, sel
+
+    init_parents = jnp.broadcast_to(
+        jnp.arange(ids.shape[-1]), ids.shape[1:]
+    )
+    # walk from the last step backwards
+    rev_ids = jnp.flip(ids, axis=0)
+    rev_parents = jnp.flip(parents, axis=0)
+    _, outs = jax.lax.scan(step, init_parents, (rev_ids, rev_parents))
+    return jnp.flip(outs, axis=0)
+
+
+def temporal_shift(x, *, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM shift (reference: operators/temporal_shift_op.h): fold the batch
+    into [N/T, T, C, H, W], shift the first fold of channels backward in
+    time, the second forward, rest unshifted."""
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pad = jnp.zeros((n, 1, c, h, w), x.dtype)
+    prev = jnp.concatenate([v[:, 1:], pad], axis=1)[:, :, :c1]
+    nxt = jnp.concatenate([pad, v[:, :-1]], axis=1)[:, :, c1:c2]
+    keep = v[:, :, c2:]
+    out = jnp.concatenate([prev, nxt, keep], axis=2).reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def affine_grid(theta, *, out_shape, align_corners=True):
+    """reference: operators/affine_grid_op.h — 2D batch affine sampling grid.
+    theta [N, 2, 3] -> grid [N, H, W, 2] (normalized coords)."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size, dtype=theta.dtype)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size,
+                            dtype=theta.dtype)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gx, gy = jnp.meshgrid(xs, ys)  # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,nik->nhwi", base, theta)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """Bilinear tensor product (reference: operators/bilinear_tensor_product_op.h):
+    out[n, o] = x1[n, :] @ W[o] @ x2[n, :] + b[o]."""
+    out = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def pixel_unshuffle(x, *, downscale_factor, data_format="NCHW"):
+    """reference: phi pixel_unshuffle kernel."""
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+# ---------------------------------------------------------------------------
+# losses — reference: the corresponding phi loss kernels
+# ---------------------------------------------------------------------------
+def square_error_cost(input, label):
+    """reference: operators/squared_l2_distance — per-element (x - y)^2."""
+    d = input - label
+    return d * d
+
+
+def log_loss(input, label, *, epsilon=1e-4):
+    """reference: operators/log_loss_op.h."""
+    return -label * jnp.log(input + epsilon) - (1.0 - label) * jnp.log(
+        1.0 - input + epsilon
+    )
+
+
+def dice_loss(input, label, *, epsilon=1e-5):
+    """reference: nn/functional/loss.py dice_loss (prob input, int label)."""
+    label_oh = jax.nn.one_hot(label.squeeze(-1), input.shape[-1], dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    intersect = jnp.sum(input * label_oh, axis=red)
+    denom = jnp.sum(input, axis=red) + jnp.sum(label_oh, axis=red)
+    dice = (2.0 * intersect + epsilon) / (denom + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+def npair_loss(anchor, positive, labels, *, l2_reg=0.002):
+    """reference: nn/functional/loss.py npair_loss."""
+    reg = jnp.mean(jnp.sum(anchor * anchor, axis=1)) + jnp.mean(
+        jnp.sum(positive * positive, axis=1)
+    )
+    reg = reg * 0.25 * l2_reg
+    sim = anchor @ positive.T  # [B, B]
+    labels = labels.reshape(-1)
+    target = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    return ce + reg
+
+
+def ctc_loss_per_sample(log_probs, labels, input_lengths, label_lengths,
+                        *, blank=0):
+    """CTC forward algorithm in log space over [T, B, C] log-probs
+    (reference: operators/warpctc_op.h semantics; the reference applies
+    softmax inside warpctc — callers pass raw logits through log_softmax
+    first, which F.ctc_loss does).
+
+    labels: [B, L] padded with anything (masked by label_lengths)."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    # allowed skip (s-2 -> s): ext[s] != blank and ext[s] != ext[s-2]
+    skip_ok = jnp.zeros((B, S), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])
+    )
+    sidx = jnp.arange(S)[None, :]
+    valid_s = sidx < (2 * label_lengths[:, None] + 1)
+
+    def emit(t_lp):  # [B, C] -> [B, S] log-prob of each ext symbol
+        return jnp.take_along_axis(t_lp, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    first_lab = emit(log_probs[0])[:, 1]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, first_lab, neg_inf))
+
+    def step(alpha, t_lp):
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1
+        )
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1
+        )
+        prev2 = jnp.where(skip_ok, prev2, neg_inf)
+        stacked = jnp.stack([alpha, prev1, prev2], axis=0)
+        merged = jax.scipy.special.logsumexp(stacked, axis=0)
+        new = merged + emit(t_lp)
+        return jnp.where(valid_s, new, neg_inf), None
+
+    ts = jnp.arange(1, T)
+
+    def masked_step(alpha, inputs):
+        t, t_lp = inputs
+        new, _ = step(alpha, t_lp)
+        # past each sample's input length the alphas freeze
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(masked_step, alpha0, (ts, log_probs[1:]))
+    endA = jnp.take_along_axis(alpha, (2 * label_lengths - 1)[:, None], axis=1)[:, 0]
+    endB = jnp.take_along_axis(alpha, (2 * label_lengths)[:, None], axis=1)[:, 0]
+    ll = jax.scipy.special.logsumexp(jnp.stack([endA, endB]), axis=0)
+    # empty label: loss = -sum of blank log-probs up to input_length
+    t_idx = jnp.arange(T)[:, None]
+    blank_sum = jnp.sum(
+        jnp.where(t_idx < input_lengths[None, :], log_probs[:, :, blank], 0.0),
+        axis=0,
+    )
+    ll = jnp.where(label_lengths == 0, blank_sum, ll)
+    return -ll
+
+
+def hsigmoid_loss_op(x, labels, weight, bias=None, path_table=None,
+                     path_code=None, *, num_classes):
+    """Hierarchical sigmoid loss (reference:
+    operators/hierarchical_sigmoid_op.h + funcs/matrix_bit_code.h SimpleCode:
+    c = label + num_classes; index(j) = (c >> (j+1)) - 1; bit(j) = (c >> j) & 1;
+    length = bits(c >> 1)). Returns [N, 1]."""
+    n = x.shape[0]
+    if path_table is not None:
+        # custom tree: indices [N, L] (pad -1), codes [N, L]
+        idx = path_table
+        bits = path_code.astype(x.dtype)
+        valid = (idx >= 0)
+        safe_idx = jnp.maximum(idx, 0)
+    else:
+        max_len = int(np.floor(np.log2(max(num_classes - 1, 1)))) + 1
+        c = labels.reshape(-1).astype(jnp.int64) + num_classes
+        j = jnp.arange(max_len)
+        idx = (c[:, None] >> (j[None, :] + 1)) - 1
+        bits = ((c[:, None] >> j[None, :]) & 1).astype(x.dtype)
+        # length = number of bits in (c >> 1): j valid while (c>>1) >> j > 0
+        valid = ((c[:, None] >> (j[None, :] + 1)) > 0)
+        safe_idx = jnp.clip(idx, 0, weight.shape[0] - 1)
+    w = weight[safe_idx]                       # [N, L, D]
+    pre = jnp.einsum("nld,nd->nl", w, x)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[safe_idx]
+    # sigmoid cross entropy with target bit: softplus(pre) - bit*pre
+    loss = jnp.where(valid, jax.nn.softplus(pre) - bits * pre, 0.0)
+    return jnp.sum(loss, axis=1, keepdims=True)
+
+
+def margin_cross_entropy_op(logits, label, *, margin1=1.0, margin2=0.5,
+                            margin3=0.0, scale=64.0):
+    """ArcFace-family margin softmax (reference:
+    operators/margin_cross_entropy_op.cu): target logit cos(theta) becomes
+    cos(m1*theta + m2) - m3, all logits scaled by s. Returns (loss, softmax)."""
+    oh = jax.nn.one_hot(label.reshape(-1), logits.shape[-1], dtype=logits.dtype)
+    cos = jnp.clip(logits, -1.0, 1.0)
+    if margin1 != 1.0 or margin2 != 0.0:
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2)
+    else:
+        target = cos
+    target = target - margin3
+    adjusted = jnp.where(oh > 0, target, logits) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(oh * logp, axis=-1, keepdims=True)
+    return loss, jnp.exp(logp)
+
+
+def sparse_attention_op(q, k, v, offset, columns):
+    """Block-sparse attention with a per-(batch, head) CSR pattern
+    (reference: operators/sparse_attention_op.cu). TPU-native lowering:
+    materialize the CSR pattern as a mask and let XLA fuse the masked
+    softmax — on MXU the dense QK^T is the fast path for the seq lengths
+    the reference op supports."""
+    S = q.shape[-2]
+
+    def one_head(qh, kh, vh, off, cols):
+        nnz = cols.shape[0]
+        j = jnp.arange(nnz)
+        row_of_j = jnp.searchsorted(off, j, side="right") - 1
+        mask = jnp.zeros((S, S), bool).at[row_of_j, cols].set(True)
+        scores = (qh @ kh.T) / jnp.sqrt(jnp.asarray(qh.shape[-1], qh.dtype))
+        scores = jnp.where(mask, scores, -jnp.inf)
+        # rows with no allowed key produce 0 output, not NaN
+        w = jax.nn.softmax(scores, axis=-1)
+        w = jnp.where(mask.any(-1, keepdims=True), w, 0.0)
+        return w @ vh
+
+    return jax.vmap(jax.vmap(one_head))(q, k, v, offset, columns)

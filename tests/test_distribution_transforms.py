@@ -1,0 +1,234 @@
+"""Tests for distribution transforms, TransformedDistribution,
+ExponentialFamily, nn.utils weight/spectral norm, fft hermitian transforms,
+and linalg.lu_unpack (reference: distribution/transform.py,
+transformed_distribution.py, exponential_family.py, nn/utils/, fft.py,
+tensor/linalg.py)."""
+import math
+
+import numpy as np
+import pytest
+import torch
+import torch.distributions as TD
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+from paddle_tpu import nn
+
+rng = np.random.default_rng(3)
+
+
+class TestTransforms:
+    @pytest.mark.parametrize(
+        "ours,theirs,pts",
+        [
+            (D.SigmoidTransform(), TD.SigmoidTransform(),
+             np.array([-1.0, 0.3, 2.0], np.float32)),
+            (D.ExpTransform(), TD.ExpTransform(),
+             np.array([-1.0, 0.3, 2.0], np.float32)),
+            (D.PowerTransform(2.0), TD.PowerTransform(torch.tensor(2.0)),
+             np.array([0.5, 1.5], np.float32)),
+            (D.TanhTransform(), TD.TanhTransform(),
+             np.array([-0.5, 0.9], np.float32)),
+        ],
+        ids=["sigmoid", "exp", "power", "tanh"],
+    )
+    def test_forward_inverse_jacobian_vs_torch(self, ours, theirs, pts):
+        x = paddle.to_tensor(pts)
+        fx = ours.forward(x)
+        np.testing.assert_allclose(
+            fx.numpy(), theirs(torch.tensor(pts)).numpy(), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            ours.inverse(fx).numpy(), pts, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            ours.forward_log_det_jacobian(x).numpy(),
+            theirs.log_abs_det_jacobian(
+                torch.tensor(pts), theirs(torch.tensor(pts))
+            ).numpy(),
+            rtol=1e-4, atol=1e-6,
+        )
+
+    def test_affine_and_chain(self):
+        t = D.AffineTransform(2.0, 3.0)
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        np.testing.assert_allclose(t.forward(x).numpy(), [5.0, -1.0])
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(), x.numpy())
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        np.testing.assert_allclose(
+            chain.forward(x).numpy(), np.exp(2.0 * x.numpy()), rtol=1e-6
+        )
+
+    def test_reshape_stack_independent(self):
+        r = D.ReshapeTransform((2, 3), (3, 2))
+        x = paddle.to_tensor(rng.standard_normal((4, 2, 3)).astype(np.float32))
+        y = r.forward(x)
+        assert tuple(y.shape) == (4, 3, 2)
+        np.testing.assert_allclose(r.inverse(y).numpy(), x.numpy())
+        st = D.StackTransform([D.ExpTransform(), D.TanhTransform()], axis=1)
+        x2 = paddle.to_tensor(rng.standard_normal((3, 2)).astype(np.float32))
+        y2 = st.forward(x2).numpy()
+        np.testing.assert_allclose(y2[:, 0], np.exp(x2.numpy()[:, 0]), rtol=1e-5)
+        np.testing.assert_allclose(y2[:, 1], np.tanh(x2.numpy()[:, 1]), rtol=1e-5)
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        j = it.forward_log_det_jacobian(x2)
+        assert tuple(j.shape) == (3,)
+
+    def test_stick_breaking_roundtrip(self):
+        sb = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.3, -0.2, 1.0], np.float32))
+        w = sb.forward(x)
+        assert abs(float(w.sum()) - 1.0) < 1e-5
+        np.testing.assert_allclose(sb.inverse(w).numpy(), x.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTransformedDistribution:
+    def test_lognormal_log_prob(self):
+        td = D.TransformedDistribution(D.Normal(0.5, 1.2), [D.ExpTransform()])
+        y = np.array([0.5, 1.0, 2.5], np.float32)
+        want = TD.TransformedDistribution(
+            TD.Normal(0.5, 1.2), [TD.ExpTransform()]
+        ).log_prob(torch.tensor(y)).numpy()
+        np.testing.assert_allclose(
+            td.log_prob(paddle.to_tensor(y)).numpy(), want, rtol=1e-5
+        )
+
+    def test_chain_log_prob_and_sample(self):
+        paddle.seed(0)
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0),
+            [D.AffineTransform(1.0, 0.5), D.TanhTransform()],
+        )
+        y = np.array([0.2, 0.8], np.float32)
+        want = TD.TransformedDistribution(
+            TD.Normal(0.0, 1.0),
+            [TD.AffineTransform(1.0, 0.5), TD.TanhTransform()],
+        ).log_prob(torch.tensor(y)).numpy()
+        np.testing.assert_allclose(
+            td.log_prob(paddle.to_tensor(y)).numpy(), want, rtol=1e-4
+        )
+        s = td.sample((500,)).numpy()
+        assert (np.abs(s) <= 1.0).all()  # tanh range
+
+    def test_transform_call_on_distribution(self):
+        td = D.ExpTransform()(D.Normal(0.0, 1.0))
+        assert isinstance(td, D.TransformedDistribution)
+
+
+class TestExponentialFamily:
+    def test_entropy_matches_torch_normal(self):
+        class _NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = paddle.to_tensor(loc)
+                self.scale = paddle.to_tensor(scale)
+                super().__init__(tuple(self.loc.shape))
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale**2, -0.5 / self.scale**2)
+
+            def _log_normalizer(self, x, y):
+                return -0.25 * x**2 / y + 0.5 * paddle.log(-math.pi / y)
+
+            @property
+            def _mean_carrier_measure(self):
+                return 0.0
+
+        got = float(_NormalEF(np.float32(1.5), np.float32(0.7)).entropy())
+        want = float(TD.Normal(1.5, 0.7).entropy())
+        assert abs(got - want) < 1e-4
+
+    def test_kl_submodule(self):
+        from paddle_tpu.distribution.kl import kl_divergence, register_kl
+
+        v = float(kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)))
+        want = float(TD.kl_divergence(TD.Normal(0.0, 1.0), TD.Normal(1.0, 2.0)))
+        assert abs(v - want) < 1e-5
+        assert callable(register_kl)
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        paddle.seed(0)
+        l = nn.Linear(4, 3)
+        w0 = l.weight.numpy().copy()
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+        y0 = l(x).numpy()
+        nn.utils.weight_norm(l, dim=1)
+        np.testing.assert_allclose(l(x).numpy(), y0, rtol=1e-5)
+        l(x).sum().backward()
+        grads = {n for n, p in l.named_parameters() if p.grad is not None}
+        assert "weight_g" in grads and "weight_v" in grads
+        nn.utils.remove_weight_norm(l)
+        np.testing.assert_allclose(l.weight.numpy(), w0, rtol=1e-5)
+        np.testing.assert_allclose(l(x).numpy(), y0, rtol=1e-5)
+
+    def test_spectral_norm_caps_singular_value(self):
+        paddle.seed(0)
+        l = nn.Linear(6, 5)
+        nn.utils.spectral_norm(l, n_power_iterations=20)
+        l(paddle.to_tensor(np.ones((1, 6), np.float32)))
+        sv = np.linalg.svd(l.weight.numpy(), compute_uv=False)
+        assert abs(sv[0] - 1.0) < 0.05
+
+    def test_parameters_to_vector_roundtrip(self):
+        l = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(l.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        nn.utils.vector_to_parameters(vec * 0 + 1.0, l.parameters())
+        np.testing.assert_allclose(l.weight.numpy(), np.ones((3, 2)))
+
+
+class TestFFTHermitian:
+    def test_ihfft2_matches_scipy_and_roundtrips(self):
+        x = rng.standard_normal((4, 6))
+        ih = paddle.fft.ihfft2(paddle.to_tensor(x))
+        scipy_fft = pytest.importorskip("scipy.fft")
+        np.testing.assert_allclose(ih.numpy(), scipy_fft.ihfft2(x),
+                                   rtol=1e-8, atol=1e-12)
+        np.testing.assert_allclose(paddle.fft.hfft2(ih).numpy(), x,
+                                   rtol=1e-8, atol=1e-10)
+
+    def test_hfftn_roundtrip(self):
+        x = rng.standard_normal((3, 4, 6))
+        rt = paddle.fft.hfftn(paddle.fft.ihfftn(paddle.to_tensor(x))).numpy()
+        np.testing.assert_allclose(rt, x, rtol=1e-8, atol=1e-10)
+
+
+class TestLuUnpack:
+    @pytest.mark.parametrize("shape", [(5, 5), (4, 6), (6, 4)])
+    def test_reconstructs(self, shape):
+        A = rng.standard_normal(shape)
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ L.numpy() @ U.numpy(), A, rtol=1e-8, atol=1e-10
+        )
+
+    def test_get_infos(self):
+        A = rng.standard_normal((3, 3))
+        lu, piv, info = paddle.linalg.lu(paddle.to_tensor(A), get_infos=True)
+        assert (info.numpy() == 0).all()
+
+
+class TestGlobalInitializer:
+    def test_override_and_restore(self):
+        nn.initializer.set_global_initializer(nn.initializer.Constant(0.5))
+        try:
+            l = nn.Linear(2, 2)
+            np.testing.assert_allclose(l.weight.numpy(), 0.5)
+            l2 = nn.Linear(2, 2, weight_attr=nn.initializer.Constant(0.25))
+            np.testing.assert_allclose(l2.weight.numpy(), 0.25)
+        finally:
+            nn.initializer.set_global_initializer(None, None)
+        l3 = nn.Linear(2, 2)
+        assert not np.allclose(l3.weight.numpy(), 0.5)
+
+    def test_bilinear_init_shape(self):
+        w = nn.initializer.Bilinear()._generate((2, 2, 4, 4), "float32")
+        assert w.shape == (2, 2, 4, 4)
+        # symmetric upsampling kernel
+        np.testing.assert_allclose(
+            np.asarray(w)[0, 0], np.asarray(w)[0, 0].T, rtol=1e-6
+        )

@@ -1,0 +1,354 @@
+"""OpTest-style numeric tests for the N-d pooling/conv/fold/loss surface
+completion (reference: nn/functional/{pooling,conv,common,loss,extension}.py
+and their OpTest suites)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+rng = np.random.default_rng(7)
+
+
+def _t(x):
+    return paddle.to_tensor(x)
+
+
+class TestPool3D:
+    x = rng.standard_normal((2, 3, 6, 8, 10)).astype(np.float32)
+
+    @pytest.mark.parametrize("ks,st,pd,ceil", [(2, 2, 0, False), (3, 2, 1, True)])
+    def test_max_pool3d(self, ks, st, pd, ceil):
+        got = F.max_pool3d(_t(self.x), ks, st, pd, ceil_mode=ceil).numpy()
+        want = torch.nn.functional.max_pool3d(
+            torch.tensor(self.x), ks, st, pd, ceil_mode=ceil
+        ).numpy()
+        np.testing.assert_allclose(got, want)
+
+    @pytest.mark.parametrize("excl", [True, False])
+    def test_avg_pool3d_ceil(self, excl):
+        got = F.avg_pool3d(
+            _t(self.x), 3, 2, 1, ceil_mode=True, exclusive=excl
+        ).numpy()
+        want = torch.nn.functional.avg_pool3d(
+            torch.tensor(self.x), 3, 2, 1, ceil_mode=True,
+            count_include_pad=not excl,
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_avg_pool1d(self):
+        x = rng.standard_normal((2, 3, 11)).astype(np.float32)
+        got = F.avg_pool1d(_t(x), 3, 2, 1).numpy()
+        want = torch.nn.functional.avg_pool1d(
+            torch.tensor(x), 3, 2, 1, count_include_pad=False
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_adaptive_pools(self):
+        x2 = self.x[:, :, 0]
+        np.testing.assert_allclose(
+            F.adaptive_max_pool2d(_t(x2), (3, 5)).numpy(),
+            torch.nn.functional.adaptive_max_pool2d(torch.tensor(x2), (3, 5)).numpy(),
+        )
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool3d(_t(self.x), (2, 3, 5)).numpy(),
+            torch.nn.functional.adaptive_avg_pool3d(
+                torch.tensor(self.x), (2, 3, 5)
+            ).numpy(),
+            rtol=1e-4, atol=1e-6,
+        )
+
+    def test_unpool3d_roundtrip(self):
+        x = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        tout, tidx = torch.nn.functional.max_pool3d(
+            torch.tensor(x), 2, 2, return_indices=True
+        )
+        got = F.max_unpool3d(
+            _t(tout.numpy()), _t(tidx.numpy().astype(np.int64)), 2
+        ).numpy()
+        want = torch.nn.functional.max_unpool3d(tout, tidx, 2).numpy()
+        np.testing.assert_allclose(got, want)
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose(self):
+        x = rng.standard_normal((2, 4, 9)).astype(np.float32)
+        w = rng.standard_normal((4, 5, 3)).astype(np.float32)
+        got = F.conv1d_transpose(_t(x), _t(w), stride=2, padding=1,
+                                 output_padding=1).numpy()
+        want = torch.nn.functional.conv_transpose1d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+            output_padding=1,
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_transpose(self):
+        x = rng.standard_normal((1, 4, 5, 6, 7)).astype(np.float32)
+        w = rng.standard_normal((4, 2, 3, 3, 3)).astype(np.float32)
+        b = rng.standard_normal(2).astype(np.float32)
+        got = F.conv3d_transpose(_t(x), _t(w), _t(b), stride=2, padding=1).numpy()
+        want = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2, padding=1
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_layer_grads_flow(self):
+        layer = nn.Conv3DTranspose(3, 4, 2)
+        x = _t(rng.standard_normal((1, 3, 3, 3, 3)).astype(np.float32))
+        layer(x).sum().backward()
+        assert layer.weight.grad is not None
+
+
+class TestFoldMisc:
+    def test_fold_inverts_unfold(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        cols = F.unfold(_t(x), 2, strides=2)
+        back = F.fold(cols, (8, 8), 2, strides=2)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-5)
+
+    def test_fold_overlapping_matches_torch(self):
+        cols = rng.standard_normal((1, 2 * 9, 9)).astype(np.float32)
+        got = F.fold(_t(cols), (6, 6), 3, strides=2, paddings=1).numpy()
+        want = torch.nn.functional.fold(
+            torch.tensor(cols), (6, 6), 3, stride=2, padding=1
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_diag_embed(self):
+        x = rng.standard_normal((2, 3)).astype(np.float32)
+        for off, d1, d2 in [(0, -2, -1), (1, -2, -1), (-1, 0, 2)]:
+            np.testing.assert_allclose(
+                F.diag_embed(_t(x), off, d1, d2).numpy(),
+                torch.diag_embed(torch.tensor(x), off, d1, d2).numpy(),
+            )
+
+    def test_sequence_mask_and_gather_tree(self):
+        got = F.sequence_mask(_t(np.array([2, 0, 4])), maxlen=5).numpy()
+        np.testing.assert_array_equal(
+            got, [[1, 1, 0, 0, 0], [0, 0, 0, 0, 0], [1, 1, 1, 1, 0]]
+        )
+        # reference docs example (gather_tree_op.cc)
+        ids = _t(np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]]))
+        parents = _t(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]]))
+        np.testing.assert_array_equal(
+            F.gather_tree(ids, parents).numpy(),
+            [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]],
+        )
+
+    def test_affine_grid(self):
+        theta = rng.standard_normal((2, 2, 3)).astype(np.float32)
+        for ac in (True, False):
+            np.testing.assert_allclose(
+                F.affine_grid(_t(theta), (2, 1, 4, 5), align_corners=ac).numpy(),
+                torch.nn.functional.affine_grid(
+                    torch.tensor(theta), (2, 1, 4, 5), align_corners=ac
+                ).numpy(),
+                rtol=1e-4, atol=1e-6,
+            )
+
+    def test_bilinear(self):
+        x1 = rng.standard_normal((4, 5)).astype(np.float32)
+        x2 = rng.standard_normal((4, 6)).astype(np.float32)
+        w = rng.standard_normal((3, 5, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            F.bilinear(_t(x1), _t(x2), _t(w)).numpy(),
+            torch.nn.functional.bilinear(
+                torch.tensor(x1), torch.tensor(x2), torch.tensor(w)
+            ).numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_temporal_shift(self):
+        x = rng.standard_normal((4, 8, 3, 3)).astype(np.float32)
+        got = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 8, 3, 3)
+        want = np.zeros_like(v)
+        want[:, :-1, :2] = v[:, 1:, :2]
+        want[:, 1:, 2:4] = v[:, :-1, 2:4]
+        want[:, :, 4:] = v[:, :, 4:]
+        np.testing.assert_allclose(got, want.reshape(4, 8, 3, 3))
+
+    def test_inplace_activations(self):
+        x = _t(np.array([-1.0, 2.0], np.float32))
+        assert F.tanh_(x) is x
+        np.testing.assert_allclose(x.numpy(), np.tanh([-1.0, 2.0]), rtol=1e-6)
+
+    def test_zeropad2d_and_dropout3d(self):
+        x = _t(rng.standard_normal((1, 2, 3, 3)).astype(np.float32))
+        assert F.zeropad2d(x, [1, 2, 0, 1]).shape == [1, 2, 4, 6]
+        x3 = _t(rng.standard_normal((2, 4, 2, 2, 2)).astype(np.float32))
+        out = F.dropout3d(x3, p=0.5, training=True)
+        # whole channels zeroed or scaled
+        o = out.numpy().reshape(2, 4, -1)
+        for b in range(2):
+            for c in range(4):
+                assert (o[b, c] == 0).all() or np.allclose(
+                    o[b, c], x3.numpy().reshape(2, 4, -1)[b, c] * 2
+                )
+
+
+class TestLosses:
+    def test_ctc_loss_matches_torch(self):
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.standard_normal((T, B, C)).astype(np.float32)
+        labels = rng.integers(1, C, (B, L))
+        in_lens = np.array([12, 9, 7])
+        lab_lens = np.array([4, 2, 0])
+        want = torch.nn.functional.ctc_loss(
+            torch.tensor(logits).log_softmax(-1), torch.tensor(labels),
+            torch.tensor(in_lens), torch.tensor(lab_lens), blank=0,
+            reduction="none",
+        ).numpy()
+        got = F.ctc_loss(
+            _t(logits), _t(labels), _t(in_lens), _t(lab_lens),
+            reduction="none",
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_grad(self):
+        T, B, C = 6, 2, 5
+        x = _t(rng.standard_normal((T, B, C)).astype(np.float32))
+        x.stop_gradient = False
+        loss = F.ctc_loss(
+            x, _t(rng.integers(1, C, (B, 2))), _t(np.array([6, 6])),
+            _t(np.array([2, 2])),
+        )
+        loss.backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_square_log_dice_npair(self):
+        a = rng.random((3, 4)).astype(np.float32)
+        b = rng.random((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            F.square_error_cost(_t(a), _t(b)).numpy(), (a - b) ** 2, rtol=1e-6
+        )
+        got = F.log_loss(_t(a[:, 0:1]), _t((b[:, 0:1] > 0.5).astype(np.float32))).numpy()
+        assert np.isfinite(got).all()
+        probs = F.softmax(_t(a), axis=-1)
+        label = _t(rng.integers(0, 4, (3, 1)))
+        d = F.dice_loss(probs, label)
+        assert 0.0 <= float(d) <= 1.0
+        anchor = rng.standard_normal((4, 8)).astype(np.float32)
+        pos = rng.standard_normal((4, 8)).astype(np.float32)
+        lab = np.array([0, 1, 0, 2])
+        assert np.isfinite(float(F.npair_loss(_t(anchor), _t(pos), _t(lab))))
+
+    def test_margin_cross_entropy_reduces_to_ce(self):
+        logits = np.tanh(rng.standard_normal((4, 10))).astype(np.float32)
+        label = rng.integers(0, 10, 4)
+        loss = F.margin_cross_entropy(
+            _t(logits), _t(label), margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=4.0, reduction="none",
+        ).numpy()
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits * 4.0), torch.tensor(label), reduction="none"
+        ).numpy()
+        np.testing.assert_allclose(loss.ravel(), want, rtol=1e-5)
+
+    def test_hsigmoid_matches_simplecode_reference(self):
+        x = rng.standard_normal((5, 8)).astype(np.float32)
+        labels = rng.integers(0, 7, 5)
+        w = (rng.standard_normal((6, 8)) * 0.3).astype(np.float32)
+        b = (rng.standard_normal(6) * 0.3).astype(np.float32)
+        got = F.hsigmoid_loss(_t(x), _t(labels), 7, _t(w), _t(b)).numpy()
+        # python port of funcs/matrix_bit_code.h SimpleCode
+        want = []
+        for vec, l in zip(x, labels):
+            c = int(l) + 7
+            s = 0.0
+            for j in range((c >> 1).bit_length()):
+                pre = float(vec @ w[(c >> (j + 1)) - 1] + b[(c >> (j + 1)) - 1])
+                s += np.log1p(np.exp(pre)) - ((c >> j) & 1) * pre
+            want.append([s])
+        np.testing.assert_allclose(got, np.array(want, np.float32), rtol=1e-4, atol=1e-5)
+
+    def test_class_center_sample(self):
+        label = _t(np.array([1, 5, 1, 9]))
+        remapped, sampled = F.class_center_sample(label, 20, 8)
+        s = sampled.numpy()
+        assert len(s) == 8 and {1, 5, 9} <= set(s.tolist())
+        r = remapped.numpy()
+        assert (s[r] == label.numpy()).all()
+
+    def test_sparse_attention_full_pattern_is_dense(self):
+        B, H, S, D = 1, 2, 4, 8
+        q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        off = np.tile(np.arange(0, S * S + 1, S, dtype=np.int32), (B, H, 1))
+        cols = np.tile(np.tile(np.arange(S, dtype=np.int32), S), (B, H, 1))
+        got = F.sparse_attention(_t(q), _t(k), _t(v), _t(off), _t(cols)).numpy()
+        want = torch.nn.functional.scaled_dot_product_attention(
+            torch.tensor(q), torch.tensor(k), torch.tensor(v)
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestDecode:
+    def test_beam_search_decodes_and_ranks(self):
+        paddle.seed(0)
+        cell = nn.GRUCell(4, 8)
+        proj = nn.Linear(8, 10)
+        emb = nn.Embedding(10, 4)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        ids, scores, lens = nn.dynamic_decode(
+            dec, inits=paddle.zeros([2, 8]), max_step_num=6,
+            return_length=True,
+        )
+        assert ids.shape[:2] == [2, 3]
+        sc = scores.numpy()
+        assert (np.diff(sc, axis=1) <= 1e-6).all(), "beams not ranked"
+        assert (lens.numpy() <= 6).all()
+
+
+class TestReviewFixes:
+    def test_unpool1d_tuple_kernel(self):
+        x = rng.standard_normal((1, 2, 8)).astype(np.float32)
+        to, ti = torch.nn.functional.max_pool1d(
+            torch.tensor(x), 2, 2, return_indices=True
+        )
+        g = F.max_unpool1d(
+            _t(to.numpy()), _t(ti.numpy().astype(np.int64)), [2]
+        ).numpy()
+        np.testing.assert_allclose(
+            g, torch.nn.functional.max_unpool1d(to, ti, 2).numpy()
+        )
+
+    def test_unpool_rejects_channels_last(self):
+        x = _t(np.ones((1, 1, 2, 2, 2), np.float32))
+        i = _t(np.zeros((1, 1, 2, 2, 2), np.int64))
+        with pytest.raises(ValueError, match="NCDHW"):
+            F.max_unpool3d(x, i, 2, data_format="NDHWC")
+
+    def test_conv_transpose_output_size(self):
+        x = rng.standard_normal((1, 4, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        for osz, op in ((9, 0), (10, 1)):
+            g = F.conv2d_transpose(
+                _t(x), _t(w), stride=2, padding=1, output_size=[osz, osz]
+            )
+            want = torch.nn.functional.conv_transpose2d(
+                torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+                output_padding=op,
+            ).numpy()
+            np.testing.assert_allclose(g.numpy(), want, rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError, match="unreachable"):
+            F.conv2d_transpose(_t(x), _t(w), stride=2, output_size=[20, 20])
+
+    def test_conv1d_transpose_string_padding_raises(self):
+        x = _t(rng.standard_normal((1, 4, 5)).astype(np.float32))
+        w = _t(rng.standard_normal((4, 2, 3)).astype(np.float32))
+        with pytest.raises(NotImplementedError):
+            F.conv1d_transpose(x, w, padding="SAME")
+
+    def test_lu_unpack_flags(self):
+        A = rng.standard_normal((4, 4))
+        lu, piv = paddle.linalg.lu(_t(A))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv, unpack_ludata=False)
+        assert L is None and U is None and P is not None
+        P2, L2, U2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
+        assert P2 is None and L2 is not None and U2 is not None
