@@ -375,6 +375,35 @@ class StickBreakingTransform(Transform):
 
         return apply(_isb, y, op_name="stick_breaking_inv")
 
+    def _forward_log_det_jacobian(self, x):
+        """reference: transform.py StickBreakingTransform
+        forward_log_det_jacobian: sum of log sigmoid'(x - log offset)
+        corrected by the remaining stick mass (torch-identical identity)."""
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+
+        def _ldj(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1])
+            z = v - jnp.log(offset)
+            y = _sb_fwd(v)
+            return jnp.sum(
+                -z + jax.nn.log_sigmoid(z) + jnp.log(y[..., :-1]), axis=-1
+            )
+
+        def _sb_fwd(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1])
+            z = 1.0 / (1.0 + jnp.exp(-(v - jnp.log(offset))))
+            zc = jnp.cumprod(1.0 - z, axis=-1)
+            ones = jnp.ones(v.shape[:-1] + (1,), v.dtype)
+            return jnp.concatenate([z, ones], -1) * jnp.concatenate(
+                [ones, zc], -1
+            )
+
+        import jax
+
+        return apply(_ldj, x, op_name="stick_breaking_ldj")
+
     def forward_shape(self, shape):
         return tuple(shape[:-1]) + (shape[-1] + 1,)
 

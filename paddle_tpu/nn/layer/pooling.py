@@ -191,11 +191,13 @@ class MaxUnPool1D(Layer):
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
+        self.data_format = data_format
         self.output_size = output_size
 
     def forward(self, x, indices):
         return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
-                              self.padding, self.output_size)
+                              self.padding, self.output_size,
+                              self.data_format)
 
 
 class MaxUnPool3D(Layer):
@@ -205,8 +207,10 @@ class MaxUnPool3D(Layer):
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
+        self.data_format = data_format
         self.output_size = output_size
 
     def forward(self, x, indices):
         return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
-                              self.padding, self.output_size)
+                              self.padding, self.output_size,
+                              self.data_format)

@@ -181,5 +181,5 @@ class QuantizedConv2DTranspose(Layer):
         return F.conv2d_transpose(
             x, w, self._conv.bias, self._conv._stride, self._conv._padding,
             self._conv._output_padding, self._conv._groups,
-            self._conv._dilation, self._conv._data_format,
+            self._conv._dilation, self._conv._data_format, output_size,
         )

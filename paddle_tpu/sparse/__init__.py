@@ -59,8 +59,11 @@ class SparseCooTensor:
 
     def to_dense(self) -> Tensor:
         def f(idx, vals, shape):
+            # hybrid COO: idx covers the leading sparse dims; trailing dims
+            # (e.g. the channel axis of a voxel grid) live in the values
             out = jnp.zeros(shape, vals.dtype)
-            return out.at[tuple(idx[i] for i in range(len(shape)))].add(vals)
+            nsparse = idx.shape[0]
+            return out.at[tuple(idx[i] for i in range(nsparse))].add(vals)
 
         return apply(f, self.indices, self.values, shape=tuple(self.shape),
                      op_name="coo_to_dense")
@@ -238,3 +241,135 @@ class _Functional:
 
 
 functional = _Functional()
+
+
+def _dense3d(x):
+    """SparseCooTensor [N, D, H, W, C] -> dense jnp array."""
+    return x.to_dense()._value if isinstance(x, SparseCooTensor) else (
+        x._value if hasattr(x, "_value") else x
+    )
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC"):
+    """Sparse 3-D convolution (reference: sparse/functional/conv.py:68
+    conv3d). TPU-native lowering: sparse voxels are densified and the conv
+    runs on the MXU — XLA's strength is dense contraction; scatter/gather
+    sparse kernels (the reference's GPU rulebook) do not map to the
+    systolic array. Weight layout follows the reference: [kD, kH, kW,
+    C_in/g, C_out]."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..nn import functional as F
+
+    dense = _dense3d(x)
+    from ..core.tensor import Tensor as _T
+
+    xt = dense if isinstance(dense, _T) else _T(dense, stop_gradient=True)
+    w = weight if hasattr(weight, "_value") else _T(weight)
+    # reference weight [kd, kh, kw, cin/g, cout] -> lax OIDHW
+    wt = w.transpose([4, 3, 0, 1, 2])
+    # bias joins AFTER sparsification: the reference adds it only at active
+    # output sites; a dense bias-add would turn every empty voxel nonzero
+    out = F.conv3d(
+        xt, wt, None, stride=stride, padding=padding, dilation=dilation,
+        groups=groups, data_format="NDHWC",
+    )
+    sp = _to_sparse_coo(out)
+    if bias is not None:
+        sp = SparseCooTensor(sp.indices, sp.values + bias, sp.shape)
+    return sp
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC"):
+    """Submanifold sparse conv (reference: sparse/functional/conv.py:182):
+    output sites restricted to the input's active sites."""
+    import numpy as _np
+
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("subm_conv3d input must be a SparseCooTensor")
+    out = conv3d(x, weight, bias, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups, data_format=data_format)
+    # the submanifold constraint (output sites == input sites) only makes
+    # sense when the conv preserves the voxel grid — the reference requires
+    # stride 1 + shape-preserving padding for subm convs
+    if out.shape[:-1] != x.shape[:-1]:
+        raise ValueError(
+            f"subm_conv3d needs a shape-preserving conv (stride 1, padding "
+            f"kernel//2): input sites grid {x.shape[:-1]} vs conv output "
+            f"grid {out.shape[:-1]}"
+        )
+    dense = out.to_dense().numpy()
+    mask = _np.zeros(dense.shape[:-1], bool)
+    idx = _np.asarray(x.indices.numpy())
+    mask[tuple(idx)] = True
+    dense = dense * mask[..., None]
+    from ..core.tensor import to_tensor as _tt
+
+    return _to_sparse_coo(_tt(dense))
+
+
+def _to_sparse_coo(dense_t):
+    import numpy as _np
+
+    arr = dense_t.numpy()
+    site = _np.abs(arr).sum(-1) > 0 if arr.ndim >= 2 else _np.abs(arr) > 0
+    idx = _np.stack(_np.nonzero(site))
+    vals = arr[tuple(idx)]
+    from ..core.tensor import to_tensor as _tt
+
+    return sparse_coo_tensor(_tt(idx), _tt(vals), shape=list(arr.shape))
+
+
+class _Conv3DBase(paddle.nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._subm = subm
+        # reference sparse conv weight layout [kd, kh, kw, cin/g, cout]
+        self.weight = self.create_parameter(
+            shape=[*kernel_size, in_channels // groups, out_channels],
+            attr=weight_attr,
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter(shape=[out_channels], attr=bias_attr,
+                                       is_bias=True)
+        )
+
+    def forward(self, x):
+        fn = subm_conv3d if self._subm else conv3d
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._dilation, self._groups)
+
+
+class Conv3D(_Conv3DBase):
+    """reference: sparse/layer/conv.py Conv3D."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("subm", None)
+        super().__init__(*args, subm=False, **kwargs)
+
+
+class SubmConv3D(_Conv3DBase):
+    """reference: sparse/layer/conv.py:250 SubmConv3D."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("subm", None)
+        super().__init__(*args, subm=True, **kwargs)
+
+
+_Functional.conv3d = staticmethod(conv3d)
+_Functional.subm_conv3d = staticmethod(subm_conv3d)
+
+from . import creation  # noqa: E402,F401

@@ -1,0 +1,7 @@
+"""paddle.sparse.creation — sparse tensor constructors submodule.
+
+Reference analogue: python/paddle/sparse/creation.py.
+"""
+from . import sparse_coo_tensor, sparse_csr_tensor  # noqa: F401
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor"]

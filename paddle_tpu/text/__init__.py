@@ -246,3 +246,7 @@ class WMT16(_WMT):
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en", download=True):
         super().__init__(mode, src_dict_size, 29)
+
+
+# reference name alias (python/paddle/text/datasets/conll05.py Conll05st)
+Conll05st = Conll05

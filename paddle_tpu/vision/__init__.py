@@ -12,3 +12,11 @@ def get_image_backend():
 
 def set_image_backend(backend):
     pass
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference: vision/image.py image_load — PIL
+    backend)."""
+    from PIL import Image
+
+    return Image.open(path)

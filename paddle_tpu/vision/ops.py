@@ -143,17 +143,78 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     )
 
 
+def _roi_pool_impl(x, boxes, box_batch_idx, *, output_size, spatial_scale):
+    """Quantized-bin max RoI pooling (reference: phi roi_pool kernel) —
+    per-ROI dynamic bins expressed as masked maxima over the feature map."""
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    x1 = jnp.round(boxes[:, 0] * spatial_scale)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+    py = jnp.arange(ph, dtype=x.dtype)
+    px = jnp.arange(pw, dtype=x.dtype)
+    hs = jnp.clip(jnp.floor(py[None, :] * bin_h[:, None] + y1[:, None]), 0, h)
+    he = jnp.clip(jnp.ceil((py[None, :] + 1) * bin_h[:, None] + y1[:, None]), 0, h)
+    ws = jnp.clip(jnp.floor(px[None, :] * bin_w[:, None] + x1[:, None]), 0, w)
+    we = jnp.clip(jnp.ceil((px[None, :] + 1) * bin_w[:, None] + x1[:, None]), 0, w)
+    ih = jnp.arange(h, dtype=x.dtype)
+    iw = jnp.arange(w, dtype=x.dtype)
+    mh = (ih[None, None, :] >= hs[:, :, None]) & (ih[None, None, :] < he[:, :, None])
+    mw = (iw[None, None, :] >= ws[:, :, None]) & (iw[None, None, :] < we[:, :, None])
+    imgs = x[box_batch_idx]                              # [r, C, H, W]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    # two-stage masked max keeps intermediates at [r, C, pw, H] instead of
+    # a [r, C, ph, pw, H, W] blow-up
+    over_w = jnp.where(
+        mw[:, None, :, None, :], imgs[:, :, None, :, :], neg
+    ).max(axis=-1)                                       # [r, C, pw, H]
+    out = jnp.where(
+        mh[:, None, None, :, :], over_w[:, :, :, None, :], neg
+    ).max(axis=-1)                                       # [r, C, pw, ph]
+    out = jnp.swapaxes(out, 2, 3)                        # [r, C, ph, pw]
+    return jnp.where(jnp.isfinite(out), out, 0.0)        # empty bin -> 0
+
+
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
-    raise NotImplementedError(
-        "roi_pool's quantized integer bins are per-ROI dynamic shapes; use "
-        "roi_align (the accuracy-preferred op the reference docs recommend)"
+    """reference: python/paddle/vision/ops.py roi_pool (phi roi_pool)."""
+    import numpy as _np  # noqa: shadows the module helper intentionally
+
+    from ..core.dispatch import apply
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    counts = _np.asarray(boxes_num.numpy() if hasattr(boxes_num, "numpy") else boxes_num)
+    batch_idx = _np.repeat(_np.arange(len(counts)), counts)
+    from ..core.tensor import to_tensor as _tt
+
+    return apply(
+        _roi_pool_impl, x, boxes, _tt(batch_idx),
+        output_size=tuple(output_size), spatial_scale=float(spatial_scale),
+        op_name="roi_pool",
     )
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError(
-        "deform_conv2d needs a gather-heavy custom kernel; register one via "
-        "paddle.utils.cpp_extension / register_op if required"
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2 (reference:
+    python/paddle/vision/ops.py deform_conv2d)."""
+    from ..core.dispatch import apply
+
+    def pair(v):
+        return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * 2
+
+    return apply(
+        _deform_conv2d_impl, x, offset, weight, mask, bias,
+        stride=pair(stride), padding=pair(padding), dilation=pair(dilation),
+        deformable_groups=deformable_groups, groups=groups,
+        op_name="deform_conv2d",
     )
 
 
@@ -202,3 +263,367 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
         scale_x_y=float(scale_x_y), op_name="yolo_box",
     )
     return out[0], out[1]
+
+
+def _deform_conv2d_impl(x, offset, weight, mask, bias, *, stride, padding,
+                        dilation, deformable_groups, groups):
+    """Deformable conv v1/v2 (reference: phi deformable_conv kernel,
+    operators/deformable_conv_op.cc): per-tap fractional sampling offsets
+    (+ optional v2 modulation mask), gathered bilinearly then contracted on
+    the MXU like a dense conv."""
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = deformable_groups
+
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    oy = off[:, :, :, 0]
+    ox = off[:, :, :, 1]
+    if mask is not None:
+        m = mask.reshape(n, dg, kh * kw, oh, ow)
+    base_y = (jnp.arange(oh) * sh - ph)[:, None]
+    base_x = (jnp.arange(ow) * sw - pw)[None, :]
+    taps_y = jnp.arange(kh) * dh
+    taps_x = jnp.arange(kw) * dw
+    tap_y = (taps_y[:, None].repeat(kw, 1)).reshape(-1)   # [kh*kw]
+    tap_x = (taps_x[None, :].repeat(kh, 0)).reshape(-1)
+    # sample coords [n, dg, k, oh, ow]
+    sy = base_y[None, None, None] + tap_y[None, None, :, None, None] + oy
+    sx = base_x[None, None, None] + tap_x[None, None, :, None, None] + ox
+
+    def bilinear(img, yy, xx):
+        # img [cpg, H, W]; yy/xx [k, oh, ow]; out-of-bounds taps contribute 0
+        valid = (yy > -1.0) & (yy < h) & (xx > -1.0) & (xx < w)
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        v = (
+            img[:, y0, x0] * (1 - wy) * (1 - wx)
+            + img[:, y0, x1] * (1 - wy) * wx
+            + img[:, y1, x0] * wy * (1 - wx)
+            + img[:, y1, x1] * wy * wx
+        )
+        return v * valid[None]
+
+    cpg = cin // dg
+    xg = x.reshape(n, dg, cpg, h, w)
+    sampled = jax.vmap(jax.vmap(bilinear))(xg, sy, sx)  # [n, dg, cpg, k, oh, ow]
+    if mask is not None:
+        sampled = sampled * m[:, :, None]
+    sampled = sampled.reshape(n, cin, kh * kw, oh, ow)
+    wflat = weight.reshape(cout, cin_g, kh * kw)
+    if groups == 1:
+        out = jnp.einsum("nckhw,ock->nohw", sampled, wflat)
+    else:
+        cog = cout // groups
+        sg = sampled.reshape(n, groups, cin // groups, kh * kw, oh, ow)
+        wg = wflat.reshape(groups, cog, cin_g, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", sg, wg).reshape(n, cout, oh, ow)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _psroi_pool_impl(x, boxes, box_batch_idx, *, output_size, spatial_scale,
+                     output_channels):
+    """Position-sensitive RoI average pooling (reference: phi psroi_pool
+    kernel): input channel c*ph*pw + i*pw + j feeds output channel c at
+    bin (i, j)."""
+    ph, pw = output_size
+    n, cin, h, w = x.shape
+    r = boxes.shape[0]
+    # reference kernel: round box coords FIRST, then apply spatial_scale
+    # (phi psroi_pool: roi_start = round(coord) * scale,
+    #  roi_end = (round(coord) + 1) * scale)
+    x1 = jnp.round(boxes[:, 0]) * spatial_scale
+    y1 = jnp.round(boxes[:, 1]) * spatial_scale
+    x2 = (jnp.round(boxes[:, 2]) + 1.0) * spatial_scale
+    y2 = (jnp.round(boxes[:, 3]) + 1.0) * spatial_scale
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+    py = jnp.arange(ph, dtype=x.dtype)
+    px = jnp.arange(pw, dtype=x.dtype)
+    hs = jnp.clip(jnp.floor(py[None, :] * bin_h[:, None] + y1[:, None]), 0, h)
+    he = jnp.clip(jnp.ceil((py[None, :] + 1) * bin_h[:, None] + y1[:, None]), 0, h)
+    ws = jnp.clip(jnp.floor(px[None, :] * bin_w[:, None] + x1[:, None]), 0, w)
+    we = jnp.clip(jnp.ceil((px[None, :] + 1) * bin_w[:, None] + x1[:, None]), 0, w)
+    ih = jnp.arange(h, dtype=x.dtype)
+    iw = jnp.arange(w, dtype=x.dtype)
+    mh = (ih[None, None, :] >= hs[:, :, None]) & (ih[None, None, :] < he[:, :, None])
+    mw = (iw[None, None, :] >= ws[:, :, None]) & (iw[None, None, :] < we[:, :, None])
+    mask = mh[:, :, None, :, None] & mw[:, None, :, None, :]   # [r,ph,pw,H,W]
+    area = jnp.maximum(mask.sum(axis=(3, 4)), 1)               # [r,ph,pw]
+    imgs = x[box_batch_idx].reshape(r, output_channels, ph, pw, h, w)
+    summed = jnp.einsum("rcijhw,rijhw->rcij", imgs, mask.astype(x.dtype))
+    return summed / area[:, None].astype(x.dtype)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference: python/paddle/vision/ops.py psroi_pool."""
+    import numpy as np_
+
+    from ..core.dispatch import apply
+    from ..core.tensor import to_tensor as _tt
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    cin = x.shape[1]
+    if cin % (ph * pw) != 0:
+        raise ValueError(
+            f"input channels {cin} must be divisible by output_size "
+            f"{ph}*{pw} (position-sensitive channel mapping)"
+        )
+    counts = np_.asarray(
+        boxes_num.numpy() if hasattr(boxes_num, "numpy") else boxes_num
+    )
+    batch_idx = np_.repeat(np_.arange(len(counts)), counts)
+    return apply(
+        _psroi_pool_impl, x, boxes, _tt(batch_idx),
+        output_size=tuple(output_size), spatial_scale=float(spatial_scale),
+        output_channels=cin // (ph * pw), op_name="psroi_pool",
+    )
+
+
+def _yolo_loss_impl(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                    class_num, ignore_thresh, downsample_ratio,
+                    use_label_smooth, scale_x_y):
+    """YOLOv3 training loss (reference: phi/kernels/cpu/yolov3_loss_kernel.cc):
+    per-sample sum of location (BCE xy + L1 wh, scaled by (2 - w*h)*score),
+    class BCE, and objectness BCE with ignore-region masking."""
+    n, _, h, w = x.shape
+    mask_num = len(anchor_mask)
+    b = gt_box.shape[1]
+    an_num = len(anchors) // 2
+    input_size = downsample_ratio * h
+    scale = scale_x_y
+    bias = -0.5 * (scale - 1.0)
+    xr = x.reshape(n, mask_num, 5 + class_num, h, w)
+    anc = jnp.asarray(anchors, x.dtype).reshape(an_num, 2)
+    mask_anc = anc[jnp.asarray(anchor_mask)]              # [M, 2]
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+            jnp.exp(-jnp.abs(logit))
+        )
+
+    # predicted boxes (normalized) per (n, m, h, w)
+    gx = (jnp.arange(w, dtype=x.dtype)[None, :]
+          + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) / w
+    gy = (jnp.arange(h, dtype=x.dtype)[:, None]
+          + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) / h
+    gw = jnp.exp(xr[:, :, 2]) * mask_anc[None, :, 0, None, None] / input_size
+    gh = jnp.exp(xr[:, :, 3]) * mask_anc[None, :, 1, None, None] / input_size
+
+    gt_valid = (gt_box[:, :, 2] > 1e-6) & (gt_box[:, :, 3] > 1e-6)  # [n, b]
+
+    def iou_centered(px_, py_, pw_, ph_, qx, qy, qw, qh):
+        lw = jnp.minimum(px_ + pw_ / 2, qx + qw / 2) - jnp.maximum(
+            px_ - pw_ / 2, qx - qw / 2
+        )
+        lh = jnp.minimum(py_ + ph_ / 2, qy + qh / 2) - jnp.maximum(
+            py_ - ph_ / 2, qy - qh / 2
+        )
+        inter = jnp.where((lw > 0) & (lh > 0), lw * lh, 0.0)
+        return inter / (pw_ * ph_ + qw * qh - inter + 1e-12)
+
+    # ignore mask: best pred-gt IoU over valid gts > ignore_thresh
+    iou_all = iou_centered(
+        gx[..., None], gy[..., None], gw[..., None], gh[..., None],
+        gt_box[:, None, None, None, :, 0], gt_box[:, None, None, None, :, 1],
+        gt_box[:, None, None, None, :, 2], gt_box[:, None, None, None, :, 3],
+    )                                                    # [n, m, h, w, b]
+    iou_all = jnp.where(gt_valid[:, None, None, None, :], iou_all, 0.0)
+    best_iou = jax.lax.stop_gradient(iou_all.max(-1))
+    ignore = best_iou > ignore_thresh                    # [n, m, h, w]
+
+    # gt -> best anchor matching (shifted boxes: wh IoU only)
+    gt_w = gt_box[:, :, 2]
+    gt_h = gt_box[:, :, 3]
+    an_w = anc[None, None, :, 0] / input_size
+    an_h = anc[None, None, :, 1] / input_size
+    inter = jnp.minimum(gt_w[..., None], an_w) * jnp.minimum(gt_h[..., None], an_h)
+    union = gt_w[..., None] * gt_h[..., None] + an_w * an_h - inter
+    best_n = jnp.argmax(inter / (union + 1e-12), axis=-1)   # [n, b]
+    # map to mask slot (-1 if not in anchor_mask)
+    mask_arr = jnp.asarray(anchor_mask)
+    slot = jnp.argmax(best_n[..., None] == mask_arr[None, None, :], -1)
+    in_mask = (best_n[..., None] == mask_arr[None, None, :]).any(-1)
+    matched = gt_valid & in_mask
+    gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+
+    smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+    pos, neg = 1.0 - smooth, smooth
+
+    nn_idx = jnp.arange(n)[:, None].repeat(b, 1)            # [n, b]
+    pred_at = xr[nn_idx, slot, :, gj, gi]                   # [n, b, 5+C]
+    mask_an = mask_anc[slot]                                # [n, b, 2]
+    tx = gt_box[:, :, 0] * w - gi
+    ty = gt_box[:, :, 1] * h - gj
+    tw = jnp.log(jnp.maximum(gt_box[:, :, 2] * input_size / mask_an[..., 0], 1e-9))
+    th = jnp.log(jnp.maximum(gt_box[:, :, 3] * input_size / mask_an[..., 1], 1e-9))
+    loc_scale = (2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]) * gt_score
+    loc = (
+        bce(pred_at[..., 0], tx) + bce(pred_at[..., 1], ty)
+        + jnp.abs(pred_at[..., 2] - tw) + jnp.abs(pred_at[..., 3] - th)
+    ) * loc_scale
+    cls_target = jnp.where(
+        jax.nn.one_hot(gt_label, class_num) > 0, pos, neg
+    )
+    cls = bce(pred_at[..., 5:], cls_target).sum(-1) * gt_score
+    per_gt = jnp.where(matched, loc + cls, 0.0)
+
+    # objectness: positive cells (scatter score), ignored cells skip the
+    # loss. Unmatched/padding gt rows are routed to an out-of-bounds slot so
+    # the drop-mode scatter discards them — a 0.0 .set() would overwrite a
+    # real positive landing on the same cell.
+    obj_target = jnp.zeros((n, mask_num, h, w), x.dtype)
+    slot_or_oob = jnp.where(matched, slot, mask_num)
+    obj_target = obj_target.at[nn_idx, slot_or_oob, gj, gi].set(
+        gt_score, mode="drop"
+    )
+    positive = obj_target > 1e-5
+    obj_logit = xr[:, :, 4]
+    obj_loss = jnp.where(
+        positive, bce(obj_logit, 1.0) * obj_target,
+        jnp.where(ignore, 0.0, bce(obj_logit, 0.0)),
+    )
+    return per_gt.sum(-1) + obj_loss.sum((1, 2, 3))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: python/paddle/vision/ops.py yolo_loss (yolov3_loss op)."""
+    import numpy as np_
+
+    from ..core.dispatch import apply
+    from ..core.tensor import to_tensor as _tt
+
+    if gt_score is None:
+        gt_score = _tt(np_.ones(tuple(gt_label.shape), np_.float32))
+    return apply(
+        _yolo_loss_impl, x, gt_box, gt_label, gt_score,
+        anchors=tuple(int(a) for a in anchors),
+        anchor_mask=tuple(int(m) for m in anchor_mask),
+        class_num=int(class_num), ignore_thresh=float(ignore_thresh),
+        downsample_ratio=int(downsample_ratio),
+        use_label_smooth=bool(use_label_smooth), scale_x_y=float(scale_x_y),
+        op_name="yolo_loss",
+    )
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file)."""
+    import numpy as np_
+
+    from ..core.tensor import to_tensor as _tt
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return _tt(np_.frombuffer(data, np_.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference: vision/ops.py
+    decode_jpeg — nvjpeg there; PIL here)."""
+    import io
+
+    import numpy as np_
+    from PIL import Image
+
+    from ..core.tensor import to_tensor as _tt
+
+    data = bytes(np_.asarray(x.numpy(), np_.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np_.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = np_.transpose(arr, (2, 0, 1))
+    return _tt(np_.ascontiguousarray(arr))
+
+
+# layer wrappers (reference: python/paddle/vision/ops.py classes)
+from ..nn.layer_base import Layer as _Layer  # noqa: E402
+
+
+class RoIAlign(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D(_Layer):
+    """reference: python/paddle/vision/ops.py DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, *kernel_size],
+            attr=weight_attr,
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter(shape=[out_channels], attr=bias_attr,
+                                       is_bias=True)
+        )
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self._stride, self._padding,
+            self._dilation, self._deformable_groups, self._groups, mask,
+        )

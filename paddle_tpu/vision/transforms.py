@@ -209,3 +209,156 @@ class ColorJitter(BaseTransform):
 
     def _apply_image(self, img):
         return self.b(img)
+
+
+# functional API + remaining reference transform classes
+from . import transforms_functional as F  # noqa: E402
+from .transforms_functional import (  # noqa: E402,F401
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    center_crop,
+    crop,
+    hflip,
+    normalize,
+    pad,
+    resize,
+    rotate,
+    to_grayscale,
+    to_tensor,
+    vflip,
+)
+
+
+def _uniform(lo, hi):
+    import jax as _jax
+
+    from ..core import random as _random
+
+    return float(_jax.random.uniform(_random.next_key(), (), minval=lo,
+                                     maxval=hi))
+
+
+class ContrastTransform(BaseTransform):
+    """reference: transforms.py ContrastTransform — random contrast in
+    [max(0, 1-value), 1+value]."""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(
+            img, _uniform(max(0.0, 1 - self.value), 1 + self.value)
+        )
+
+
+class SaturationTransform(BaseTransform):
+    """reference: transforms.py SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(
+            img, _uniform(max(0.0, 1 - self.value), 1 + self.value)
+        )
+
+
+class HueTransform(BaseTransform):
+    """reference: transforms.py HueTransform — random hue in
+    [-value, value], value <= 0.5."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, _uniform(-self.value, self.value))
+
+
+class Grayscale(BaseTransform):
+    """reference: transforms.py Grayscale."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    """reference: transforms.py Pad."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    """reference: transforms.py RandomRotation — rotate by a random angle
+    drawn from degrees=(min, max) (or [-d, d] for scalar d)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            if degrees < 0:
+                raise ValueError("scalar degrees must be non-negative")
+            self.degrees = (-degrees, degrees)
+        else:
+            self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = _uniform(self.degrees[0], self.degrees[1])
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing — zero a random rectangle."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        import numpy as _np
+
+        if _uniform(0.0, 1.0) >= self.prob:
+            return img
+        arr = _np.array(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = h * w * _uniform(self.scale[0], self.scale[1])
+        aspect = _uniform(self.ratio[0], self.ratio[1])
+        eh = min(h, max(1, int(round((area * aspect) ** 0.5))))
+        ew = min(w, max(1, int(round((area / aspect) ** 0.5))))
+        top = int(_uniform(0, max(1e-6, h - eh)))
+        left = int(_uniform(0, max(1e-6, w - ew)))
+        if chw:
+            arr[:, top : top + eh, left : left + ew] = self.value
+        else:
+            arr[top : top + eh, left : left + ew] = self.value
+        return arr

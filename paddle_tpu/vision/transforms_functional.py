@@ -1,0 +1,324 @@
+"""paddle.vision.transforms functional ops.
+
+Reference analogue: python/paddle/vision/transforms/functional.py (+
+functional_pil.py / functional_cv2.py backends). One numpy backend here:
+images are HWC uint8/float numpy arrays, PIL Images, or CHW Tensors;
+outputs keep the input container type where meaningful.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
+    "hflip", "vflip", "rotate", "adjust_brightness", "adjust_contrast",
+    "adjust_hue", "adjust_saturation", "to_grayscale",
+]
+
+
+def _as_hwc(img):
+    """-> (HWC float np array, restore_fn)."""
+    try:
+        from PIL import Image
+
+        if isinstance(img, Image.Image):
+            arr = np.asarray(img)
+            return arr, "pil"
+    except ImportError:
+        pass
+    from ..core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        arr = img.numpy()
+        if arr.ndim == 3 and arr.shape[0] in (1, 3, 4):
+            arr = np.transpose(arr, (1, 2, 0))
+        return arr, "tensor"
+    return np.asarray(img), "np"
+
+
+def _restore(arr, kind, like=None):
+    if kind == "pil":
+        from PIL import Image
+
+        return Image.fromarray(np.asarray(arr).astype(np.uint8))
+    if kind == "tensor":
+        from ..core.tensor import to_tensor as _tt
+
+        if arr.ndim == 3:
+            arr = np.transpose(arr, (2, 0, 1))
+        return _tt(np.ascontiguousarray(arr))
+    return arr
+
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC [0,255] -> CHW float32 [0,1] Tensor (reference: functional.py
+    to_tensor)."""
+    from ..core.tensor import to_tensor as _tt
+
+    arr, _ = _as_hwc(pic)
+    arr = np.asarray(arr)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    arr = arr.astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return _tt(np.ascontiguousarray(arr))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ..core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        arr = img.numpy().astype(np.float32)
+    else:
+        arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        out = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        out = (arr - mean) / std
+    if isinstance(img, Tensor):
+        from ..core.tensor import to_tensor as _tt
+
+        return _tt(out)
+    return out
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Resize HWC image (reference: functional.py resize; int size scales
+    the shorter edge)."""
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), size
+    else:
+        oh, ow = int(size[0]), int(size[1])
+    import jax.image
+
+    order = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+             "lanczos": "lanczos3"}.get(interpolation, "linear")
+    out = np.asarray(
+        jax.image.resize(arr.astype(np.float32), (oh, ow, arr.shape[2]), order)
+    )
+    if arr.dtype == np.uint8:
+        out = np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    if squeeze:
+        out = out[:, :, 0]
+    return _restore(out, kind)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Pad HWC image (reference: functional.py pad; padding int or
+    (l, t, r, b))."""
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l = r = int(padding[0])
+        t = b = int(padding[1])
+    else:
+        l, t, r, b = (int(p) for p in padding)
+    spec = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    return _restore(np.pad(arr, spec, mode, **kwargs), kind)
+
+
+def crop(img, top, left, height, width):
+    arr, kind = _as_hwc(img)
+    return _restore(
+        np.asarray(arr)[top : top + height, left : left + width], kind
+    )
+
+
+def center_crop(img, output_size):
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return _restore(arr[top : top + th, left : left + tw], kind)
+
+
+def hflip(img):
+    arr, kind = _as_hwc(img)
+    return _restore(np.asarray(arr)[:, ::-1], kind)
+
+
+def vflip(img):
+    arr, kind = _as_hwc(img)
+    return _restore(np.asarray(arr)[::-1], kind)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by `angle` degrees (reference:
+    functional.py rotate)."""
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else (
+        center[1], center[0]
+    )
+    rad = math.radians(angle)
+    cos, sin = math.cos(rad), math.sin(rad)
+    if expand:
+        corners = np.array(
+            [[-cx, -cy], [w - 1 - cx, -cy], [-cx, h - 1 - cy],
+             [w - 1 - cx, h - 1 - cy]]
+        )
+        rot = np.abs(corners @ np.array([[cos, sin], [-sin, cos]]))
+        ow = int(math.ceil(2 * rot[:, 0].max())) + 1
+        oh = int(math.ceil(2 * rot[:, 1].max())) + 1
+        ocx, ocy = (ow - 1) / 2.0, (oh - 1) / 2.0
+    else:
+        oh, ow, ocx, ocy = h, w, cx, cy
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    # inverse map: output pixel -> input coords (rotate by -angle)
+    dx = xs - ocx
+    dy = ys - ocy
+    sx = cos * dx - sin * dy + cx
+    sy = sin * dx + cos * dy + cy
+    if interpolation == "nearest":
+        ix = np.rint(sx).astype(np.int64)
+        iy = np.rint(sy).astype(np.int64)
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        out = np.full((oh, ow, arr.shape[2]), fill, arr.dtype)
+        out[valid] = arr[iy[valid], ix[valid]]
+    else:  # bilinear
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = sx - x0
+        wy = sy - y0
+        out = np.zeros((oh, ow, arr.shape[2]), np.float32)
+        total_w = np.zeros((oh, ow, 1), np.float32)
+        for ddy, ddx, wgt in (
+            (0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
+            (1, 0, wy * (1 - wx)), (1, 1, wy * wx),
+        ):
+            yy, xx = y0 + ddy, x0 + ddx
+            valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+            wv = (wgt * valid).astype(np.float32)[..., None]
+            out += wv * arr[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+            total_w += wv
+        fillmask = total_w[..., 0] == 0
+        out = np.where(total_w > 0, out / np.maximum(total_w, 1e-12), fill)
+        out[fillmask] = fill
+        if arr.dtype == np.uint8:
+            out = np.clip(np.rint(out), 0, 255)
+        out = out.astype(arr.dtype)
+    if squeeze:
+        out = out[:, :, 0]
+    return _restore(out, kind)
+
+
+def _blend(img1, img2, ratio):
+    out = img1.astype(np.float32) * ratio + img2.astype(np.float32) * (1 - ratio)
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    out = _blend(arr, np.zeros_like(arr), brightness_factor)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return _restore(out, kind)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    gray = arr.astype(np.float32).mean() if arr.ndim == 2 else (
+        (arr[..., :3].astype(np.float32) @ [0.299, 0.587, 0.114]).mean()
+    )
+    out = _blend(arr, np.full_like(arr, gray, dtype=np.float32), contrast_factor)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return _restore(out, kind)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    gray = arr[..., :3].astype(np.float32) @ [0.299, 0.587, 0.114]
+    out = _blend(arr, np.repeat(gray[..., None], arr.shape[-1], -1),
+                 saturation_factor)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return _restore(out, kind)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor in [-0.5, 0.5] turns (reference:
+    functional.py adjust_hue via HSV roundtrip)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    dtype = arr.dtype
+    rgb = arr[..., :3].astype(np.float32)
+    if dtype == np.uint8:
+        rgb = rgb / 255.0
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(
+        mx == r, ((g - b) / diff) % 6.0,
+        np.where(mx == g, (b - r) / diff + 2.0, (r - g) / diff + 4.0),
+    ) / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - f * s)
+    t = v * (1.0 - (1.0 - f) * s)
+    i = i.astype(np.int64) % 6
+    sector = [
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1),
+    ]
+    out = np.zeros_like(rgb)
+    for k in range(6):
+        m = i == k
+        out[m] = sector[k][m]
+    if dtype == np.uint8:
+        out = np.clip(np.rint(out * 255.0), 0, 255).astype(np.uint8)
+    if arr.shape[-1] > 3:
+        out = np.concatenate([out, arr[..., 3:]], -1)
+    return _restore(out, kind)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, kind = _as_hwc(img)
+    arr = np.asarray(arr)
+    gray = arr[..., :3].astype(np.float32) @ [0.299, 0.587, 0.114]
+    if arr.dtype == np.uint8:
+        gray = np.clip(np.rint(gray), 0, 255).astype(np.uint8)
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return _restore(out, kind)

@@ -1,0 +1,232 @@
+"""Tests for the vision surface completion: transforms functional + classes,
+detection ops (deform_conv2d, roi_pool, psroi_pool, yolo_loss), io ops,
+datasets, model aliases, and sparse Conv3D (reference:
+python/paddle/vision/{transforms,ops,datasets,models}, python/paddle/sparse)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+rng = np.random.default_rng(11)
+
+
+class TestTransformsFunctional:
+    img = rng.integers(0, 255, (20, 30, 3)).astype(np.uint8)
+
+    def test_geometric(self):
+        import paddle_tpu.vision.transforms_functional as TF
+
+        np.testing.assert_array_equal(TF.hflip(self.img), self.img[:, ::-1])
+        np.testing.assert_array_equal(TF.vflip(self.img), self.img[::-1])
+        assert TF.crop(self.img, 2, 3, 10, 12).shape == (10, 12, 3)
+        assert TF.center_crop(self.img, 10).shape == (10, 10, 3)
+        assert TF.pad(self.img, (1, 2, 3, 4)).shape == (26, 34, 3)
+        assert TF.resize(self.img, 10).shape == (10, 15, 3)  # short edge
+
+    def test_rotate_90_matches_pil(self):
+        import paddle_tpu.vision.transforms_functional as TF
+        from PIL import Image
+
+        pil = Image.fromarray(self.img)
+        np.testing.assert_array_equal(
+            TF.rotate(self.img, 90, expand=True),
+            np.asarray(pil.rotate(90, expand=True)),
+        )
+
+    def test_photometric_matches_pil(self):
+        import paddle_tpu.vision.transforms_functional as TF
+        from PIL import Image, ImageEnhance
+
+        pil = Image.fromarray(self.img)
+        for fac in (0.5, 1.5):
+            ours = TF.adjust_brightness(self.img, fac).astype(int)
+            want = np.asarray(ImageEnhance.Brightness(pil).enhance(fac)).astype(int)
+            assert np.abs(ours - want).max() <= 1
+            ours = TF.adjust_saturation(self.img, fac).astype(int)
+            want = np.asarray(ImageEnhance.Color(pil).enhance(fac)).astype(int)
+            assert np.abs(ours - want).max() <= 2
+
+    def test_hue_roundtrip_and_grayscale(self):
+        import paddle_tpu.vision.transforms_functional as TF
+        from PIL import Image
+
+        h2 = TF.adjust_hue(TF.adjust_hue(self.img, 0.25), -0.25)
+        assert np.abs(h2.astype(int) - self.img.astype(int)).max() <= 2
+        gray = TF.to_grayscale(self.img)[:, :, 0].astype(int)
+        want = np.asarray(Image.fromarray(self.img).convert("L")).astype(int)
+        assert np.abs(gray - want).max() <= 1
+
+    def test_transform_classes(self):
+        T = paddle.vision.transforms
+        paddle.seed(0)
+        for cls, args in [
+            (T.ContrastTransform, (0.4,)), (T.SaturationTransform, (0.4,)),
+            (T.HueTransform, (0.2,)), (T.Grayscale, ()), (T.Pad, (2,)),
+            (T.RandomRotation, (30,)), (T.RandomErasing, ()),
+        ]:
+            out = cls(*args)(self.img)
+            assert np.asarray(out).size > 0
+
+
+class TestDetectionOps:
+    def test_deform_conv2d_zero_offset_is_conv(self):
+        x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+        off0 = np.zeros((2, 18, 6, 6), np.float32)
+        got = V.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off0), paddle.to_tensor(w)
+        ).numpy()
+        want = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_deform_conv2d_mask_modulates(self):
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+        off0 = np.zeros((1, 18, 4, 4), np.float32)
+        m = np.full((1, 9, 4, 4), 0.5, np.float32)
+        got = V.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off0), paddle.to_tensor(w),
+            mask=paddle.to_tensor(m),
+        ).numpy()
+        want = 0.5 * torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w)
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_deform_conv2d_grad(self):
+        layer = V.DeformConv2D(2, 3, 3)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 6, 6)).astype(np.float32))
+        off = paddle.to_tensor(
+            0.1 * rng.standard_normal((1, 18, 4, 4)).astype(np.float32)
+        )
+        layer(x, off).sum().backward()
+        assert layer.weight.grad is not None
+
+    def test_roi_pool_hand_case(self):
+        fm = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = V.roi_pool(
+            paddle.to_tensor(fm), paddle.to_tensor(boxes),
+            paddle.to_tensor(np.array([1])), 2, 1.0,
+        ).numpy()
+        np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+    def test_psroi_pool_channel_mapping(self):
+        ph = pw = 2
+        cin = 2 * ph * pw
+        fm = np.zeros((1, cin, 6, 6), np.float32)
+        for c in range(cin):
+            fm[0, c] = c
+        out = V.psroi_pool(
+            paddle.to_tensor(fm),
+            paddle.to_tensor(np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)),
+            paddle.to_tensor(np.array([1])), 2, 1.0,
+        ).numpy()
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    assert out[0, c, i, j] == c * 4 + i * 2 + j
+
+    def test_yolo_loss_runs_and_differentiates(self):
+        n, mask_num, C, h, w = 2, 3, 4, 5, 5
+        x = paddle.to_tensor(
+            rng.standard_normal((n, mask_num * (5 + C), h, w)).astype(np.float32)
+        )
+        x.stop_gradient = False
+        gt_box = paddle.to_tensor(np.array(
+            [[[0.3, 0.3, 0.2, 0.2], [0.7, 0.7, 0.4, 0.3]],
+             [[0.5, 0.5, 0.1, 0.1], [0, 0, 0, 0]]], np.float32))
+        gt_label = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+        loss = V.yolo_loss(x, gt_box, gt_label, anchors, [0, 1, 2], C, 0.7, 32)
+        assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        img = rng.integers(0, 255, (8, 9, 3)).astype(np.uint8)
+        p = tmp_path / "x.jpg"
+        Image.fromarray(img).save(p, quality=95)
+        dec = V.decode_jpeg(V.read_file(str(p)), mode="rgb")
+        assert tuple(dec.shape) == (3, 8, 9)
+
+
+class TestModelsDatasets:
+    def test_aliases_exist_and_run(self):
+        M = paddle.vision.models
+        m = M.MobileNetV3Small(num_classes=5)
+        m.eval()
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 3, 64, 64)).astype(np.float32)
+        )
+        with paddle.no_grad():
+            assert m(x).shape == [1, 5]
+        for name in ["MobileNetV3Large", "ResNeXt", "resnext101_32x4d",
+                     "resnext101_64x4d", "resnext152_32x4d",
+                     "resnext152_64x4d", "resnext50_64x4d", "vgg13",
+                     "wide_resnet101_2"]:
+            assert hasattr(M, name), name
+        assert len(M.vgg13(num_classes=4).parameters()) > 10
+
+    def test_datasets(self):
+        ds = paddle.vision.datasets.Flowers(mode="train")
+        img, lab = ds[0]
+        assert img.shape[-1] == 3 and 0 <= int(lab) < 102
+        voc = paddle.vision.datasets.VOC2012(mode="valid")
+        im, seg = voc[0]
+        assert seg.shape == (64, 64)
+
+    def test_image_load(self, tmp_path):
+        from PIL import Image
+
+        p = tmp_path / "a.png"
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(p)
+        img = paddle.vision.image_load(str(p))
+        assert np.asarray(img).shape == (4, 4, 3)
+
+
+class TestSparseConv:
+    def test_conv3d_matches_dense(self):
+        import paddle_tpu.sparse as S
+
+        paddle.seed(0)
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        for s in [(0, 0, 0, 0), (0, 1, 2, 3), (0, 3, 3, 3)]:
+            dense[s] = rng.standard_normal(2)
+        idx = np.stack(np.nonzero(np.abs(dense).sum(-1) > 0))
+        sp = S.sparse_coo_tensor(
+            paddle.to_tensor(idx), paddle.to_tensor(dense[tuple(idx)]),
+            shape=[1, 4, 4, 4, 2],
+        )
+        conv = S.Conv3D(2, 4, 3, padding=1)
+        out = conv(sp)
+        w = conv.weight.numpy()
+        want = torch.nn.functional.conv3d(
+            torch.tensor(np.transpose(dense, (0, 4, 1, 2, 3))),
+            torch.tensor(np.transpose(w, (4, 3, 0, 1, 2))),
+            torch.tensor(conv.bias.numpy()), padding=1,
+        ).numpy()
+        np.testing.assert_allclose(
+            np.transpose(out.to_dense().numpy(), (0, 4, 1, 2, 3)), want,
+            rtol=1e-4, atol=2e-5,
+        )
+
+    def test_subm_conv3d_constraint(self):
+        import paddle_tpu.sparse as S
+
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        dense[0, 1, 1, 1] = [1.0, -1.0]
+        idx = np.stack(np.nonzero(np.abs(dense).sum(-1) > 0))
+        sp = S.sparse_coo_tensor(
+            paddle.to_tensor(idx), paddle.to_tensor(dense[tuple(idx)]),
+            shape=[1, 4, 4, 4, 2],
+        )
+        out = S.SubmConv3D(2, 3, 3, padding=1)(sp).to_dense().numpy()
+        active = np.abs(out).sum(-1) > 0
+        # only the single input site may be active
+        assert active.sum() <= 1 and active[0, 1, 1, 1] or active.sum() == 0
