@@ -72,12 +72,18 @@ def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
 
 
 def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
-                with_mask: bool = True) -> Dict[str, np.ndarray]:
+                with_mask: bool = True, excluded=None) -> Dict[str, np.ndarray]:
     """Apply n:m pruning to supported layer weights; registers masks so
-    decorate()d optimizers keep them."""
+    decorate()d optimizers keep them. `excluded`: layer/param names to skip
+    (static.sparsity.set_excluded_layers contract)."""
     pruned = {}
+    excluded = set(excluded or ())
     for name, layer in model.named_sublayers(include_self=True):
         if type(layer).__name__ not in _SUPPORTED:
+            continue
+        if name in excluded or getattr(
+            getattr(layer, "weight", None), "name", None
+        ) in excluded:
             continue
         w = getattr(layer, "weight", None)
         if w is None or w._value.ndim < 2 or w._value.shape[-1] % m != 0:

@@ -244,10 +244,11 @@ functional = _Functional()
 
 
 def _dense3d(x):
-    """SparseCooTensor [N, D, H, W, C] -> dense jnp array."""
-    return x.to_dense()._value if isinstance(x, SparseCooTensor) else (
-        x._value if hasattr(x, "_value") else x
-    )
+    """SparseCooTensor [N, D, H, W, C] -> dense Tensor (autograd intact:
+    to_dense is a dispatched scatter, so grads flow back to x.values)."""
+    if isinstance(x, SparseCooTensor):
+        return x.to_dense()
+    return x
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -263,6 +264,11 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     from ..core.dispatch import apply
     from ..nn import functional as F
 
+    if data_format != "NDHWC":
+        raise ValueError(
+            f"sparse conv3d supports NDHWC only (the reference sparse "
+            f"layout), got {data_format}"
+        )
     dense = _dense3d(x)
     from ..core.tensor import Tensor as _T
 
@@ -301,26 +307,36 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
             f"kernel//2): input sites grid {x.shape[:-1]} vs conv output "
             f"grid {out.shape[:-1]}"
         )
-    dense = out.to_dense().numpy()
-    mask = _np.zeros(dense.shape[:-1], bool)
-    idx = _np.asarray(x.indices.numpy())
-    mask[tuple(idx)] = True
-    dense = dense * mask[..., None]
-    from ..core.tensor import to_tensor as _tt
+    # gather the dense conv result at the INPUT's active sites — this IS
+    # the submanifold output, and the gather keeps autograd connected
+    dense = out.to_dense()
+    vals = _gather_sites(dense, x.indices)
+    return SparseCooTensor(x.indices, vals, out.shape)
 
-    return _to_sparse_coo(_tt(dense))
+
+def _gather_sites(dense_t, indices):
+    """Differentiable gather of dense values at COO sites [nsparse, nnz]."""
+
+    def f(d, idx):
+        return d[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    return apply(f, dense_t, indices, op_name="coo_gather_sites")
 
 
 def _to_sparse_coo(dense_t):
+    """Sparsify a dense Tensor. Active sites are found on the host from a
+    DETACHED copy (data-dependent nnz can't trace); the values themselves
+    are gathered differentiably so grads flow to the producing op."""
     import numpy as _np
 
-    arr = dense_t.numpy()
+    arr = _np.asarray(dense_t.numpy())
     site = _np.abs(arr).sum(-1) > 0 if arr.ndim >= 2 else _np.abs(arr) > 0
     idx = _np.stack(_np.nonzero(site))
-    vals = arr[tuple(idx)]
     from ..core.tensor import to_tensor as _tt
 
-    return sparse_coo_tensor(_tt(idx), _tt(vals), shape=list(arr.shape))
+    idx_t = _tt(idx.astype(_np.int64))
+    vals = _gather_sites(dense_t, idx_t)
+    return SparseCooTensor(idx_t, vals, list(arr.shape))
 
 
 class _Conv3DBase(paddle.nn.Layer):

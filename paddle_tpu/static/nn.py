@@ -1,0 +1,701 @@
+"""paddle.static.nn — static-graph layer functions.
+
+Reference analogue: python/paddle/static/nn/__init__.py (fc, conv2d,
+batch_norm, control flow, sequence ops...). On this stack a "static layer
+fn" is an eager/traceable call that creates its parameters in the current
+default Program's scope on first use — the same build-once semantics
+without a ProgramDesc. Sequence ops operate on padded [B, T, ...] batches
+(the LoDTensor replacement per SURVEY §7 "dynamic shapes" policy); ragged
+semantics take an optional `length` tensor where the reference reads LoD.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "batch_norm", "layer_norm", "instance_norm",
+    "group_norm", "data_norm", "spectral_norm", "prelu", "deform_conv2d",
+    "bilinear_tensor_product", "row_conv", "nce", "crf_decoding",
+    "multi_box_head", "py_func", "case", "cond", "switch_case", "while_loop",
+    "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand", "sequence_expand_as", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_pool",
+    "sequence_reshape", "sequence_reverse", "sequence_scatter",
+    "sequence_slice", "sequence_softmax", "sequence_unpad",
+]
+
+_param_registry = {}
+
+
+def _layer_cache(key, builder):
+    """Build-once parameter holder keyed by (program id, call-site key)."""
+    from . import default_main_program
+
+    prog = default_main_program()
+    cache = getattr(prog, "_static_layers", None)
+    if cache is None:
+        cache = prog._static_layers = {}
+    if key not in cache:
+        cache[key] = builder()
+    return cache[key]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py fc."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    flat = []
+    for t in xs:
+        lead = 1
+        for d in t.shape[:num_flatten_dims]:
+            lead *= d
+        flat.append(t.reshape([lead, -1]))
+    key = (name or "fc", size, tuple(t.shape[-1] for t in flat))
+
+    def build():
+        return [
+            paddle.nn.Linear(int(t.shape[-1]), size, weight_attr=weight_attr,
+                             bias_attr=bias_attr if i == 0 else False)
+            for i, t in enumerate(flat)
+        ]
+
+    layers = _layer_cache(key, build)
+    out = layers[0](flat[0])
+    for layer, t in zip(layers[1:], flat[1:]):
+        out = out + layer(t)
+    if activation:
+        out = getattr(paddle.nn.functional, activation)(out)
+    lead_shape = list(xs[0].shape[:num_flatten_dims])
+    return out.reshape(lead_shape + [size])
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    key = ("embedding", tuple(size))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.Embedding(size[0], size[1],
+                                         padding_idx=padding_idx,
+                                         weight_attr=param_attr),
+    )
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    key = (name or "conv2d", cin, num_filters, tuple(np.atleast_1d(filter_size)))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.Conv2D(
+            int(cin), num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format),
+    )
+    out = layer(input)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    key = (name or "conv3d", cin, num_filters, tuple(np.atleast_1d(filter_size)))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.Conv3D(
+            int(cin), num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format),
+    )
+    out = layer(input)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    key = (name or "conv2dT", cin, num_filters, tuple(np.atleast_1d(filter_size)))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.Conv2DTranspose(
+            int(cin), num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format),
+    )
+    out = layer(input, output_size=output_size)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    key = (name or "conv3dT", cin, num_filters, tuple(np.atleast_1d(filter_size)))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.Conv3DTranspose(
+            int(cin), num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format),
+    )
+    out = layer(input, output_size=output_size)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    key = (name or "batch_norm", int(c))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.BatchNorm(
+            int(c), momentum=momentum, epsilon=epsilon,
+            param_attr=param_attr, bias_attr=bias_attr,
+            data_layout=data_layout),
+    )
+    layer.training = not is_test and not use_global_stats
+    out = layer(input)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    key = (name or "layer_norm", tuple(shape))
+    layer = _layer_cache(
+        key, lambda: paddle.nn.LayerNorm(shape, epsilon=epsilon,
+                                         weight_attr=param_attr if scale else False,
+                                         bias_attr=bias_attr if shift else False),
+    )
+    out = layer(input)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    c = int(input.shape[1])
+    layer = _layer_cache(
+        (name or "instance_norm", c),
+        lambda: paddle.nn.InstanceNorm2D(c, epsilon=epsilon,
+                                         weight_attr=param_attr,
+                                         bias_attr=bias_attr),
+    )
+    return layer(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    layer = _layer_cache(
+        (name or "group_norm", groups, c),
+        lambda: paddle.nn.GroupNorm(groups, c, epsilon=epsilon,
+                                    weight_attr=param_attr,
+                                    bias_attr=bias_attr),
+    )
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kwargs):
+    """reference: static/nn/common.py data_norm — normalization by global
+    accumulated statistics (PS CTR models). Single-process form: running
+    batch statistics without scale/shift coupling."""
+    c = int(input.shape[-1])
+
+    def build():
+        import paddle_tpu as p
+
+        state = {
+            "size": p.to_tensor(np.full(c, epsilon, np.float32)),
+            "sum": p.to_tensor(np.zeros(c, np.float32)),
+            "square_sum": p.to_tensor(np.full(c, epsilon, np.float32)),
+        }
+        return state
+
+    state = _layer_cache((name or "data_norm", c), build)
+    bsz = input.shape[0]
+    with paddle.no_grad():
+        state["size"].set_value(state["size"] + float(bsz))
+        state["sum"].set_value(state["sum"] + input.sum(axis=0).detach())
+        state["square_sum"].set_value(
+            state["square_sum"] + (input * input).sum(axis=0).detach()
+        )
+    mean = state["sum"] / state["size"]
+    var = state["square_sum"] / state["size"] - mean * mean
+    out = (input - mean) / paddle.sqrt(var.clip(min=epsilon))
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    layer = _layer_cache(
+        (name or "spectral_norm", tuple(weight.shape), dim),
+        lambda: paddle.nn.SpectralNorm(weight.shape, dim=dim,
+                                       power_iters=power_iters, eps=eps),
+    )
+    return layer(weight)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = int(x.shape[1] if data_format == "NCHW" else x.shape[-1])
+    else:  # element
+        num = int(np.prod(x.shape[1:]))
+    layer = _layer_cache(
+        (name or "prelu", mode, num),
+        lambda: paddle.nn.PReLU(num_parameters=num, weight_attr=param_attr),
+    )
+    return layer(x)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+
+    cin = int(x.shape[1])
+    layer = _layer_cache(
+        (name or "deform_conv2d", cin, num_filters,
+         tuple(np.atleast_1d(filter_size))),
+        lambda: DeformConv2D(cin, num_filters, filter_size, stride=stride,
+                             padding=padding, dilation=dilation,
+                             deformable_groups=deformable_groups,
+                             groups=groups, weight_attr=param_attr,
+                             bias_attr=bias_attr),
+    )
+    return layer(x, offset, mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    layer = _layer_cache(
+        (name or "bilinear", int(x.shape[-1]), int(y.shape[-1]), size),
+        lambda: paddle.nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                                   weight_attr=param_attr,
+                                   bias_attr=bias_attr),
+    )
+    out = layer(x, y)
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference: static/nn/common.py row_conv —
+    the DeepSpeech2 op): out[t] = sum_{i=0..k} in[t+i] * W[i]."""
+    k = future_context_size + 1
+    d = int(input.shape[-1])
+    layer = _layer_cache(
+        ("row_conv", k, d),
+        lambda: paddle.create_parameter([k, d], "float32"),
+    )
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def _rc(v, w):
+        # v [B, T, D]; pad future frames with zeros
+        pads = jnp.zeros(v.shape[:1] + (k - 1,) + v.shape[2:], v.dtype)
+        vp = jnp.concatenate([v, pads], axis=1)
+        out = jnp.zeros_like(v)
+        for i in range(k):
+            out = out + vp[:, i : i + v.shape[1]] * w[i]
+        return out
+
+    out = apply(_rc, input, layer, op_name="row_conv")
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: static/nn/common.py
+    nce op): positive class + sampled negatives through sigmoid CE."""
+    d = int(input.shape[-1])
+    num_neg = num_neg_samples or 10
+
+    def build():
+        w = paddle.create_parameter([num_total_classes, d], "float32")
+        b = paddle.create_parameter([num_total_classes], "float32",
+                                    is_bias=True)
+        return (w, b)
+
+    w, b = _layer_cache(("nce", num_total_classes, d), build)
+    bsz = input.shape[0]
+    import jax as _jax
+
+    from ..core import random as _random
+
+    neg = _jax.random.randint(
+        _random.next_key(), (bsz, num_neg), 0, num_total_classes
+    )
+    from ..core.tensor import Tensor
+
+    neg_t = Tensor(neg, stop_gradient=True)
+    lab = label.reshape([-1, 1])
+    idx = paddle.concat([lab, neg_t], axis=1)            # [B, 1+num_neg]
+    wsel = paddle.gather(w, idx.reshape([-1])).reshape(
+        [bsz, 1 + num_neg, d]
+    )
+    bsel = paddle.gather(b, idx.reshape([-1])).reshape([bsz, 1 + num_neg])
+    logits = (wsel * input.unsqueeze(1)).sum(axis=-1) + bsel
+    targets = paddle.concat(
+        [paddle.ones([bsz, 1]), paddle.zeros([bsz, num_neg])], axis=1
+    )
+    loss = paddle.nn.functional.binary_cross_entropy_with_logits(
+        logits, targets, reduction="none"
+    )
+    return loss.sum(axis=1, keepdim=True)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Viterbi decode with a learned transition matrix (reference:
+    static/nn/common.py crf_decoding over the linear_chain_crf params)."""
+    from ..text import viterbi_decode
+
+    n_tags = int(input.shape[-1])
+    trans = _layer_cache(
+        ("crf_decoding", n_tags),
+        lambda: paddle.create_parameter([n_tags + 2, n_tags], "float32"),
+    )
+    # reference layout: rows 0/1 are start/stop, rest tag-to-tag
+    if length is None:
+        length = paddle.to_tensor(
+            np.full(input.shape[0], input.shape[1], np.int64)
+        )
+    scores, path = viterbi_decode(
+        input, trans[2:], length, include_bos_eos_tag=False
+    )
+    return path
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection heads (reference: static/nn/common.py multi_box_head):
+    per-feature-map loc/conf convs + prior boxes."""
+    locs, confs, priors, pvars = [], [], [], []
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    n_layers = len(inputs)
+    if min_sizes is None:
+        # reference ratio interpolation (first layer pinned at 10%/20%)
+        min_ratio, max_ratio = int(min_ratio), int(max_ratio)
+        min_sizes, max_sizes = [], []
+        if n_layers > 2:
+            step = int((max_ratio - min_ratio) / (n_layers - 2))
+            for r in range(min_ratio, max_ratio + 1, step):
+                min_sizes.append(base_size * r / 100.0)
+                max_sizes.append(base_size * (r + step) / 100.0)
+        else:
+            min_sizes.append(base_size * min_ratio / 100.0)
+            max_sizes.append(base_size * max_ratio / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        ar_full = [1.0]
+        for a in ar:
+            ar_full.append(a)
+            if flip:
+                ar_full.append(1.0 / a)
+        n_priors = len(ar_full) + (1 if max_sizes else 0)
+        loc = conv2d(feat, n_priors * 4, kernel_size, padding=pad,
+                     stride=stride, name=f"{name or 'mbox'}_loc{i}")
+        conf = conv2d(feat, n_priors * num_classes, kernel_size, padding=pad,
+                      stride=stride, name=f"{name or 'mbox'}_conf{i}")
+        fh, fw = int(feat.shape[2]), int(feat.shape[3])
+        # prior boxes for this map
+        sw = steps[i] if steps else img_w / fw
+        sh = steps[i] if steps else img_h / fh
+        boxes = []
+        for y in range(fh):
+            for x_ in range(fw):
+                cx = (x_ + offset) * sw
+                cy = (y + offset) * sh
+                sizes = []
+                ms = min_sizes[i]
+                for a in ar_full:
+                    sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+                if max_sizes:
+                    bigger = np.sqrt(ms * max_sizes[i])
+                    sizes.append((bigger, bigger))
+                for bw, bh in sizes:
+                    box = [
+                        (cx - bw / 2) / img_w, (cy - bh / 2) / img_h,
+                        (cx + bw / 2) / img_w, (cy + bh / 2) / img_h,
+                    ]
+                    if clip:
+                        box = [min(max(v, 0.0), 1.0) for v in box]
+                    boxes.append(box)
+        priors.append(np.asarray(boxes, np.float32))
+        pvars.append(np.tile(np.asarray(variance, np.float32),
+                             (len(boxes), 1)))
+        b = int(feat.shape[0])
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([b, -1, 4]))
+        confs.append(
+            conf.transpose([0, 2, 3, 1]).reshape([b, -1, num_classes])
+        )
+    mbox_locs = paddle.concat(locs, axis=1)
+    mbox_confs = paddle.concat(confs, axis=1)
+    box = paddle.to_tensor(np.concatenate(priors, 0))
+    var = paddle.to_tensor(np.concatenate(pvars, 0))
+    return mbox_locs, mbox_confs, box, var
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from . import py_func as _pf
+
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# --- control flow (reference: static/nn/control_flow.py; lowered to python
+# callables — the traced program inlines the taken structure, and
+# paddle.jit uses lax control flow where tensors decide) -------------------
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    import jax
+
+    pv = pred
+    if hasattr(pv, "_value"):
+        pv = pv._value
+    try:
+        taken = bool(pv)
+    except jax.errors.TracerBoolConversionError:
+        raise NotImplementedError(
+            "static.nn.cond with a traced predicate: write the branch with "
+            "paddle.where / lax.cond inside a to_static function instead"
+        )
+    if taken:
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        pv = pred._value if hasattr(pred, "_value") else pred
+        if bool(pv):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(branch_index) if not isinstance(branch_index, int) else branch_index
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference: static/nn/control_flow.py while_loop. Runs the python
+    loop eagerly; under jit use paddle.jit with lax.while_loop."""
+    vars_ = list(loop_vars)
+    while bool(cond(*vars_)):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+# --- sequence ops over padded [B, T, ...] batches -------------------------
+def _lens_or_full(x, length):
+    if length is None:
+        return np.full(int(x.shape[0]), int(x.shape[1]), np.int64)
+    return np.asarray(length.numpy() if hasattr(length, "numpy") else length)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  length=None):
+    """max/avg/sum/sqrt/first/last pooling over the time axis."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.tensor import to_tensor
+
+    lens = to_tensor(_lens_or_full(input, length))
+    pt = pool_type.lower()
+
+    def _pool(v, ln):
+        t = v.shape[1]
+        mask = jnp.arange(t)[None, :] < ln[:, None]
+        for _ in range(v.ndim - 2):
+            mask = mask[..., None]
+        if pt == "max":
+            return jnp.where(mask, v, -jnp.inf).max(axis=1)
+        if pt == "first":
+            return v[:, 0]
+        if pt == "last":
+            return jnp.take_along_axis(
+                v, (ln - 1).reshape(-1, *([1] * (v.ndim - 1))), axis=1
+            )[:, 0]
+        s = jnp.where(mask, v, 0.0).sum(axis=1)
+        if pt == "sum":
+            return s
+        denom = jnp.maximum(ln, 1).astype(v.dtype)
+        denom = denom.reshape(-1, *([1] * (s.ndim - 1)))
+        if pt == "average":
+            return s / denom
+        if pt == "sqrt":
+            return s / jnp.sqrt(denom)
+        raise ValueError(pool_type)
+
+    return apply(_pool, input, lens, op_name=f"sequence_pool_{pt}")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.tensor import to_tensor
+
+    lens = to_tensor(_lens_or_full(input, length))
+
+    def _sm(v, ln):
+        mask = jnp.arange(v.shape[1])[None, :] < ln[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        masked = jnp.where(mask, v, -jnp.inf)
+        out = jax.nn.softmax(masked, axis=1)
+        return jnp.where(mask, out, 0.0)
+
+    import jax
+
+    return apply(_sm, input, lens, op_name="sequence_softmax")
+
+
+def sequence_reverse(x, name=None, length=None):
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.tensor import to_tensor
+
+    lens = to_tensor(_lens_or_full(x, length))
+
+    def _rev(v, ln):
+        t = v.shape[1]
+        idx = jnp.arange(t)[None, :]
+        src = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)), axis=1
+        )
+
+    return apply(_rev, x, lens, op_name="sequence_reverse")
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_concat(input, name=None):
+    return paddle.concat(list(input), axis=1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over time (reference: sequence_conv op) =
+    conv1d over the padded batch."""
+    d = int(input.shape[-1])
+    layer = _layer_cache(
+        (name or "sequence_conv", d, num_filters, filter_size),
+        lambda: paddle.nn.Conv1D(d, num_filters, filter_size,
+                                 padding=(filter_size - 1) // 2 if padding else 0,
+                                 weight_attr=param_attr, bias_attr=bias_attr),
+    )
+    out = layer(input.transpose([0, 2, 1])).transpose([0, 2, 1])
+    return getattr(paddle.nn.functional, act)(out) if act else out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding windows of ids (reference: sequence_enumerate op)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def _enum(v):
+        t = v.shape[1]
+        outs = []
+        for off in range(win_size):
+            idx = jnp.arange(t) + off
+            col = jnp.where(idx < t, v[:, jnp.minimum(idx, t - 1)], pad_value)
+            outs.append(col)
+        return jnp.stack(outs, axis=-1)
+
+    return apply(_enum, input, differentiable=False,
+                 op_name="sequence_enumerate")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x per the batch of y (padded-batch semantics:
+    tile x's batch to y's)."""
+    reps = int(y.shape[0]) // max(int(x.shape[0]), 1)
+    return paddle.concat([x] * reps, axis=0) if reps > 1 else x
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """x already padded in this design; returns (x, lengths)."""
+    lens = paddle.to_tensor(_lens_or_full(x, None))
+    return x, lens
+
+
+def sequence_unpad(x, length, name=None):
+    """Mask out the padding tail (stays padded-rectangular: XLA needs
+    static shapes; consumers read `length`)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.tensor import to_tensor
+
+    lens = to_tensor(_lens_or_full(x, length))
+
+    def _mask(v, ln):
+        m = jnp.arange(v.shape[1])[None, :] < ln[:, None]
+        return v * m.reshape(m.shape + (1,) * (v.ndim - 2)).astype(v.dtype)
+
+    return apply(_mask, x, lens, op_name="sequence_unpad")
+
+
+def sequence_reshape(input, new_dim):
+    b = int(input.shape[0])
+    return input.reshape([b, -1, new_dim])
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice (same offset/length per row under padded
+    batches)."""
+    off = int(np.asarray(offset.numpy() if hasattr(offset, "numpy") else offset).reshape(-1)[0])
+    ln = int(np.asarray(length.numpy() if hasattr(length, "numpy") else length).reshape(-1)[0])
+    return input[:, off : off + ln]
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter updates into per-row time positions (reference:
+    sequence_scatter op)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def _scatter(v, idx, upd):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v.at[rows, idx].add(upd)
+
+    return apply(_scatter, input, index, updates, op_name="sequence_scatter")

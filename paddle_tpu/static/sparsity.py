@@ -1,0 +1,51 @@
+"""paddle.static.sparsity — 2:4 structured-sparsity (ASP) static API.
+
+Reference analogue: python/paddle/fluid/contrib/sparsity/asp.py exposed as
+paddle.static.sparsity. Delegates to the working ASP implementation in
+paddle_tpu.incubate.asp.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..incubate import asp as _asp
+
+__all__ = [
+    "calculate_density",
+    "decorate",
+    "prune_model",
+    "reset_excluded_layers",
+    "set_excluded_layers",
+]
+
+_excluded = set()
+
+
+def calculate_density(x):
+    """Fraction of nonzero entries (reference: sparsity/utils.py
+    calculate_density)."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((arr != 0).sum() / max(arr.size, 1))
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so steps preserve pruned masks (reference:
+    sparsity/asp.py decorate)."""
+    return _asp.decorate(optimizer)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune a model's weights to n:m sparsity (reference: asp.prune_model).
+    Layers named via set_excluded_layers are skipped."""
+    return _asp.prune_model(model, n=n, m=m, mask_algo=mask_algo,
+                            with_mask=with_mask, excluded=_excluded)
+
+
+def set_excluded_layers(main_program=None, param_names=None):
+    global _excluded
+    _excluded |= set(param_names or [])
+
+
+def reset_excluded_layers(main_program=None):
+    global _excluded
+    _excluded = set()

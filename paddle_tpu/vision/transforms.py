@@ -346,19 +346,18 @@ class RandomErasing(BaseTransform):
     def _apply_image(self, img):
         import numpy as _np
 
+        from .transforms_functional import _as_hwc, _restore
+
         if _uniform(0.0, 1.0) >= self.prob:
             return img
-        arr = _np.array(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
-        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        arr, kind = _as_hwc(img)
+        arr = _np.array(arr)
+        h, w = arr.shape[0], arr.shape[1]
         area = h * w * _uniform(self.scale[0], self.scale[1])
         aspect = _uniform(self.ratio[0], self.ratio[1])
         eh = min(h, max(1, int(round((area * aspect) ** 0.5))))
         ew = min(w, max(1, int(round((area / aspect) ** 0.5))))
         top = int(_uniform(0, max(1e-6, h - eh)))
         left = int(_uniform(0, max(1e-6, w - ew)))
-        if chw:
-            arr[:, top : top + eh, left : left + ew] = self.value
-        else:
-            arr[top : top + eh, left : left + ew] = self.value
-        return arr
+        arr[top : top + eh, left : left + ew] = self.value
+        return _restore(arr, kind)

@@ -1,0 +1,285 @@
+"""Tests for the paddle.static surface completion (reference:
+python/paddle/static/__init__.py, static/nn/, static/sparsity)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+sn = static.nn
+rng = np.random.default_rng(5)
+
+
+class TestStaticNN:
+    def test_fc_program_build_once(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            static.data("x", [None, 8])
+
+        def build(feed):
+            h = sn.fc(feed["x"], 16, activation="relu", name="fc1")
+            return sn.fc(h, 2, name="fc2")
+
+        prog.set_builder(build)
+        exe = static.Executor()
+        x = np.ones((4, 8), np.float32)
+        with static.program_guard(prog):
+            out1 = exe.run(prog, feed={"x": x})
+            out2 = exe.run(prog, feed={"x": x})
+        assert out1[0].shape == (4, 2)
+        np.testing.assert_allclose(out1[0], out2[0])  # params built once
+
+    def test_conv_and_norm_fns(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = paddle.to_tensor(
+                rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+            )
+            out = sn.conv2d(x, 4, 3, padding=1, act="relu", name="c1")
+            assert out.shape == [2, 4, 8, 8]
+            out = sn.batch_norm(out, name="bn1")
+            out = sn.group_norm(out, groups=2, name="gn1")
+            flat = out.flatten(1)
+            out = sn.layer_norm(flat, name="ln1")
+            assert np.isfinite(out.numpy()).all()
+
+    def test_sequence_ops(self):
+        xs = paddle.to_tensor(np.array(
+            [[[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]],
+             [[4.0, 4.0], [5.0, 5.0], [6.0, 6.0]]], np.float32))
+        lens = paddle.to_tensor(np.array([2, 3]))
+        np.testing.assert_allclose(
+            sn.sequence_pool(xs, "average", length=lens).numpy()[0],
+            [1.5, 1.5],
+        )
+        np.testing.assert_allclose(
+            sn.sequence_last_step(xs, length=lens).numpy()[0], [2.0, 2.0]
+        )
+        np.testing.assert_allclose(
+            sn.sequence_first_step(xs).numpy()[1], [4.0, 4.0]
+        )
+        sm = sn.sequence_softmax(
+            paddle.to_tensor(np.zeros((1, 4, 1), np.float32)),
+            length=paddle.to_tensor(np.array([2])),
+        ).numpy()
+        np.testing.assert_allclose(sm[0, :, 0], [0.5, 0.5, 0, 0])
+        rev = sn.sequence_reverse(xs, length=lens).numpy()
+        np.testing.assert_allclose(rev[0, 0], [2.0, 2.0])
+        np.testing.assert_allclose(rev[0, 2], [3.0, 3.0])  # pad untouched
+        un = sn.sequence_unpad(xs, lens).numpy()
+        assert (un[0, 2] == 0).all()
+        enum = sn.sequence_enumerate(
+            paddle.to_tensor(np.array([[1, 2, 3]])), win_size=2
+        ).numpy()
+        np.testing.assert_array_equal(enum[0], [[1, 2], [2, 3], [3, 0]])
+
+    def test_control_flow(self):
+        out = sn.while_loop(
+            lambda i: int(i) < 5, lambda i: i + 2, [paddle.to_tensor(0)]
+        )
+        assert int(out[0]) == 6
+        assert sn.switch_case(1, {0: lambda: 10, 1: lambda: 20}) == 20
+        assert sn.case([(paddle.to_tensor(False), lambda: 1),
+                        (paddle.to_tensor(True), lambda: 2)]) == 2
+        assert sn.cond(paddle.to_tensor(True), lambda: "a", lambda: "b") == "a"
+
+    def test_nce_crf_rowconv(self):
+        emb = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        lab = paddle.to_tensor(np.array([1, 2, 0, 3]))
+        with static.program_guard(static.Program()):
+            loss = sn.nce(emb, lab, 10, num_neg_samples=3)
+            assert loss.shape == [4, 1] and np.isfinite(loss.numpy()).all()
+            seq = paddle.to_tensor(
+                rng.standard_normal((2, 5, 6)).astype(np.float32)
+            )
+            assert sn.crf_decoding(seq).shape[0] == 2
+            assert sn.row_conv(seq, 2).shape == [2, 5, 6]
+
+    def test_multi_box_head(self):
+        with static.program_guard(static.Program()):
+            img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+            f1 = paddle.to_tensor(rng.standard_normal((1, 8, 8, 8)).astype(np.float32))
+            f2 = paddle.to_tensor(rng.standard_normal((1, 8, 4, 4)).astype(np.float32))
+            locs, confs, box, var = sn.multi_box_head(
+                [f1, f2], img, base_size=64, num_classes=3,
+                aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            )
+            n_priors = box.shape[0]
+            assert locs.shape == [1, n_priors, 4]
+            assert confs.shape == [1, n_priors, 3]
+            assert var.shape == [n_priors, 4]
+
+
+class TestStaticMisc:
+    def test_scope_and_global_var(self):
+        v = static.create_global_var([2], 2.5, "float32", persistable=True,
+                                     name="scope_var")
+        assert static.global_scope().find_var("scope_var") is v
+        fresh = static.Scope()
+        with static.scope_guard(fresh):
+            assert static.global_scope() is fresh
+        assert static.global_scope() is not fresh
+
+    def test_save_load_roundtrip(self, tmp_path):
+        v = static.create_global_var([3], 1.25, "float32", persistable=True,
+                                     name="persist_me")
+        prog = static.Program()
+        static.save(prog, str(tmp_path / "model"))
+        v.set_value(np.zeros(3, np.float32))
+        static.load(prog, str(tmp_path / "model"))
+        np.testing.assert_allclose(v.numpy(), 1.25)
+        state = static.load_program_state(str(tmp_path / "model"))
+        assert "persist_me" in state
+        v.set_value(np.zeros(3, np.float32))
+        static.set_program_state(prog, state)
+        np.testing.assert_allclose(v.numpy(), 1.25)
+
+    def test_serialize_roundtrip(self):
+        data = static.serialize_program(
+            [static.Variable("x", [None, 4], "float32")], []
+        )
+        p2 = static.deserialize_program(data)
+        assert "x" in p2.feed_vars
+        blob = static.serialize_persistables([], [])
+        static.deserialize_persistables(p2, blob)
+
+    def test_metric_ops(self):
+        logits = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        lab = paddle.to_tensor(np.array([[1], [0]]))
+        assert float(static.accuracy(logits, lab)) == 1.0
+        probs = paddle.nn.functional.softmax(logits, -1)
+        assert 0.9 <= float(static.auc(probs, lab)) <= 1.0001
+
+    def test_ema(self):
+        net = paddle.nn.Linear(2, 2)
+        ema = static.ExponentialMovingAverage(0.9).register(net.parameters())
+        w0 = net.weight.numpy().copy()
+        net.weight.set_value(w0 + 1.0)
+        ema.update()
+        with ema.apply():
+            assert not np.allclose(net.weight.numpy(), w0 + 1.0)
+        np.testing.assert_allclose(net.weight.numpy(), w0 + 1.0)
+
+    def test_places_and_strategies(self):
+        assert len(static.cpu_places(2)) == 2
+        assert static.cuda_places([0])[0].device_type == "tpu"
+        bs = static.BuildStrategy()
+        bs.fuse_bn_act_ops = True
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+        with static.device_guard("gpu:0"):
+            pass
+        p = static.Print(paddle.to_tensor(np.arange(3)), message="dbg")
+        assert p is not None
+
+    def test_py_func(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = static.py_func(lambda t: t * 2, x, None)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+
+
+class TestStaticSparsity:
+    def test_prune_and_density(self):
+        net = paddle.nn.Linear(8, 8)
+        net.weight.set_value(
+            rng.standard_normal((8, 8)).astype(np.float32) + 0.1
+        )
+        assert static.sparsity.calculate_density(net.weight) == 1.0
+        static.sparsity.prune_model(net)
+        d = static.sparsity.calculate_density(net.weight)
+        assert abs(d - 0.5) < 1e-6  # 2:4
+        # excluded layers are skipped
+        net2 = paddle.nn.Linear(8, 8)
+        net2.weight.set_value(
+            rng.standard_normal((8, 8)).astype(np.float32) + 0.1
+        )
+        static.sparsity.set_excluded_layers(param_names=[""])
+        try:
+            static.sparsity.prune_model(net2)
+            assert static.sparsity.calculate_density(net2.weight) == 1.0
+        finally:
+            static.sparsity.reset_excluded_layers()
+
+
+class TestReviewFixes:
+    def test_static_nn_params_persist(self, tmp_path):
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                static.data("x", [None, 4])
+            prog.set_builder(lambda f: sn.fc(f["x"], 2, name="persist_fc"))
+            exe = static.Executor()
+            with static.program_guard(prog):
+                o1 = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)})
+            static.save(prog, str(tmp_path / "m"))
+            import pickle
+
+            state = pickle.load(open(tmp_path / "m.pdparams", "rb"))
+            assert any("persist_fc" in k for k in state)
+            p = prog.all_parameters()[0]
+            p.set_value(np.zeros_like(p.numpy()))
+            static.load(prog, str(tmp_path / "m"))
+            prog._compiled_cache.clear()
+            with static.program_guard(prog):
+                o2 = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)})
+            np.testing.assert_allclose(o1[0], o2[0])
+        finally:
+            paddle.disable_static()
+
+    def test_builder_side_effects_once_per_run(self):
+        paddle.enable_static()
+        try:
+            calls = []
+            prog = static.Program()
+            with static.program_guard(prog):
+                static.data("x", [None, 2])
+
+            def build(feed):
+                calls.append(1)
+                return feed["x"] * 2
+
+            prog.set_builder(build)
+            exe = static.Executor()
+            with static.program_guard(prog):
+                exe.run(prog, feed={"x": np.ones((1, 2), np.float32)})
+            assert len(calls) == 1
+        finally:
+            paddle.disable_static()
+
+    def test_ema_fixed_decay_without_thres(self):
+        net = paddle.nn.Linear(2, 2)
+        ema = static.ExponentialMovingAverage(0.999).register(net.parameters())
+        w0 = net.weight.numpy().copy()
+        net.weight.set_value(w0 + 1.0)
+        ema.update()
+        np.testing.assert_allclose(
+            ema._shadow[0], 0.999 * w0 + 0.001 * (w0 + 1.0), rtol=1e-6
+        )
+
+    def test_print_summarize_all(self, capsys):
+        static.Print(paddle.to_tensor(np.arange(5)), summarize=-1)
+        assert "4" in capsys.readouterr().out
+
+    def test_scope_var_slot(self):
+        sc = static.Scope()
+        v = sc.var("x")
+        v.set(np.ones(2, np.float32))
+        assert sc.find_var("x").get_tensor().shape == [2]
+
+    def test_sparse_conv_grads_flow(self):
+        import paddle_tpu.sparse as S
+
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        dense[0, 1, 1, 1] = [1.0, -1.0]
+        idx = np.stack(np.nonzero(np.abs(dense).sum(-1) > 0))
+        sp = S.sparse_coo_tensor(
+            paddle.to_tensor(idx), paddle.to_tensor(dense[tuple(idx)]),
+            shape=[1, 4, 4, 4, 2],
+        )
+        conv = S.Conv3D(2, 4, 3, padding=1)
+        conv(sp).values.sum().backward()
+        assert conv.weight.grad is not None
+        assert np.isfinite(conv.weight.grad.numpy()).all()
