@@ -125,6 +125,45 @@ def bench_ps_table(iters=10, batch=65536, dim=64):
             "value": round(batch * iters * 2 / dt / 1e6, 2), "unit": "M lookups/s"}
 
 
+def bench_gpt_longseq(steps=6, bsz=1, seq=4096):
+    """Long-context GPT: seq 4096 through the Pallas flash-attention path
+    (+ recompute) — the capability the reference lacks (SURVEY §5)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTPretrainingCriterion, gpt2_345m, GPTForPretraining
+
+    paddle.seed(0)
+    cfg = gpt2_345m(max_seq_len=seq)
+    cfg.dropout = 0.0
+    cfg.attn_dropout = 0.0
+    cfg.use_recompute = True
+    model = paddle.amp.decorate(GPTForPretraining(cfg), level="O2", dtype="bfloat16")
+    criterion = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = paddle.jit.compile_train_step(
+        model, lambda o, t: criterion(o.astype("float32"), t), opt
+    )
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (bsz, seq + 1)), jnp.int32)
+    )
+    x = paddle.Tensor(ids[:, :-1], stop_gradient=True)
+    y = paddle.Tensor(ids[:, 1:], stop_gradient=True)
+    float(step(x, y))
+    float(step(x, y))
+    t0 = time.time()
+    last = None
+    for _ in range(steps):
+        last = step(x, y)
+    float(last)
+    dt = time.time() - t0
+    return {"metric": "gpt2_345m_seq4096_tokens_per_sec_per_chip",
+            "value": round(bsz * seq * steps / dt, 1), "unit": "tokens/s/chip"}
+
+
 def bench_mnist_eager(steps=30, bsz=64):
     """BASELINE config 1: LeNet MNIST pure-eager — per-op dispatch overhead."""
     import paddle_tpu as paddle
@@ -237,6 +276,7 @@ def main():
         for name, fn in (
             ("resnet50", bench_resnet50),
             ("bert", bench_bert),
+            ("gpt_longseq", bench_gpt_longseq),
             ("mnist", bench_mnist_eager),
             ("ps_table", bench_ps_table),
         ):

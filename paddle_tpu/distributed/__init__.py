@@ -61,3 +61,20 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_
     if operation == "embedding":
         return mp.VocabParallelEmbedding(size[0], size[1])
     raise ValueError(f"unsupported split operation {operation!r}")
+
+# surface completion (reference: python/paddle/distributed/__init__.py)
+from .compat import (  # noqa: E402,F401
+    CountFilterEntry,
+    ParallelMode,
+    ProbabilityEntry,
+    ShowClickEntry,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+)
+from . import launch  # noqa: E402,F401
+from . import passes  # noqa: E402,F401
+from . import sharding  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: E402,F401
+from . import ps  # noqa: E402,F401

@@ -1,0 +1,514 @@
+"""paddle.distributed surface completion: ParallelMode, PS entry configs,
+gloo shims, launch-era cluster helpers, sharding API, and pass framework.
+
+Reference analogue: python/paddle/distributed/__init__.py __all__,
+distributed/entry_attr.py, distributed/utils.py, distributed/sharding/,
+distributed/passes/pass_base.py.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+
+import numpy as np
+
+__all__ = [
+    "ParallelMode",
+    "CountFilterEntry",
+    "ProbabilityEntry",
+    "ShowClickEntry",
+    "gloo_init_parallel_env",
+    "gloo_barrier",
+    "gloo_release",
+]
+
+
+class ParallelMode:
+    """reference: fleet/base/topology.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+# --- PS sparse-entry configs (reference: distributed/entry_attr.py) --------
+class EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Sample rows into the sparse table with a probability (reference:
+    entry_attr.py ProbabilityEntry)."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit rows only after `count_filter` occurrences (reference:
+    entry_attr.py CountFilterEntry)."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """Show/click weighted entry (reference: entry_attr.py ShowClickEntry)."""
+
+    def __init__(self, show_name, click_name):
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show_name}:{self._click_name}"
+
+
+# --- gloo CPU barrier shims (reference: distributed/parallel.py gloo_*) ----
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-side rendezvous (reference inits a gloo context; the jax
+    coordination service plays that role — see parallel.init_parallel_env)."""
+    from .parallel import init_parallel_env
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    if rank_num > 1:
+        init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """Release the CPU rendezvous context (no-op: the coordination service
+    lives for the process)."""
+
+
+# --- launch-era cluster model (reference: distributed/utils.py) ------------
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s-%(levelname)s: %(message)s"
+        ))
+        logger.addHandler(h)
+    return logger
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    ports = set()
+    for _ in range(num * 4):
+        if len(ports) >= num:
+            break
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("", 0))
+            ports.add(s.getsockname()[1])
+    return ports if len(ports) >= num else None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """reference: distributed/utils.py add_arguments (argparse helper)."""
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + f" Default: %(default)s.", **kwargs,
+    )
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return f"gpu:{self.gpus} endpoint:{self.endpoint} rank:{self.rank}"
+
+    def __eq__(self, t):
+        return (self.gpus == t.gpus and self.endpoint == t.endpoint
+                and self.rank == t.rank)
+
+    def __ne__(self, t):
+        return not self == t
+
+    def rank_str(self):
+        return str(self.rank)
+
+
+class Pod:
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+        self.servers = []
+        self.workers = []
+        self.heter_workers = []
+        self.gpus = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} "
+                f"port:{self.port} trainers:{[str(t) for t in self.trainers]}")
+
+    def __eq__(self, pod):
+        return (self.rank == pod.rank and self.id == pod.id
+                and self.addr == pod.addr and self.port == pod.port
+                and self.trainers == pod.trainers)
+
+    def __ne__(self, pod):
+        return not self == pod
+
+    def rank_str(self):
+        return str(self.rank)
+
+    def get_visible_gpus(self):
+        return ",".join(str(g) for g in self.gpus)
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+    def __str__(self):
+        return str(self.endpoint)
+
+    def __eq__(self, j):
+        return self.endpoint == j.endpoint
+
+    def __ne__(self, j):
+        return not self == j
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return all((self.hdfs_ugi, self.hdfs_name, self.hdfs_path))
+
+    def __str__(self):
+        return (f"hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} "
+                f"hdfs_path:{self.hdfs_path}")
+
+    def __eq__(self, n):
+        return str(self) == str(n)
+
+    def __ne__(self, n):
+        return not self == n
+
+
+class Cluster:
+    """reference: distributed/utils.py Cluster — pods of trainers."""
+
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return f"pods:{[str(p) for p in self.pods]}"
+
+    def __eq__(self, c):
+        return (len(self.pods) == len(c.pods)
+                and all(a == b for a, b in zip(self.pods, c.pods)))
+
+    def __ne__(self, c):
+        return not self == c
+
+    def update_pods(self, cluster):
+        self.pods = list(cluster.pods)
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def pod_by_id(self, pod_id):
+        for p in self.pods:
+            if str(p.id) == str(pod_id):
+                return p
+        return None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode=None,
+                devices_per_proc=None):
+    """Build a Cluster from endpoint lists (reference:
+    distributed/utils.py get_cluster)."""
+    cluster = Cluster()
+    rank = 0
+    for pod_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = pod_rank
+        pod.addr = ip
+        pod.id = pod_rank
+        eps = (trainer_endpoints[pod_rank]
+               if trainer_endpoints and isinstance(trainer_endpoints[0], list)
+               else [e for e in (trainer_endpoints or []) if e.split(":")[0] == ip])
+        n = len(eps) or len(devices_per_proc or [0])
+        for i in range(n):
+            t = Trainer()
+            t.gpus = ([devices_per_proc[i]] if devices_per_proc
+                      and i < len(devices_per_proc) else [i])
+            t.endpoint = eps[i] if i < len(eps) else f"{ip}:617{i}"
+            t.rank = rank
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    cluster.pods[0].port = int(
+        cluster.pods[0].trainers[0].endpoint.split(":")[-1]
+    ) if cluster.pods[0].trainers else 6170
+    return cluster, cluster.pods[min(
+        node_ips.index(node_ip) if node_ip in node_ips else 0,
+        len(cluster.pods) - 1)]
+
+
+# --- group-sharded (ZeRO) user API (reference: distributed/sharding/) ------
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False):
+    """Wrap model+optimizer in ZeRO sharding (reference:
+    sharding/group_sharded.py group_sharded_parallel; levels os / os_g /
+    p_g_os = stages 1/2/3). On this stack sharding is a GSPMD param-spec:
+    shard_params installs the specs, the compiled step does the rest."""
+    from ..parallel.sharding import shard_params
+
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}.get(level)
+    if stage is None:
+        raise ValueError(
+            f"level must be one of os|os_g|p_g_os, got {level!r}"
+        )
+    shard_params(model, zero_stage=stage)
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference: sharding/group_sharded.py save_group_sharded_model."""
+    import paddle_tpu as paddle
+
+    if output.endswith((".pdparams", ".pdopt", ".pdmodel")):
+        raise ValueError(
+            "save_group_sharded_model expects a directory/prefix, got a "
+            f"file suffix: {output}"
+        )
+    os.makedirs(output, exist_ok=True)
+    paddle.save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        paddle.save(optimizer.state_dict(),
+                    os.path.join(output, "model.pdopt"))
+
+
+# --- program-pass framework (reference: distributed/passes/pass_base.py) ---
+_pass_registry = {}
+
+
+class PassContext:
+    """Carries pass inputs/outputs (reference: pass_base.py PassContext)."""
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassBase:
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def check_before_apply(self, main_program, startup_program, context):
+        return True
+
+    def apply(self, main_programs, startup_programs, context=None):
+        context = context or PassContext()
+        mains = main_programs if isinstance(main_programs, list) else [main_programs]
+        starts = (startup_programs if isinstance(startup_programs, list)
+                  else [startup_programs])
+        for m, s in zip(mains, starts):
+            self._apply_single_impl(m, s, context)
+        return context
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _pass_registry[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name, pass_attrs=None):
+    """Instantiate a registered pass (reference: pass_base.py new_pass)."""
+    if name not in _pass_registry:
+        raise ValueError(
+            f"no pass named {name!r}; registered: {sorted(_pass_registry)}"
+        )
+    p = _pass_registry[name]()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """Apply a list of passes in order (reference: pass_base.py
+    PassManager)."""
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+        self.context = PassContext()
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs, startup_programs):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self.context)
+        return self.context
+
+
+# --- local trainer process management (reference: distributed/utils.py
+# start_local_trainers/watch_local_trainers/terminate_local_procs) ----------
+def start_local_trainers(cluster, pod, training_script, training_script_args,
+                         log_dir=None, envs=None):
+    """Spawn one subprocess per trainer in `pod` with the PADDLE_* env
+    contract (reference: distributed/utils.py start_local_trainers)."""
+    import subprocess
+    import sys
+
+    current_env = dict(os.environ)
+    current_env.update(envs or {})
+    procs = []
+    for idx, t in enumerate(pod.trainers):
+        proc_env = {
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_CURRENT_ENDPOINT": str(t.endpoint),
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(cluster.trainers_endpoints()),
+        }
+        env = dict(current_env)
+        env.update(proc_env)
+        cmd = [sys.executable, "-u", training_script] + list(
+            training_script_args or []
+        )
+        fn = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+            proc = subprocess.Popen(cmd, env=env, stdout=fn, stderr=fn)
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        tp = TrainerProc()
+        tp.proc = proc
+        tp.rank = t.rank
+        tp.local_rank = idx
+        tp.log_fn = fn
+        tp.cmd = cmd
+        procs.append(tp)
+    return procs
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll trainer procs; raise if any failed, return alive list
+    (reference: distributed/utils.py watch_local_trainers)."""
+    alive = []
+    error = False
+    for p in procs:
+        ret = p.proc.poll()
+        if ret is None:
+            alive.append(p)
+        elif ret != 0:
+            error = True
+    if error:
+        terminate_local_procs(procs)
+        raise RuntimeError("ABORT!!! Out of all trainers, one failed")
+    return alive
+
+
+def terminate_local_procs(procs):
+    """Kill remaining trainer procs (reference: terminate_local_procs)."""
+    import time
+
+    for p in procs:
+        if p.proc.poll() is None:
+            p.proc.terminate()
+            if p.log_fn:
+                p.log_fn.close()
+    time.sleep(1)
+    for p in procs:
+        if p.proc.poll() is None:
+            p.proc.kill()
+
+
+def pull_worker_log(tp):
+    """Tail a trainer's log file to stdout (reference: pull_worker_log)."""
+    if tp.log_fn is None:
+        return
+    with open(tp.log_fn.name) as f:
+        f.seek(tp.log_offset or 0)
+        data = f.read()
+        tp.log_offset = f.tell()
+    if data:
+        print(data, end="")

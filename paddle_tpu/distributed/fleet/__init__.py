@@ -240,3 +240,119 @@ def barrier_worker():
     from ..collective import barrier
 
     barrier()
+
+
+class UtilBase:
+    """reference: fleet/base/util_factory.py UtilBase — cross-worker helper
+    collectives + fs access for user scripts."""
+
+    def __init__(self):
+        from .utils import LocalFS
+
+        self._fs = LocalFS()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ..collective import ReduceOp, all_reduce as _ar
+        from ...core.tensor import to_tensor
+
+        t = input if hasattr(input, "_value") else to_tensor(np.asarray(input))
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        out = _ar(t, op=op)
+        return out.numpy() if not hasattr(input, "_value") else out
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _b
+
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        from ...parallel.topology import get_mesh
+
+        mesh = get_mesh()
+        if mesh is None or mesh.devices.size == 1:
+            return [input]
+        from ..collective import all_gather as _ag
+        from ...core.tensor import to_tensor
+
+        out = []
+        _ag(out, to_tensor(np.asarray(input)))
+        return [o.numpy() for o in out]
+
+    def get_file_shard(self, files):
+        """Split a file list across workers (reference: UtilBase
+        get_file_shard)."""
+        import jax
+
+        n = jax.process_count()
+        rank = jax.process_index()
+        per = len(files) // n
+        rem = len(files) % n
+        start = rank * per + min(rank, rem)
+        end = start + per + (1 if rank < rem else 0)
+        return list(files)[start:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        import jax
+
+        if jax.process_index() == rank_id:
+            print(message)
+
+
+_util = UtilBase()
+
+
+class Fleet:
+    """Class form of the fleet facade (reference: fleet_base.py:206 Fleet).
+    The module-level functions (fleet.init etc.) are the canonical API;
+    this class binds them for scripts instantiating Fleet()."""
+
+    def __init__(self):
+        self.util = _util
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        return init(role_maker=role_maker, is_collective=is_collective,
+                    strategy=strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy=strategy)
+
+    def is_first_worker(self):
+        import jax
+
+        return jax.process_index() == 0
+
+    def worker_index(self):
+        import jax
+
+        return jax.process_index()
+
+    def worker_num(self):
+        import jax
+
+        return jax.process_count()
+
+    def is_worker(self):
+        return True
+
+    def barrier_worker(self):
+        self.util.barrier()
+
+    def stop_worker(self):
+        pass
+
+
+from .role_maker import Role  # noqa: E402,F401
+from .dataset import (  # noqa: E402,F401
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+from . import utils  # noqa: E402,F401
+from . import base  # noqa: E402,F401

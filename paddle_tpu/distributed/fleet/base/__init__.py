@@ -1,0 +1,2 @@
+"""paddle.distributed.fleet.base (reference package path)."""
+from . import topology  # noqa: F401

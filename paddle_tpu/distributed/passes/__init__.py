@@ -1,0 +1,16 @@
+"""paddle.distributed.passes — program-rewrite pass framework.
+
+Reference analogue: python/paddle/distributed/passes/ (pass_base.py +
+auto_parallel/ps passes). On this stack program rewriting is GSPMD's job;
+the framework is provided so pass-based reference workflows (auto_parallel
+custom passes, PS pass pipelines) can register and chain passes.
+"""
+from ..compat import (  # noqa: F401
+    PassBase,
+    PassContext,
+    PassManager,
+    new_pass,
+    register_pass,
+)
+
+__all__ = ["new_pass", "PassManager", "PassContext"]

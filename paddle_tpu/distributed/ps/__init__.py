@@ -238,3 +238,6 @@ class TheOnePSRuntime:
     def load_persistables(self, dirname: str):
         for name, t in self._tables.items():
             t.load(os.path.join(dirname, f"{name}.sparse"))
+
+from . import the_one_ps  # noqa: E402,F401
+from . import utils  # noqa: E402,F401

@@ -1,0 +1,85 @@
+"""paddle.distributed.ps.the_one_ps — PS table model (reference:
+python/paddle/distributed/ps/the_one_ps.py:816 TheOnePSRuntime + table
+class hierarchy). The working runtime/table live in this package's
+__init__ (C++ MemorySparseTable + TheOnePSRuntime); these classes carry
+the reference's table-proto configuration surface.
+"""
+from __future__ import annotations
+
+from . import MemorySparseTable, TheOnePSRuntime  # noqa: F401
+
+__all__ = [
+    "Table", "SparseTable", "GeoSparseTable", "DenseTable", "TensorTable",
+    "BarrierTable",
+]
+
+
+class Table:
+    """Base table config (reference: the_one_ps.py Table)."""
+
+    def __init__(self):
+        self.table_class = None
+        self.shard_num = -1
+        self.type = None
+        self.accessor = None
+        self.common = None
+        self.tensor = None
+
+    def _set(self, table_proto):
+        for k, v in self.__dict__.items():
+            if v is not None and hasattr(table_proto, k):
+                setattr(table_proto, k, v)
+
+
+class SparseTable(Table):
+    """reference: the_one_ps.py SparseTable (MemorySparseTable config)."""
+
+    def __init__(self, context=None, send_ctx=None):
+        super().__init__()
+        self.table_class = "MemorySparseTable"
+        self.type = "PS_SPARSE_TABLE"
+        self.context = context
+        self.send_ctx = send_ctx
+        self.shard_num = 32
+
+    def instantiate(self, emb_dim, **kwargs):
+        return MemorySparseTable(emb_dim, shard_num=self.shard_num, **kwargs)
+
+
+class GeoSparseTable(SparseTable):
+    """reference: the_one_ps.py GeoSparseTable (geo-async sparse)."""
+
+    def __init__(self, context=None, send_ctx=None):
+        super().__init__(context, send_ctx)
+        self.table_class = "MemorySparseGeoTable"
+
+
+class DenseTable(Table):
+    """reference: the_one_ps.py DenseTable."""
+
+    def __init__(self, context=None, send_ctx=None):
+        super().__init__()
+        self.table_class = "MemoryDenseTable"
+        self.type = "PS_DENSE_TABLE"
+        self.shard_num = 256
+
+
+class TensorTable(Table):
+    """reference: the_one_ps.py TensorTable."""
+
+    def __init__(self, idx=0, tensor_dict=None, role_maker=None):
+        super().__init__()
+        self.table_class = "TensorTable"
+        self.type = "PS_OTHER_TABLE"
+        self.idx = idx
+        self.tensor_dict = tensor_dict or {}
+
+
+class BarrierTable(Table):
+    """reference: the_one_ps.py BarrierTable (trainer sync)."""
+
+    def __init__(self, context=None, idx=0):
+        super().__init__()
+        self.table_class = "BarrierTable"
+        self.type = "PS_OTHER_TABLE"
+        self.idx = idx

@@ -1,0 +1,3 @@
+"""paddle.distributed.ps.utils (reference package path)."""
+from . import ps_factory  # noqa: F401
+from .ps_factory import PsProgramBuilderFactory  # noqa: F401

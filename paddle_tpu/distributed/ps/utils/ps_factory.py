@@ -1,0 +1,79 @@
+"""paddle.distributed.ps.utils.ps_factory — PS program-builder selection.
+
+Reference analogue: python/paddle/distributed/ps/utils/ps_factory.py — the
+builders rewrite static programs per PS mode (sync/async/geo/gpu/fl).
+Program rewriting is GSPMD/XLA's job here, so each builder carries the
+mode decision and compiles the attrs into the runtime config the
+TheOnePSRuntime consumes.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "PsProgramBuilder", "GeoPsProgramBuilder", "CpuSyncPsProgramBuilder",
+    "CpuAsyncPsProgramBuilder", "GpuPsProgramBuilder",
+    "HeterAsyncPsProgramBuilder", "FlPsProgramBuilder",
+    "PsProgramBuilderFactory",
+]
+
+
+class PsProgramBuilder:
+    mode = "sync"
+
+    def __init__(self, pass_ctx):
+        self.pass_ctx = pass_ctx
+        self.attrs = (pass_ctx.get_attr("attrs", {})
+                      if hasattr(pass_ctx, "get_attr") else dict(pass_ctx or {}))
+
+    def _build_trainer_programs(self):
+        pass
+
+    def _build_pserver_programs(self):
+        pass
+
+    def _build_programs(self):
+        self.attrs["ps_mode"] = self.mode
+        self._build_trainer_programs()
+        self._build_pserver_programs()
+        return self.attrs
+
+
+class CpuSyncPsProgramBuilder(PsProgramBuilder):
+    mode = "sync"
+
+
+class CpuAsyncPsProgramBuilder(PsProgramBuilder):
+    mode = "async"
+
+
+class GeoPsProgramBuilder(PsProgramBuilder):
+    mode = "geo"
+
+
+class GpuPsProgramBuilder(PsProgramBuilder):
+    mode = "gpups"
+
+
+class HeterAsyncPsProgramBuilder(PsProgramBuilder):
+    mode = "heter"
+
+
+class FlPsProgramBuilder(PsProgramBuilder):
+    mode = "fl"
+
+
+class PsProgramBuilderFactory:
+    """reference: ps_factory.py — pick the builder from the strategy."""
+
+    def _create_ps_program_builder(self, pass_ctx):
+        attrs = (pass_ctx.get_attr("attrs", {})
+                 if hasattr(pass_ctx, "get_attr") else dict(pass_ctx or {}))
+        mode = str(attrs.get("ps_mode", "sync")).lower()
+        cls = {
+            "sync": CpuSyncPsProgramBuilder,
+            "async": CpuAsyncPsProgramBuilder,
+            "geo": GeoPsProgramBuilder,
+            "gpups": GpuPsProgramBuilder,
+            "heter": HeterAsyncPsProgramBuilder,
+            "fl": FlPsProgramBuilder,
+        }.get(mode, CpuSyncPsProgramBuilder)
+        return cls(pass_ctx)

@@ -1,0 +1,10 @@
+"""paddle.distributed.sharding — group-sharded (ZeRO) user API.
+
+Reference analogue: python/paddle/distributed/sharding/group_sharded.py.
+"""
+from ..compat import (  # noqa: F401
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

@@ -1,0 +1,31 @@
+"""paddle.distributed.utils — launch-era cluster helpers + MoE collectives.
+
+Reference analogue: python/paddle/distributed/utils.py (Cluster/Pod/
+Trainer/JobServer models, get_cluster, port helpers, logger, and the MoE
+global_scatter/global_gather collectives).
+"""
+from .compat import (  # noqa: F401
+    Cluster,
+    pull_worker_log,
+    start_local_trainers,
+    terminate_local_procs,
+    watch_local_trainers,
+    Hdfs,
+    JobServer,
+    Pod,
+    Trainer,
+    TrainerProc,
+    add_arguments,
+    find_free_ports,
+    get_cluster,
+    get_host_name_ip,
+    get_logger,
+)
+from ..incubate.moe import global_gather, global_scatter  # noqa: F401
+
+__all__ = [
+    "get_host_name_ip", "Trainer", "get_cluster", "start_local_trainers",
+    "watch_local_trainers", "find_free_ports", "JobServer", "Cluster",
+    "Pod", "Hdfs", "add_arguments", "terminate_local_procs", "TrainerProc",
+    "get_logger", "pull_worker_log", "global_scatter", "global_gather",
+]

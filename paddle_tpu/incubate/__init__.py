@@ -14,3 +14,21 @@ from . import autograd  # noqa: F401
 # registers a global ForkingPickler reducer for Tensor as an import side
 # effect, which must stay opt-in (import paddle.incubate.multiprocessing),
 # matching the reference's explicit-import contract.
+from .ops_extra import (  # noqa: F401
+    LookAhead,
+    ModelAverage,
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    minimize_bfgs,
+    minimize_lbfgs,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from . import optimizer  # noqa: F401
+from . import operators  # noqa: F401

@@ -263,3 +263,39 @@ class MoELayer(Layer):
         )
         out = paddle.einsum("ech,tec->th", expert_out, combine)
         return out.reshape(orig_shape)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Variable-count MoE dispatch alltoall (reference:
+    distributed/utils.py:57 global_scatter over global_scatter_op.cu.cc).
+
+    This framework's MoE path dispatches with CAPACITY-PADDED alltoall
+    (static shapes — see MoELayer): ragged per-expert counts can't trace
+    under XLA. World size 1 is the degenerate identity; for >1 use
+    MoELayer / the padded alltoall primitive."""
+    from ..parallel.topology import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None or mesh.devices.size == 1:
+        return x.clone() if hasattr(x, "clone") else x
+    raise NotImplementedError(
+        "ragged global_scatter has no static-shape XLA lowering; use "
+        "incubate.moe.MoELayer (capacity-padded dispatch) or "
+        "distributed.alltoall on equal splits"
+    )
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (reference: distributed/utils.py:179)."""
+    from ..parallel.topology import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None or mesh.devices.size == 1:
+        return x.clone() if hasattr(x, "clone") else x
+    raise NotImplementedError(
+        "ragged global_gather has no static-shape XLA lowering; use "
+        "incubate.moe.MoELayer (capacity-padded combine) or "
+        "distributed.alltoall on equal splits"
+    )

@@ -85,3 +85,86 @@ class FusedTransformerEncoderLayer(nn.Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, src_mask))
+
+
+# functional forms (reference: incubate/nn/functional/ fused_multi_head_
+# attention / fused_feedforward over the fused CUDA ops) — one traced
+# segment each; XLA fuses the chain.
+def fused_multi_head_attention(
+    x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+    pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+    qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+    dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+    mode="upscale_in_train", ring_id=-1, add_residual=True, name=None,
+):
+    """reference: incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention. qkv_weight: [3, H, D/H, D]."""
+    import paddle_tpu as paddle
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    three, heads, hdim, d = (int(s) for s in qkv_weight.shape)
+    w = qkv_weight.reshape([3 * heads * hdim, d])
+    qkv = paddle.matmul(x, w, transpose_y=True)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape([-1])
+    b, s = x.shape[0], x.shape[1]
+    qkv = qkv.reshape([b, s, 3, heads, hdim])
+    q, k, v = qkv.unstack(axis=2)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0, training=training,
+    )
+    out = out.reshape([b, s, heads * hdim])
+    out = paddle.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(
+    x, linear1_weight, linear2_weight, linear1_bias=None, linear2_bias=None,
+    ln1_scale=None, ln1_bias=None, ln2_scale=None, ln2_bias=None,
+    dropout1_rate=0.5, dropout2_rate=0.5, activation="relu",
+    ln1_epsilon=1e-5, ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+    mode="upscale_in_train", ring_id=-1, add_residual=True, name=None,
+):
+    """reference: incubate/nn/functional/fused_transformer.py
+    fused_feedforward."""
+    import paddle_tpu as paddle
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = paddle.matmul(x, linear1_weight)
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = paddle.matmul(h, linear2_weight)
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = residual + h
+    if not pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return h
+
+
+class _FunctionalNS:
+    fused_multi_head_attention = staticmethod(fused_multi_head_attention)
+    fused_feedforward = staticmethod(fused_feedforward)
+
+
+functional = _FunctionalNS()

@@ -1,0 +1,2 @@
+"""paddle.incubate.operators (reference: incubate/operators/)."""
+from .resnet_unit import ResNetUnit, resnet_unit  # noqa: F401

@@ -1,0 +1,81 @@
+"""paddle.incubate.operators.resnet_unit — the fused conv+BN(+add)+relu
+block (reference: incubate/operators/resnet_unit.py over the
+resnet_unit_op cuDNN-fusion kernel). XLA fuses the same chain from the
+unfused graph, so the layer composes Conv2D+BatchNorm and lets the
+compiler do the fusion the CUDA op hand-codes.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["ResNetUnit", "resnet_unit"]
+
+
+def resnet_unit(x, filter_x, scale_x, bias_x, mean_x, var_x, z=None,
+                filter_z=None, scale_z=None, bias_z=None, mean_z=None,
+                var_z=None, stride=1, stride_z=1, padding=0, dilation=1,
+                groups=1, momentum=0.9, eps=1e-5, data_format="NHWC",
+                fuse_add=False, has_shortcut=False, use_global_stats=False,
+                is_test=False, act="relu"):
+    """Functional fused unit: conv(x)+BN [+ conv(z)+BN or z] -> act."""
+    fmt = "NHWC" if data_format == "NHWC" else "NCHW"
+    out = F.conv2d(x, filter_x, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=fmt)
+    out = F.batch_norm(out, mean_x, var_x, scale_x, bias_x,
+                       training=not (is_test or use_global_stats),
+                       momentum=momentum, epsilon=eps, data_format=fmt)
+    if fuse_add or has_shortcut:
+        if has_shortcut and filter_z is not None:
+            z = F.conv2d(z, filter_z, stride=stride_z, padding=0,
+                         data_format=fmt)
+            z = F.batch_norm(z, mean_z, var_z, scale_z, bias_z,
+                             training=not (is_test or use_global_stats),
+                             momentum=momentum, epsilon=eps, data_format=fmt)
+        out = out + z
+    if act == "relu":
+        out = F.relu(out)
+    return out
+
+
+class ResNetUnit(nn.Layer):
+    """reference: incubate/operators/resnet_unit.py ResNetUnit layer."""
+
+    def __init__(self, num_channels_x, num_filters, filter_size, stride=1,
+                 momentum=0.9, eps=1e-5, data_format="NHWC", act="relu",
+                 fuse_add=False, has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter_x_attr=None, scale_x_attr=None,
+                 bias_x_attr=None, moving_mean_x_name=None,
+                 moving_var_x_name=None, num_channels_z=1, stride_z=1,
+                 filter_z_attr=None, scale_z_attr=None, bias_z_attr=None,
+                 moving_mean_z_name=None, moving_var_z_name=None):
+        super().__init__()
+        self._fuse_add = fuse_add
+        self._has_shortcut = has_shortcut
+        self._act = act
+        self._data_format = data_format
+        fmt = data_format
+        self.conv_x = nn.Conv2D(num_channels_x, num_filters, filter_size,
+                                stride=stride, padding=(filter_size - 1) // 2,
+                                weight_attr=filter_x_attr, bias_attr=False,
+                                data_format=fmt)
+        self.bn_x = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                   epsilon=eps, weight_attr=scale_x_attr,
+                                   bias_attr=bias_x_attr, data_format=fmt)
+        if has_shortcut:
+            self.conv_z = nn.Conv2D(num_channels_z, num_filters, 1,
+                                    stride=stride_z, weight_attr=filter_z_attr,
+                                    bias_attr=False, data_format=fmt)
+            self.bn_z = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                       epsilon=eps, weight_attr=scale_z_attr,
+                                       bias_attr=bias_z_attr, data_format=fmt)
+
+    def forward(self, x, z=None):
+        out = self.bn_x(self.conv_x(x))
+        if self._fuse_add or self._has_shortcut:
+            if self._has_shortcut:
+                z = self.bn_z(self.conv_z(z))
+            out = out + z
+        if self._act == "relu":
+            out = F.relu(out)
+        return out

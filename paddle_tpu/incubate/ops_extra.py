@@ -1,0 +1,461 @@
+"""paddle.incubate top-level ops: segment reductions, graph sampling ops,
+fused softmax-mask kernels, meta-optimizers, and L-BFGS/BFGS minimizers.
+
+Reference analogue: python/paddle/incubate/__init__.py re-exports
+(tensor/math.py segment_*, operators/graph_*.py, operators/
+softmax_mask_fuse*.py, incubate/optimizer/{lookahead,modelaverage}.py,
+incubate/optimizer/functional/{bfgs,lbfgs}.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.dispatch import apply, no_grad
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+    "graph_sample_neighbors", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage",
+    "minimize_bfgs", "minimize_lbfgs",
+]
+
+
+# --- segment reductions (reference: tensor/math.py segment_* over
+# phi segment_pool kernels; ids must be sorted ascending) -------------------
+def _segment(x, segment_ids, kind):
+    import jax
+
+    n = int(np.asarray(segment_ids.numpy()).max()) + 1 if segment_ids.size else 0
+
+    def f(v, ids, *, num, kind):
+        import jax.numpy as jnp
+
+        if kind == "sum":
+            return jax.ops.segment_sum(v, ids, num_segments=num)
+        if kind == "mean":
+            s = jax.ops.segment_sum(v, ids, num_segments=num)
+            c = jax.ops.segment_sum(jnp.ones_like(ids, v.dtype), ids,
+                                    num_segments=num)
+            shape = (-1,) + (1,) * (v.ndim - 1)
+            return s / jnp.maximum(c, 1).reshape(shape)
+        if kind == "min":
+            return jax.ops.segment_min(v, ids, num_segments=num)
+        return jax.ops.segment_max(v, ids, num_segments=num)
+
+    return apply(f, x, segment_ids, num=n, kind=kind,
+                 op_name=f"segment_{kind}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+# --- graph ops (reference: incubate/operators/graph_*.py) ------------------
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather rows at src, scatter-reduce to dst (reference:
+    operators/graph_send_recv_op.h — the message-passing primitive)."""
+    import jax
+
+    n = int(out_size) if out_size else int(x.shape[0])
+
+    def f(v, src, dst, *, num, kind):
+        import jax.numpy as jnp
+
+        msgs = v[src]
+        if kind == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=num)
+        if kind == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+            c = jax.ops.segment_sum(jnp.ones_like(dst, v.dtype), dst,
+                                    num_segments=num)
+            shape = (-1,) + (1,) * (v.ndim - 1)
+            return s / jnp.maximum(c, 1).reshape(shape)
+        if kind == "min":
+            return jax.ops.segment_min(msgs, dst, num_segments=num)
+        return jax.ops.segment_max(msgs, dst, num_segments=num)
+
+    return apply(f, x, src_index, dst_index, num=n, kind=pool_type.lower(),
+                 op_name="graph_send_recv")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """Sample up to sample_size neighbors per input node from a CSC graph
+    (reference: operators/graph_sample_neighbors_op.cu). Host-side op:
+    neighbor counts are data-dependent."""
+    row_np = np.asarray(row.numpy()).reshape(-1)
+    colptr_np = np.asarray(colptr.numpy()).reshape(-1)
+    nodes = np.asarray(input_nodes.numpy()).reshape(-1)
+    rng = np.random.default_rng(0)
+    out_neighbors, out_counts, out_eids = [], [], []
+    eids_np = None if eids is None else np.asarray(eids.numpy()).reshape(-1)
+    for nid in nodes:
+        s, e = int(colptr_np[nid]), int(colptr_np[nid + 1])
+        neigh = row_np[s:e]
+        ids = np.arange(s, e)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.permutation(len(neigh))[:sample_size]
+            neigh = neigh[pick]
+            ids = ids[pick]
+        out_neighbors.append(neigh)
+        out_counts.append(len(neigh))
+        if eids_np is not None:
+            out_eids.append(eids_np[ids])
+    out_n = to_tensor(np.concatenate(out_neighbors) if out_neighbors
+                      else np.zeros(0, row_np.dtype))
+    out_c = to_tensor(np.asarray(out_counts, np.int64))
+    if return_eids:
+        oe = to_tensor(np.concatenate(out_eids) if out_eids
+                       else np.zeros(0, np.int64))
+        return out_n, out_c, oe
+    return out_n, out_c
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to local ids (reference:
+    operators/graph_reindex_op.cu)."""
+    x_np = np.asarray(x.numpy()).reshape(-1)
+    nb = np.asarray(neighbors.numpy()).reshape(-1)
+    cnt = np.asarray(count.numpy()).reshape(-1)
+    order = {}
+    for v in x_np:
+        order.setdefault(int(v), len(order))
+    for v in nb:
+        order.setdefault(int(v), len(order))
+    remap = np.array([order[int(v)] for v in nb], np.int64)
+    # dst index: input node i repeated count[i] times
+    dst = np.repeat(np.arange(len(x_np), dtype=np.int64), cnt)
+    nodes = np.array(sorted(order, key=order.get), np.int64)
+    return to_tensor(remap), to_tensor(dst), to_tensor(nodes)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference:
+    operators/graph_khop_sampler_op.cu): chained sampling with a global
+    reindex. Returns (edge_src, edge_dst, sample_index, reindex_x)."""
+    cur = input_nodes
+    frontiers, all_neighbors, all_counts = [], [], []
+    for size in sample_sizes:
+        frontiers.append(np.asarray(cur.numpy()).reshape(-1))
+        nb, cnt = graph_sample_neighbors(row, colptr, cur, sample_size=size)
+        all_neighbors.append(np.asarray(nb.numpy()).reshape(-1))
+        all_counts.append(np.asarray(cnt.numpy()).reshape(-1))
+        cur = nb
+    src_nodes = np.concatenate(frontiers)        # aligned with counts
+    neighbors = np.concatenate(all_neighbors)
+    counts = np.concatenate(all_counts)
+    order = {}
+    for v in np.asarray(input_nodes.numpy()).reshape(-1):
+        order.setdefault(int(v), len(order))
+    for v in np.concatenate([src_nodes, neighbors]):
+        order.setdefault(int(v), len(order))
+    edge_src = np.array([order[int(v)] for v in neighbors], np.int64)
+    edge_dst = np.repeat(
+        np.array([order[int(v)] for v in src_nodes], np.int64), counts
+    )
+    nodes = np.array(sorted(order, key=order.get), np.int64)
+    reindex_x = np.array(
+        [order[int(v)] for v in np.asarray(input_nodes.numpy()).reshape(-1)],
+        np.int64,
+    )
+    return (to_tensor(edge_src), to_tensor(edge_dst), to_tensor(nodes),
+            to_tensor(reindex_x))
+
+
+# --- fused mask softmaxes (reference: operators/softmax_mask_fuse_op.cu) ---
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference fused transformer attention
+    mask-add; XLA fuses the add into the softmax)."""
+
+    def f(v, m):
+        import jax
+
+        return jax.nn.softmax(v + m, axis=-1)
+
+    return apply(f, x, mask, op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal (upper-triangle masked) pattern fused
+    (reference: softmax_mask_fuse_upper_triangle_op.cu)."""
+
+    def f(v):
+        import jax
+        import jax.numpy as jnp
+
+        s = v.shape[-1]
+        causal = jnp.tril(jnp.ones((v.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(causal, v, -1e9), axis=-1)
+
+    return apply(f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+# --- meta-optimizers (reference: incubate/optimizer/lookahead.py,
+# modelaverage.py) ----------------------------------------------------------
+class LookAhead:
+    """k-step lookahead wrapper: slow weights interpolate toward fast
+    weights every k steps (reference: lookahead.py LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameters
+
+    def step(self):
+        params = self.inner_optimizer._parameters
+        if self._slow is None:
+            self._slow = [np.asarray(p.numpy()) for p in params]
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            with no_grad():
+                for i, p in enumerate(params):
+                    slow = self._slow[i] + self.alpha * (
+                        np.asarray(p.numpy()) - self._slow[i]
+                    )
+                    self._slow[i] = slow
+                    p.set_value(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step
+        return sd
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window (reference:
+    modelaverage.py ModelAverage; apply()/restore() swap averages in)."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000000000, name=None):
+        self._params = list(parameters or [])
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sum = [np.zeros_like(np.asarray(p.numpy())) for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._count += 1
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + np.asarray(p.numpy())
+        # sliding window restart (reference num_accumulates logic)
+        if self._count >= self.max_w or (
+            self._count >= self.min_w
+            and self._count >= self.rate * self.max_w
+        ):
+            for i in range(len(self._sum)):
+                self._sum[i] = np.asarray(self._params[i].numpy())
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._backup = [np.asarray(p.numpy()) for p in self._params]
+            with no_grad():
+                for i, p in enumerate(self._params):
+                    p.set_value(self._sum[i] / max(self._count, 1))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            with no_grad():
+                for p, b in zip(self._params, self._backup):
+                    p.set_value(b)
+            self._backup = None
+
+
+# --- second-order minimizers (reference: incubate/optimizer/functional/
+# bfgs.py, lbfgs.py) --------------------------------------------------------
+def _line_search(f, xk, pk, g, f0, max_iters=20):
+    """Backtracking Armijo line search on host scalars."""
+    alpha, c1, rho = 1.0, 1e-4, 0.5
+    slope = float((g * pk).sum())
+    for _ in range(max_iters):
+        fx = float(f(xk + alpha * pk))
+        if fx <= f0 + c1 * alpha * slope:
+            break
+        alpha *= rho
+    return alpha
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn="strong_wolfe",
+                  dtype="float32", name=None):
+    """BFGS minimization (reference: functional/bfgs.py minimize_bfgs).
+    Returns (is_converge, num_iters, position, objective_value,
+    objective_gradient, inverse_hessian_estimate)."""
+    from ..autograd import grad as _grad
+
+    x = initial_position.detach().clone()
+    n = int(np.prod(x.shape))
+    H = (np.eye(n, dtype=np.float64)
+         if initial_inverse_hessian_estimate is None
+         else np.asarray(initial_inverse_hessian_estimate.numpy(), np.float64))
+
+    def fval(v):
+        return objective_func(v)
+
+    def gval(v):
+        vv = v.detach().clone()
+        vv.stop_gradient = False
+        out = objective_func(vv)
+        (g,) = _grad(out, [vv])
+        return np.asarray(g.numpy(), np.float64).reshape(-1)
+
+    xk = np.asarray(x.numpy(), np.float64).reshape(-1)
+    converged = False
+    k = 0
+    g = gval(to_tensor(xk.reshape(x.shape).astype(np.float64)))
+    for k in range(1, max_iters + 1):
+        if np.linalg.norm(g, np.inf) < tolerance_grad:
+            converged = True
+            break
+        p = -H @ g
+        f0 = float(fval(to_tensor(xk.reshape(x.shape))))
+        alpha = _line_search(
+            lambda v: fval(to_tensor(np.asarray(v).reshape(x.shape))),
+            xk, p, g, f0,
+        )
+        s = alpha * p
+        if np.linalg.norm(s) < tolerance_change:
+            converged = True
+            break
+        x_new = xk + s
+        g_new = gval(to_tensor(x_new.reshape(x.shape)))
+        y = g_new - g
+        sy = float(s @ y)
+        if sy > 1e-10:
+            rho_ = 1.0 / sy
+            I = np.eye(n)
+            H = (I - rho_ * np.outer(s, y)) @ H @ (I - rho_ * np.outer(y, s)) \
+                + rho_ * np.outer(s, s)
+        xk, g = x_new, g_new
+    pos = to_tensor(xk.reshape(x.shape).astype(np.float64)).astype(dtype)
+    return (
+        to_tensor(np.asarray(converged)),
+        to_tensor(np.int64(k)),
+        pos,
+        fval(pos),
+        to_tensor(g.astype(np.float64)).astype(dtype).reshape(x.shape),
+        to_tensor(H.astype(np.float64)).astype(dtype),
+    )
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", dtype="float32", name=None):
+    """L-BFGS minimization (reference: functional/lbfgs.py minimize_lbfgs).
+    Returns (is_converge, num_iters, position, objective_value,
+    objective_gradient)."""
+    from ..autograd import grad as _grad
+
+    x = initial_position.detach().clone()
+
+    def fval(v):
+        return objective_func(v)
+
+    def gval(v):
+        vv = v.detach().clone()
+        vv.stop_gradient = False
+        out = objective_func(vv)
+        (g,) = _grad(out, [vv])
+        return np.asarray(g.numpy(), np.float64).reshape(-1)
+
+    xk = np.asarray(x.numpy(), np.float64).reshape(-1)
+    s_hist, y_hist = [], []
+    g = gval(to_tensor(xk.reshape(x.shape)))
+    converged = False
+    k = 0
+    for k in range(1, max_iters + 1):
+        if np.linalg.norm(g, np.inf) < tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = g.copy()
+        alphas = []
+        for s, y in reversed(list(zip(s_hist, y_hist))):
+            rho_ = 1.0 / max(float(s @ y), 1e-10)
+            a = rho_ * float(s @ q)
+            alphas.append((a, rho_))
+            q -= a * y
+        if y_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            q *= float(s @ y) / max(float(y @ y), 1e-10)
+        for (a, rho_), (s, y) in zip(reversed(alphas), zip(s_hist, y_hist)):
+            b = rho_ * float(y @ q)
+            q += (a - b) * s
+        p = -q
+        f0 = float(fval(to_tensor(xk.reshape(x.shape))))
+        alpha = _line_search(
+            lambda v: fval(to_tensor(np.asarray(v).reshape(x.shape))),
+            xk, p, g, f0,
+        )
+        s = alpha * p
+        if np.linalg.norm(s) < tolerance_change:
+            converged = True
+            break
+        x_new = xk + s
+        g_new = gval(to_tensor(x_new.reshape(x.shape)))
+        s_hist.append(s)
+        y_hist.append(g_new - g)
+        if len(s_hist) > history_size:
+            s_hist.pop(0)
+            y_hist.pop(0)
+        xk, g = x_new, g_new
+    pos = to_tensor(xk.reshape(x.shape)).astype(dtype)
+    return (
+        to_tensor(np.asarray(converged)),
+        to_tensor(np.int64(k)),
+        pos,
+        fval(pos),
+        to_tensor(g).astype(dtype).reshape(x.shape),
+    )

@@ -1,0 +1,4 @@
+"""paddle.incubate.optimizer — LookAhead/ModelAverage + functional
+minimizers (reference: python/paddle/incubate/optimizer/)."""
+from ..ops_extra import LookAhead, ModelAverage  # noqa: F401
+from . import functional  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.incubate.optimizer.functional — BFGS / L-BFGS minimizers
+(reference: python/paddle/incubate/optimizer/functional/)."""
+from ..ops_extra import minimize_bfgs, minimize_lbfgs  # noqa: F401
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

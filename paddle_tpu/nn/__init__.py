@@ -4,6 +4,7 @@ Reference analogue: python/paddle/nn/ (25.2k LoC).
 """
 from . import functional  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer_base import Layer, Parameter  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401

@@ -92,8 +92,17 @@ class Program:
         self._compiled_cache: Dict = {}
 
     def set_builder(self, fn: Callable):
-        """Register the callable(feed_dict)->fetches that defines this program."""
-        self.builder = fn
+        """Register the callable(feed_dict)->fetches that defines this program.
+
+        Each invocation resets the unnamed-layer call sequence so static.nn
+        layer fns resolve to the SAME parameters every run (build-once)."""
+
+        def wrapped(feed):
+            self._call_seq = {}
+            return fn(feed)
+
+        wrapped.__wrapped__ = fn
+        self.builder = wrapped
         return self
 
     def global_block(self):
@@ -102,23 +111,30 @@ class Program:
     def all_parameters(self):
         """Parameters created by static.nn layer fns under this program
         (reference: Program.all_parameters over persistable vars)."""
+        def slug(key):
+            # full call-site key -> stable, collision-free checkpoint name
+            return "_".join(
+                str(k).replace(" ", "") for k in key
+            ).replace("#call_", "c")
+
         out = []
         for key, obj in getattr(self, "_static_layers", {}).items():
             layers = obj if isinstance(obj, (list, tuple)) else [obj]
+            base = slug(key)
             for li, layer in enumerate(layers):
                 if hasattr(layer, "named_parameters"):
                     for pname, p in layer.named_parameters():
-                        # stable checkpoint name derived from the call-site
-                        # key (auto-generated param_N names vary per process)
-                        p.name = f"{key[0]}_{li}.{pname}"
+                        # derived from the FULL key (auto param_N names vary
+                        # per process; key[0] alone can collide)
+                        p.name = f"{base}_{li}.{pname}"
                         out.append(p)
                 elif hasattr(layer, "_value"):  # bare Parameter
-                    layer.name = f"{key[0]}_{li}"
+                    layer.name = f"{base}_{li}"
                     out.append(layer)
                 elif isinstance(layer, dict):  # state dicts (data_norm)
                     for k, v in layer.items():
                         if hasattr(v, "_value"):
-                            v.name = f"{key[0]}.{k}"
+                            v.name = f"{base}.{k}"
                             out.append(v)
         return out
 
@@ -222,7 +238,10 @@ class Executor:
 
             def pure(*feed_vals):
                 d = {k: Tensor(v, stop_gradient=True) for k, v in zip(names, feed_vals)}
-                with no_grad():
+                # guard THIS program as default while tracing: static.nn
+                # layer caches must resolve against it, not whatever
+                # program happens to be default at trace time
+                with program_guard(program), no_grad():
                     out = builder(d)
                 if isinstance(out, (list, tuple)):
                     return tuple(

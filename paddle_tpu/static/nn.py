@@ -32,14 +32,27 @@ __all__ = [
 _param_registry = {}
 
 
-def _layer_cache(key, builder):
-    """Build-once parameter holder keyed by (program id, call-site key)."""
+def _layer_cache(key, builder, named=True):
+    """Build-once parameter holder keyed by the call-site key.
+
+    Unnamed calls additionally key on their call-sequence index within the
+    current builder invocation (reset by Program's builder wrapper), so two
+    same-shape unnamed layers get independent parameters — matching the
+    reference, where every fc() call creates fresh parameters unless a
+    shared param name is given."""
     from . import default_main_program
 
     prog = default_main_program()
     cache = getattr(prog, "_static_layers", None)
     if cache is None:
         cache = prog._static_layers = {}
+    if not named:
+        seq = getattr(prog, "_call_seq", None)
+        if seq is None:
+            seq = prog._call_seq = {}
+        idx = seq.get(key, 0)
+        seq[key] = idx + 1
+        key = key + ("#call", idx)
     if key not in cache:
         cache[key] = builder()
     return cache[key]
@@ -64,7 +77,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
             for i, t in enumerate(flat)
         ]
 
-    layers = _layer_cache(key, build)
+    layers = _layer_cache(key, build, named=name is not None)
     out = layers[0](flat[0])
     for layer, t in zip(layers[1:], flat[1:]):
         out = out + layer(t)
@@ -81,7 +94,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         key, lambda: paddle.nn.Embedding(size[0], size[1],
                                          padding_idx=padding_idx,
                                          weight_attr=param_attr),
-    )
+    named=False)
     return layer(input)
 
 
@@ -95,7 +108,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
             int(cin), num_filters, filter_size, stride=stride, padding=padding,
             dilation=dilation, groups=groups, weight_attr=param_attr,
             bias_attr=bias_attr, data_format=data_format),
-    )
+    named=name is not None)
     out = layer(input)
     return getattr(paddle.nn.functional, act)(out) if act else out
 
@@ -110,7 +123,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
             int(cin), num_filters, filter_size, stride=stride, padding=padding,
             dilation=dilation, groups=groups, weight_attr=param_attr,
             bias_attr=bias_attr, data_format=data_format),
-    )
+    named=name is not None)
     out = layer(input)
     return getattr(paddle.nn.functional, act)(out) if act else out
 
@@ -126,7 +139,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
             int(cin), num_filters, filter_size, stride=stride, padding=padding,
             dilation=dilation, groups=groups, weight_attr=param_attr,
             bias_attr=bias_attr, data_format=data_format),
-    )
+    named=name is not None)
     out = layer(input, output_size=output_size)
     return getattr(paddle.nn.functional, act)(out) if act else out
 
@@ -142,7 +155,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
             int(cin), num_filters, filter_size, stride=stride, padding=padding,
             dilation=dilation, groups=groups, weight_attr=param_attr,
             bias_attr=bias_attr, data_format=data_format),
-    )
+    named=name is not None)
     out = layer(input, output_size=output_size)
     return getattr(paddle.nn.functional, act)(out) if act else out
 
@@ -159,7 +172,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
             int(c), momentum=momentum, epsilon=epsilon,
             param_attr=param_attr, bias_attr=bias_attr,
             data_layout=data_layout),
-    )
+    named=name is not None)
     layer.training = not is_test and not use_global_stats
     out = layer(input)
     return getattr(paddle.nn.functional, act)(out) if act else out
@@ -174,7 +187,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
         key, lambda: paddle.nn.LayerNorm(shape, epsilon=epsilon,
                                          weight_attr=param_attr if scale else False,
                                          bias_attr=bias_attr if shift else False),
-    )
+    named=name is not None)
     out = layer(input)
     return getattr(paddle.nn.functional, act)(out) if act else out
 
@@ -187,7 +200,7 @@ def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
         lambda: paddle.nn.InstanceNorm2D(c, epsilon=epsilon,
                                          weight_attr=param_attr,
                                          bias_attr=bias_attr),
-    )
+    named=False)
     return layer(input)
 
 
@@ -199,7 +212,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
         lambda: paddle.nn.GroupNorm(groups, c, epsilon=epsilon,
                                     weight_attr=param_attr,
                                     bias_attr=bias_attr),
-    )
+    named=name is not None)
     return layer(input)
 
 
@@ -220,14 +233,21 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
         }
         return state
 
-    state = _layer_cache((name or "data_norm", c), build)
+    state = _layer_cache((name or "data_norm", c), build, named=name is not None)
     bsz = input.shape[0]
-    with paddle.no_grad():
-        state["size"].set_value(state["size"] + float(bsz))
-        state["sum"].set_value(state["sum"] + input.sum(axis=0).detach())
-        state["square_sum"].set_value(
-            state["square_sum"] + (input * input).sum(axis=0).detach()
-        )
+    import jax.core as _jcore
+
+    tracing = isinstance(getattr(input, "_value", None), _jcore.Tracer)
+    if not tracing:
+        # running-stat accumulation is a host-side mutation; under a jit
+        # trace (Executor's compiled path) the stats freeze at their
+        # warm-run values — the traced program must stay pure
+        with paddle.no_grad():
+            state["size"].set_value(state["size"] + float(bsz))
+            state["sum"].set_value(state["sum"] + input.sum(axis=0).detach())
+            state["square_sum"].set_value(
+                state["square_sum"] + (input * input).sum(axis=0).detach()
+            )
     mean = state["sum"] / state["size"]
     var = state["square_sum"] / state["size"] - mean * mean
     out = (input - mean) / paddle.sqrt(var.clip(min=epsilon))
@@ -239,7 +259,7 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
         (name or "spectral_norm", tuple(weight.shape), dim),
         lambda: paddle.nn.SpectralNorm(weight.shape, dim=dim,
                                        power_iters=power_iters, eps=eps),
-    )
+    named=False)
     return layer(weight)
 
 
@@ -253,7 +273,7 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     layer = _layer_cache(
         (name or "prelu", mode, num),
         lambda: paddle.nn.PReLU(num_parameters=num, weight_attr=param_attr),
-    )
+    named=False)
     return layer(x)
 
 
@@ -271,7 +291,7 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
                              deformable_groups=deformable_groups,
                              groups=groups, weight_attr=param_attr,
                              bias_attr=bias_attr),
-    )
+    named=name is not None)
     return layer(x, offset, mask)
 
 
@@ -282,7 +302,7 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
         lambda: paddle.nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
                                    weight_attr=param_attr,
                                    bias_attr=bias_attr),
-    )
+    named=False)
     out = layer(x, y)
     return getattr(paddle.nn.functional, act)(out) if act else out
 
@@ -295,7 +315,7 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     layer = _layer_cache(
         ("row_conv", k, d),
         lambda: paddle.create_parameter([k, d], "float32"),
-    )
+    named=False)
     import jax.numpy as jnp
 
     from ..core.dispatch import apply
@@ -327,7 +347,7 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
                                     is_bias=True)
         return (w, b)
 
-    w, b = _layer_cache(("nce", num_total_classes, d), build)
+    w, b = _layer_cache(("nce", num_total_classes, d), build, named=False)
     bsz = input.shape[0]
     import jax as _jax
 
@@ -364,7 +384,7 @@ def crf_decoding(input, param_attr=None, label=None, length=None):
     trans = _layer_cache(
         ("crf_decoding", n_tags),
         lambda: paddle.create_parameter([n_tags + 2, n_tags], "float32"),
-    )
+    named=False)
     # reference layout: rows 0/1 are start/stop, rest tag-to-tag
     if length is None:
         length = paddle.to_tensor(
@@ -616,7 +636,7 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
         lambda: paddle.nn.Conv1D(d, num_filters, filter_size,
                                  padding=(filter_size - 1) // 2 if padding else 0,
                                  weight_attr=param_attr, bias_attr=bias_attr),
-    )
+    named=False)
     out = layer(input.transpose([0, 2, 1])).transpose([0, 2, 1])
     return getattr(paddle.nn.functional, act)(out) if act else out
 

@@ -1,0 +1,272 @@
+"""Tests for the distributed/incubate surface completion (reference:
+python/paddle/distributed/__init__.py, distributed/utils.py,
+distributed/sharding/, distributed/passes/, incubate/__init__.py)."""
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.incubate as I
+
+rng = np.random.default_rng(9)
+
+
+class TestSegmentAndGraphOps:
+    def test_segment_ops(self):
+        x = paddle.to_tensor(
+            np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0], [7.0, 8.0]],
+                     np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 2]))
+        np.testing.assert_allclose(I.segment_sum(x, ids).numpy(),
+                                   [[4, 6], [5, 6], [7, 8]])
+        np.testing.assert_allclose(I.segment_mean(x, ids).numpy(),
+                                   [[2, 3], [5, 6], [7, 8]])
+        np.testing.assert_allclose(I.segment_min(x, ids).numpy(),
+                                   [[1, 2], [5, 6], [7, 8]])
+        np.testing.assert_allclose(I.segment_max(x, ids).numpy(),
+                                   [[3, 4], [5, 6], [7, 8]])
+
+    def test_segment_sum_grad(self):
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        x.stop_gradient = False
+        I.segment_sum(x, paddle.to_tensor(np.array([0, 1, 1, 0]))).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 2)))
+
+    def test_graph_send_recv(self):
+        x = paddle.to_tensor(
+            np.array([[1.0], [2.0], [3.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = I.graph_send_recv(x, src, dst, "sum", out_size=3).numpy()
+        np.testing.assert_allclose(out, [[1.0], [4.0], [2.0]])
+
+    def test_graph_sampling_chain(self):
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5]))
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 1]))
+        nb, cnt = I.graph_sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0, 2])), sample_size=-1)
+        assert cnt.numpy().tolist() == [2, 2]
+        nb1, cnt1 = I.graph_sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0])), sample_size=1)
+        assert cnt1.numpy().tolist() == [1]
+        es, ed, nodes, rx = I.graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.array([0])), [2, 2])
+        assert len(es.numpy()) == len(ed.numpy())
+        assert rx.numpy().tolist() == [0]
+
+    def test_softmax_mask_fuse(self):
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 2, 4, 4)).astype(np.float32))
+        m = paddle.to_tensor(
+            rng.standard_normal((2, 1, 4, 4)).astype(np.float32))
+        np.testing.assert_allclose(
+            I.softmax_mask_fuse(x, m).numpy(),
+            torch.softmax(torch.tensor(x.numpy() + m.numpy()), -1).numpy(),
+            rtol=1e-5)
+        ut = I.softmax_mask_fuse_upper_triangle(x).numpy()
+        np.testing.assert_allclose(ut[0, 0, 0, 1:], 0, atol=1e-7)
+
+
+class TestMetaOptimizers:
+    def test_lookahead_trains(self):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 1)
+        opt = I.LookAhead(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()),
+            alpha=0.5, k=2)
+        x = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+        y = paddle.to_tensor(x.numpy() @ np.ones((4, 1), np.float32))
+        first = None
+        for _ in range(20):
+            loss = ((net(x) - y) ** 2).mean()
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.2
+
+    def test_model_average(self):
+        net = paddle.nn.Linear(2, 2)
+        ma = I.ModelAverage(0.15, parameters=net.parameters())
+        w0 = net.weight.numpy().copy()
+        net.weight.set_value(w0 + 2.0)
+        ma.step()
+        net.weight.set_value(w0 + 4.0)
+        ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(net.weight.numpy(), w0 + 3.0, rtol=1e-6)
+        np.testing.assert_allclose(net.weight.numpy(), w0 + 4.0)
+
+    def test_bfgs_lbfgs_quadratic(self):
+        target = paddle.to_tensor(np.array([1.0, -2.0]))
+
+        def quad(v):
+            return ((v - target) ** 2).sum()
+
+        ok, iters, pos, val, g, H = I.minimize_bfgs(
+            quad, paddle.to_tensor(np.array([0.0, 0.0])))
+        np.testing.assert_allclose(pos.numpy(), [1.0, -2.0], atol=1e-4)
+        ok2, it2, pos2, val2, g2 = I.minimize_lbfgs(
+            quad, paddle.to_tensor(np.array([5.0, 5.0])))
+        np.testing.assert_allclose(pos2.numpy(), [1.0, -2.0], atol=1e-4)
+
+
+class TestFusedFunctional:
+    def test_fused_mha_and_ffn(self):
+        paddle.seed(0)
+        b, s, d, h = 2, 4, 16, 4
+        x = paddle.to_tensor(rng.standard_normal((b, s, d)).astype(np.float32))
+        qkv_w = paddle.to_tensor(
+            (rng.standard_normal((3, h, d // h, d)) * 0.1).astype(np.float32))
+        lin_w = paddle.to_tensor(
+            (rng.standard_normal((d, d)) * 0.1).astype(np.float32))
+        ln_s = paddle.to_tensor(np.ones(d, np.float32))
+        ln_b = paddle.to_tensor(np.zeros(d, np.float32))
+        out = I.nn.functional.fused_multi_head_attention(
+            x, qkv_w, lin_w, pre_layer_norm=True, pre_ln_scale=ln_s,
+            pre_ln_bias=ln_b, dropout_rate=0.0, attn_dropout_rate=0.0,
+            training=False)
+        assert out.shape == [b, s, d] and np.isfinite(out.numpy()).all()
+        w1 = paddle.to_tensor(
+            (rng.standard_normal((d, 4 * d)) * 0.1).astype(np.float32))
+        w2 = paddle.to_tensor(
+            (rng.standard_normal((4 * d, d)) * 0.1).astype(np.float32))
+        out2 = I.nn.functional.fused_feedforward(
+            x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0, ln2_scale=ln_s,
+            ln2_bias=ln_b, training=False)
+        assert out2.shape == [b, s, d]
+
+    def test_resnet_unit(self):
+        from paddle_tpu.incubate.operators import ResNetUnit
+
+        ru = ResNetUnit(8, 16, 3, data_format="NCHW", has_shortcut=True,
+                        num_channels_z=8)
+        x = paddle.to_tensor(rng.standard_normal((1, 8, 8, 8)).astype(np.float32))
+        out = ru(x, x)
+        assert out.shape == [1, 16, 8, 8]
+        assert float(out.numpy().min()) >= 0  # relu
+
+
+class TestDistributedCompat:
+    def test_entries_and_modes(self):
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        assert "0.5" in dist.ProbabilityEntry(0.5)._to_attr()
+        assert "5" in dist.CountFilterEntry(5)._to_attr()
+        assert "show" in dist.ShowClickEntry("show", "click")._to_attr()
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(2.0)
+
+    def test_cluster_model(self):
+        from paddle_tpu.distributed.utils import find_free_ports, get_cluster
+
+        ports = find_free_ports(2)
+        assert ports and len(ports) == 2
+        cluster, pod = get_cluster(
+            ["127.0.0.1"], "127.0.0.1",
+            ["127.0.0.1:6170", "127.0.0.1:6171"])
+        assert cluster.trainers_nranks() == 2
+        assert cluster.trainers_endpoints() == ["127.0.0.1:6170",
+                                                "127.0.0.1:6171"]
+        assert cluster.pod_by_id(0) is pod
+
+    def test_local_trainers_lifecycle(self, tmp_path):
+        from paddle_tpu.distributed.utils import (
+            get_cluster,
+            start_local_trainers,
+            terminate_local_procs,
+            watch_local_trainers,
+        )
+
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os\nprint('rank', os.environ['PADDLE_TRAINER_ID'])\n")
+        cluster, pod = get_cluster(["127.0.0.1"], "127.0.0.1",
+                                   ["127.0.0.1:6170"])
+        procs = start_local_trainers(cluster, pod, str(script), [],
+                                     log_dir=str(tmp_path))
+        import time
+
+        for _ in range(50):
+            if not watch_local_trainers(procs, 1):
+                break
+            time.sleep(0.2)
+        terminate_local_procs(procs)
+        assert "rank 0" in (tmp_path / "workerlog.0").read_text()
+
+    def test_pass_framework(self):
+        from paddle_tpu.distributed.compat import PassBase, register_pass
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+
+        @register_pass("surface_test_pass")
+        class _P(PassBase):
+            def _apply_single_impl(self, m, s, ctx):
+                ctx.set_attr("count", (ctx.get_attr("count") or 0) + 1)
+
+        ctx = PassManager([new_pass("surface_test_pass")]).apply([None], [None])
+        assert ctx.get_attr("count") == 1
+        with pytest.raises(ValueError):
+            new_pass("no_such_pass")
+
+    def test_group_sharded_parallel_api(self, tmp_path):
+        from paddle_tpu.distributed.sharding import (
+            group_sharded_parallel,
+            save_group_sharded_model,
+        )
+
+        dist.fleet.init(is_collective=True)
+        net = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        m, o = group_sharded_parallel(net, opt, "p_g_os")
+        save_group_sharded_model(m, str(tmp_path / "out"), o)
+        assert (tmp_path / "out" / "model.pdparams").exists()
+        assert (tmp_path / "out" / "model.pdopt").exists()
+        with pytest.raises(ValueError):
+            group_sharded_parallel(net, opt, "bogus")
+
+    def test_fleet_class_and_util(self):
+        fl = dist.fleet.Fleet()
+        assert fl.is_first_worker() and fl.worker_num() >= 1
+        assert fl.util.get_file_shard(["a", "b"]) == ["a", "b"]
+
+    def test_ps_tables_and_factory(self):
+        from paddle_tpu.distributed.ps.the_one_ps import (
+            BarrierTable,
+            DenseTable,
+            SparseTable,
+        )
+        from paddle_tpu.distributed.ps.utils.ps_factory import (
+            GeoPsProgramBuilder,
+            PsProgramBuilderFactory,
+        )
+
+        tab = SparseTable().instantiate(8)
+        assert tab.pull(np.array([1, 2, 3])).shape == (3, 8)
+        assert DenseTable().table_class == "MemoryDenseTable"
+        assert BarrierTable().type == "PS_OTHER_TABLE"
+        b = PsProgramBuilderFactory()._create_ps_program_builder(
+            {"ps_mode": "geo"})
+        assert isinstance(b, GeoPsProgramBuilder)
+        assert b._build_programs()["ps_mode"] == "geo"
+
+    def test_global_scatter_gather(self):
+        from paddle_tpu.distributed.utils import global_gather, global_scatter
+        from paddle_tpu.parallel.topology import get_mesh
+
+        t = paddle.to_tensor(np.ones((3, 2), np.float32))
+        mesh = get_mesh()
+        if mesh is None or mesh.devices.size == 1:
+            np.testing.assert_allclose(global_scatter(t, None, None).numpy(),
+                                       t.numpy())
+            np.testing.assert_allclose(global_gather(t, None, None).numpy(),
+                                       t.numpy())
+        else:
+            # ragged alltoall has no static-shape lowering on a live mesh:
+            # the API must refuse loudly and point at the MoE path
+            with pytest.raises(NotImplementedError, match="MoELayer"):
+                global_scatter(t, None, None)
