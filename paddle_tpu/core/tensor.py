@@ -326,7 +326,24 @@ class Tensor:
     cast = astype
 
     # -- indexing (dynamic — bypasses per-op jit cache) ----------------------
+    def __iter__(self):
+        """Bounded iteration over axis 0 (reference Tensor iterates rows).
+
+        Without this, Python falls back to __getitem__ iteration, and jax's
+        clamped out-of-bounds indexing would yield the last row forever."""
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d Tensor")
+        return (self[i] for i in range(self.shape[0]))
+
     def __getitem__(self, idx):
+        # plain leading-axis int: validate bounds eagerly (jax clamps
+        # silently; the reference raises)
+        if isinstance(idx, (int, np.integer)):
+            n = self.shape[0] if self.ndim else 0
+            if not -n <= idx < n:
+                raise IndexError(
+                    f"index {idx} is out of bounds for axis 0 with size {n}"
+                )
         idx = _unwrap_index(idx)
 
         # closure over idx → dispatch skips the jit cache for it, but still

@@ -913,6 +913,22 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 # ---------------------------------------------------------------------------
 # Tensor method patching (varbase_patch_methods analogue)
 # ---------------------------------------------------------------------------
+
+def _rebind_inplace(target, out):
+    """Shared in-place rebind: adopt `out`'s value and (if recorded) its tape
+    edge, preserving `target`'s identity. Single point of truth for every
+    generated *_ method."""
+    target._value = out._value
+    if out._grad_node is not None:
+        # keep the recorded edge so backward flows through the in-place op;
+        # no_grad updates (optimizers) leave leaf/trainable status untouched
+        target._grad_node = out._grad_node
+        target._out_index = out._out_index
+        target.stop_gradient = out.stop_gradient
+    target._bump_version()
+    return target
+
+
 def _patch_tensor_methods():
     import sys
 
@@ -1008,17 +1024,7 @@ def _patch_tensor_methods():
     # autograd edge must survive the rebind (paddle in-place ops keep grads)
     def _inplace(fn):
         def method(self, *a, **k):
-            out = fn(self, *a, **k)
-            self._value = out._value
-            if out._grad_node is not None:
-                # keep the recorded edge so backward flows through the
-                # in-place op; no_grad updates (optimizers) leave the
-                # tensor's leaf/trainable status untouched
-                self._grad_node = out._grad_node
-                self._out_index = out._out_index
-                self.stop_gradient = out.stop_gradient
-            self._bump_version()
-            return self
+            return _rebind_inplace(self, fn(self, *a, **k))
         return method
 
     Tensor.add_ = _inplace(add)
@@ -1045,6 +1051,8 @@ def _patch_tensor_methods():
 
 
 _patch_tensor_methods()
+
+# (__all__ is assembled once, after the method-binding pass at the bottom)
 
 
 # ---------------------------------------------------------------------------
@@ -1205,5 +1213,70 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
 def disable_signal_handler():
     """reference: paddle.disable_signal_handler — no custom handlers here."""
 
+
+
+
+
+def _bind_remaining_tensor_methods():
+    """Bind the rest of the reference Tensor-method surface (reference:
+    tensor/__init__.py tensor_method_func list): module fns as methods,
+    the linalg family, and generated in-place variants."""
+    import sys
+
+    mod = sys.modules[__name__]
+
+    for name in (
+        "add_n", "broadcast_shape", "broadcast_tensors", "concat",
+        "floor_mod", "gcd", "increment", "is_complex", "is_empty",
+        "is_floating_point", "is_integer", "is_tensor", "lcm", "multiplex",
+        "nanquantile", "reverse", "scatter_nd", "shard_index", "slice",
+        "squeeze_", "stack", "stanh", "strided_slice", "tanh_", "unbind",
+        "unsqueeze_",
+    ):
+        fn = getattr(mod, name, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    from . import linalg as _l
+    for name, target in (
+        ("cholesky", "cholesky"), ("cholesky_solve", "cholesky_solve"),
+        ("cond", "cond"), ("cov", "cov"), ("eig", "eig"),
+        ("eigvals", "eigvals"), ("eigvalsh", "eigvalsh"),
+        ("inverse", "inv"), ("lstsq", "lstsq"), ("lu", "lu"),
+        ("lu_unpack", "lu_unpack"), ("matrix_power", "matrix_power"),
+        ("multi_dot", "multi_dot"), ("qr", "qr"), ("solve", "solve"),
+        ("triangular_solve", "triangular_solve"),
+    ):
+        fn = getattr(_l, target, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    def _inplace_of(fn):
+        def method(self, *args, **kwargs):
+            return _rebind_inplace(self, fn(self, *args, **kwargs))
+
+        return method
+
+    for base in ("ceil", "exp", "floor", "round", "rsqrt", "sqrt",
+                 "reciprocal", "erfinv", "lerp", "flatten"):
+        fn = getattr(mod, base, None)
+        if fn is not None and not hasattr(Tensor, base + "_"):
+            setattr(Tensor, base + "_", _inplace_of(fn))
+    pfn = getattr(mod, "put_along_axis", None)
+    if pfn is not None and not hasattr(Tensor, "put_along_axis_"):
+        setattr(Tensor, "put_along_axis_", _inplace_of(pfn))
+
+    # module-level aliases for the generated in-place forms (reference
+    # exposes paddle.sqrt_ etc.)
+    for base in ("ceil", "exp", "floor", "round", "rsqrt", "sqrt",
+                 "reciprocal", "erfinv", "lerp", "flatten"):
+        nm = base + "_"
+        if not hasattr(mod, nm) and hasattr(Tensor, nm):
+            setattr(mod, nm, getattr(Tensor, nm))
+    if not hasattr(mod, "put_along_axis_") and hasattr(Tensor, "put_along_axis_"):
+        mod.put_along_axis_ = Tensor.put_along_axis_
+
+
+_bind_remaining_tensor_methods()
 
 __all__ = [n for n in dir() if not n.startswith("_")]
