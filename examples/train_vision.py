@@ -1,0 +1,76 @@
+"""Image classification end to end: transform pipeline -> MobileNetV3 ->
+hapi Model.fit -> EMA weights -> inference predictor artifact.
+
+Usage:
+  python examples/train_vision.py [--model mobilenet_v3_small] [--epochs 2]
+
+Uses the synthetic-fallback Flowers dataset (no egress in this
+environment); point PADDLE_TPU_SYNTH_N at a larger size for longer runs.
+"""
+import argparse
+import os
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.vision import models as M
+from paddle_tpu.vision import transforms as T
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="mobilenet_v3_small")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--classes", type=int, default=8)
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    os.environ.setdefault("PADDLE_TPU_SYNTH_N", "128")
+
+    pipeline = T.Compose([
+        T.Resize((64, 64)),
+        T.RandomHorizontalFlip(0.5),
+        T.ContrastTransform(0.2),
+        T.ToTensor(),
+        T.Normalize([0.5] * 3, [0.5] * 3),
+    ])
+    ds = paddle.vision.datasets.Flowers(mode="train", transform=pipeline)
+    # remap the synthetic 102-class labels into a small head for a fast demo
+    ds.labels = ds.labels % args.classes
+
+    net = getattr(M, args.model)(num_classes=args.classes)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=2e-3, parameters=net.parameters(), weight_decay=1e-4
+    )
+    ema = static.ExponentialMovingAverage(0.99).register(net.parameters())
+
+    model = paddle.Model(net)
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  metrics=paddle.metric.Accuracy())
+
+    class EMAStep(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            ema.update()
+
+    model.fit(ds, epochs=args.epochs, batch_size=args.batch_size, verbose=1,
+              callbacks=[EMAStep()])
+
+    with ema.apply():
+        model.evaluate(ds, batch_size=args.batch_size, verbose=0)
+        # export the EMA weights as the serving artifact
+        paddle.jit.save(
+            net, "/tmp/vision_model",
+            input_spec=[paddle.static.InputSpec([None, 3, 64, 64], "float32")],
+        )
+    print("saved StableHLO artifact to /tmp/vision_model*")
+
+
+if __name__ == "__main__":
+    main()

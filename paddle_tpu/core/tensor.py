@@ -337,8 +337,11 @@ class Tensor:
 
     def __getitem__(self, idx):
         # plain leading-axis int: validate bounds eagerly (jax clamps
-        # silently; the reference raises)
-        if isinstance(idx, (int, np.integer)):
+        # silently; the reference raises). bool is an int subclass but is a
+        # mask/newaxis index, not a position.
+        if isinstance(idx, (int, np.integer)) and not isinstance(
+            idx, (bool, np.bool_)
+        ):
             n = self.shape[0] if self.ndim else 0
             if not -n <= idx < n:
                 raise IndexError(

@@ -84,12 +84,22 @@ class HostPipelineTrainer:
             )
         )
 
-    def train_batch(self, micro_xs: Sequence, micro_labels: Sequence) -> float:
+    def train_batch(self, micro_xs: Sequence, micro_labels: Sequence,
+                    schedule: str = "1f1b") -> float:
         """One step over num_micro microbatches; returns the mean loss.
 
-        Schedule: forward task chain (stage k gated on k-1 per microbatch,
-        pipelined by the actors) then backward chain in reverse — GPipe
-        order, the reference's origin_scheduler."""
+        The actors gate stage k's microbatch t on stage k-1's t, so
+        execution is dataflow-pipelined either way; `schedule` controls the
+        RESIDENCY policy (reference: pipeline_parallel.py:80
+        forward_backward_pipeline vs the origin/GPipe scheduler):
+          - "1f1b": stage 0 admits at most n_stages microbatches beyond the
+            completed backwards — steady-state one-forward-one-backward, so
+            at most n_stages residual sets are ever live per stage.
+          - "gpipe": all forwards admitted immediately; every microbatch's
+            residuals stay live until its backward (more memory, same math).
+        """
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"schedule must be 1f1b|gpipe, got {schedule!r}")
         num_micro = len(micro_xs)
         if num_micro == 0:
             raise ValueError("train_batch needs at least one microbatch")
@@ -98,6 +108,29 @@ class HostPipelineTrainer:
                 f"{num_micro} microbatches but {len(micro_labels)} label sets"
             )
         n = self.n_stages
+        import threading as _threading
+
+        # 1F1B window: n_stages tokens; fwd stage 0 takes one per admitted
+        # microbatch, bwd stage 0 returns it when that microbatch's grads
+        # are done (the classic warmup / steady 1F1B / cooldown shape)
+        window = _threading.Semaphore(n) if schedule == "1f1b" else None
+        self._inflight = 0
+        self._peak_inflight = 0
+        self._failed = False
+        lock = _threading.Lock()
+
+        def _admit():
+            # bounded wait so a failure elsewhere in the pipeline surfaces
+            # as an exception instead of parking this actor thread forever
+            # on a token the dead backward will never return
+            while window is not None and not window.acquire(timeout=0.2):
+                if self._failed:
+                    raise RuntimeError(
+                        "pipeline failed on another stage; aborting admission"
+                    )
+
+        def _fail():
+            self._failed = True
         acts = [[None] * num_micro for _ in range(n + 1)]   # stage inputs
         vjps = [[None] * num_micro for _ in range(n)]
         cts = [[None] * num_micro for _ in range(n + 1)]    # cotangents
@@ -108,26 +141,47 @@ class HostPipelineTrainer:
 
         def fwd_task(k):
             def run(t):
-                x = jax.device_put(acts[k][t], self.devices[k])
-                if k == n - 1:
-                    lbl = jax.device_put(micro_labels[t], self.devices[k])
-                    loss, vjp = self._fwd[k](self.params[k], x, lbl)
-                    losses[t] = loss
-                    cts[k + 1][t] = jnp.ones_like(loss)
-                else:
-                    y, vjp = self._fwd[k](self.params[k], x)
-                    acts[k + 1][t] = y
-                vjps[k][t] = vjp
+                try:
+                    if k == 0:
+                        _admit()
+                        with lock:
+                            self._inflight += 1
+                            self._peak_inflight = max(
+                                self._peak_inflight, self._inflight
+                            )
+                    x = jax.device_put(acts[k][t], self.devices[k])
+                    if k == n - 1:
+                        lbl = jax.device_put(micro_labels[t], self.devices[k])
+                        loss, vjp = self._fwd[k](self.params[k], x, lbl)
+                        losses[t] = loss
+                        cts[k + 1][t] = jnp.ones_like(loss)
+                    else:
+                        y, vjp = self._fwd[k](self.params[k], x)
+                        acts[k + 1][t] = y
+                    vjps[k][t] = vjp
+                except BaseException:
+                    _fail()
+                    raise
 
             return run
 
         def bwd_task(k):
             def run(t):
-                ct = jax.device_put(cts[k + 1][t], self.devices[k])
-                out = self._bwd[k](vjps[k][t], ct)
-                grads[k][t] = out[0]
-                cts[k][t] = out[1]
-                vjps[k][t] = None  # free residuals early
+                try:
+                    ct = jax.device_put(cts[k + 1][t], self.devices[k])
+                    out = self._bwd[k](vjps[k][t], ct)
+                    grads[k][t] = out[0]
+                    cts[k][t] = out[1]
+                    vjps[k][t] = None  # free residuals early
+                except BaseException:
+                    _fail()
+                    raise
+                finally:
+                    if k == 0:
+                        with lock:
+                            self._inflight -= 1
+                        if window is not None:
+                            window.release()
 
             return run
 

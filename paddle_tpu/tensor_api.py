@@ -332,7 +332,9 @@ angle = _unary(_m.angle, "angle")
 conj = _unary(_m.conj, "conj")
 real = _unary(_m.real, "real")
 imag = _unary(_m.imag, "imag")
-tanh_ = tanh
+def tanh_(x, name=None):
+    """In-place tanh (reference: paddle.tanh_)."""
+    return _rebind_inplace(x, tanh(x))
 
 
 def polygamma(x, n, name=None):
@@ -1153,16 +1155,13 @@ def unbind(input, axis=0):
 
 
 def squeeze_(x, axis=None, name=None):
-    # shape-changing in-place rebind: bypass set_value's same-shape guard
-    x._value = squeeze(x, axis=axis)._value
-    x._bump_version()
-    return x
+    # shape-changing in-place rebind (keeps the autograd edge like every
+    # other generated *_ method)
+    return _rebind_inplace(x, squeeze(x, axis=axis))
 
 
 def unsqueeze_(x, axis, name=None):
-    x._value = unsqueeze(x, axis=axis)._value
-    x._bump_version()
-    return x
+    return _rebind_inplace(x, unsqueeze(x, axis=axis))
 
 
 def set_printoptions(precision=None, threshold=None, edgeitems=None,
@@ -1217,6 +1216,11 @@ def disable_signal_handler():
 
 
 
+_INPLACE_BASES = ("ceil", "exp", "floor", "round", "rsqrt", "sqrt",
+                  "reciprocal", "erfinv", "lerp", "flatten",
+                  "put_along_axis")
+
+
 def _bind_remaining_tensor_methods():
     """Bind the rest of the reference Tensor-method surface (reference:
     tensor/__init__.py tensor_method_func list): module fns as methods,
@@ -1257,24 +1261,15 @@ def _bind_remaining_tensor_methods():
 
         return method
 
-    for base in ("ceil", "exp", "floor", "round", "rsqrt", "sqrt",
-                 "reciprocal", "erfinv", "lerp", "flatten"):
+    for base in _INPLACE_BASES:
         fn = getattr(mod, base, None)
-        if fn is not None and not hasattr(Tensor, base + "_"):
-            setattr(Tensor, base + "_", _inplace_of(fn))
-    pfn = getattr(mod, "put_along_axis", None)
-    if pfn is not None and not hasattr(Tensor, "put_along_axis_"):
-        setattr(Tensor, "put_along_axis_", _inplace_of(pfn))
-
-    # module-level aliases for the generated in-place forms (reference
-    # exposes paddle.sqrt_ etc.)
-    for base in ("ceil", "exp", "floor", "round", "rsqrt", "sqrt",
-                 "reciprocal", "erfinv", "lerp", "flatten"):
         nm = base + "_"
+        if fn is not None and not hasattr(Tensor, nm):
+            setattr(Tensor, nm, _inplace_of(fn))
+        # module-level aliases for the generated in-place forms (reference
+        # exposes paddle.sqrt_ etc.)
         if not hasattr(mod, nm) and hasattr(Tensor, nm):
             setattr(mod, nm, getattr(Tensor, nm))
-    if not hasattr(mod, "put_along_axis_") and hasattr(Tensor, "put_along_axis_"):
-        mod.put_along_axis_ = Tensor.put_along_axis_
 
 
 _bind_remaining_tensor_methods()

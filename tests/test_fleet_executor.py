@@ -174,3 +174,49 @@ def test_host_pipeline_trainer_matches_single_device():
             np.asarray(trainer.params[k]["w"]), np.asarray(ref[k]["w"]), rtol=1e-5
         )
     assert pipe_losses[-1] < pipe_losses[0]
+
+
+def test_host_pipeline_1f1b_window_and_parity():
+    """1F1B caps in-flight microbatches at n_stages; numerics identical to
+    GPipe (reference: pipeline_parallel.py:80 forward_backward_pipeline)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet_executor.pipeline_trainer import (
+        HostPipelineTrainer,
+    )
+
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32) * 0.3)
+    w2 = jnp.asarray(rng.standard_normal((8, 2)).astype(np.float32) * 0.3)
+    micro_xs = [jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32))
+                for _ in range(8)]
+    micro_ys = [jnp.asarray(rng.standard_normal((4, 2)).astype(np.float32))
+                for _ in range(8)]
+
+    def make():
+        return HostPipelineTrainer(
+            stage_fns=[
+                lambda p, x: jnp.tanh(x @ p),
+                lambda p, x: x @ p,
+            ],
+            params=[w1, w2],
+            loss_fn=lambda y, lbl: jnp.mean((y - lbl) ** 2),
+            learning_rate=0.1,
+            devices=[jax.devices()[0]] * 2,
+        )
+
+    t1 = make()
+    loss_1f1b = t1.train_batch(micro_xs, micro_ys, schedule="1f1b")
+    assert t1._peak_inflight <= 2, t1._peak_inflight
+    t2 = make()
+    loss_gpipe = t2.train_batch(micro_xs, micro_ys, schedule="gpipe")
+    assert abs(loss_1f1b - loss_gpipe) < 1e-6
+    for a, b in zip(jax.tree_util.tree_leaves(t1.params),
+                    jax.tree_util.tree_leaves(t2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    import pytest
+
+    with pytest.raises(ValueError, match="1f1b"):
+        make().train_batch(micro_xs, micro_ys, schedule="bogus")
