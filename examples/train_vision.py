@@ -2,7 +2,9 @@
 hapi Model.fit -> EMA weights -> inference predictor artifact.
 
 Usage:
-  python examples/train_vision.py [--model mobilenet_v3_small] [--epochs 2]
+  JAX_PLATFORMS=cpu python examples/train_vision.py \
+      [--model mobilenet_v3_small] [--epochs 2]
+  # drop JAX_PLATFORMS=cpu to run on the session accelerator
 
 Uses the synthetic-fallback Flowers dataset (no egress in this
 environment); point PADDLE_TPU_SYNTH_N at a larger size for longer runs.
