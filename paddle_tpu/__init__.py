@@ -18,7 +18,15 @@ Layer map (TPU-native; see SURVEY.md for the reference's):
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+from .version import full_version as __version__  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: version.commit costs a git subprocess on first access
+    if name == "__git_commit__":
+        return version.commit
+    raise AttributeError(name)
 
 import jax as _jax
 

@@ -1,0 +1,70 @@
+"""paddle.version — build version metadata.
+
+Reference analogue: the generated python/paddle/version.py (full_version,
+major/minor/patch/rc, commit, show()).
+"""
+import os
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+with_mkl = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit", "show"]
+
+_commit_cache = None
+
+
+def _resolve_commit():
+    """Source-tree HEAD, resolved lazily (an installed wheel has no build
+    step to bake it in; the reference generates version.py at build time).
+    Returns 'unknown' unless the enclosing git repo really is this source
+    tree — otherwise a venv inside an unrelated checkout would report that
+    project's HEAD."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], capture_output=True,
+            text=True, timeout=5, cwd=root,
+        ).stdout.strip()
+        if not top or not os.path.isdir(os.path.join(top, "paddle_tpu")):
+            return "unknown"
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=5, cwd=root,
+        ).stdout.strip()
+        return out or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def __getattr__(name):
+    # PEP 562 lazy attribute: `commit` costs a git subprocess, so it is
+    # resolved on first access, not at import
+    global _commit_cache
+    if name == "commit":
+        if _commit_cache is None:
+            _commit_cache = _resolve_commit()
+        return _commit_cache
+    raise AttributeError(name)
+
+
+def show():
+    """Print version info (reference: version.py show())."""
+    if istaged:
+        print("paddle_tpu", full_version)
+    else:
+        print("commit:", __getattr__("commit"))
+    print("major:", major)
+    print("minor:", minor)
+    print("patch:", patch)
+    print("rc:", rc)
+
+
+def mkl():
+    return with_mkl
