@@ -511,7 +511,15 @@ def reshape(x, shape, name=None):
 def _shape_allow_minus(shape):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    return tuple(int(s) for s in shape)
+    out = []
+    for s in shape:
+        try:
+            out.append(int(s))
+        except Exception:
+            # symbolic dimension (jax.export shape polymorphism): keep the
+            # _DimExpr so batch-polymorphic reshapes export
+            out.append(s)
+    return tuple(out)
 
 
 def reshape_(x, shape, name=None):

@@ -230,3 +230,35 @@ class TestSparseConv:
         active = np.abs(out).sum(-1) > 0
         # only the single input site may be active
         assert active.sum() <= 1 and active[0, 1, 1, 1] or active.sum() == 0
+
+
+class TestExport:
+    def test_new_families_export_batch_polymorphic(self, tmp_path):
+        """jit.save must stay shape-polymorphic through channel_shuffle's
+        symbolic-batch reshapes (regression: int() on _DimExpr)."""
+        import warnings
+
+        paddle.seed(0)
+        m = paddle.vision.models.shufflenet_v2_x0_25(num_classes=6)
+        m.eval()
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
+        )
+        with paddle.no_grad():
+            want = m(x).numpy()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # the pin-to-1 fallback warns
+            paddle.jit.save(
+                m, str(tmp_path / "m"),
+                input_spec=[paddle.static.InputSpec([None, 3, 64, 64],
+                                                    "float32")],
+            )
+        loaded = paddle.jit.load(str(tmp_path / "m"))
+        got = loaded(x)
+        got = got[0] if isinstance(got, (list, tuple)) else got
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+        out5 = loaded(paddle.to_tensor(
+            rng.standard_normal((5, 3, 64, 64)).astype(np.float32)
+        ))
+        out5 = out5[0] if isinstance(out5, (list, tuple)) else out5
+        assert tuple(out5.shape) == (5, 6)
