@@ -25,8 +25,13 @@ import time
 import numpy as np
 
 
-def bench_resnet50(steps=8, bsz=64):
-    """BASELINE config 2: ResNet-50, AMP O2 bf16, compiled train step."""
+def bench_resnet50(steps=8, bsz=256):
+    """BASELINE config 2: ResNet-50, AMP O2 bf16, compiled train step.
+
+    b256 saturates the chip (PROFILE_RESNET.md: b64 1.8k, b128/b256 2.2k
+    imgs/s, b512 regresses); 2.2k/chip is the measured XLA ceiling for
+    faithful batch-stats BN on this part.
+    """
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,62 @@
+"""Probe conv layout/dtype performance on the live chip (VERDICT r2 item 2).
+
+Chains iterations through a data dependency and fetches the result to host so
+the async dispatch queue can't hide execution time.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def conv_loss(x, w, dn):
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME", dimension_numbers=dn)
+    return jnp.sum(out.astype(jnp.float32) ** 2)
+
+
+def bench_conv(layout, dtype, bsz, c, hw, k=3, iters=30):
+    if layout == "NCHW":
+        xshape = (bsz, c, hw, hw)
+        dn = ("NCHW", "OIHW", "NCHW")
+        wshape = (c, c, k, k)
+    else:
+        xshape = (bsz, hw, hw, c)
+        dn = ("NHWC", "HWIO", "NHWC")
+        wshape = (k, k, c, c)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(xshape) * 0.01, dtype)
+    w = jnp.asarray(rng.standard_normal(wshape) * 0.01, dtype)
+
+    grad = jax.grad(functools.partial(conv_loss, dn=dn), argnums=(0, 1))
+
+    @jax.jit
+    def step(x, w):
+        gx, gw = grad(x, w)
+        return x - 1e-6 * gx.astype(x.dtype), w - 1e-6 * gw.astype(w.dtype)
+
+    x, w = step(x, w)
+    jax.block_until_ready((x, w))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x, w = step(x, w)
+    _ = np.asarray(jnp.sum(w.astype(jnp.float32)))  # force full chain to host
+    dt = (time.perf_counter() - t0) / iters
+    flops = 3 * 2 * bsz * hw * hw * c * c * k * k
+    return dt, flops / dt / 1e12
+
+
+def main():
+    print("devices:", jax.devices())
+    for layout in ("NCHW", "NHWC"):
+        for dtype in (jnp.float32, jnp.bfloat16):
+            for bsz in (64, 256):
+                dt, tf = bench_conv(layout, dtype, bsz, 128, 28)
+                print(f"conv3x3 c128 hw28 {layout} {jnp.dtype(dtype).name} b{bsz}: "
+                      f"{dt*1e3:.3f} ms  {tf:.1f} TF/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Pure-JAX ResNet-50 train-step ceiling probe, NHWC + bf16 (VERDICT r2 item 2).
+
+Hand-rolled functional ResNet-50 (no framework overhead) to find what this
+chip can actually do, and compare NHWC vs NCHW at the whole-model level.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+LAYERS = [3, 4, 6, 3]
+
+
+def conv_init(rng, k, cin, cout):
+    w = rng.standard_normal((k, k, cin, cout)) * np.sqrt(2.0 / (k * k * cin))
+    return jnp.asarray(w, jnp.bfloat16)
+
+
+def bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def make_params(rng):
+    params = {"stem": conv_init(rng, 7, 3, 64), "stem_bn": bn_init(64)}
+    cin = 64
+    for i, (planes, n) in enumerate(zip([64, 128, 256, 512], LAYERS)):
+        blocks = []
+        for b in range(n):
+            stride = 2 if (b == 0 and i > 0) else 1
+            blk = {
+                "c1": conv_init(rng, 1, cin, planes), "bn1": bn_init(planes),
+                "c2": conv_init(rng, 3, planes, planes), "bn2": bn_init(planes),
+                "c3": conv_init(rng, 1, planes, planes * 4), "bn3": bn_init(planes * 4),
+            }
+            if b == 0:
+                blk["down"] = conv_init(rng, 1, cin, planes * 4)
+                blk["down_bn"] = bn_init(planes * 4)
+            blocks.append(blk)
+            cin = planes * 4
+        params[f"layer{i}"] = blocks
+    params["fc_w"] = jnp.asarray(rng.standard_normal((2048, 1000)) * 0.01, jnp.bfloat16)
+    params["fc_b"] = jnp.zeros((1000,), jnp.float32)
+    return params
+
+
+def conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn(x, p):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.var(xf, axis=(0, 1, 2))
+    out = (xf - mean) * jax.lax.rsqrt(var + 1e-5) * p["scale"] + p["bias"]
+    return out.astype(jnp.bfloat16)
+
+
+def block(x, p, stride):
+    out = jax.nn.relu(bn(conv(x, p["c1"]), p["bn1"]))
+    out = jax.nn.relu(bn(conv(out, p["c2"], stride), p["bn2"]))
+    out = bn(conv(out, p["c3"]), p["bn3"])
+    if "down" in p:
+        x = bn(conv(x, p["down"], stride), p["down_bn"])
+    return jax.nn.relu(out + x)
+
+
+def forward(params, x):
+    x = jax.nn.relu(bn(conv(x, params["stem"], 2), params["stem_bn"]))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for i in range(4):
+        for b, blk in enumerate(params[f"layer{i}"]):
+            stride = 2 if (b == 0 and i > 0) else 1
+            x = block(x, blk, stride)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x @ params["fc_w"].astype(jnp.float32) + params["fc_b"]
+
+
+def loss_fn(params, x, y):
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+@jax.jit
+def train_step(params, x, y):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    new = jax.tree_util.tree_map(lambda p, g: p - 0.01 * g.astype(p.dtype), params, grads)
+    return new, loss
+
+
+def main():
+    rng = np.random.default_rng(0)
+    params = make_params(rng)
+    for bsz in (64, 128, 256, 512):
+        x = jnp.asarray(rng.standard_normal((bsz, 224, 224, 3)), jnp.bfloat16)
+        y = jnp.asarray(rng.integers(0, 1000, (bsz,)), jnp.int32)
+        p = params
+        p, loss = train_step(p, x, y)
+        np.asarray(loss)  # hard sync after compile
+        steps = 10
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, loss = train_step(p, x, y)
+        np.asarray(loss)  # hard sync
+        dt = (time.perf_counter() - t0) / steps
+        print(f"NHWC bf16 b{bsz}: {bsz/dt:.0f} imgs/s  ({dt*1e3:.1f} ms/step)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
