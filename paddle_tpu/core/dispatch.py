@@ -39,6 +39,7 @@ __all__ = [
 ]
 
 _tls = threading.local()
+_amp = None  # lazily bound paddle_tpu.amp module (circular at import time)
 
 
 def _grad_state():
@@ -231,7 +232,19 @@ class GradNode:
         return f"<GradNode {self.op_name}>"
 
 
+_FLOAT_DTYPES = frozenset(
+    np.dtype(d)
+    for d in (
+        jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64,
+        jnp.complex64, jnp.complex128,
+    )
+)
+
+
 def _is_float_array(v) -> bool:
+    dt = getattr(v, "dtype", None)
+    if dt is not None:
+        return dt in _FLOAT_DTYPES
     try:
         return jnp.issubdtype(jnp.result_type(v), jnp.floating) or jnp.issubdtype(
             jnp.result_type(v), jnp.complexfloating
@@ -254,28 +267,36 @@ def apply(
     """
     from .tensor import Tensor  # circular at import time only
 
-    kwargs.pop("name", None)
-    vals = [a._value if isinstance(a, Tensor) else a for a in args]
-    kw_items = tuple(sorted((k, _hashable(v)) for k, v in kwargs.items()))
+    if kwargs:
+        kwargs.pop("name", None)
+        kw_items = tuple(sorted((k, _hashable(v)) for k, v in kwargs.items()))
+    else:
+        kw_items = ()
+
+    # one pass over args: unwrap values AND find differentiable positions
+    vals = []
+    diff_idx: List[int] = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            v = a._value
+            vals.append(v)
+            if not a.stop_gradient and getattr(v, "dtype", None) in _FLOAT_DTYPES:
+                diff_idx.append(i)
+        else:
+            vals.append(a)
 
     # AMP O1 input casting (reference: tracer.cc:222-240 AMP auto-cast)
-    from .. import amp as _amp
+    global _amp
+    if _amp is None:
+        from .. import amp as _amp_mod
 
+        _amp = _amp_mod
     if _amp.amp_active():
         vals = _amp.maybe_cast_inputs(
             op_name or getattr(fn, "__name__", "op"), vals
         )
 
-    record = (
-        differentiable
-        and is_grad_enabled()
-        and any(
-            isinstance(a, Tensor)
-            and not a.stop_gradient
-            and _is_float_array(a._value)
-            for a in args
-        )
-    )
+    record = differentiable and bool(diff_idx) and _grad_state().grad_enabled
 
     if not record:
         jfn = _jitted(fn, kw_items) if flags.flag("eager_op_jit") else None
@@ -284,12 +305,6 @@ def apply(
         else:
             out_vals = fn(*vals, **dict(kw_items))
         return _wrap_outputs(out_vals, stop_gradient=True, node=None)
-
-    diff_idx = [
-        i
-        for i, a in enumerate(args)
-        if isinstance(a, Tensor) and not a.stop_gradient and _is_float_array(a._value)
-    ]
 
     # run the recorded primal through a CACHED forward+vjp program when the
     # op is cacheable: linearization is staged once per (op, statics, diff
@@ -399,6 +414,148 @@ def _check_nan_inf(op_name, arrays):
 
 
 # ---------------------------------------------------------------------------
+# Compiled-tape backward: when every node on the tape has a jax-pytree vjp
+# closure, the whole dependency-counted sweep is pure jax and can be traced
+# into ONE XLA program (cached by tape topology + residual structure). An
+# eager training step then dispatches a single backward program instead of
+# one per recorded op — the tape is, in effect, compiled. Falls back to the
+# per-node sweep for hooks / create_graph / retain_graph / PyLayer vjps.
+# ---------------------------------------------------------------------------
+_tape_bwd_cache: Dict[Tuple, Callable] = {}
+
+
+def _make_tape_backward(avals, seqflags, edges, n_leaves, root_key):
+    def fn(vjp_fns, seed):
+        cot = {root_key: seed}
+        leaf_out = [None] * n_leaves
+        for idx in range(len(avals)):
+            cts = []
+            for i, (shape, dtype) in enumerate(avals[idx]):
+                c = cot.pop((idx, i), None)
+                cts.append(jnp.zeros(shape, dtype) if c is None else c)
+            packed = tuple(cts) if seqflags[idx] else cts[0]
+            grads = vjp_fns[idx](packed)
+            for (prod, oi, leaf_slot), g in zip(edges[idx], grads):
+                if g is None or (
+                    hasattr(g, "dtype") and g.dtype == jax.dtypes.float0
+                ):
+                    continue
+                if prod >= 0:
+                    k = (prod, oi)
+                    prev = cot.get(k)
+                    cot[k] = g if prev is None else prev + g
+                elif leaf_slot >= 0:
+                    prev = leaf_out[leaf_slot]
+                    leaf_out[leaf_slot] = g if prev is None else prev + g
+        return leaf_out
+
+    return jax.jit(fn)
+
+
+def _try_compiled_tape_backward(root, seed_val) -> bool:
+    """Run root.backward() as one compiled program. Returns False when the
+    tape has features the compiled path doesn't cover (caller falls back)."""
+    from .tensor import Tensor
+
+    root_node = root._grad_node
+    if root_node is None:
+        return False
+
+    # discover graph + consumer counts (mirrors run_backward pass 1)
+    nodes: List[GradNode] = []
+    index: Dict[int, int] = {}
+    pending: Dict[int, int] = {}
+    stack = [root_node]
+    while stack:
+        node = stack.pop()
+        if id(node) in index:
+            continue
+        if not node.jit_vjp or node.vjp_fn is None:
+            return False
+        index[id(node)] = len(nodes)
+        nodes.append(node)
+        for edge in node.inputs:
+            if edge.tensor._backward_hooks:
+                return False
+            prod = edge.node
+            if prod is not None:
+                pending[id(prod)] = pending.get(id(prod), 0) + 1
+                if id(prod) not in index:
+                    stack.append(prod)
+
+    # topological order (consumers before producers), Kahn from the root
+    order_nodes: List[GradNode] = []
+    ready = [root_node] if pending.get(id(root_node), 0) == 0 else []
+    counts = dict(pending)
+    seen = set()
+    while ready:
+        node = ready.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        order_nodes.append(node)
+        for edge in node.inputs:
+            prod = edge.node
+            if prod is not None:
+                counts[id(prod)] -= 1
+                if counts[id(prod)] == 0:
+                    ready.append(prod)
+    if len(order_nodes) != len(nodes):
+        return False  # disconnected pieces (multi-root tape) — fall back
+
+    node_pos = {id(n): i for i, n in enumerate(order_nodes)}
+    leaf_slots: Dict[int, int] = {}
+    leaf_tensors: List = []
+    edges_rec = []
+    avals_rec = []
+    seq_rec = []
+    for n in order_nodes:
+        avals_rec.append(tuple(n.out_avals))
+        seq_rec.append(n.out_is_seq)
+        erec = []
+        for edge in n.inputs:
+            if edge.node is not None:
+                erec.append((node_pos[id(edge.node)], edge.out_index, -1))
+            else:
+                t = edge.tensor
+                if t.stop_gradient:
+                    erec.append((-1, 0, -1))  # grad discarded
+                else:
+                    slot = leaf_slots.get(id(t))
+                    if slot is None:
+                        slot = len(leaf_tensors)
+                        leaf_slots[id(t)] = slot
+                        leaf_tensors.append(t)
+                    erec.append((-1, 0, slot))
+        edges_rec.append(tuple(erec))
+
+    avals_rec = tuple(avals_rec)
+    seq_rec = tuple(seq_rec)
+    edges_rec = tuple(edges_rec)
+    key = (avals_rec, seq_rec, edges_rec, len(leaf_tensors), root._out_index)
+    fn = _tape_bwd_cache.get(key)
+    if fn is None:
+        fn = _make_tape_backward(
+            avals_rec, seq_rec, edges_rec, len(leaf_tensors),
+            (0, root._out_index),
+        )
+        _tape_bwd_cache[key] = fn
+    vjp_fns = [n.vjp_fn for n in order_nodes]
+    leaf_vals = fn(vjp_fns, seed_val)
+    for t, g in zip(leaf_tensors, leaf_vals):
+        if g is None:
+            continue
+        if t.grad is None:
+            t.grad = Tensor(g, stop_gradient=True)
+        else:
+            t.grad._value = t.grad._value + g
+    for n in order_nodes:
+        n.vjp_fn = None
+        n.primal_fn = None
+    return True
+
+
+# ---------------------------------------------------------------------------
 # Backward engine
 # ---------------------------------------------------------------------------
 def run_backward(
@@ -431,6 +588,29 @@ def run_backward(
         grad_tensors = [None] * len(roots)
     if create_graph:
         retain_graph = True
+
+    # compiled-tape fast path: single root, plain accumulate-into-.grad
+    # backward with no graph retention → one XLA program for the whole sweep
+    if (
+        not retain_graph
+        and not create_graph
+        and inputs is None
+        and accumulate_into_grad
+        and len(roots) == 1
+        and roots[0]._grad_node is not None
+        and flags.flag("eager_tape_jit")
+    ):
+        root = roots[0]
+        g0 = grad_tensors[0]
+        if g0 is None:
+            if root._value.size == 1:
+                seed = jnp.ones_like(root._value)
+            else:
+                seed = None  # shape error — the standard path raises it
+        else:
+            seed = g0._value if isinstance(g0, Tensor) else jnp.asarray(g0)
+        if seed is not None and _try_compiled_tape_backward(root, seed):
+            return None
 
     def _raw(g):
         return g._value if isinstance(g, Tensor) else g

@@ -80,6 +80,10 @@ define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debug mode)")
 define_flag("benchmark", False, "sync after each op and record timings")
 define_flag("eager_op_jit", True, "wrap per-op lowering in jax.jit with a compile cache")
 define_flag(
+    "eager_tape_jit", True,
+    "compile the whole eager backward sweep into one cached XLA program",
+)
+define_flag(
     "use_standalone_executor", True, "use the compiled whole-program executor path"
 )
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")

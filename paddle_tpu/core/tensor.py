@@ -349,8 +349,104 @@ class Tensor:
                 )
         idx = _unwrap_index(idx)
 
-        # closure over idx → dispatch skips the jit cache for it, but still
-        # records the tape (vjp handles the scatter-back for masks/gathers)
+        # a bare int (or all-int tuple) varies call to call — pass it as a
+        # TRACED scalar so ONE compiled program serves every index value
+        # (static-kwarg caching here would compile per index: a row-iteration
+        # loop would trigger a compile storm and unbounded cache growth)
+        if isinstance(idx, (int, np.integer)) and not isinstance(
+            idx, (bool, np.bool_)
+        ):
+            i = int(idx)
+            i += self.shape[0] if i < 0 else 0  # bounds checked above
+            return dispatch.apply(
+                _take_leading, self, jnp.asarray(i, jnp.int32), op_name="getitem"
+            )
+        if (
+            isinstance(idx, tuple)
+            and idx
+            and len(idx) <= self.ndim
+            and all(
+                isinstance(e, (int, np.integer))
+                and not isinstance(e, (bool, np.bool_))
+                for e in idx
+            )
+        ):
+            wrapped = []
+            for ax, e in enumerate(idx):
+                n = self._value.shape[ax]
+                e = int(e)
+                if not -n <= e < n:
+                    raise IndexError(
+                        f"index {e} is out of bounds for axis {ax} with size {n}"
+                    )
+                wrapped.append(jnp.asarray(e + n if e < 0 else e, jnp.int32))
+            return dispatch.apply(
+                _getitem_ints, self, *wrapped, op_name="getitem"
+            )
+
+        # mixed tuple (ints among slices/None/Ellipsis): wrap the ints as
+        # traced scalars so one program per tuple STRUCTURE serves every int
+        # value — `x[i, :]` in a loop must not compile per i
+        if (
+            isinstance(idx, tuple)
+            and any(
+                isinstance(e, (int, np.integer))
+                and not isinstance(e, (bool, np.bool_))
+                for e in idx
+            )
+            and not any(isinstance(e, (bool, np.bool_)) for e in idx)
+            and _index_is_static(idx)
+        ):
+            spec, ints = [], []
+            ax = 0
+            for e in idx:
+                if e is None:
+                    spec.append(None)
+                    continue
+                if e is Ellipsis:
+                    spec.append(e)
+                    ax += self.ndim - sum(
+                        1 for q in idx if q is not None and q is not Ellipsis
+                    )
+                    continue
+                if isinstance(e, (int, np.integer)) and not isinstance(
+                    e, (bool, np.bool_)
+                ):
+                    n = self._value.shape[ax]
+                    e = int(e)
+                    if not -n <= e < n:
+                        raise IndexError(
+                            f"index {e} is out of bounds for axis {ax} with size {n}"
+                        )
+                    ints.append(jnp.asarray(e + n if e < 0 else e, jnp.int32))
+                    spec.append(_INT_SLOT)
+                else:
+                    spec.append(e)
+                ax += 1
+            return dispatch.apply(
+                _getitem_mixed, self, *ints, spec=tuple(spec), op_name="getitem"
+            )
+
+        # fully-static indices (slices/None/Ellipsis) are hashable → pass as
+        # a static kwarg so the op hits the per-op jit + vjp caches instead
+        # of re-linearizing on every call (ADVICE r1 / VERDICT r2 item 9).
+        # Slice patterns mostly repeat; a bounded guard keeps pathological
+        # non-repeating patterns (sliding windows) from growing the jit
+        # cache without limit — beyond the cap they take the uncached path.
+        if _index_is_static(idx):
+            try:  # slices are unhashable before Python 3.12 → closure path
+                cacheable = idx in _static_idx_seen or len(_static_idx_seen) < 512
+                if cacheable:
+                    _static_idx_seen.add(idx)
+            except TypeError:
+                cacheable = False
+            if cacheable:
+                return dispatch.apply(
+                    _getitem_static, self, idx=idx, op_name="getitem"
+                )
+
+        # array-valued index → closure; dispatch skips the jit cache for it,
+        # but still records the tape (vjp handles the scatter-back for gathers)
         def _getitem(x):
             return x[idx]
 
@@ -390,6 +486,47 @@ def _unwrap_index(idx):
     if isinstance(idx, list):
         return jnp.asarray(np.asarray(idx))
     return idx
+
+
+def _getitem_static(x, *, idx):
+    return x[idx]
+
+
+def _take_leading(x, i):
+    return jnp.take(x, i, axis=0)
+
+
+def _getitem_ints(x, *idxs):
+    return x[idxs]
+
+
+# placeholder marking traced-int positions inside a mixed index tuple
+_INT_SLOT = "__traced_int__"
+
+# distinct static index values routed through the jit cache (bounded guard)
+_static_idx_seen: set = set()
+
+
+def _getitem_mixed(x, *ints, spec):
+    it = iter(ints)
+    idx = tuple(next(it) if e == _INT_SLOT else e for e in spec)
+    return x[idx]
+
+
+def _index_is_static(idx) -> bool:
+    """True when idx is fully hashable static metadata (no arrays)."""
+    if idx is None or idx is Ellipsis:
+        return True
+    if isinstance(idx, (int, np.integer, bool, np.bool_)):
+        return True
+    if isinstance(idx, slice):
+        return all(
+            s is None or isinstance(s, (int, np.integer))
+            for s in (idx.start, idx.stop, idx.step)
+        )
+    if isinstance(idx, tuple):
+        return all(_index_is_static(i) for i in idx)
+    return False
 
 
 def _index_is_traceable(idx) -> bool:

@@ -460,6 +460,18 @@ def cross_entropy(
         )
         loss = nll_from_logprob(lg, label, soft_label, ignore_index, axis)
     else:
+        # fold mean/sum into the one fused op when no post-scaling applies —
+        # the whole loss is then a single dispatched program (fwd and bwd)
+        if (
+            weight is None
+            and reduction in ("mean", "sum")
+            and not (reduction == "mean" and ignore_index != -100 and not soft_label)
+        ):
+            return apply(
+                _nn.softmax_with_cross_entropy, input, label, soft_label=soft_label,
+                ignore_index=ignore_index, axis=axis, reduction=reduction,
+                op_name="softmax_with_cross_entropy",
+            )
         loss = apply(
             _nn.softmax_with_cross_entropy, input, label, soft_label=soft_label,
             ignore_index=ignore_index, axis=axis, op_name="softmax_with_cross_entropy",

@@ -601,21 +601,29 @@ def gumbel_softmax(x, key, *, temperature=1.0, hard=False, axis=-1):
 # operators/softmax_with_cross_entropy_op
 # ---------------------------------------------------------------------------
 def softmax_with_cross_entropy(
-    logits, label, *, soft_label=False, ignore_index=-100, axis=-1
+    logits, label, *, soft_label=False, ignore_index=-100, axis=-1,
+    reduction="none",
 ):
+    """reduction folds the mean/sum into this one op so an eager training
+    step dispatches a single program for the whole loss (the reference's
+    softmax_with_cross_entropy is likewise one fused kernel)."""
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
-        return loss
-    lab = label
-    if lab.ndim == logits.ndim:
-        lab = jnp.squeeze(lab, axis=axis)
-    picked = jnp.take_along_axis(
-        logp, jnp.expand_dims(jnp.clip(lab, 0, None).astype(jnp.int32), axis), axis=axis
-    )
-    loss = -picked
-    valid = jnp.expand_dims(lab != ignore_index, axis)
-    loss = jnp.where(valid, loss, 0.0)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim:
+            lab = jnp.squeeze(lab, axis=axis)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lab, 0, None).astype(jnp.int32), axis), axis=axis
+        )
+        loss = -picked
+        valid = jnp.expand_dims(lab != ignore_index, axis)
+        loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
     return loss
 
 

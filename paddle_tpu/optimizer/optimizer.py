@@ -91,8 +91,11 @@ class Optimizer:
     # -- main API ------------------------------------------------------------
     @no_grad()
     def step(self):
-        """reference: optimizer.py:1185 step — one phi optimizer-kernel launch
-        per param; here one cached jitted XLA call per (rule, shape, dtype)."""
+        """reference: optimizer.py:1185 step. The reference launches one phi
+        optimizer kernel per param; here ALL param updates run as ONE cached
+        jitted XLA program (the merged_adam/multi_tensor path the reference
+        gates behind use_multi_tensor), so eager training pays a single
+        dispatch per step instead of one per parameter."""
         params_grads = [
             (p, p.grad)
             for p in self._param_list()
@@ -101,8 +104,83 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
-        for p, g in params_grads:
-            self._apply_one(p, g)
+        if params_grads:
+            self._apply_fused(params_grads)
+
+    def _apply_fused(self, params_grads):
+        params = [p for p, _ in params_grads]
+        g_vals = [
+            (g._value if isinstance(g, Tensor) else g) for _, g in params_grads
+        ]
+        states = []
+        for p in params:
+            st = self._accumulators.get(id(p))
+            if st is None:
+                st = self._create_state(p)
+                self._accumulators[id(p)] = st
+            states.append(st)
+        # key covers everything the traced update reads besides its arrays:
+        # rule identity, global + per-param statics, and array shapes/dtypes
+        # (jit would retrace on those anyway; keying here keeps one wrapper
+        # per configuration instead of leaking one per optimizer instance).
+        # The key is memoized per (param identity, shapes/dtypes) — rebuilding
+        # it each step costs more than the whole host-side dispatch.
+        sig = (
+            tuple(sorted(self._hyper().items())),
+            self._weight_decay,
+            tuple(
+                (id(p), p._value.shape, p._value.dtype, g.dtype)
+                for p, g in zip(params, g_vals)
+            ),
+        )
+        memo = getattr(self, "_fused_key_memo", None)
+        if memo is not None and memo[0] == sig:
+            key = memo[1]
+        else:
+            key = (
+                type(self),
+                tuple(sorted(self._hyper().items())),
+                tuple(
+                    tuple(sorted(self._per_param_hyper(p).items())) for p in params
+                ),
+                self._weight_decay,
+                tuple(
+                    (p._value.shape, str(p._value.dtype), str(g.dtype))
+                    for p, g in zip(params, g_vals)
+                ),
+            )
+            self._fused_key_memo = (sig, key)
+        fn = _jit_update_cache.get(key)
+        if fn is None:
+            rule = type(self)._update
+            hypers = [dict(self._hyper(), **self._per_param_hyper(p)) for p in params]
+            # the traced rule reads nothing off the instance except
+            # _weight_decay (via _apply_weight_decay_l2) — bind a bare shim
+            # carrying just that scalar, NOT `self`: this cache is global and
+            # capturing the instance would pin its accumulators (potentially
+            # hundreds of MB of moments) for the process lifetime
+            ctx = object.__new__(type(self))
+            ctx._weight_decay = self._weight_decay
+
+            def fused(p_vals, g_vals, lr, sts, _ctx=ctx, _hypers=hypers):
+                new_ps, new_sts = [], []
+                for pv, gv, st, hy in zip(p_vals, g_vals, sts, _hypers):
+                    if gv.dtype != pv.dtype:
+                        gv = gv.astype(pv.dtype)
+                    np_, nst = rule(_ctx, pv, gv, lr, st, **hy)
+                    new_ps.append(np_)
+                    new_sts.append(nst)
+                return new_ps, new_sts
+
+            fn = jax.jit(fused)
+            _jit_update_cache[key] = fn
+        new_ps, new_sts = fn(
+            [p._value for p in params], g_vals,
+            jnp.asarray(self.get_lr(), dtype=jnp.float32), states,
+        )
+        for p, npv, nst in zip(params, new_ps, new_sts):
+            p._value = npv
+            self._accumulators[id(p)] = nst
 
     def _param_list(self) -> List[Tensor]:
         if self._parameters is None:
@@ -111,43 +189,6 @@ class Optimizer:
                 "mode is driven through minimize())"
             )
         return self._parameters
-
-    def _apply_one(self, p: Tensor, g: Tensor):
-        state = self._accumulators.get(id(p))
-        if state is None:
-            state = self._create_state(p)
-            self._accumulators[id(p)] = state
-        gval = g._value if isinstance(g, Tensor) else g
-        if gval.dtype != p._value.dtype:
-            gval = gval.astype(p._value.dtype)
-        # the key must cover EVERY value the traced rule reads off self —
-        # _hyper(), per-param overrides (AdamW decay exclusion, Lars
-        # exclude list), and the base-class weight decay — or a second
-        # optimizer instance would silently reuse a stale compiled update
-        per = self._per_param_hyper(p)
-        key = (
-            type(self),
-            tuple(sorted(self._hyper().items())),
-            tuple(sorted(per.items())),
-            self._weight_decay,
-            p._value.shape,
-            str(p._value.dtype),
-        )
-        fn = _jit_update_cache.get(key)
-        if fn is None:
-            hyper = dict(self._hyper(), **per)
-            rule = type(self)._update
-
-            def pure(pv, gv, lr, st, _self=self):
-                return rule(_self, pv, gv, lr, st, **hyper)
-
-            fn = jax.jit(pure)
-            _jit_update_cache[key] = fn
-        new_p, new_state = fn(
-            p._value, gval, jnp.asarray(self.get_lr(), dtype=jnp.float32), state
-        )
-        p._value = new_p
-        self._accumulators[id(p)] = new_state
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         """reference: optimizer.py:1120 — backward + apply."""
