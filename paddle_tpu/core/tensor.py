@@ -371,15 +371,10 @@ class Tensor:
                 for e in idx
             )
         ):
-            wrapped = []
-            for ax, e in enumerate(idx):
-                n = self._value.shape[ax]
-                e = int(e)
-                if not -n <= e < n:
-                    raise IndexError(
-                        f"index {e} is out of bounds for axis {ax} with size {n}"
-                    )
-                wrapped.append(jnp.asarray(e + n if e < 0 else e, jnp.int32))
+            wrapped = [
+                _checked_traced_int(e, self._value.shape[ax], ax)
+                for ax, e in enumerate(idx)
+            ]
             return dispatch.apply(
                 _getitem_ints, self, *wrapped, op_name="getitem"
             )
@@ -412,13 +407,9 @@ class Tensor:
                 if isinstance(e, (int, np.integer)) and not isinstance(
                     e, (bool, np.bool_)
                 ):
-                    n = self._value.shape[ax]
-                    e = int(e)
-                    if not -n <= e < n:
-                        raise IndexError(
-                            f"index {e} is out of bounds for axis {ax} with size {n}"
-                        )
-                    ints.append(jnp.asarray(e + n if e < 0 else e, jnp.int32))
+                    ints.append(
+                        _checked_traced_int(e, self._value.shape[ax], ax)
+                    )
                     spec.append(_INT_SLOT)
                 else:
                     spec.append(e)
@@ -498,6 +489,17 @@ def _take_leading(x, i):
 
 def _getitem_ints(x, *idxs):
     return x[idxs]
+
+
+def _checked_traced_int(e, n, ax):
+    """Bounds-check int index `e` on an axis of size `n`, wrap negatives,
+    and return it as a traced i32 scalar (shared by every int-index path)."""
+    e = int(e)
+    if not -n <= e < n:
+        raise IndexError(
+            f"index {e} is out of bounds for axis {ax} with size {n}"
+        )
+    return jnp.asarray(e + n if e < 0 else e, jnp.int32)
 
 
 # placeholder marking traced-int positions inside a mixed index tuple

@@ -58,15 +58,61 @@ __all__ = [
     "QueueDataset",
     "LocalSGDOptimizer",
     "DGCMomentumOptimizer",
+    "is_server",
+    "init_server",
+    "run_server",
+    "init_worker",
+    "stop_worker",
 ]
 
-_state = {"strategy": None, "hcg": None, "initialized": False}
+_state = {"strategy": None, "hcg": None, "initialized": False, "ps": None}
+
+
+def _ps_runtime():
+    """Lazy TheOnePSRuntime singleton (reference: fleet._runtime_handle)."""
+    if _state["ps"] is None:
+        from ..ps import TheOnePSRuntime
+
+        _state["ps"] = TheOnePSRuntime()
+    return _state["ps"]
+
+
+def is_server() -> bool:
+    import os
+
+    return os.getenv("TRAINING_ROLE", "TRAINER") == "PSERVER"
+
+
+def init_server(*args, **kwargs):
+    """reference: fleet_base.py init_server → TheOnePSRuntime._init_server."""
+    _ps_runtime()._init_server(*args, **kwargs)
+
+
+def run_server():
+    """Serve until a trainer stops the fleet (reference: run_server)."""
+    _ps_runtime()._run_server()
+
+
+def init_worker(*args, **kwargs):
+    """reference: fleet_base.py init_worker → _init_worker (PS client)."""
+    _ps_runtime()._init_worker(*args, **kwargs)
+
+
+def stop_worker():
+    """reference: fleet_base.py stop_worker — barrier, then trainer 0
+    broadcasts STOP to the server fleet."""
+    _ps_runtime()._stop_worker()
 
 
 def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None):
     """reference: fleet_base.py:206 fleet.init."""
     strategy = strategy or DistributedStrategy()
     _state["strategy"] = strategy
+    if is_server():
+        # a PSERVER process never touches the chip mesh — it only hosts
+        # tables (reference: server role skips collective init)
+        _state["initialized"] = True
+        return None
     hybrid = strategy.hybrid_configs
     dp = hybrid.get("dp_degree", 1)
     mp = hybrid.get("mp_degree", 1)
@@ -340,13 +386,29 @@ class Fleet:
         return jax.process_count()
 
     def is_worker(self):
-        return True
+        return not is_server()
+
+    def is_server(self):
+        return is_server()
+
+    def init_server(self, *args, **kwargs):
+        return init_server(*args, **kwargs)
+
+    def run_server(self):
+        return run_server()
+
+    def init_worker(self, *args, **kwargs):
+        return init_worker(*args, **kwargs)
 
     def barrier_worker(self):
-        self.util.barrier()
+        ps = _state["ps"]
+        if ps is not None and ps.is_distributed:
+            ps.barrier()
+        else:
+            self.util.barrier()
 
     def stop_worker(self):
-        pass
+        return stop_worker()
 
 
 from .role_maker import Role  # noqa: E402,F401

@@ -29,7 +29,11 @@ from ...core.dispatch import GradNode, is_grad_enabled
 from ...core.tensor import Tensor
 from ...nn.layer_base import Layer
 
-__all__ = ["MemorySparseTable", "SparseEmbedding", "TheOnePSRuntime"]
+__all__ = [
+    "MemorySparseTable", "SparseEmbedding", "TheOnePSRuntime",
+    "PsServer", "PsClient", "DistributedSparseTable",
+    "GeoDistributedSparseTable", "DenseTableHandle", "Communicator",
+]
 
 _lib = None
 
@@ -39,8 +43,12 @@ def _load_lib():
     if _lib is None:
         from ...utils import cpp_extension
 
-        src = os.path.join(os.path.dirname(__file__), "csrc", "memory_sparse_table.cc")
-        _lib = cpp_extension.load("ps_table", [src])
+        csrc = os.path.join(os.path.dirname(__file__), "csrc")
+        src = os.path.join(csrc, "memory_sparse_table.cc")
+        _lib = cpp_extension.load(
+            "ps_table", [src],
+            depends=[os.path.join(csrc, "ps_sparse_table.h")],
+        )
         _lib.ps_table_create.restype = ctypes.c_void_p
         _lib.ps_table_create.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -52,6 +60,9 @@ def _load_lib():
             ctypes.c_void_p, ctypes.c_int,
         ]
         _lib.ps_table_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        _lib.ps_table_push_raw.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ]
         _lib.ps_table_size.restype = ctypes.c_int64
@@ -107,6 +118,16 @@ class MemorySparseTable:
         )
         self._lib.ps_table_push(self._h, keys.ctypes.data, keys.size, grads.ctypes.data)
 
+    def push_raw(self, keys: np.ndarray, deltas: np.ndarray):
+        """Raw delta add (no optimizer) — the geo-async merge primitive."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        deltas = np.ascontiguousarray(deltas, dtype=np.float32).reshape(
+            keys.size, self.emb_dim
+        )
+        self._lib.ps_table_push_raw(
+            self._h, keys.ctypes.data, keys.size, deltas.ctypes.data
+        )
+
     def set_lr(self, lr: float):
         self._lib.ps_table_set_lr(self._h, ctypes.c_float(lr))
 
@@ -143,8 +164,16 @@ class SparseEmbedding(Layer):
         # table is a hash map — any int64 feature id works, like the ref)
         self.emb_dim = int(size[1])
         self.padding_idx = padding_idx
-        self.table = table or MemorySparseTable(
-            self.emb_dim, shard_num, optimizer, learning_rate, init_range, seed
+        # identity check, NOT truthiness: tables define __len__, and a
+        # freshly created (empty) table is falsy — `table or ...` would
+        # silently discard it and train on a private default table
+        self.table = (
+            table
+            if table is not None
+            else MemorySparseTable(
+                self.emb_dim, shard_num, optimizer, learning_rate, init_range,
+                seed,
+            )
         )
 
     def forward(self, ids: Tensor) -> Tensor:
@@ -201,43 +230,188 @@ class SparseEmbedding(Layer):
 
 
 class TheOnePSRuntime:
-    """Single-host TheOnePS runtime (reference: ps/the_one_ps.py:816).
+    """TheOnePS runtime — in-process tables on one host, the networked
+    PsService fleet across hosts (reference: ps/the_one_ps.py:816
+    TheOnePSRuntime._init_server:1049 / _init_worker:903).
 
-    Owns the named tables; init_server/init_worker collapse to in-process
-    setup on one host. save/load persist every table to a directory —
-    the reference's save_persistables for sparse tables.
+    Roles follow the launch env contract (PaddleCloudRoleMaker):
+      - a PSERVER process calls `_init_server()` + `_run_server()`: starts
+        the C++ PsService on PADDLE_PORT and blocks until STOP;
+      - a TRAINER process calls `_init_worker()`: connects a PsClient to
+        PADDLE_PSERVERS_IP_PORT_LIST; `create_table` then yields
+        DistributedSparseTable stubs instead of local tables.
+    With no server endpoints configured, everything stays in-process
+    (single-host mode — tables are local C++ MemorySparseTables).
     """
 
     def __init__(self):
         self._tables = {}
+        self._table_ids = {}
+        self._next_id = 1
+        self._server = None
+        self._client = None
+        self._endpoints = []
 
-    def create_table(self, name: str, emb_dim: int, **kwargs) -> MemorySparseTable:
+    # -- role bootstrap ------------------------------------------------------
+    def _init_server(self, *args, **kwargs):
+        """Start this process's PsService (reference _init_server:1049)."""
+        from .service import PsServer
+
+        if self._server is not None:
+            return
+        eps = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._endpoints = eps.split(",") if eps else []
+        port = int(os.getenv("PADDLE_PORT", "0"))
+        n_servers = max(len(self._endpoints), 1)
+        sid_env = os.getenv("PADDLE_SERVER_ID")
+        if sid_env is not None:
+            server_id = int(sid_env)
+            if port == 0 and server_id < len(self._endpoints):
+                # bind the advertised port, not an ephemeral one
+                port = int(self._endpoints[server_id].rpartition(":")[2])
+        elif len(self._endpoints) <= 1:
+            server_id = 0
+            if port == 0 and self._endpoints:
+                port = int(self._endpoints[0].rpartition(":")[2])
+        else:
+            # derive id (and port when unset) from this host's position in
+            # the endpoint list — the launch CLI sets PADDLE_PORT + POD_IP
+            # but no explicit server id. A silent fallback to id 0 would
+            # make multiple servers write colliding checkpoint partitions,
+            # so an unresolvable identity is an error.
+            my = os.getenv("POD_IP", "127.0.0.1")
+            server_id = None
+            for i, ep in enumerate(self._endpoints):
+                ip, _, p = ep.rpartition(":")
+                if ip == my and (port == 0 or int(p) == port):
+                    server_id, port = i, int(p)
+                    break
+            if server_id is None:
+                raise RuntimeError(
+                    f"cannot locate this server (POD_IP={my!r}, "
+                    f"PADDLE_PORT={port}) in PADDLE_PSERVERS_IP_PORT_LIST="
+                    f"{self._endpoints}; set PADDLE_SERVER_ID explicitly "
+                    "(hostname endpoints need it — matching is by IP)"
+                )
+        n_trainers = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._server = PsServer(
+            port=port, server_id=server_id, n_servers=n_servers,
+            n_trainers=n_trainers,
+        )
+
+    def _run_server(self):
+        """Serve until a trainer broadcasts STOP (reference run_server)."""
+        if self._server is None:
+            self._init_server()
+        self._server.wait()
+
+    def _init_worker(self, *args, **kwargs):
+        """Connect this trainer to the server fleet (reference
+        _init_worker:903). No-op single-host when no endpoints are set."""
+        from .service import PsClient
+
+        if self._client is not None:
+            return
+        eps = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._endpoints = [e for e in eps.split(",") if e]
+        if not self._endpoints:
+            return  # single-host in-process mode
+        trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._client = PsClient(self._endpoints, trainer_id=trainer_id)
+        # servers may still be binding — retry the first ping briefly
+        import time
+
+        for attempt in range(50):
+            try:
+                self._client.ping()
+                break
+            except ConnectionError:
+                if attempt == 49:
+                    raise
+                time.sleep(0.2)
+
+    def _stop_worker(self):
+        """Trainer 0 stops the fleet after everyone is done (reference
+        stop_worker + the barrier-then-stop shutdown dance)."""
+        if self._client is None:
+            return
+        self._client.barrier()
+        if self._client.trainer_id == 0:
+            self._client.stop_servers()
+        self._client = None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self._client is not None
+
+    def barrier(self):
+        if self._client is not None:
+            self._client.barrier()
+
+    # -- tables --------------------------------------------------------------
+    def create_table(self, name: str, emb_dim: int, *, geo_steps: int = 0,
+                     **kwargs):
+        """Local MemorySparseTable on one host; a DistributedSparseTable
+        stub (or geo replica when geo_steps>0) against the fleet."""
         if name in self._tables:
             raise ValueError(f"table {name!r} already exists")
-        t = MemorySparseTable(emb_dim, **kwargs)
+        if self._client is not None:
+            from .service import DistributedSparseTable, GeoDistributedSparseTable
+
+            tid = self._table_ids.setdefault(name, self._next_id)
+            self._next_id += 1
+            cls = GeoDistributedSparseTable if geo_steps > 0 else DistributedSparseTable
+            extra = {"geo_steps": geo_steps} if geo_steps > 0 else {}
+            t = cls(self._client, tid, emb_dim, **extra, **kwargs)
+        else:
+            t = MemorySparseTable(emb_dim, **kwargs)
         self._tables[name] = t
         return t
 
-    def get_table(self, name: str) -> MemorySparseTable:
+    def create_dense_table(self, name: str, params, optimizer: str = "sgd",
+                           learning_rate: float = 0.01):
+        """Server-resident dense parameters (reference MemoryDenseTable)."""
+        from .service import DenseTableHandle
+
+        if self._client is None:
+            raise RuntimeError(
+                "dense tables need the distributed PS (call _init_worker "
+                "with PADDLE_PSERVERS_IP_PORT_LIST set)"
+            )
+        tid = self._table_ids.setdefault(name, self._next_id)
+        self._next_id += 1
+        h = DenseTableHandle(
+            self._client, tid, params, optimizer, learning_rate
+        )
+        self._tables[name] = h
+        return h
+
+    def get_table(self, name: str):
         return self._tables[name]
-
-    def _init_server(self, *args, **kwargs):
-        pass  # in-process tables need no server bootstrap on one host
-
-    def _init_worker(self, *args, **kwargs):
-        pass
-
-    def _stop_worker(self):
-        pass
 
     def save_persistables(self, dirname: str):
         os.makedirs(dirname, exist_ok=True)
+        if self._client is not None:
+            self._client.save(dirname)
+            return
         for name, t in self._tables.items():
             t.save(os.path.join(dirname, f"{name}.sparse"))
 
     def load_persistables(self, dirname: str):
+        if self._client is not None:
+            self._client.load(dirname)
+            return
         for name, t in self._tables.items():
             t.load(os.path.join(dirname, f"{name}.sparse"))
 
+from . import service  # noqa: E402,F401
+from .service import (  # noqa: E402,F401
+    Communicator,
+    DenseTableHandle,
+    DistributedSparseTable,
+    GeoDistributedSparseTable,
+    PsClient,
+    PsServer,
+)
 from . import the_one_ps  # noqa: E402,F401
 from . import utils  # noqa: E402,F401

@@ -1,260 +1,53 @@
-// MemorySparseTable — host-RAM sharded sparse embedding table.
+// MemorySparseTable C ABI — in-process facade over the sharded sparse table
+// (table logic lives in ps_sparse_table.h, shared with the networked
+// PsService in ps_server.cc / ps_client.cc).
 //
 // Reference analogue: paddle/fluid/distributed/ps/table/memory_sparse_table.cc
-// (sharded unordered_map embedding store with per-shard task parallelism) and
-// ps/table/sparse_sgd_rule.cc (per-feature optimizer rules applied inside the
-// table on push — SGD / AdaGrad).
-//
-// TPU-native role: the TPU holds the dense model; sparse features live in
-// host RAM behind this table. PullSparse materializes a minibatch's rows for
-// upload to the chip; PushSparse applies the optimizer to the touched rows
-// only. Exposed as a C ABI for ctypes (the framework's pybind replacement).
+// and ps/table/sparse_sgd_rule.cc. Exposed as a C ABI for ctypes (the
+// framework's pybind replacement).
 //
 // Build: g++ -O3 -std=c++17 -shared -fPIC memory_sparse_table.cc -o libps_table.so -lpthread
 
-#include <cmath>
-#include <cstdint>
-#include <cstdio>
-#include <cstring>
-#include <random>
-#include <thread>
-#include <unordered_map>
-#include <vector>
+#include "ps_sparse_table.h"
 
-namespace {
-
-enum OptType : int32_t { OPT_SGD = 0, OPT_ADAGRAD = 1 };
-
-struct Entry {
-  std::vector<float> emb;
-  std::vector<float> g2sum;  // adagrad accumulator (empty for sgd)
-};
-
-// Thread-safety model: run_sharded partitions shards across its worker
-// threads, so within one pull/push call no shard is touched by two threads.
-// Concurrent pull/push calls from DIFFERENT caller threads are NOT
-// supported (the reference serializes through per-table task queues; the
-// Python layer is effectively single-caller under the GIL + blocking call).
-struct Shard {
-  std::unordered_map<int64_t, Entry> map;
-};
-
-struct Table {
-  int emb_dim;
-  int shard_num;
-  int32_t opt_type;
-  float lr;
-  float init_range;   // uniform(-init_range, init_range); 0 => zeros
-  float adagrad_eps;
-  uint64_t seed;
-  std::vector<Shard> shards;
-
-  Table(int dim, int nshard, int32_t opt, float lr_, float range, uint64_t seed_)
-      : emb_dim(dim),
-        shard_num(nshard),
-        opt_type(opt),
-        lr(lr_),
-        init_range(range),
-        adagrad_eps(1e-6f),
-        seed(seed_),
-        shards(nshard) {}
-
-  int shard_of(int64_t key) const {
-    uint64_t h = (static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ULL) >> 32;
-    return static_cast<int>(h % static_cast<uint64_t>(shard_num));
-  }
-
-  void init_entry(int64_t key, Entry* e) {
-    e->emb.resize(emb_dim);
-    if (init_range > 0.f) {
-      // per-key deterministic init: same key always gets the same row,
-      // independent of insertion order or shard count
-      std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
-      std::uniform_real_distribution<float> dist(-init_range, init_range);
-      for (int i = 0; i < emb_dim; ++i) e->emb[i] = dist(gen);
-    }
-    if (opt_type == OPT_ADAGRAD) e->g2sum.assign(emb_dim, 0.f);
-  }
-
-  // gather rows for keys; missing keys are created (reference PullSparse
-  // create-on-miss semantics for training; pull_only skips creation for
-  // inference lookups and returns zeros)
-  void pull(const int64_t* keys, int64_t n, float* out, bool create) {
-    run_sharded(keys, n, [&](int64_t idx) {
-      int64_t key = keys[idx];
-      Shard& sh = shards[shard_of(key)];
-      auto it = sh.map.find(key);
-      if (it == sh.map.end()) {
-        if (!create) {
-          std::memset(out + idx * emb_dim, 0, sizeof(float) * emb_dim);
-          return;
-        }
-        Entry e;
-        init_entry(key, &e);
-        it = sh.map.emplace(key, std::move(e)).first;
-      }
-      std::memcpy(out + idx * emb_dim, it->second.emb.data(),
-                  sizeof(float) * emb_dim);
-    });
-  }
-
-  // apply optimizer update for grads (duplicate keys in one batch fold
-  // their updates sequentially, matching the reference's push accumulation)
-  void push(const int64_t* keys, int64_t n, const float* grads) {
-    run_sharded(keys, n, [&](int64_t idx) {
-      int64_t key = keys[idx];
-      Shard& sh = shards[shard_of(key)];
-      auto it = sh.map.find(key);
-      if (it == sh.map.end()) {
-        Entry e;
-        init_entry(key, &e);
-        it = sh.map.emplace(key, std::move(e)).first;
-      }
-      Entry& e = it->second;
-      const float* g = grads + idx * emb_dim;
-      if (opt_type == OPT_ADAGRAD) {
-        for (int i = 0; i < emb_dim; ++i) {
-          e.g2sum[i] += g[i] * g[i];
-          e.emb[i] -= lr * g[i] / (std::sqrt(e.g2sum[i]) + adagrad_eps);
-        }
-      } else {
-        for (int i = 0; i < emb_dim; ++i) e.emb[i] -= lr * g[i];
-      }
-    });
-  }
-
-  // shard-parallel execution: each worker owns a subset of shards so no
-  // entry is touched by two threads (reference: shards_task_pool_)
-  template <typename F>
-  void run_sharded(const int64_t* keys, int64_t n, F fn) {
-    int nthreads = std::min<int64_t>(shard_num, std::min<int64_t>(n, 8));
-    if (nthreads <= 1 || n < 1024) {
-      // serialize per shard lock-free
-      for (int64_t i = 0; i < n; ++i) fn(i);
-      return;
-    }
-    std::vector<std::thread> ts;
-    ts.reserve(nthreads);
-    for (int t = 0; t < nthreads; ++t) {
-      ts.emplace_back([&, t] {
-        for (int64_t i = 0; i < n; ++i) {
-          if (shard_of(keys[i]) % nthreads == t) fn(i);
-        }
-      });
-    }
-    for (auto& th : ts) th.join();
-  }
-
-  int64_t size() const {
-    int64_t s = 0;
-    for (const auto& sh : shards) s += static_cast<int64_t>(sh.map.size());
-    return s;
-  }
-
-  bool save(const char* path) const {
-    FILE* f = std::fopen(path, "wb");
-    if (!f) return false;
-    int64_t n = size();
-    int32_t has_g2 = (opt_type == OPT_ADAGRAD) ? 1 : 0;
-    bool ok = std::fwrite(&emb_dim, sizeof(emb_dim), 1, f) == 1 &&
-              std::fwrite(&has_g2, sizeof(has_g2), 1, f) == 1 &&
-              std::fwrite(&n, sizeof(n), 1, f) == 1;
-    for (const auto& sh : shards) {
-      if (!ok) break;
-      for (const auto& kv : sh.map) {
-        ok = ok && std::fwrite(&kv.first, sizeof(int64_t), 1, f) == 1 &&
-             std::fwrite(kv.second.emb.data(), sizeof(float), emb_dim, f) ==
-                 static_cast<size_t>(emb_dim);
-        if (has_g2)
-          ok = ok &&
-               std::fwrite(kv.second.g2sum.data(), sizeof(float), emb_dim, f) ==
-                   static_cast<size_t>(emb_dim);
-        if (!ok) break;
-      }
-    }
-    ok = (std::fclose(f) == 0) && ok;  // disk-full surfaces at flush
-    return ok;
-  }
-
-  bool load(const char* path) {
-    FILE* f = std::fopen(path, "rb");
-    if (!f) return false;
-    int dim = 0;
-    int32_t has_g2 = 0;
-    int64_t n = 0;
-    if (std::fread(&dim, sizeof(dim), 1, f) != 1 || dim != emb_dim ||
-        std::fread(&has_g2, sizeof(has_g2), 1, f) != 1 ||
-        std::fread(&n, sizeof(n), 1, f) != 1) {
-      std::fclose(f);
-      return false;
-    }
-    // restore replaces the whole table (the reference's load contract):
-    // stale post-checkpoint rows must not survive a rewind
-    for (auto& sh : shards) sh.map.clear();
-    bool ok = true;
-    for (int64_t i = 0; i < n; ++i) {
-      int64_t key;
-      if (std::fread(&key, sizeof(key), 1, f) != 1) {
-        ok = false;  // truncated checkpoint — fail loudly, not partially
-        break;
-      }
-      Entry e;
-      e.emb.resize(emb_dim);
-      if (std::fread(e.emb.data(), sizeof(float), emb_dim, f) !=
-          static_cast<size_t>(emb_dim)) {
-        ok = false;
-        break;
-      }
-      if (has_g2) {
-        e.g2sum.resize(emb_dim);
-        if (std::fread(e.g2sum.data(), sizeof(float), emb_dim, f) !=
-            static_cast<size_t>(emb_dim)) {
-          ok = false;
-          break;
-        }
-      } else if (opt_type == OPT_ADAGRAD) {
-        e.g2sum.assign(emb_dim, 0.f);
-      }
-      shards[shard_of(key)].map[key] = std::move(e);
-    }
-    std::fclose(f);
-    if (!ok)
-      for (auto& sh : shards) sh.map.clear();
-    return ok;
-  }
-};
-
-}  // namespace
+using ps::SparseTable;
 
 extern "C" {
 
 void* ps_table_create(int emb_dim, int shard_num, int opt_type, float lr,
                       float init_range, uint64_t seed) {
-  return new Table(emb_dim, shard_num, opt_type, lr, init_range, seed);
+  return new SparseTable(emb_dim, shard_num, opt_type, lr, init_range, seed);
 }
 
-void ps_table_destroy(void* h) { delete static_cast<Table*>(h); }
+void ps_table_destroy(void* h) { delete static_cast<SparseTable*>(h); }
 
 void ps_table_pull(void* h, const int64_t* keys, int64_t n, float* out,
                    int create) {
-  static_cast<Table*>(h)->pull(keys, n, out, create != 0);
+  static_cast<SparseTable*>(h)->pull(keys, n, out, create != 0);
 }
 
 void ps_table_push(void* h, const int64_t* keys, int64_t n,
                    const float* grads) {
-  static_cast<Table*>(h)->push(keys, n, grads);
+  static_cast<SparseTable*>(h)->push(keys, n, grads);
 }
 
-int64_t ps_table_size(void* h) { return static_cast<Table*>(h)->size(); }
+void ps_table_push_raw(void* h, const int64_t* keys, int64_t n,
+                       const float* deltas) {
+  static_cast<SparseTable*>(h)->push(keys, n, deltas, /*raw=*/true);
+}
+
+int64_t ps_table_size(void* h) { return static_cast<SparseTable*>(h)->size(); }
 
 int ps_table_save(void* h, const char* path) {
-  return static_cast<Table*>(h)->save(path) ? 0 : -1;
+  return static_cast<SparseTable*>(h)->save(path) ? 0 : -1;
 }
 
 int ps_table_load(void* h, const char* path) {
-  return static_cast<Table*>(h)->load(path) ? 0 : -1;
+  return static_cast<SparseTable*>(h)->load(path) ? 0 : -1;
 }
 
-void ps_table_set_lr(void* h, float lr) { static_cast<Table*>(h)->lr = lr; }
+void ps_table_set_lr(void* h, float lr) {
+  static_cast<SparseTable*>(h)->lr = lr;
+}
 
 }  // extern "C"

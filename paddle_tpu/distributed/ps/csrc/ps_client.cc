@@ -1,0 +1,385 @@
+// PsService client — trainer-side stub talking to every server of the fleet.
+//
+// Reference analogue: paddle/fluid/distributed/ps/service/brpc_ps_client.h
+// (BrpcPsClient: per-server channels, key partitioning by hash, request
+// fan-out with region reassembly). Sparse keys route by server_of(key);
+// dense tables split into one contiguous chunk per server; requests to the
+// involved servers run on parallel threads and results scatter back into
+// the caller's buffers in original key order.
+//
+// C ABI (ctypes): ps_client_create("ip:port,ip:port,...") + verbs below.
+// Every call returns 0 on success, -1 on a transport/servers error.
+
+#include <atomic>
+#include <cstdlib>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ps_net.h"
+
+namespace ps {
+namespace {
+
+struct Conn {
+  std::string host;
+  int port = 0;
+  int fd = -1;
+  std::mutex mu;  // one in-flight request per server connection
+
+  bool ensure() {
+    if (fd >= 0) return true;
+    fd = connect_to(host, port);
+    return fd >= 0;
+  }
+
+  void drop() {
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+  }
+};
+
+struct Client {
+  std::vector<std::unique_ptr<Conn>> conns;
+
+  int n_servers() const { return static_cast<int>(conns.size()); }
+
+  // Commands safe to resend after a mid-request transport failure: the
+  // server may or may not have executed the first copy, so only
+  // side-effect-free (or overwrite-semantics) verbs retry. PUSH_* would
+  // double-apply gradients and BARRIER would double-count an arrival.
+  static bool idempotent(uint32_t cmd) {
+    switch (cmd) {
+      case CMD_PING:
+      case CMD_CREATE_SPARSE:
+      case CMD_CREATE_DENSE:
+      case CMD_PULL_SPARSE:
+      case CMD_PULL_DENSE:
+      case CMD_SET_DENSE:
+      case CMD_STAT:
+      case CMD_SET_LR:
+      case CMD_SAVE:
+      case CMD_LOAD:
+        return true;
+      default:
+        return false;
+    }
+  }
+
+  // one framed request/response on server i
+  bool request(int i, Header& h, const void* payload,
+               std::vector<char>* resp_payload, int64_t* resp_n = nullptr) {
+    Conn& c = *conns[i];
+    std::lock_guard<std::mutex> lk(c.mu);
+    const int max_attempts = idempotent(h.cmd) ? 2 : 1;
+    for (int attempt = 0; attempt < max_attempts; ++attempt) {
+      if (!c.ensure()) return false;
+      h.magic = kMagic;
+      bool ok = write_full(c.fd, &h, sizeof(h)) &&
+                (h.nbytes == 0 ||
+                 write_full(c.fd, payload, static_cast<size_t>(h.nbytes)));
+      Header rh{};
+      ok = ok && read_full(c.fd, &rh, sizeof(rh)) && rh.magic == kMagic;
+      if (!ok) {
+        c.drop();  // stale connection (server restart) — retry once fresh
+        continue;
+      }
+      if (resp_payload) resp_payload->resize(static_cast<size_t>(rh.nbytes));
+      if (rh.nbytes > 0) {
+        std::vector<char> sink;
+        std::vector<char>* dst = resp_payload ? resp_payload : &sink;
+        if (!resp_payload) sink.resize(static_cast<size_t>(rh.nbytes));
+        if (!read_full(c.fd, dst->data(), static_cast<size_t>(rh.nbytes))) {
+          c.drop();
+          continue;
+        }
+      }
+      if (resp_n) *resp_n = rh.n;
+      return rh.flags == kStatusOk;
+    }
+    return false;
+  }
+
+  // broadcast the same request to all servers (create/save/load/lr/stop)
+  bool broadcast(Header h, const void* payload) {
+    if (n_servers() == 1) {
+      Header hi = h;
+      return request(0, hi, payload, nullptr);
+    }
+    std::atomic<bool> ok{true};
+    std::vector<std::thread> ts;
+    for (int i = 0; i < n_servers(); ++i) {
+      ts.emplace_back([&, i] {
+        Header hi = h;
+        if (!request(i, hi, payload, nullptr)) ok.store(false);
+      });
+    }
+    for (auto& t : ts) t.join();
+    return ok.load();
+  }
+
+  // run `work(i)` for each involved server — inline when there is only one
+  // (the per-minibatch hot path should not pay thread create/join), fanned
+  // out on threads otherwise so per-server RPC latencies overlap
+  template <typename W>
+  bool fan_out(const std::vector<int>& servers, W work) {
+    if (servers.size() == 1) return work(servers[0]);
+    std::atomic<bool> ok{true};
+    std::vector<std::thread> ts;
+    ts.reserve(servers.size());
+    for (int s : servers)
+      ts.emplace_back([&, s] {
+        if (!work(s)) ok.store(false);
+      });
+    for (auto& t : ts) t.join();
+    return ok.load();
+  }
+};
+
+// dense chunk [start, end) owned by server i
+inline void dense_chunk(int64_t len, int n_servers, int i, int64_t* start,
+                        int64_t* end) {
+  *start = len * i / n_servers;
+  *end = len * (i + 1) / n_servers;
+}
+
+}  // namespace
+}  // namespace ps
+
+extern "C" {
+
+void* ps_client_create(const char* endpoints_csv) {
+  auto* c = new ps::Client();
+  std::string s(endpoints_csv);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    if (comma == std::string::npos) comma = s.size();
+    std::string ep = s.substr(pos, comma - pos);
+    pos = comma + 1;
+    size_t colon = ep.rfind(':');
+    if (colon == std::string::npos) continue;
+    auto conn = std::make_unique<ps::Conn>();
+    conn->host = ep.substr(0, colon);
+    conn->port = std::atoi(ep.c_str() + colon + 1);
+    c->conns.push_back(std::move(conn));
+  }
+  if (c->conns.empty()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void ps_client_destroy(void* h) {
+  auto* c = static_cast<ps::Client*>(h);
+  for (auto& conn : c->conns) conn->drop();
+  delete c;
+}
+
+int ps_client_n_servers(void* h) {
+  return static_cast<ps::Client*>(h)->n_servers();
+}
+
+int ps_client_ping(void* h) {
+  ps::Header hd{0, ps::CMD_PING, 0, 0, 0, 0};
+  return static_cast<ps::Client*>(h)->broadcast(hd, nullptr) ? 0 : -1;
+}
+
+int ps_client_create_sparse(void* h, uint32_t table_id, int dim,
+                            int shard_num, int opt, float lr, float range,
+                            uint64_t seed) {
+  char payload[28];
+  std::memcpy(payload, &dim, 4);
+  std::memcpy(payload + 4, &shard_num, 4);
+  std::memcpy(payload + 8, &opt, 4);
+  std::memcpy(payload + 12, &lr, 4);
+  std::memcpy(payload + 16, &range, 4);
+  std::memcpy(payload + 20, &seed, 8);
+  ps::Header hd{0, ps::CMD_CREATE_SPARSE, table_id, 0, 0, 28};
+  return static_cast<ps::Client*>(h)->broadcast(hd, payload) ? 0 : -1;
+}
+
+// init != nullptr seeds every server's chunk from the trainer-0 values
+int ps_client_create_dense(void* h, uint32_t table_id, int64_t len, int opt,
+                           float lr, const float* init) {
+  auto* c = static_cast<ps::Client*>(h);
+  std::atomic<bool> ok{true};
+  std::vector<std::thread> ts;
+  for (int i = 0; i < c->n_servers(); ++i) {
+    ts.emplace_back([&, i] {
+      int64_t s, e;
+      ps::dense_chunk(len, c->n_servers(), i, &s, &e);
+      int64_t chunk = e - s;
+      std::vector<char> payload(16 + (init ? sizeof(float) * chunk : 0));
+      std::memcpy(payload.data(), &opt, 4);
+      std::memcpy(payload.data() + 4, &lr, 4);
+      std::memcpy(payload.data() + 8, &chunk, 8);
+      if (init)
+        std::memcpy(payload.data() + 16, init + s, sizeof(float) * chunk);
+      ps::Header hd{0, ps::CMD_CREATE_DENSE, table_id, 0, chunk,
+                    static_cast<int64_t>(payload.size())};
+      if (!c->request(i, hd, payload.data(), nullptr)) ok.store(false);
+    });
+  }
+  for (auto& t : ts) t.join();
+  return ok.load() ? 0 : -1;
+}
+
+int ps_client_pull_sparse(void* h, uint32_t table_id, const int64_t* keys,
+                          int64_t n, int emb_dim, float* out, int create) {
+  auto* c = static_cast<ps::Client*>(h);
+  const int S = c->n_servers();
+  // partition original positions by owning server
+  std::vector<std::vector<int64_t>> pos(S);
+  std::vector<int> involved;
+  for (int64_t i = 0; i < n; ++i)
+    pos[ps::server_of(keys[i], S)].push_back(i);
+  for (int s = 0; s < S; ++s)
+    if (!pos[s].empty()) involved.push_back(s);
+  bool ok = c->fan_out(involved, [&](int s) {
+    const auto& ps_idx = pos[s];
+    std::vector<int64_t> sk(ps_idx.size());
+    for (size_t j = 0; j < ps_idx.size(); ++j) sk[j] = keys[ps_idx[j]];
+    ps::Header hd{0, ps::CMD_PULL_SPARSE, table_id,
+                  create ? ps::kFlagCreate : 0u,
+                  static_cast<int64_t>(sk.size()),
+                  static_cast<int64_t>(sk.size() * sizeof(int64_t))};
+    std::vector<char> resp;
+    if (!c->request(s, hd, sk.data(), &resp) ||
+        resp.size() != sk.size() * sizeof(float) * emb_dim)
+      return false;
+    const float* rows = reinterpret_cast<const float*>(resp.data());
+    for (size_t j = 0; j < ps_idx.size(); ++j)
+      std::memcpy(out + ps_idx[j] * emb_dim, rows + j * emb_dim,
+                  sizeof(float) * emb_dim);
+    return true;
+  });
+  return ok ? 0 : -1;
+}
+
+int ps_client_push_sparse(void* h, uint32_t table_id, const int64_t* keys,
+                          int64_t n, int emb_dim, const float* grads,
+                          int raw) {
+  auto* c = static_cast<ps::Client*>(h);
+  const int S = c->n_servers();
+  std::vector<std::vector<int64_t>> pos(S);
+  std::vector<int> involved;
+  for (int64_t i = 0; i < n; ++i)
+    pos[ps::server_of(keys[i], S)].push_back(i);
+  for (int s = 0; s < S; ++s)
+    if (!pos[s].empty()) involved.push_back(s);
+  bool ok = c->fan_out(involved, [&](int s) {
+    const auto& ps_idx = pos[s];
+    const size_t m = ps_idx.size();
+    std::vector<char> payload(m * sizeof(int64_t) +
+                              m * sizeof(float) * emb_dim);
+    int64_t* sk = reinterpret_cast<int64_t*>(payload.data());
+    float* sg =
+        reinterpret_cast<float*>(payload.data() + m * sizeof(int64_t));
+    for (size_t j = 0; j < m; ++j) {
+      sk[j] = keys[ps_idx[j]];
+      std::memcpy(sg + j * emb_dim, grads + ps_idx[j] * emb_dim,
+                  sizeof(float) * emb_dim);
+    }
+    ps::Header hd{0, ps::CMD_PUSH_SPARSE, table_id,
+                  raw ? ps::kFlagRaw : 0u, static_cast<int64_t>(m),
+                  static_cast<int64_t>(payload.size())};
+    return c->request(s, hd, payload.data(), nullptr);
+  });
+  return ok ? 0 : -1;
+}
+
+static std::vector<int> all_servers(ps::Client* c) {
+  std::vector<int> v(c->n_servers());
+  for (int i = 0; i < c->n_servers(); ++i) v[i] = i;
+  return v;
+}
+
+int ps_client_pull_dense(void* h, uint32_t table_id, float* out,
+                         int64_t len) {
+  auto* c = static_cast<ps::Client*>(h);
+  bool ok = c->fan_out(all_servers(c), [&](int i) {
+    int64_t s, e;
+    ps::dense_chunk(len, c->n_servers(), i, &s, &e);
+    if (e == s) return true;
+    ps::Header hd{0, ps::CMD_PULL_DENSE, table_id, 0, 0, 0};
+    std::vector<char> resp;
+    if (!c->request(i, hd, nullptr, &resp) ||
+        resp.size() != sizeof(float) * static_cast<size_t>(e - s))
+      return false;
+    std::memcpy(out + s, resp.data(), resp.size());
+    return true;
+  });
+  return ok ? 0 : -1;
+}
+
+static int dense_scatter(void* h, uint32_t table_id, const float* vals,
+                         int64_t len, ps::Cmd cmd) {
+  auto* c = static_cast<ps::Client*>(h);
+  bool ok = c->fan_out(all_servers(c), [&](int i) {
+    int64_t s, e;
+    ps::dense_chunk(len, c->n_servers(), i, &s, &e);
+    if (e == s) return true;
+    ps::Header hd{0, static_cast<uint32_t>(cmd), table_id, 0, e - s,
+                  static_cast<int64_t>(sizeof(float) * (e - s))};
+    return c->request(i, hd, vals + s, nullptr);
+  });
+  return ok ? 0 : -1;
+}
+
+int ps_client_push_dense(void* h, uint32_t table_id, const float* grads,
+                         int64_t len) {
+  return dense_scatter(h, table_id, grads, len, ps::CMD_PUSH_DENSE);
+}
+
+int ps_client_set_dense(void* h, uint32_t table_id, const float* vals,
+                        int64_t len) {
+  return dense_scatter(h, table_id, vals, len, ps::CMD_SET_DENSE);
+}
+
+// global barrier across trainers, coordinated by server 0 (reference:
+// BarrierTable lives on one server)
+int ps_client_barrier(void* h, int trainer_id) {
+  ps::Header hd{0, ps::CMD_BARRIER, 0, 0, trainer_id, 0};
+  return static_cast<ps::Client*>(h)->request(0, hd, nullptr, nullptr) ? 0
+                                                                       : -1;
+}
+
+int ps_client_save(void* h, const char* dirname) {
+  ps::Header hd{0, ps::CMD_SAVE, 0, 0, 0,
+                static_cast<int64_t>(std::strlen(dirname))};
+  return static_cast<ps::Client*>(h)->broadcast(hd, dirname) ? 0 : -1;
+}
+
+int ps_client_load(void* h, const char* dirname) {
+  ps::Header hd{0, ps::CMD_LOAD, 0, 0, 0,
+                static_cast<int64_t>(std::strlen(dirname))};
+  return static_cast<ps::Client*>(h)->broadcast(hd, dirname) ? 0 : -1;
+}
+
+int64_t ps_client_stat(void* h) {
+  auto* c = static_cast<ps::Client*>(h);
+  int64_t total = 0;
+  for (int i = 0; i < c->n_servers(); ++i) {
+    ps::Header hd{0, ps::CMD_STAT, 0, 0, 0, 0};
+    int64_t n = 0;
+    if (!c->request(i, hd, nullptr, nullptr, &n)) return -1;
+    total += n;
+  }
+  return total;
+}
+
+int ps_client_set_lr(void* h, float lr) {
+  ps::Header hd{0, ps::CMD_SET_LR, 0, 0, 0, 4};
+  return static_cast<ps::Client*>(h)->broadcast(hd, &lr) ? 0 : -1;
+}
+
+int ps_client_stop_servers(void* h) {
+  ps::Header hd{0, ps::CMD_STOP, 0, 0, 0, 0};
+  return static_cast<ps::Client*>(h)->broadcast(hd, nullptr) ? 0 : -1;
+}
+
+}  // extern "C"

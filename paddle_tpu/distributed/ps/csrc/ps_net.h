@@ -1,0 +1,116 @@
+// Wire protocol + socket helpers shared by the PsService server and client.
+//
+// Reference analogue: the brpc transport under
+// paddle/fluid/distributed/ps/service/brpc_ps_server.h /
+// brpc_ps_client.h. This framework replaces brpc with a dependency-free
+// length-prefixed binary protocol over TCP (localhost or DCN): every
+// request is one framed message and gets exactly one framed response on the
+// same connection (connections are per-client-thread serialized).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace ps {
+
+constexpr uint32_t kMagic = 0x50535631;  // "PSV1"
+
+enum Cmd : uint32_t {
+  CMD_PING = 1,
+  CMD_CREATE_SPARSE = 2,
+  CMD_CREATE_DENSE = 3,
+  CMD_PULL_SPARSE = 4,
+  CMD_PUSH_SPARSE = 5,
+  CMD_PULL_DENSE = 6,
+  CMD_PUSH_DENSE = 7,
+  CMD_BARRIER = 8,
+  CMD_SAVE = 9,
+  CMD_LOAD = 10,
+  CMD_STAT = 11,
+  CMD_SET_LR = 12,
+  CMD_STOP = 13,
+  CMD_SET_DENSE = 14,
+};
+
+// flags bits
+constexpr uint32_t kFlagCreate = 1u;  // PULL_SPARSE: create-on-miss
+constexpr uint32_t kFlagRaw = 2u;     // PUSH_SPARSE: raw delta add (geo)
+
+struct Header {
+  uint32_t magic;
+  uint32_t cmd;
+  uint32_t table_id;
+  uint32_t flags;
+  int64_t n;       // element count / trainer id (BARRIER)
+  int64_t nbytes;  // payload bytes following this header
+};
+
+// status returned in response Header.flags
+constexpr uint32_t kStatusOk = 0;
+constexpr uint32_t kStatusErr = 1;
+
+inline bool read_full(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t r = ::recv(fd, p, len, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool write_full(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t r = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline int connect_to(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// key → owning server. Distinct finalizer from SparseTable::shard_of so
+// server routing and in-server shard routing stay decorrelated.
+inline int server_of(int64_t key, int n_servers) {
+  uint64_t x = static_cast<uint64_t>(key) + 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  x ^= x >> 31;
+  return static_cast<int>(x % static_cast<uint64_t>(n_servers));
+}
+
+}  // namespace ps

@@ -1,0 +1,252 @@
+// Sharded host-RAM sparse embedding table (shared by the in-process facade
+// and the networked PsService).
+//
+// Reference analogue: paddle/fluid/distributed/ps/table/memory_sparse_table.cc
+// (sharded unordered_map embedding store with per-shard task parallelism) and
+// ps/table/sparse_sgd_rule.cc (per-feature optimizer applied inside the table
+// on push — SGD / AdaGrad).
+//
+// Thread-safety: each shard carries its own mutex, so concurrent pull/push
+// calls from different caller threads (multiple trainer connections in the
+// PsService) are safe; within one call, run_sharded additionally partitions
+// shards across worker threads so a shard's mutex is uncontended in the
+// single-caller case.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace ps {
+
+enum OptType : int32_t { OPT_SGD = 0, OPT_ADAGRAD = 1 };
+
+struct Entry {
+  std::vector<float> emb;
+  std::vector<float> g2sum;  // adagrad accumulator (empty for sgd)
+};
+
+struct Shard {
+  std::unordered_map<int64_t, Entry> map;
+  std::mutex mu;
+};
+
+struct SparseTable {
+  int emb_dim;
+  int shard_num;
+  int32_t opt_type;
+  float lr;
+  float init_range;  // uniform(-init_range, init_range); 0 => zeros
+  float adagrad_eps;
+  uint64_t seed;
+  std::vector<Shard> shards;
+
+  SparseTable(int dim, int nshard, int32_t opt, float lr_, float range,
+              uint64_t seed_)
+      : emb_dim(dim),
+        shard_num(nshard),
+        opt_type(opt),
+        lr(lr_),
+        init_range(range),
+        adagrad_eps(1e-6f),
+        seed(seed_),
+        shards(nshard) {}
+
+  int shard_of(int64_t key) const {
+    uint64_t h = (static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ULL) >> 32;
+    return static_cast<int>(h % static_cast<uint64_t>(shard_num));
+  }
+
+  void init_entry(int64_t key, Entry* e) const {
+    e->emb.resize(emb_dim);
+    if (init_range > 0.f) {
+      // per-key deterministic init: same key always gets the same row,
+      // independent of insertion order, shard count, or which server/host
+      // materializes it (load-bearing for geo replicas)
+      std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
+      std::uniform_real_distribution<float> dist(-init_range, init_range);
+      for (int i = 0; i < emb_dim; ++i) e->emb[i] = dist(gen);
+    }
+    if (opt_type == OPT_ADAGRAD) e->g2sum.assign(emb_dim, 0.f);
+  }
+
+  // gather rows for keys; missing keys are created (reference PullSparse
+  // create-on-miss semantics for training; create=false skips creation for
+  // inference lookups and returns zeros)
+  void pull(const int64_t* keys, int64_t n, float* out, bool create) {
+    run_sharded(keys, n, [&](Shard& sh, int64_t idx) {
+      int64_t key = keys[idx];
+      auto it = sh.map.find(key);
+      if (it == sh.map.end()) {
+        if (!create) {
+          std::memset(out + idx * emb_dim, 0, sizeof(float) * emb_dim);
+          return;
+        }
+        Entry e;
+        init_entry(key, &e);
+        it = sh.map.emplace(key, std::move(e)).first;
+      }
+      std::memcpy(out + idx * emb_dim, it->second.emb.data(),
+                  sizeof(float) * emb_dim);
+    });
+  }
+
+  // apply optimizer update for grads; raw=true adds the payload directly to
+  // the embedding instead (the geo-async delta merge — reference
+  // MemorySparseGeoTable's push without an accessor rule)
+  void push(const int64_t* keys, int64_t n, const float* grads,
+            bool raw = false) {
+    run_sharded(keys, n, [&](Shard& sh, int64_t idx) {
+      int64_t key = keys[idx];
+      auto it = sh.map.find(key);
+      if (it == sh.map.end()) {
+        Entry e;
+        init_entry(key, &e);
+        it = sh.map.emplace(key, std::move(e)).first;
+      }
+      Entry& e = it->second;
+      const float* g = grads + idx * emb_dim;
+      if (raw) {
+        for (int i = 0; i < emb_dim; ++i) e.emb[i] += g[i];
+      } else if (opt_type == OPT_ADAGRAD) {
+        for (int i = 0; i < emb_dim; ++i) {
+          e.g2sum[i] += g[i] * g[i];
+          e.emb[i] -= lr * g[i] / (std::sqrt(e.g2sum[i]) + adagrad_eps);
+        }
+      } else {
+        for (int i = 0; i < emb_dim; ++i) e.emb[i] -= lr * g[i];
+      }
+    });
+  }
+
+  // shard-parallel execution: keys are bucketed by shard in one pass, each
+  // worker thread owns a subset of shards, and the shard mutex is taken
+  // ONCE per (shard, call) — amortized locking plus cache-friendly grouped
+  // access (reference: shards_task_pool_). fn runs with the lock held.
+  template <typename F>
+  void run_sharded(const int64_t* keys, int64_t n, F fn) {
+    if (n < 1024) {
+      for (int64_t i = 0; i < n; ++i) {
+        Shard& sh = shards[shard_of(keys[i])];
+        std::lock_guard<std::mutex> lk(sh.mu);
+        fn(sh, i);
+      }
+      return;
+    }
+    std::vector<std::vector<int64_t>> buckets(shard_num);
+    for (auto& b : buckets) b.reserve(n / shard_num + 8);
+    for (int64_t i = 0; i < n; ++i) buckets[shard_of(keys[i])].push_back(i);
+    int nthreads = std::min<int64_t>(shard_num, 8);
+    std::vector<std::thread> ts;
+    ts.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) {
+      ts.emplace_back([&, t] {
+        for (int s = t; s < shard_num; s += nthreads) {
+          if (buckets[s].empty()) continue;
+          Shard& sh = shards[s];
+          std::lock_guard<std::mutex> lk(sh.mu);
+          for (int64_t idx : buckets[s]) fn(sh, idx);
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+
+  int64_t size() {
+    int64_t s = 0;
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      s += static_cast<int64_t>(sh.map.size());
+    }
+    return s;
+  }
+
+  bool save(const char* path) {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    int64_t n = size();
+    int32_t has_g2 = (opt_type == OPT_ADAGRAD) ? 1 : 0;
+    bool ok = std::fwrite(&emb_dim, sizeof(emb_dim), 1, f) == 1 &&
+              std::fwrite(&has_g2, sizeof(has_g2), 1, f) == 1 &&
+              std::fwrite(&n, sizeof(n), 1, f) == 1;
+    for (auto& sh : shards) {
+      if (!ok) break;
+      std::lock_guard<std::mutex> lk(sh.mu);
+      for (const auto& kv : sh.map) {
+        ok = ok && std::fwrite(&kv.first, sizeof(int64_t), 1, f) == 1 &&
+             std::fwrite(kv.second.emb.data(), sizeof(float), emb_dim, f) ==
+                 static_cast<size_t>(emb_dim);
+        if (has_g2)
+          ok = ok &&
+               std::fwrite(kv.second.g2sum.data(), sizeof(float), emb_dim,
+                           f) == static_cast<size_t>(emb_dim);
+        if (!ok) break;
+      }
+    }
+    ok = (std::fclose(f) == 0) && ok;  // disk-full surfaces at flush
+    return ok;
+  }
+
+  bool load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    int dim = 0;
+    int32_t has_g2 = 0;
+    int64_t n = 0;
+    if (std::fread(&dim, sizeof(dim), 1, f) != 1 || dim != emb_dim ||
+        std::fread(&has_g2, sizeof(has_g2), 1, f) != 1 ||
+        std::fread(&n, sizeof(n), 1, f) != 1) {
+      std::fclose(f);
+      return false;
+    }
+    // restore replaces the whole table (the reference's load contract):
+    // stale post-checkpoint rows must not survive a rewind
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      sh.map.clear();
+    }
+    bool ok = true;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t key;
+      if (std::fread(&key, sizeof(key), 1, f) != 1) {
+        ok = false;  // truncated checkpoint — fail loudly, not partially
+        break;
+      }
+      Entry e;
+      e.emb.resize(emb_dim);
+      if (std::fread(e.emb.data(), sizeof(float), emb_dim, f) !=
+          static_cast<size_t>(emb_dim)) {
+        ok = false;
+        break;
+      }
+      if (has_g2) {
+        e.g2sum.resize(emb_dim);
+        if (std::fread(e.g2sum.data(), sizeof(float), emb_dim, f) !=
+            static_cast<size_t>(emb_dim)) {
+          ok = false;
+          break;
+        }
+      } else if (opt_type == OPT_ADAGRAD) {
+        e.g2sum.assign(emb_dim, 0.f);
+      }
+      Shard& sh = shards[shard_of(key)];
+      std::lock_guard<std::mutex> lk(sh.mu);
+      sh.map[key] = std::move(e);
+    }
+    std::fclose(f);
+    if (!ok)
+      for (auto& sh : shards) {
+        std::lock_guard<std::mutex> lk(sh.mu);
+        sh.map.clear();
+      }
+    return ok;
+  }
+};
+
+}  // namespace ps

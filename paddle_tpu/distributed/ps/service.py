@@ -1,0 +1,526 @@
+"""PsService — multi-host parameter-server transport (ctypes facades).
+
+Reference analogue:
+  - paddle/fluid/distributed/ps/service/brpc_ps_server.h  (PsService RPC
+    server dispatching pull/push/barrier/save/load onto table shards);
+  - paddle/fluid/distributed/ps/service/brpc_ps_client.h  (per-server
+    channels, hash key partitioning, fan-out + region reassembly);
+  - ps/service/communicator/communicator.h (sync/async/geo push modes).
+
+TPU-native design: the dense model runs on chips under XLA; the sparse/PS
+side is host C++ (csrc/ps_server.cc, csrc/ps_client.cc) speaking a framed
+binary protocol over TCP — localhost in tests, DCN across hosts. ctypes
+calls release the GIL, so trainer compute overlaps RPC.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "PsServer",
+    "PsClient",
+    "DistributedSparseTable",
+    "GeoDistributedSparseTable",
+    "DenseTableHandle",
+    "Communicator",
+]
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_DEPENDS = [
+    os.path.join(_CSRC, "ps_net.h"),
+    os.path.join(_CSRC, "ps_sparse_table.h"),
+    os.path.join(_CSRC, "ps_dense_table.h"),
+]
+
+_server_lib = None
+_client_lib = None
+
+
+def _load_server_lib():
+    global _server_lib
+    if _server_lib is None:
+        from ...utils import cpp_extension
+
+        lib = cpp_extension.load(
+            "ps_server", [os.path.join(_CSRC, "ps_server.cc")], depends=_DEPENDS
+        )
+        lib.ps_server_create.restype = ctypes.c_void_p
+        lib.ps_server_create.argtypes = [ctypes.c_int] * 4
+        lib.ps_server_port.restype = ctypes.c_int
+        lib.ps_server_port.argtypes = [ctypes.c_void_p]
+        lib.ps_server_wait.argtypes = [ctypes.c_void_p]
+        lib.ps_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ps_server_destroy.argtypes = [ctypes.c_void_p]
+        _server_lib = lib
+    return _server_lib
+
+
+def _load_client_lib():
+    global _client_lib
+    if _client_lib is None:
+        from ...utils import cpp_extension
+
+        lib = cpp_extension.load(
+            "ps_client", [os.path.join(_CSRC, "ps_client.cc")], depends=_DEPENDS
+        )
+        lib.ps_client_create.restype = ctypes.c_void_p
+        lib.ps_client_create.argtypes = [ctypes.c_char_p]
+        lib.ps_client_destroy.argtypes = [ctypes.c_void_p]
+        lib.ps_client_n_servers.restype = ctypes.c_int
+        lib.ps_client_n_servers.argtypes = [ctypes.c_void_p]
+        lib.ps_client_ping.restype = ctypes.c_int
+        lib.ps_client_ping.argtypes = [ctypes.c_void_p]
+        lib.ps_client_create_sparse.restype = ctypes.c_int
+        lib.ps_client_create_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+        ]
+        lib.ps_client_create_dense.restype = ctypes.c_int
+        lib.ps_client_create_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_void_p,
+        ]
+        lib.ps_client_pull_sparse.restype = ctypes.c_int
+        lib.ps_client_pull_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ps_client_push_sparse.restype = ctypes.c_int
+        lib.ps_client_push_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ps_client_pull_dense.restype = ctypes.c_int
+        lib.ps_client_pull_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.ps_client_push_dense.restype = ctypes.c_int
+        lib.ps_client_push_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.ps_client_set_dense.restype = ctypes.c_int
+        lib.ps_client_set_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.ps_client_barrier.restype = ctypes.c_int
+        lib.ps_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ps_client_save.restype = ctypes.c_int
+        lib.ps_client_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ps_client_load.restype = ctypes.c_int
+        lib.ps_client_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ps_client_stat.restype = ctypes.c_int64
+        lib.ps_client_stat.argtypes = [ctypes.c_void_p]
+        lib.ps_client_set_lr.restype = ctypes.c_int
+        lib.ps_client_set_lr.argtypes = [ctypes.c_void_p, ctypes.c_float]
+        lib.ps_client_stop_servers.restype = ctypes.c_int
+        lib.ps_client_stop_servers.argtypes = [ctypes.c_void_p]
+        _client_lib = lib
+    return _client_lib
+
+
+_OPT_IDS = {"sgd": 0, "adagrad": 1}
+_DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
+
+
+class PsServer:
+    """One parameter-server process (reference: BrpcPsServer)."""
+
+    def __init__(self, port: int = 0, server_id: int = 0, n_servers: int = 1,
+                 n_trainers: int = 1):
+        self._lib = _load_server_lib()
+        self._h = self._lib.ps_server_create(
+            int(port), int(server_id), int(n_servers), int(n_trainers)
+        )
+        if not self._h:
+            raise RuntimeError(f"PsServer failed to bind port {port}")
+        self.server_id = server_id
+
+    @property
+    def port(self) -> int:
+        return self._lib.ps_server_port(self._h)
+
+    def wait(self):
+        """Block until a STOP arrives (fleet.run_server loop)."""
+        self._lib.ps_server_wait(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.ps_server_stop(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ps_server_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Trainer-side stub for the whole server fleet (reference: BrpcPsClient)."""
+
+    def __init__(self, endpoints: Sequence[str], trainer_id: int = 0):
+        self._lib = _load_client_lib()
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self._h = self._lib.ps_client_create(",".join(self.endpoints).encode())
+        if not self._h:
+            raise RuntimeError(f"PsClient: bad endpoints {endpoints}")
+        self._dense_meta: Dict[int, int] = {}  # table_id -> length
+
+    # -- lifecycle -----------------------------------------------------------
+    def ping(self):
+        if self._lib.ps_client_ping(self._h) != 0:
+            raise ConnectionError(f"ping failed for {self.endpoints}")
+
+    def stop_servers(self):
+        self._lib.ps_client_stop_servers(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ps_client_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # -- tables --------------------------------------------------------------
+    def create_sparse_table(self, table_id: int, emb_dim: int,
+                            shard_num: int = 16, optimizer: str = "adagrad",
+                            learning_rate: float = 0.05,
+                            init_range: float = 0.01, seed: int = 0):
+        if self._lib.ps_client_create_sparse(
+            self._h, table_id, emb_dim, shard_num, _OPT_IDS[optimizer],
+            ctypes.c_float(learning_rate), ctypes.c_float(init_range),
+            ctypes.c_uint64(seed),
+        ) != 0:
+            raise RuntimeError("create_sparse_table failed")
+
+    def create_dense_table(self, table_id: int, length: int,
+                           optimizer: str = "sgd", learning_rate: float = 0.01,
+                           init: Optional[np.ndarray] = None):
+        buf = None
+        if init is not None:
+            buf = np.ascontiguousarray(init, np.float32).reshape(-1)
+            if buf.size != length:
+                raise ValueError("init length mismatch")
+        if self._lib.ps_client_create_dense(
+            self._h, table_id, length, _DENSE_OPT_IDS[optimizer],
+            ctypes.c_float(learning_rate),
+            buf.ctypes.data if buf is not None else None,
+        ) != 0:
+            raise RuntimeError("create_dense_table failed")
+        self._dense_meta[table_id] = length
+
+    # -- sparse verbs --------------------------------------------------------
+    def pull_sparse(self, table_id: int, keys: np.ndarray, emb_dim: int,
+                    create: bool = True) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        out = np.empty((keys.size, emb_dim), np.float32)
+        if self._lib.ps_client_pull_sparse(
+            self._h, table_id, keys.ctypes.data, keys.size, emb_dim,
+            out.ctypes.data, 1 if create else 0,
+        ) != 0:
+            raise ConnectionError("pull_sparse failed")
+        return out
+
+    def push_sparse(self, table_id: int, keys: np.ndarray,
+                    grads: np.ndarray, raw: bool = False):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32)
+        emb_dim = grads.size // max(keys.size, 1)
+        if self._lib.ps_client_push_sparse(
+            self._h, table_id, keys.ctypes.data, keys.size, emb_dim,
+            grads.ctypes.data, 1 if raw else 0,
+        ) != 0:
+            raise ConnectionError("push_sparse failed")
+
+    # -- dense verbs ---------------------------------------------------------
+    def pull_dense(self, table_id: int, length: Optional[int] = None) -> np.ndarray:
+        length = length or self._dense_meta[table_id]
+        out = np.empty(length, np.float32)
+        if self._lib.ps_client_pull_dense(
+            self._h, table_id, out.ctypes.data, length
+        ) != 0:
+            raise ConnectionError("pull_dense failed")
+        return out
+
+    def push_dense(self, table_id: int, grads: np.ndarray):
+        grads = np.ascontiguousarray(grads, np.float32).reshape(-1)
+        if self._lib.ps_client_push_dense(
+            self._h, table_id, grads.ctypes.data, grads.size
+        ) != 0:
+            raise ConnectionError("push_dense failed")
+
+    def set_dense(self, table_id: int, values: np.ndarray):
+        values = np.ascontiguousarray(values, np.float32).reshape(-1)
+        if self._lib.ps_client_set_dense(
+            self._h, table_id, values.ctypes.data, values.size
+        ) != 0:
+            raise ConnectionError("set_dense failed")
+
+    # -- coordination --------------------------------------------------------
+    def barrier(self):
+        if self._lib.ps_client_barrier(self._h, self.trainer_id) != 0:
+            raise ConnectionError("barrier failed")
+
+    def save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        if self._lib.ps_client_save(self._h, dirname.encode()) != 0:
+            raise IOError(f"distributed save to {dirname} failed")
+
+    def load(self, dirname: str):
+        if self._lib.ps_client_load(self._h, dirname.encode()) != 0:
+            raise IOError(f"distributed load from {dirname} failed")
+
+    def stat(self) -> int:
+        n = self._lib.ps_client_stat(self._h)
+        if n < 0:
+            raise ConnectionError("stat failed")
+        return int(n)
+
+    def set_lr(self, lr: float):
+        self._lib.ps_client_set_lr(self._h, ctypes.c_float(lr))
+
+
+class DistributedSparseTable:
+    """MemorySparseTable-compatible facade over the server fleet, so
+    SparseEmbedding(table=...) works unchanged across hosts (reference:
+    distributed_lookup_table on the worker side)."""
+
+    def __init__(self, client: PsClient, table_id: int, emb_dim: int,
+                 shard_num: int = 16, optimizer: str = "adagrad",
+                 learning_rate: float = 0.05, init_range: float = 0.01,
+                 seed: int = 0, create: bool = True):
+        self.client = client
+        self.table_id = table_id
+        self.emb_dim = emb_dim
+        if create:
+            client.create_sparse_table(
+                table_id, emb_dim, shard_num, optimizer, learning_rate,
+                init_range, seed,
+            )
+
+    def pull(self, keys: np.ndarray, create: bool = True) -> np.ndarray:
+        return self.client.pull_sparse(self.table_id, keys, self.emb_dim, create)
+
+    def push(self, keys: np.ndarray, grads: np.ndarray):
+        self.client.push_sparse(self.table_id, keys, grads)
+
+    def set_lr(self, lr: float):
+        self.client.set_lr(lr)
+
+    def __len__(self):
+        return self.client.stat()
+
+    def save(self, dirname: str):
+        self.client.save(dirname)
+
+    def load(self, dirname: str):
+        self.client.load(dirname)
+
+
+class GeoDistributedSparseTable(DistributedSparseTable):
+    """Geo-async sparse table (reference: GeoSparseTable +
+    communicator GeoCommunicator): the trainer reads AND optimizes a local
+    replica; every `geo_steps` pushes the accumulated local deltas
+    (raw-added server-side) and refreshes touched rows from the server.
+    Deterministic per-key init makes replicas agree on never-synced rows.
+    """
+
+    def __init__(self, client: PsClient, table_id: int, emb_dim: int,
+                 shard_num: int = 16, optimizer: str = "adagrad",
+                 learning_rate: float = 0.05, init_range: float = 0.01,
+                 seed: int = 0, geo_steps: int = 10, create: bool = True):
+        super().__init__(client, table_id, emb_dim, shard_num, optimizer,
+                         learning_rate, init_range, seed, create)
+        from . import MemorySparseTable
+
+        self.local = MemorySparseTable(
+            emb_dim, shard_num=shard_num, optimizer=optimizer,
+            learning_rate=learning_rate, init_range=init_range, seed=seed,
+        )
+        self.geo_steps = geo_steps
+        self._step = 0
+        # base snapshot of keys touched SINCE THE LAST SYNC only — entries
+        # are evicted after each sync, so host memory and per-sync cost are
+        # bounded by the inter-sync working set, not the whole history
+        self._base: Dict[int, np.ndarray] = {}
+
+    def pull(self, keys: np.ndarray, create: bool = True) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        rows = self.local.pull(keys, create=create)
+        if create:
+            for k, row in zip(keys.tolist(), rows):
+                if k not in self._base:
+                    self._base[k] = row.copy()
+        return rows
+
+    def push(self, keys: np.ndarray, grads: np.ndarray):
+        # record bases for keys pushed without a prior pull this interval
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        fresh = [k for k in keys.tolist() if k not in self._base]
+        if fresh:
+            fk = np.asarray(fresh, np.int64)
+            for k, row in zip(fresh, self.local.pull(fk, create=True)):
+                self._base[k] = row.copy()
+        self.local.push(keys, grads)
+        self._step += 1
+        if self._step % self.geo_steps == 0:
+            self.sync()
+
+    def sync(self):
+        """Push local deltas (raw add), adopt the merged server rows, and
+        evict the synced bases (next touch re-snapshots)."""
+        if not self._base:
+            return
+        ks = np.fromiter(self._base.keys(), np.int64, len(self._base))
+        cur = self.local.pull(ks, create=True)
+        base = np.stack([self._base[int(k)] for k in ks])
+        delta = cur - base
+        touched = np.abs(delta).sum(axis=1) > 0
+        if touched.any():
+            self.client.push_sparse(
+                self.table_id, ks[touched], delta[touched], raw=True
+            )
+        merged = super(GeoDistributedSparseTable, self).pull(ks, create=True)
+        # overwrite the local replica with the authoritative merged rows
+        self.local.push_raw(ks, merged - cur)
+        self._base.clear()
+
+    def refresh(self, keys: np.ndarray):
+        """Adopt the authoritative merged server rows for `keys` without
+        pushing anything — the reference geo trainers' periodic pull of
+        rows they read but did not recently update."""
+        ks = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        cur = self.local.pull(ks, create=True)
+        merged = super(GeoDistributedSparseTable, self).pull(ks, create=True)
+        self.local.push_raw(ks, merged - cur)
+        for k in ks.tolist():
+            self._base.pop(k, None)  # re-snapshot on next touch
+
+
+class DenseTableHandle:
+    """Server-resident dense parameters for PS-mode training (reference:
+    MemoryDenseTable + the pull_dense/push_dense_grad worker loop).
+
+    Registers a list of framework Tensors (parameters); `init()` seeds the
+    servers from trainer 0; each step `push_pull(grads)` sends the flat
+    grad and installs the post-update values back into the tensors — the
+    server is the optimizer, trainers stay stateless (PS division of labor).
+    """
+
+    def __init__(self, client: PsClient, table_id: int, params: List,
+                 optimizer: str = "sgd", learning_rate: float = 0.01):
+        self.client = client
+        self.table_id = table_id
+        self.params = list(params)
+        self.shapes = [tuple(p.shape) for p in self.params]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.total = sum(self.sizes)
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+
+    def _flat(self, arrays) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(a, np.float32).reshape(-1) for a in arrays]
+        ) if arrays else np.zeros(0, np.float32)
+
+    def init(self, is_first_trainer: bool):
+        vals = self._flat([p.numpy() for p in self.params])
+        self.client.create_dense_table(
+            self.table_id, self.total, self.optimizer, self.learning_rate,
+            init=vals if is_first_trainer else None,
+        )
+        if is_first_trainer:
+            # idempotent overwrite in case the table pre-existed (restart)
+            self.client.set_dense(self.table_id, vals)
+
+    def pull_into_params(self):
+        flat = self.client.pull_dense(self.table_id, self.total)
+        self._scatter(flat)
+
+    def _scatter(self, flat: np.ndarray):
+        import jax.numpy as jnp
+
+        off = 0
+        for p, size, shape in zip(self.params, self.sizes, self.shapes):
+            chunk = flat[off:off + size].reshape(shape)
+            p._value = jnp.asarray(chunk)
+            off += size
+
+    def push(self, grads: Optional[List] = None):
+        """Push this trainer's grads (server applies the optimizer). In
+        sync-SGD, barrier between push and pull_into_params so every
+        trainer's contribution lands before anyone reads."""
+        if grads is None:
+            grads = [p.grad for p in self.params]
+        flat = self._flat(
+            [g._value if hasattr(g, "_value") else g for g in grads]
+        )
+        self.client.push_dense(self.table_id, flat)
+
+    def push_pull(self, grads: Optional[List] = None):
+        """Push then immediately pull — the fully-async single-trainer
+        convenience; multi-trainer sync loops should push / barrier / pull."""
+        self.push(grads)
+        self.pull_into_params()
+
+
+class Communicator:
+    """Sparse-push communicator with sync / async modes (reference:
+    ps/service/communicator/communicator.h AsyncCommunicator). In async
+    mode pushes enqueue to a background flusher so the trainer never
+    blocks on the wire; flush() drains (the reference's barrier point)."""
+
+    def __init__(self, table: DistributedSparseTable, mode: str = "sync",
+                 max_queue: int = 64):
+        if mode not in ("sync", "async"):
+            raise ValueError("mode must be sync|async")
+        self.table = table
+        self.mode = mode
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._err: Optional[BaseException] = None
+        self._thread = None
+        if mode == "async":
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                try:
+                    self.table.push(*item)
+                except BaseException as e:  # surfaced on next push/flush
+                    self._err = e
+            finally:
+                self._q.task_done()
+
+    def push(self, keys: np.ndarray, grads: np.ndarray):
+        if self._err:
+            raise self._err
+        if self.mode == "sync":
+            self.table.push(keys, grads)
+        else:
+            self._q.put((np.array(keys, np.int64), np.array(grads, np.float32)))
+
+    def flush(self):
+        if self.mode == "async":
+            self._q.join()
+        if self._err:
+            raise self._err
+
+    def stop(self):
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join()
+            self._thread = None

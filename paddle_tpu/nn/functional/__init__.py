@@ -451,6 +451,12 @@ def cross_entropy(
             label = paddle.nn.functional.one_hot(label, num)
             soft_label = True
         label = label * (1.0 - label_smoothing) + label_smoothing / num
+    # mean with a real ignore_index divides by the VALID count (handled in
+    # the tail below); one predicate gates both that branch and the
+    # fused-reduction exclusion so they cannot drift apart
+    mean_needs_valid_count = (
+        reduction == "mean" and ignore_index != -100 and not soft_label
+    )
     if not use_softmax:
         lg = apply(
             lambda p: __import__("jax.numpy", fromlist=["log"]).log(
@@ -465,7 +471,7 @@ def cross_entropy(
         if (
             weight is None
             and reduction in ("mean", "sum")
-            and not (reduction == "mean" and ignore_index != -100 and not soft_label)
+            and not mean_needs_valid_count
         ):
             return apply(
                 _nn.softmax_with_cross_entropy, input, label, soft_label=soft_label,
@@ -489,9 +495,7 @@ def cross_entropy(
         loss = loss * w
         if reduction == "mean":
             return loss.sum() / w.sum().clip(min=1e-12)
-    if reduction == "mean" and ignore_index != -100 and not soft_label:
-        import paddle_tpu as paddle
-
+    if mean_needs_valid_count:
         valid = (label != ignore_index).astype(loss.dtype)
         denom = valid.sum().clip(min=1.0)
         return loss.sum() / denom

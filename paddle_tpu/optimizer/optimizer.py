@@ -125,8 +125,12 @@ class Optimizer:
         # per configuration instead of leaking one per optimizer instance).
         # The key is memoized per (param identity, shapes/dtypes) — rebuilding
         # it each step costs more than the whole host-side dispatch.
+        per_hypers = tuple(
+            tuple(sorted(self._per_param_hyper(p).items())) for p in params
+        )
         sig = (
             tuple(sorted(self._hyper().items())),
+            per_hypers,
             self._weight_decay,
             tuple(
                 (id(p), p._value.shape, p._value.dtype, g.dtype)
@@ -140,9 +144,7 @@ class Optimizer:
             key = (
                 type(self),
                 tuple(sorted(self._hyper().items())),
-                tuple(
-                    tuple(sorted(self._per_param_hyper(p).items())) for p in params
-                ),
+                per_hypers,
                 self._weight_decay,
                 tuple(
                     (p._value.shape, str(p._value.dtype), str(g.dtype))

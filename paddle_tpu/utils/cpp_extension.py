@@ -45,6 +45,7 @@ def load(
     build_directory: Optional[str] = None,
     verbose: bool = False,
     ops: Optional[Sequence[str]] = None,
+    depends: Optional[Sequence[str]] = None,
 ):
     """Compile C++ sources to lib<name>.so (content-hash cached) and dlopen it.
 
@@ -57,7 +58,11 @@ def load(
     build_dir = build_directory or get_build_directory()
     cflags = _DEFAULT_CFLAGS + (extra_cflags or [])
     ldflags = ["-lpthread"] + (extra_ldflags or [])
-    digest = _source_digest(sources, cflags + ldflags)
+    # `depends` (headers) participate in the content hash so an edited
+    # header rebuilds the .so, but are not passed to the compile line
+    digest = _source_digest(
+        list(sources) + list(depends or []), cflags + ldflags
+    )
     so_path = os.path.join(build_dir, f"lib{name}.{digest}.so")
     if not os.path.exists(so_path):
         # build to a per-pid temp path then atomically rename: concurrent

@@ -1,0 +1,320 @@
+"""Multi-host parameter-server service tests (VERDICT r2 item 1).
+
+Covers: wire-level sparse/dense verbs vs the in-process table, trainer
+barrier, geo-async replica sync, the async communicator, and — the
+TestDistBase pattern (reference: unittests/test_dist_base.py:782) — a real
+2-pserver × 2-trainer localhost CTR training run whose final full-batch
+loss must match the single-process run.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def fleet2():
+    """Two PsServers + a client, shared across the in-process tests."""
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    s0 = PsServer(port=0, server_id=0, n_servers=2, n_trainers=2)
+    s1 = PsServer(port=0, server_id=1, n_servers=2, n_trainers=2)
+    eps = [f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"]
+    c0 = PsClient(eps, trainer_id=0)
+    c1 = PsClient(eps, trainer_id=1)
+    yield s0, s1, c0, c1
+    c0.stop_servers()
+
+
+def test_sparse_matches_local_table(fleet2):
+    from paddle_tpu.distributed.ps import DistributedSparseTable, MemorySparseTable
+
+    _, _, c0, _ = fleet2
+    t = DistributedSparseTable(c0, 1, emb_dim=8, optimizer="sgd",
+                               learning_rate=0.1, seed=42)
+    local = MemorySparseTable(8, optimizer="sgd", learning_rate=0.1, seed=42)
+    keys = np.array([3, 99, 123456789, -5, 7], np.int64)
+    assert np.array_equal(t.pull(keys), local.pull(keys))
+    g = np.random.default_rng(0).standard_normal((5, 8)).astype(np.float32)
+    uk, inv = np.unique(keys, return_inverse=True)
+    mg = np.zeros((uk.size, 8), np.float32)
+    np.add.at(mg, inv, g)
+    t.push(uk, mg)
+    local.push(uk, mg)
+    assert np.array_equal(t.pull(keys), local.pull(keys))
+    assert len(t) == len(local) == 5
+    # create=False must not create rows and must return zeros
+    miss = t.pull(np.array([424242], np.int64), create=False)
+    assert np.all(miss == 0) and len(t) == 5
+
+
+def test_dense_table_rules(fleet2):
+    _, _, c0, _ = fleet2
+    init = np.arange(10, dtype=np.float32)
+    c0.create_dense_table(50, 10, "sgd", 0.5, init=init)
+    c0.push_dense(50, np.ones(10, np.float32))
+    assert np.allclose(c0.pull_dense(50), init - 0.5)
+    c0.set_dense(50, init * 2)
+    assert np.allclose(c0.pull_dense(50), init * 2)
+    # adam rule: first step moves by ~lr in the grad sign direction
+    c0.create_dense_table(51, 4, "adam", 0.1, init=np.zeros(4, np.float32))
+    c0.push_dense(51, np.full(4, 2.0, np.float32))
+    step1 = c0.pull_dense(51)
+    assert np.allclose(step1, -0.1, atol=1e-5)
+
+
+def test_save_load_roundtrip(fleet2):
+    from paddle_tpu.distributed.ps import DistributedSparseTable
+
+    _, _, c0, _ = fleet2
+    t = DistributedSparseTable(c0, 7, emb_dim=4, seed=1)
+    keys = np.arange(100, dtype=np.int64)
+    before = t.pull(keys)
+    # dense table (adam: moments must checkpoint too)
+    c0.create_dense_table(70, 6, "adam", 0.1, init=np.zeros(6, np.float32))
+    c0.push_dense(70, np.ones(6, np.float32))
+    dense_before = c0.pull_dense(70)
+    with tempfile.TemporaryDirectory() as d:
+        c0.save(d)
+        parts = sorted(os.listdir(d))
+        assert "sparse_7.part0" in parts and "sparse_7.part1" in parts
+        assert "dense_70.part0" in parts and "dense_70.part1" in parts
+        t.push(keys, np.ones((100, 4), np.float32))
+        c0.push_dense(70, np.ones(6, np.float32))
+        assert not np.allclose(t.pull(keys), before)
+        c0.load(d)
+        assert np.array_equal(t.pull(keys), before)
+        assert np.array_equal(c0.pull_dense(70), dense_before)
+        # adam moments restored: the next identical push after load must
+        # reproduce the same value as the next push before the snapshot did
+        c0.push_dense(70, np.ones(6, np.float32))
+        after_second = c0.pull_dense(70).copy()
+        c0.load(d)
+        c0.push_dense(70, np.ones(6, np.float32))
+        assert np.array_equal(c0.pull_dense(70), after_second)
+
+
+def test_barrier_releases_together(fleet2):
+    _, _, c0, c1 = fleet2
+    order = []
+    lock = threading.Lock()
+
+    def go(c, name, delay):
+        import time
+
+        time.sleep(delay)
+        c.barrier()
+        with lock:
+            order.append(name)
+
+    t0 = threading.Thread(target=go, args=(c0, "a", 0.0))
+    t1 = threading.Thread(target=go, args=(c1, "b", 0.3))
+    t0.start()
+    t1.start()
+    t0.join(timeout=10)
+    t1.join(timeout=10)
+    assert sorted(order) == ["a", "b"]  # both released, neither hung
+
+
+def test_geo_replicas_converge(fleet2):
+    from paddle_tpu.distributed.ps import GeoDistributedSparseTable
+
+    _, _, c0, c1 = fleet2
+    g0 = GeoDistributedSparseTable(c0, 9, emb_dim=4, optimizer="sgd",
+                                   learning_rate=1.0, init_range=0.0,
+                                   geo_steps=2, seed=0)
+    g1 = GeoDistributedSparseTable(c1, 9, emb_dim=4, optimizer="sgd",
+                                   learning_rate=1.0, init_range=0.0,
+                                   geo_steps=2, seed=0, create=False)
+    keys = np.array([11, 22], np.int64)
+    one = np.ones((2, 4), np.float32)
+    # each replica applies 2 local sgd steps (lr=1, grad=1 → delta -2 each),
+    # the 2nd push triggers a sync that raw-adds deltas on the server
+    for g in (g0, g1):
+        g.pull(keys)
+        g.push(keys, one)
+        g.push(keys, one)
+    # adopt the authoritative merged rows on both replicas
+    g0.refresh(keys)
+    g1.refresh(keys)
+    merged0 = g0.pull(keys)
+    merged1 = g1.pull(keys)
+    assert np.allclose(merged0, merged1)
+    assert np.allclose(merged0, -4.0)  # both replicas' -2 deltas merged
+
+
+def test_async_communicator(fleet2):
+    from paddle_tpu.distributed.ps import Communicator, DistributedSparseTable
+
+    _, _, c0, _ = fleet2
+    t = DistributedSparseTable(c0, 12, emb_dim=4, optimizer="sgd",
+                               learning_rate=1.0, init_range=0.0)
+    comm = Communicator(t, mode="async")
+    keys = np.array([5], np.int64)
+    t.pull(keys)
+    for _ in range(10):
+        comm.push(keys, np.ones((1, 4), np.float32))
+    comm.flush()
+    assert np.allclose(t.pull(keys), -10.0)
+    comm.stop()
+
+
+# ---------------------------------------------------------------------------
+# TestDistBase pattern: 2 pservers + 2 trainers in subprocesses, sync-SGD
+# CTR model; final full-batch loss must match the single-process run.
+# ---------------------------------------------------------------------------
+_CTR_SCRIPT = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import SparseEmbedding
+
+ROLE = os.environ.get("TRAINING_ROLE", "TRAINER")
+if ROLE == "PSERVER":
+    fleet.init_server()
+    fleet.run_server()
+    sys.exit(0)
+
+TID = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+NT = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+GLOBAL_B, STEPS, LR, DIM, SLOTS = 64, 20, 0.1, 8, 3
+
+fleet.init_worker()
+rt = fleet._ps_runtime()
+table = rt.create_table("emb", DIM, optimizer="sgd", learning_rate=LR, seed=7)
+emb = SparseEmbedding([1000, DIM], table=table)
+paddle.seed(0)
+lin = paddle.nn.Linear(SLOTS * DIM, 1)
+
+rng = np.random.default_rng(123)
+ids_all = rng.integers(0, 1000, (STEPS, GLOBAL_B, SLOTS)).astype(np.int64)
+y_all = rng.integers(0, 2, (STEPS, GLOBAL_B)).astype(np.float32)
+
+dist = rt.is_distributed
+if dist:
+    dense = rt.create_dense_table("dense", [lin.weight, lin.bias], "sgd", LR)
+    dense.init(TID == 0)
+    rt.barrier()
+    dense.pull_into_params()
+
+bce = paddle.nn.functional.binary_cross_entropy_with_logits
+for s in range(STEPS):
+    ids = ids_all[s][TID::NT]
+    y = y_all[s][TID::NT]
+    x = emb(paddle.to_tensor(ids))
+    out = lin(x.reshape([ids.shape[0], SLOTS * DIM])).squeeze(-1)
+    # sum/GLOBAL_B so trainer grads ADD to the single-process full-batch grad
+    loss = bce(out, paddle.to_tensor(y), reduction="sum") / GLOBAL_B
+    if dist:
+        rt.barrier()  # everyone pulled step-s rows before anyone pushes
+    loss.backward()   # sparse grads push inside the embedding vjp
+    if dist:
+        dense.push([lin.weight.grad, lin.bias.grad])
+        rt.barrier()  # all sparse + dense pushes landed
+        dense.pull_into_params()
+    else:
+        with paddle.no_grad():
+            for p in (lin.weight, lin.bias):
+                p._value = p._value - LR * p.grad._value
+    lin.weight.clear_grad(); lin.bias.clear_grad()
+
+# final full-batch loss with the final weights (trainer 0 reports)
+if dist:
+    dense.pull_into_params()
+if TID == 0:
+    ids = ids_all[-1]; y = y_all[-1]
+    with paddle.no_grad():
+        x = emb(paddle.to_tensor(ids))
+        out = lin(x.reshape([GLOBAL_B, SLOTS * DIM])).squeeze(-1)
+        loss = bce(out, paddle.to_tensor(y), reduction="sum") / GLOBAL_B
+    print("FINAL_LOSS", float(loss))
+if dist:
+    fleet.stop_worker()
+"""
+
+
+@pytest.mark.slow
+def test_dist_ctr_matches_single_process(tmp_path):
+    script = tmp_path / "ctr_worker.py"
+    script.write_text(_CTR_SCRIPT)
+    base_env = dict(os.environ)
+    base_env.update({
+        "PYTHONPATH": REPO + os.pathsep + base_env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    })
+
+    def final_loss(out):
+        for line in out.splitlines():
+            if line.startswith("FINAL_LOSS"):
+                return float(line.split()[1])
+        raise AssertionError(f"no FINAL_LOSS in output:\n{out}")
+
+    # single-process baseline (no server endpoints → local in-process table)
+    env1 = dict(base_env)
+    env1.pop("PADDLE_PSERVERS_IP_PORT_LIST", None)
+    r1 = subprocess.run([sys.executable, str(script)], env=env1,
+                        capture_output=True, text=True, timeout=300)
+    assert r1.returncode == 0, r1.stderr[-3000:]
+    single = final_loss(r1.stdout)
+
+    # 2 pservers + 2 trainers
+    p0, p1 = _free_ports(2)
+    eps = f"127.0.0.1:{p0},127.0.0.1:{p1}"
+    procs = []
+    for i, port in enumerate((p0, p1)):
+        env = dict(base_env)
+        env.update({
+            "TRAINING_ROLE": "PSERVER", "PADDLE_PORT": str(port),
+            "PADDLE_SERVER_ID": str(i), "PADDLE_PSERVERS_IP_PORT_LIST": eps,
+            "PADDLE_TRAINERS_NUM": "2",
+        })
+        procs.append(subprocess.Popen([sys.executable, str(script)], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    trainers = []
+    for t in range(2):
+        env = dict(base_env)
+        env.update({
+            "TRAINING_ROLE": "TRAINER", "PADDLE_TRAINER_ID": str(t),
+            "PADDLE_TRAINERS_NUM": "2", "PADDLE_PSERVERS_IP_PORT_LIST": eps,
+        })
+        trainers.append(subprocess.Popen([sys.executable, str(script)], env=env,
+                                         stdout=subprocess.PIPE,
+                                         stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in trainers:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-3000:]
+        outs.append(out)
+    for p in procs:
+        out, err = p.communicate(timeout=60)
+        assert p.returncode == 0, err[-3000:]
+    dist = final_loss(outs[0])
+
+    # sync-SGD with sum/GLOBAL_B scaling is mathematically identical to the
+    # single-process full-batch run; only fp summation order differs
+    assert abs(dist - single) < 2e-3, (dist, single)
+    assert 0.0 < dist < 1.5
