@@ -15,7 +15,7 @@ import ctypes
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["TaskNode", "FleetExecutor"]
+__all__ = ["TaskNode", "FleetExecutor", "MessageBus"]
 
 _lib = None
 _COMPUTE_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_int64, ctypes.c_int64)
@@ -28,8 +28,12 @@ def _load_lib():
 
         from ...utils import cpp_extension
 
-        src = os.path.join(os.path.dirname(__file__), "csrc", "fleet_executor.cc")
-        _lib = cpp_extension.load("fleet_executor", [src])
+        csrc = os.path.join(os.path.dirname(__file__), "csrc")
+        src = os.path.join(csrc, "fleet_executor.cc")
+        ps_net = os.path.join(
+            os.path.dirname(os.path.dirname(csrc)), "ps", "csrc", "ps_net.h"
+        )
+        _lib = cpp_extension.load("fleet_executor", [src], depends=[ps_net])
         _lib.carrier_create.restype = ctypes.c_void_p
         _lib.carrier_add_task.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, _COMPUTE_FN, ctypes.c_int64,
@@ -40,7 +44,110 @@ def _load_lib():
         _lib.carrier_wait.restype = ctypes.c_int32
         _lib.carrier_wait.argtypes = [ctypes.c_void_p]
         _lib.carrier_destroy.argtypes = [ctypes.c_void_p]
+        _lib.bus_create.restype = ctypes.c_void_p
+        _lib.bus_create.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        _lib.bus_port.restype = ctypes.c_int
+        _lib.bus_port.argtypes = [ctypes.c_void_p]
+        _lib.bus_attach.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        _lib.bus_detach.argtypes = [ctypes.c_void_p]
+        _lib.bus_set_task_rank.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ]
+        _lib.bus_put.restype = ctypes.c_int
+        _lib.bus_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _lib.bus_get_size.restype = ctypes.c_int64
+        _lib.bus_get_size.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        _lib.bus_take.restype = ctypes.c_int64
+        _lib.bus_take.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        _lib.bus_stop.argtypes = [ctypes.c_void_p]
+        _lib.bus_destroy.argtypes = [ctypes.c_void_p]
     return _lib
+
+
+class MessageBus:
+    """Cross-carrier transport (reference: message_bus.h:40 — brpc there,
+    framed TCP here). Routes interceptor control messages between ranks and
+    parks tensor payload blobs keyed by (task, scope) until fetched.
+
+    `endpoints` is one "host:port" per rank; this process serves
+    endpoints[rank]. put()/get() move numpy arrays (serialized with their
+    dtype+shape) between pipeline stages on different processes/hosts.
+    """
+
+    def __init__(self, rank: int, endpoints: Sequence[str]):
+        self._lib = _load_lib()
+        self.rank = int(rank)
+        self.endpoints = list(endpoints)
+        self._h = self._lib.bus_create(
+            self.rank, ",".join(self.endpoints).encode()
+        )
+        if not self._h:
+            raise RuntimeError(
+                f"MessageBus rank {rank} failed to bind {endpoints[rank]}"
+            )
+
+    @property
+    def port(self) -> int:
+        return self._lib.bus_port(self._h)
+
+    def set_task_rank(self, task_id: int, rank: int):
+        self._lib.bus_set_task_rank(self._h, task_id, rank)
+
+    def put(self, task_id: int, scope: int, array) -> None:
+        """Ship a numpy array to (task, scope) — local store or remote rank."""
+        import io
+
+        import numpy as np
+
+        bio = io.BytesIO()
+        np.save(bio, np.ascontiguousarray(array), allow_pickle=False)
+        data = bio.getvalue()
+        if self._lib.bus_put(self._h, task_id, scope, data, len(data)) != 0:
+            raise ConnectionError(
+                f"bus_put to task {task_id} scope {scope} failed"
+            )
+
+    def get(self, task_id: int, scope: int, timeout: float = 60.0):
+        """Blocking fetch of the array shipped to (task, scope)."""
+        import io
+
+        import numpy as np
+
+        n = self._lib.bus_get_size(
+            self._h, task_id, scope, int(timeout * 1000)
+        )
+        if n < 0:
+            raise TimeoutError(
+                f"no payload for task {task_id} scope {scope} within {timeout}s"
+            )
+        buf = (ctypes.c_char * n)()
+        got = self._lib.bus_take(self._h, task_id, scope, buf, n)
+        if got != n:
+            raise RuntimeError(
+                "bus payload changed between size and take "
+                f"(expected {n} bytes, take returned {got})"
+            )
+        return np.load(io.BytesIO(bytes(buf)), allow_pickle=False)
+
+    def stop(self):
+        if getattr(self, "_h", None):
+            self._lib.bus_stop(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.bus_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
 
 
 class TaskNode:
@@ -73,10 +180,24 @@ class FleetExecutor:
     style host schedule the reference's SectionWorker/interceptors give).
     """
 
-    def __init__(self, nodes: Sequence[TaskNode]):
+    def __init__(self, nodes: Sequence[TaskNode], bus: Optional[MessageBus] = None,
+                 task_ranks: Optional[Dict[int, int]] = None):
+        """`bus` + `task_ranks` turn this into one rank of a multi-process
+        executor (reference: FleetExecutor::Init registering the carrier on
+        the MessageBus): every rank declares the FULL task DAG, but only
+        tasks with task_ranks[id] == bus.rank run locally — control
+        messages to/from the rest ride the bus."""
         self._nodes: Dict[int, TaskNode] = {n.task_id: n for n in nodes}
         if len(self._nodes) != len(nodes):
             raise ValueError("duplicate task ids")
+        self._bus = bus
+        self._task_ranks = dict(task_ranks or {})
+        if (bus is None) != (not self._task_ranks):
+            raise ValueError("bus and task_ranks go together")
+        if bus is not None:
+            missing = [i for i in self._nodes if i not in self._task_ranks]
+            if missing:
+                raise ValueError(f"task_ranks missing entries for {missing}")
         # validate BOTH edge directions and their symmetry: an asymmetric
         # edge would silently hang (upstream never fed) or silently drop
         # messages (downstream unknown)
@@ -116,7 +237,10 @@ class FleetExecutor:
             self._errors.clear()
         thunks = []  # keep CFUNCTYPE objects alive for the whole run
         try:
+            my_rank = self._bus.rank if self._bus is not None else None
             for n in self._nodes.values():
+                if my_rank is not None and self._task_ranks[n.task_id] != my_rank:
+                    continue  # remote task — control flows via the bus
                 fn = n.fn
 
                 def thunk(task_id, scope, _fn=fn):
@@ -138,6 +262,10 @@ class FleetExecutor:
                     carrier, n.task_id, cfn, n.max_run_times,
                     ups, len(n.upstream), downs, len(n.downstream),
                 )
+            if self._bus is not None:
+                for tid, r in self._task_ranks.items():
+                    self._bus.set_task_rank(tid, r)
+                lib.bus_attach(self._bus._h, carrier)
             lib.carrier_start(carrier)
             if timeout is None:
                 rc = lib.carrier_wait(carrier)
@@ -168,6 +296,9 @@ class FleetExecutor:
                     raise err
                 raise RuntimeError(f"fleet executor failed rc={rc}")
         finally:
+            if self._bus is not None:
+                # bus read threads must never deliver into a dead carrier
+                lib.bus_detach(self._bus._h)
             if carrier is not None:
                 lib.carrier_destroy(carrier)
 

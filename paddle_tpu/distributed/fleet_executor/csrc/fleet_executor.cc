@@ -1,11 +1,14 @@
-// FleetExecutor — actor-model pipeline runtime (carrier + interceptors).
+// FleetExecutor — actor-model pipeline runtime (carrier + interceptors +
+// cross-host MessageBus).
 //
 // Reference analogue: paddle/fluid/distributed/fleet_executor/
 //   carrier.h:49      — Carrier owns interceptors, routes InterceptorMessage
 //   interceptor.h:43  — an actor: message queue + handler thread
 //   task_node.h       — DAG node: upstream/downstream edges, max_run_times
-//   message_bus.h:40  — inter-carrier transport (brpc); here single-process,
-//                       so the bus is the in-memory queue fabric.
+//   message_bus.h:40  — inter-carrier transport (brpc there); here a framed
+//                       TCP bus (ps_net.h helpers) carrying control messages
+//                       AND tensor payload blobs between carriers, so
+//                       interceptors span processes/hosts.
 //
 // TPU-native role: the host-side orchestrator for multi-program pipeline
 // schedules (across-host DCN pipelines and async data/ckpt work), where the
@@ -16,14 +19,21 @@
 // Build: via paddle_tpu.utils.cpp_extension (g++ -shared -fPIC).
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <map>
+#include <memory>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <unordered_map>
+#include <utility>
 #include <vector>
+
+#include "../../ps/csrc/ps_net.h"
 
 namespace {
 
@@ -85,6 +95,8 @@ class Interceptor {
   friend class Carrier;
 };
 
+class MessageBus;
+
 class Carrier {
  public:
   ~Carrier() { Wait(); }
@@ -97,11 +109,22 @@ class Carrier {
         std::vector<int64_t>(downs, downs + n_downs)));
   }
 
-  // route a message to its destination queue (the in-process MessageBus)
-  void Send(const InterceptorMessage& msg) {
+  // route a message: local interceptor queue, or — when a bus is attached
+  // and the task lives on another rank — the cross-host MessageBus
+  // (reference: Carrier::Send falling through to MessageBus::Send)
+  void Send(const InterceptorMessage& msg);
+
+  // bus → local delivery only (never re-routed, so no forwarding loops)
+  void DeliverLocal(const InterceptorMessage& msg) {
     auto it = interceptors_.find(msg.dst_id);
     if (it != interceptors_.end()) it->second->Enqueue(msg);
   }
+
+  bool IsLocal(int64_t task) const {
+    return interceptors_.count(task) != 0;
+  }
+
+  void SetBus(MessageBus* bus) { bus_ = bus; }
 
   void Start() {
     error_.store(0);
@@ -122,16 +145,312 @@ class Carrier {
 
   // record the error AND wake every interceptor with STOP — a failed stage
   // must not leave downstream actors blocked on queues that will never fill
-  void SetError(int32_t e) {
-    error_.store(e);
-    for (auto& kv : interceptors_) Send({-1, kv.first, STOP, 0});
-  }
+  void SetError(int32_t e) { SetErrorImpl(e, /*broadcast=*/true); }
+
+  // a STOP that arrived over the bus must not be re-broadcast (loop)
+  void SetErrorFromBus(int32_t e) { SetErrorImpl(e, /*broadcast=*/false); }
+
   int32_t GetError() const { return error_.load(); }
 
  private:
+  void SetErrorImpl(int32_t e, bool broadcast);
+
   std::unordered_map<int64_t, std::unique_ptr<Interceptor>> interceptors_;
   std::atomic<int32_t> error_{0};
+  MessageBus* bus_ = nullptr;
 };
+
+// ---------------------------------------------------------------------------
+// MessageBus — inter-carrier transport (reference: message_bus.h:40, brpc
+// there; framed TCP here). Carries two kinds of traffic between ranks:
+//   - interceptor control messages (DATA/STOP), delivered straight into the
+//     peer carrier's local queues;
+//   - tensor payload blobs keyed by (dst_task, scope), parked in a store
+//     until the consuming interceptor fetches them (activations/cotangents
+//     of cross-host pipeline stages).
+// ---------------------------------------------------------------------------
+enum BusMsgType : int32_t { BUS_CTRL = 0, BUS_STOP = 1, BUS_PAYLOAD = 2 };
+
+struct BusWireMsg {
+  uint32_t magic;
+  int32_t type;      // BusMsgType
+  int64_t src_task;
+  int64_t dst_task;
+  int32_t ctrl_type;  // MsgType for BUS_CTRL
+  int64_t scope;
+  int64_t nbytes;    // payload bytes following
+};
+
+class MessageBus {
+ public:
+  MessageBus(int rank, std::vector<std::pair<std::string, int>> peers)
+      : rank_(rank), peers_(std::move(peers)), out_mu_(peers_.size()) {
+    out_fds_.assign(peers_.size(), -1);
+  }
+
+  ~MessageBus() { Stop(); }
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(peers_[rank_].second));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 16) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  int port() const { return port_; }
+  int rank() const { return rank_; }
+
+  // deliveries hold carrier_mu_, so after AttachCarrier(nullptr) returns no
+  // read thread can still be inside the old carrier — destroy is then safe.
+  // Control messages that arrived while no carrier was attached (a faster
+  // peer already started its next step) are parked and flushed on attach.
+  void AttachCarrier(Carrier* c) {
+    std::lock_guard<std::mutex> lk(carrier_mu_);
+    carrier_ = c;
+    if (c != nullptr) {
+      if (pending_stop_) {
+        pending_stop_ = false;
+        pending_ctrl_.clear();
+        c->SetErrorFromBus(-3);  // a remote failure arrived while detached
+        return;
+      }
+      for (const auto& m : pending_ctrl_) c->DeliverLocal(m);
+      pending_ctrl_.clear();
+    }
+  }
+
+  void SetTaskRank(int64_t task, int r) {
+    std::lock_guard<std::mutex> lk(map_mu_);
+    task_rank_[task] = r;
+  }
+
+  int RankOf(int64_t task) {
+    std::lock_guard<std::mutex> lk(map_mu_);
+    auto it = task_rank_.find(task);
+    return it == task_rank_.end() ? -1 : it->second;
+  }
+
+  // control message to the rank owning msg.dst_id
+  bool SendCtrl(const InterceptorMessage& msg) {
+    int r = RankOf(msg.dst_id);
+    if (r < 0 || r == rank_) return false;
+    BusWireMsg w{ps::kMagic, BUS_CTRL, msg.src_id, msg.dst_id,
+                 msg.type, msg.scope_idx, 0};
+    return SendRaw(r, w, nullptr);
+  }
+
+  void BroadcastStop() {
+    BusWireMsg w{ps::kMagic, BUS_STOP, -1, -1, STOP, 0, 0};
+    for (size_t r = 0; r < peers_.size(); ++r) {
+      if (static_cast<int>(r) != rank_) SendRaw(static_cast<int>(r), w, nullptr);
+    }
+  }
+
+  // payload blob for (dst_task, scope): local store or remote rank
+  bool Put(int64_t dst_task, int64_t scope, const void* buf, int64_t nbytes) {
+    int r = RankOf(dst_task);
+    if (r < 0) return false;
+    if (r == rank_) {
+      StorePayload(dst_task, scope,
+                   std::vector<char>(static_cast<const char*>(buf),
+                                     static_cast<const char*>(buf) + nbytes));
+      return true;
+    }
+    BusWireMsg w{ps::kMagic, BUS_PAYLOAD, -1, dst_task, DATA, scope, nbytes};
+    return SendRaw(r, w, buf);
+  }
+
+  // blocking fetch of a payload's size; -1 on timeout/stop
+  int64_t GetSize(int64_t task, int64_t scope, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(store_mu_);
+    auto key = std::make_pair(task, scope);
+    bool ok = store_cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms),
+        [&] { return store_.count(key) != 0 || !running_.load(); });
+    if (!ok || !store_.count(key)) return -1;
+    return static_cast<int64_t>(store_[key].size());
+  }
+
+  // copy out + erase; returns bytes copied, -1 when absent, -2 when the
+  // stored blob exceeds `cap` (a larger payload was re-put under the same
+  // key between the caller's GetSize and Take — never overflow the buffer)
+  int64_t Take(int64_t task, int64_t scope, void* out, int64_t cap) {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    auto key = std::make_pair(task, scope);
+    auto it = store_.find(key);
+    if (it == store_.end()) return -1;
+    int64_t n = static_cast<int64_t>(it->second.size());
+    if (n > cap) return -2;
+    std::memcpy(out, it->second.data(), static_cast<size_t>(n));
+    store_.erase(it);
+    return n;
+  }
+
+  void Stop() {
+    bool was = running_.exchange(false);
+    if (!was) return;
+    store_cv_.notify_all();
+    if (listen_fd_ >= 0) {
+      // poke accept() loose, then close
+      int fd = ps::connect_to("127.0.0.1", port_);
+      if (fd >= 0) ::close(fd);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    for (size_t r = 0; r < out_fds_.size(); ++r) {
+      std::lock_guard<std::mutex> lk(out_mu_[r]);
+      if (out_fds_[r] >= 0) ::close(out_fds_[r]);
+      out_fds_[r] = -1;
+    }
+  }
+
+ private:
+  void AcceptLoop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_.load()) break;
+        continue;
+      }
+      if (!running_.load()) {
+        ::close(fd);
+        break;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      conn_fds_.push_back(fd);
+      conn_threads_.emplace_back([this, fd] { ReadLoop(fd); });
+    }
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+
+  void ReadLoop(int fd) {
+    std::vector<char> buf;
+    while (running_.load()) {
+      BusWireMsg w{};
+      if (!ps::read_full(fd, &w, sizeof(w)) || w.magic != ps::kMagic) break;
+      buf.resize(static_cast<size_t>(w.nbytes));
+      if (w.nbytes > 0 && !ps::read_full(fd, buf.data(), buf.size())) break;
+      if (w.type == BUS_PAYLOAD) {
+        StorePayload(w.dst_task, w.scope, std::move(buf));
+        buf = std::vector<char>();
+      } else {
+        std::lock_guard<std::mutex> lk(carrier_mu_);
+        Carrier* car = carrier_;
+        if (w.type == BUS_CTRL) {
+          InterceptorMessage m{w.src_task, w.dst_task, w.ctrl_type, w.scope};
+          if (car != nullptr)
+            car->DeliverLocal(m);
+          else
+            pending_ctrl_.push_back(m);  // peer outran our next attach
+        } else if (w.type == BUS_STOP) {
+          if (car != nullptr)
+            car->SetErrorFromBus(-3);
+          else
+            pending_stop_ = true;  // surface the remote failure on attach
+        }
+      }
+    }
+    ::close(fd);
+  }
+
+  void StorePayload(int64_t task, int64_t scope, std::vector<char> data) {
+    {
+      std::lock_guard<std::mutex> lk(store_mu_);
+      store_[std::make_pair(task, scope)] = std::move(data);
+    }
+    store_cv_.notify_all();
+  }
+
+  bool SendRaw(int r, const BusWireMsg& w, const void* payload) {
+    std::lock_guard<std::mutex> lk(out_mu_[r]);
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      if (out_fds_[r] < 0) {
+        out_fds_[r] = ps::connect_to(peers_[r].first, peers_[r].second);
+        if (out_fds_[r] < 0) {
+          // peer may still be binding; brief retry loop
+          for (int i = 0; i < 50 && out_fds_[r] < 0 && running_.load(); ++i) {
+            std::this_thread::sleep_for(std::chrono::milliseconds(100));
+            out_fds_[r] = ps::connect_to(peers_[r].first, peers_[r].second);
+          }
+          if (out_fds_[r] < 0) return false;
+        }
+      }
+      bool ok = ps::write_full(out_fds_[r], &w, sizeof(w)) &&
+                (w.nbytes == 0 ||
+                 ps::write_full(out_fds_[r], payload,
+                                static_cast<size_t>(w.nbytes)));
+      if (ok) return true;
+      ::close(out_fds_[r]);
+      out_fds_[r] = -1;  // stale connection — reconnect once
+    }
+    return false;
+  }
+
+  int rank_;
+  std::vector<std::pair<std::string, int>> peers_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> running_{true};
+  std::mutex carrier_mu_;
+  Carrier* carrier_ = nullptr;
+  std::vector<InterceptorMessage> pending_ctrl_;
+  bool pending_stop_ = false;
+
+  std::mutex map_mu_;
+  std::unordered_map<int64_t, int> task_rank_;
+
+  std::thread accept_thread_;
+  std::mutex conns_mu_;
+  std::vector<int> conn_fds_;
+  std::vector<std::thread> conn_threads_;
+
+  std::vector<std::mutex> out_mu_;
+  std::vector<int> out_fds_;
+
+  std::mutex store_mu_;
+  std::condition_variable store_cv_;
+  std::map<std::pair<int64_t, int64_t>, std::vector<char>> store_;
+};
+
+void Carrier::Send(const InterceptorMessage& msg) {
+  auto it = interceptors_.find(msg.dst_id);
+  if (it != interceptors_.end()) {
+    it->second->Enqueue(msg);
+  } else if (bus_ != nullptr) {
+    bus_->SendCtrl(msg);
+  }
+}
+
+void Carrier::SetErrorImpl(int32_t e, bool broadcast) {
+  int32_t expected = 0;
+  bool first = error_.compare_exchange_strong(expected, e);
+  for (auto& kv : interceptors_) DeliverLocal({-1, kv.first, STOP, 0});
+  if (first && broadcast && bus_ != nullptr) bus_->BroadcastStop();
+}
 
 void Interceptor::Loop() {
   int64_t done = 0;
@@ -186,5 +505,58 @@ int32_t carrier_wait(void* h) {
 }
 
 void carrier_destroy(void* h) { delete static_cast<Carrier*>(h); }
+
+// ---- MessageBus C ABI (reference: message_bus.h Init/Send surface) --------
+
+// endpoints_csv: "host:port,host:port,..." indexed by rank; port 0 = auto
+void* bus_create(int rank, const char* endpoints_csv) {
+  auto peers = ps::parse_endpoints(endpoints_csv);
+  if (rank < 0 || rank >= static_cast<int>(peers.size())) return nullptr;
+  auto* b = new MessageBus(rank, std::move(peers));
+  if (!b->Start()) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+int bus_port(void* h) { return static_cast<MessageBus*>(h)->port(); }
+
+void bus_attach(void* bus, void* carrier) {
+  auto* b = static_cast<MessageBus*>(bus);
+  auto* c = static_cast<Carrier*>(carrier);
+  b->AttachCarrier(c);
+  c->SetBus(b);
+}
+
+// detach before carrier_destroy: the bus read threads must never deliver
+// into a dead carrier
+void bus_detach(void* bus) {
+  static_cast<MessageBus*>(bus)->AttachCarrier(nullptr);
+}
+
+void bus_set_task_rank(void* h, int64_t task, int rank) {
+  static_cast<MessageBus*>(h)->SetTaskRank(task, rank);
+}
+
+int bus_put(void* h, int64_t dst_task, int64_t scope, const void* buf,
+            int64_t nbytes) {
+  return static_cast<MessageBus*>(h)->Put(dst_task, scope, buf, nbytes) ? 0
+                                                                        : -1;
+}
+
+int64_t bus_get_size(void* h, int64_t task, int64_t scope,
+                     int64_t timeout_ms) {
+  return static_cast<MessageBus*>(h)->GetSize(task, scope, timeout_ms);
+}
+
+int64_t bus_take(void* h, int64_t task, int64_t scope, void* out,
+                 int64_t cap) {
+  return static_cast<MessageBus*>(h)->Take(task, scope, out, cap);
+}
+
+void bus_stop(void* h) { static_cast<MessageBus*>(h)->Stop(); }
+
+void bus_destroy(void* h) { delete static_cast<MessageBus*>(h); }
 
 }  // extern "C"

@@ -18,14 +18,14 @@ rides the coordination-service transports.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
-from . import FleetExecutor, TaskNode
+from . import FleetExecutor, MessageBus, TaskNode
 
-__all__ = ["HostPipelineTrainer"]
+__all__ = ["HostPipelineTrainer", "DistHostPipelineTrainer"]
 
 
 class HostPipelineTrainer:
@@ -198,3 +198,173 @@ class HostPipelineTrainer:
             total = jax.tree_util.tree_map(lambda g: g / num_micro, total)
             self.params[k] = self._sgd(self.params[k], total, self.lr)
         return float(sum(jax.device_get(l) for l in losses) / num_micro)
+
+
+class DistHostPipelineTrainer:
+    """Cross-process 1F1B pipeline: stage k lives in process/rank k, and
+    interceptors exchange control + activations over the MessageBus
+    (reference: FleetExecutor dist-model pipelines — SectionWorkers on
+    different ranks wired by message_bus.h over brpc; here the bus is the
+    framed-TCP transport in fleet_executor.cc).
+
+    Each rank constructs this with ITS stage function and params only.
+    Activations flow rank k → k+1 and cotangents k+1 → k as bus payloads;
+    per-microbatch scheduling is the same dataflow gating as the local
+    HostPipelineTrainer, with the 1F1B admission window enforced on rank 0
+    (fwd 0 admits, bwd 0 releases — both local to rank 0).
+    """
+
+    LOSS_CHAN = -100  # bus payload channel: last rank ships losses to rank 0
+
+    def __init__(self, stage_fn: Callable, params, loss_fn: Callable,
+                 learning_rate: float, rank: int, n_stages: int,
+                 bus: MessageBus, schedule: str = "1f1b"):
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"schedule must be 1f1b|gpipe, got {schedule!r}")
+        self.rank = int(rank)
+        self.n = int(n_stages)
+        self.bus = bus
+        self.lr = learning_rate
+        self.schedule = schedule
+        self.params = params
+        self.loss_fn = loss_fn
+        last = self.rank == self.n - 1
+        if last:
+            def wrapped(p, x, lbl, _fn=stage_fn):
+                return loss_fn(_fn(p, x), lbl)
+
+            self._fwd = jax.jit(lambda p, x, lbl: jax.vjp(wrapped, p, x, lbl))
+        else:
+            self._fwd = jax.jit(lambda p, x: jax.vjp(stage_fn, p, x))
+        self._bwd = jax.jit(lambda vjp, ct: vjp(ct))
+        self._sgd = jax.jit(
+            lambda p, g, lr: jax.tree_util.tree_map(
+                lambda pv, gv: pv - lr * gv, p, g
+            )
+        )
+        # global task ids: fwd stage k = k, bwd stage k = 2n-1-k
+        self.task_ranks: Dict[int, int] = {}
+        for k in range(self.n):
+            self.task_ranks[k] = k
+            self.task_ranks[2 * self.n - 1 - k] = k
+        bus.set_task_rank(self.LOSS_CHAN, 0)
+        self._step = 0
+
+    def _nodes(self, num_micro: int) -> List[TaskNode]:
+        """The FULL 2n-node chain, declared identically on every rank."""
+        total = 2 * self.n
+        nodes = []
+        for tid in range(total):
+            fn = None
+            if self.task_ranks[tid] == self.rank:
+                k = tid if tid < self.n else 2 * self.n - 1 - tid
+                fn = self._fwd_task(k) if tid < self.n else self._bwd_task(k)
+            node = TaskNode(tid, fn, max_run_times=num_micro)
+            if tid > 0:
+                node.add_upstream_task(tid - 1)
+            if tid < total - 1:
+                node.add_downstream_task(tid + 1)
+            nodes.append(node)
+        return nodes
+
+    def _fwd_task(self, k):
+        def run(t):
+            if k == 0:
+                self._admit()
+                x = self._micro_xs[t]
+            else:
+                x = self.bus.get(k, self._scope(t))
+            x = jnp.asarray(x)
+            if k == self.n - 1:
+                lbl = jnp.asarray(self._micro_labels[t])
+                loss, vjp = self._fwd(self.params, x, lbl)
+                self._losses[t] = loss
+            else:
+                y, vjp = self._fwd(self.params, x)
+                self.bus.put(k + 1, self._scope(t), jax.device_get(y))
+            self._vjps[t] = vjp
+
+        return run
+
+    def _bwd_task(self, k):
+        def run(t):
+            try:
+                if k == self.n - 1:
+                    ct = jnp.ones_like(self._losses[t])
+                    out = self._bwd(self._vjps[t], ct)
+                    gp, gx = out[0], out[1]
+                else:
+                    ct = jnp.asarray(self.bus.get(2 * self.n - 1 - k,
+                                                  self._scope(t)))
+                    gp, gx = self._bwd(self._vjps[t], ct)
+                self._vjps[t] = None  # free residuals early
+                if k > 0:
+                    # cotangent to the upstream stage's bwd task
+                    self.bus.put(2 * self.n - k, self._scope(t),
+                                 jax.device_get(gx))
+                self._grads[t] = gp
+            finally:
+                if k == 0 and self._window is not None:
+                    self._window.release()
+
+        return run
+
+    def _scope(self, t: int) -> int:
+        # bus payload keys must be unique across steps (a fast rank may
+        # ship step s+1 payloads before a slow rank drained step s)
+        return self._step * 1_000_000 + t
+
+    def _admit(self):
+        if self._window is not None and not self._window.acquire(timeout=30.0):
+            raise RuntimeError(
+                "1f1b admission window starved (a downstream stage likely "
+                "failed; its STOP aborts this step)"
+            )
+
+    def train_batch(self, micro_xs: Optional[Sequence] = None,
+                    micro_labels: Optional[Sequence] = None,
+                    num_micro: Optional[int] = None):
+        """One global step. rank 0 supplies micro_xs, the last rank
+        micro_labels; everyone else just passes num_micro. Returns the mean
+        loss on rank 0 and the last rank, None on middle ranks."""
+        import threading
+
+        if num_micro is None:
+            num_micro = len(micro_xs) if micro_xs is not None else len(micro_labels)
+        self._micro_xs = list(micro_xs or [])
+        self._micro_labels = list(micro_labels or [])
+        if self.rank == 0 and len(self._micro_xs) != num_micro:
+            raise ValueError("rank 0 needs one x per microbatch")
+        if self.rank == self.n - 1 and len(self._micro_labels) != num_micro:
+            raise ValueError("last rank needs one label per microbatch")
+        self._vjps = [None] * num_micro
+        self._grads = [None] * num_micro
+        self._losses = [None] * num_micro
+        self._window = (
+            threading.Semaphore(self.n)
+            if (self.schedule == "1f1b" and self.rank == 0)
+            else None
+        )
+
+        FleetExecutor(
+            self._nodes(num_micro), bus=self.bus, task_ranks=self.task_ranks
+        ).run()
+
+        total = self._grads[0]
+        for t in range(1, num_micro):
+            total = jax.tree_util.tree_map(jnp.add, total, self._grads[t])
+        total = jax.tree_util.tree_map(lambda g: g / num_micro, total)
+        self.params = self._sgd(self.params, total, self.lr)
+
+        loss = None
+        if self.rank == self.n - 1:
+            loss = float(
+                sum(jax.device_get(l) for l in self._losses) / num_micro
+            )
+            if self.rank != 0:
+                self.bus.put(self.LOSS_CHAN, self._step,
+                             jnp.asarray(loss, jnp.float32))
+        if self.rank == 0 and loss is None:
+            loss = float(self.bus.get(self.LOSS_CHAN, self._step))
+        self._step += 1
+        return loss

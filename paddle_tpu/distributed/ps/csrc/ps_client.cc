@@ -152,18 +152,10 @@ extern "C" {
 
 void* ps_client_create(const char* endpoints_csv) {
   auto* c = new ps::Client();
-  std::string s(endpoints_csv);
-  size_t pos = 0;
-  while (pos < s.size()) {
-    size_t comma = s.find(',', pos);
-    if (comma == std::string::npos) comma = s.size();
-    std::string ep = s.substr(pos, comma - pos);
-    pos = comma + 1;
-    size_t colon = ep.rfind(':');
-    if (colon == std::string::npos) continue;
+  for (auto& ep : ps::parse_endpoints(endpoints_csv)) {
     auto conn = std::make_unique<ps::Conn>();
-    conn->host = ep.substr(0, colon);
-    conn->port = std::atoi(ep.c_str() + colon + 1);
+    conn->host = ep.first;
+    conn->port = ep.second;
     c->conns.push_back(std::move(conn));
   }
   if (c->conns.empty()) {
@@ -360,11 +352,12 @@ int ps_client_load(void* h, const char* dirname) {
   return static_cast<ps::Client*>(h)->broadcast(hd, dirname) ? 0 : -1;
 }
 
-int64_t ps_client_stat(void* h) {
+// table_id 0 = every table on the fleet; nonzero = that table only
+int64_t ps_client_stat(void* h, uint32_t table_id) {
   auto* c = static_cast<ps::Client*>(h);
   int64_t total = 0;
   for (int i = 0; i < c->n_servers(); ++i) {
-    ps::Header hd{0, ps::CMD_STAT, 0, 0, 0, 0};
+    ps::Header hd{0, ps::CMD_STAT, table_id, 0, 0, 0};
     int64_t n = 0;
     if (!c->request(i, hd, nullptr, nullptr, &n)) return -1;
     total += n;
@@ -372,8 +365,8 @@ int64_t ps_client_stat(void* h) {
   return total;
 }
 
-int ps_client_set_lr(void* h, float lr) {
-  ps::Header hd{0, ps::CMD_SET_LR, 0, 0, 0, 4};
+int ps_client_set_lr(void* h, uint32_t table_id, float lr) {
+  ps::Header hd{0, ps::CMD_SET_LR, table_id, 0, 0, 4};
   return static_cast<ps::Client*>(h)->broadcast(hd, &lr) ? 0 : -1;
 }
 

@@ -15,8 +15,11 @@
 #include <unistd.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+#include <utility>
+#include <vector>
 
 namespace ps {
 
@@ -101,6 +104,26 @@ inline int connect_to(const std::string& host, int port) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return fd;
+}
+
+// "host:port,host:port,..." → endpoint list (shared by the PS client and
+// the FleetExecutor MessageBus so the two transports cannot drift)
+inline std::vector<std::pair<std::string, int>> parse_endpoints(
+    const char* csv) {
+  std::vector<std::pair<std::string, int>> peers;
+  std::string s(csv);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    if (comma == std::string::npos) comma = s.size();
+    std::string ep = s.substr(pos, comma - pos);
+    pos = comma + 1;
+    size_t colon = ep.rfind(':');
+    if (colon == std::string::npos) continue;
+    peers.emplace_back(ep.substr(0, colon),
+                       std::atoi(ep.c_str() + colon + 1));
+  }
+  return peers;
 }
 
 // key → owning server. Distinct finalizer from SparseTable::shard_of so

@@ -312,10 +312,13 @@ struct Server {
         return true;
       }
       case CMD_STAT: {
+        // table_id 0 → whole fleet; nonzero → that sparse table only
         int64_t total = 0;
         {
           std::lock_guard<std::mutex> lk(tables_mu);
-          for (auto& kv : sparse) total += kv.second->size();
+          for (auto& kv : sparse)
+            if (h.table_id == 0 || kv.first == h.table_id)
+              total += kv.second->size();
         }
         reply(fd, h, kStatusOk, nullptr, 0, total);
         return true;
@@ -328,8 +331,12 @@ struct Server {
         float lr;
         std::memcpy(&lr, payload.data(), 4);
         std::lock_guard<std::mutex> lk(tables_mu);
-        for (auto& kv : sparse) kv.second->lr = lr;
-        for (auto& kv : dense) kv.second->lr = lr;
+        for (auto& kv : sparse)
+          if (h.table_id == 0 || kv.first == h.table_id)
+            kv.second->lr = lr;
+        for (auto& kv : dense)
+          if (h.table_id == 0 || kv.first == h.table_id)
+            kv.second->lr = lr;
         reply(fd, h, kStatusOk, nullptr, 0);
         return true;
       }

@@ -115,9 +115,11 @@ def _load_client_lib():
         lib.ps_client_load.restype = ctypes.c_int
         lib.ps_client_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ps_client_stat.restype = ctypes.c_int64
-        lib.ps_client_stat.argtypes = [ctypes.c_void_p]
+        lib.ps_client_stat.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.ps_client_set_lr.restype = ctypes.c_int
-        lib.ps_client_set_lr.argtypes = [ctypes.c_void_p, ctypes.c_float]
+        lib.ps_client_set_lr.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_float,
+        ]
         lib.ps_client_stop_servers.restype = ctypes.c_int
         lib.ps_client_stop_servers.argtypes = [ctypes.c_void_p]
         _client_lib = lib
@@ -279,14 +281,16 @@ class PsClient:
         if self._lib.ps_client_load(self._h, dirname.encode()) != 0:
             raise IOError(f"distributed load from {dirname} failed")
 
-    def stat(self) -> int:
-        n = self._lib.ps_client_stat(self._h)
+    def stat(self, table_id: int = 0) -> int:
+        """Row count of one sparse table, or of the whole fleet (id 0)."""
+        n = self._lib.ps_client_stat(self._h, table_id)
         if n < 0:
             raise ConnectionError("stat failed")
         return int(n)
 
-    def set_lr(self, lr: float):
-        self._lib.ps_client_set_lr(self._h, ctypes.c_float(lr))
+    def set_lr(self, lr: float, table_id: int = 0):
+        """Set the optimizer lr of one table, or of every table (id 0)."""
+        self._lib.ps_client_set_lr(self._h, table_id, ctypes.c_float(lr))
 
 
 class DistributedSparseTable:
@@ -314,10 +318,10 @@ class DistributedSparseTable:
         self.client.push_sparse(self.table_id, keys, grads)
 
     def set_lr(self, lr: float):
-        self.client.set_lr(lr)
+        self.client.set_lr(lr, table_id=self.table_id)
 
     def __len__(self):
-        return self.client.stat()
+        return self.client.stat(table_id=self.table_id)
 
     def save(self, dirname: str):
         self.client.save(dirname)

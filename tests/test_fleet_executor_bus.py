@@ -1,0 +1,201 @@
+"""Cross-host FleetExecutor MessageBus tests (VERDICT r2 item 5).
+
+The bus spans carriers in different processes (reference: message_bus.h:40
+over brpc; here framed TCP). Covers payload put/get in-process and across
+processes, and the done-criterion: a 2-process DistHostPipelineTrainer run
+whose per-step losses match the single-process HostPipelineTrainer.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.test_ps_service import _free_ports  # noqa: E402 (shared helper)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bus_local_put_get():
+    from paddle_tpu.distributed.fleet_executor import MessageBus
+
+    (p,) = _free_ports(1)
+    bus = MessageBus(0, [f"127.0.0.1:{p}"])
+    bus.set_task_rank(7, 0)
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    bus.put(7, 5, arr)
+    out = bus.get(7, 5, timeout=5)
+    assert np.array_equal(out, arr)
+    with pytest.raises(TimeoutError):
+        bus.get(7, 5, timeout=0.2)  # consumed — store must not retain
+    bus.stop()
+
+
+def test_bus_cross_process_payload_and_ctrl():
+    """Two processes: rank 1 computes doubles of what rank 0 ships, control
+    messages drive a remote task, results come back over the bus."""
+    p0, p1 = _free_ports(2)
+    eps = f"127.0.0.1:{p0},127.0.0.1:{p1}"
+    peer = (
+        "import numpy as np\n"
+        "from paddle_tpu.distributed.fleet_executor import (FleetExecutor,"
+        " MessageBus, TaskNode)\n"
+        f"bus = MessageBus(1, '{eps}'.split(','))\n"
+        "bus.set_task_rank(100, 0)\n"
+        "def work(t):\n"
+        "    x = bus.get(1, t, timeout=30)\n"
+        "    bus.put(100, t, x * 2)\n"
+        "nodes = [TaskNode(0, None, max_run_times=3),"
+        " TaskNode(1, work, max_run_times=3)]\n"
+        "nodes[0].add_downstream_task(1); nodes[1].add_upstream_task(0)\n"
+        "FleetExecutor(nodes, bus=bus, task_ranks={0: 0, 1: 1}).run(timeout=60)\n"
+        "print('PEER_DONE')\n"
+    )
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    proc = subprocess.Popen([sys.executable, "-c", peer], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        from paddle_tpu.distributed.fleet_executor import (
+            FleetExecutor, MessageBus, TaskNode,
+        )
+
+        bus = MessageBus(0, eps.split(","))
+        bus.set_task_rank(100, 0)
+        sent = {}
+
+        def feed(t):
+            arr = np.full((4,), float(t + 1), np.float32)
+            sent[t] = arr
+            bus.put(1, t, arr)
+
+        nodes = [TaskNode(0, feed, max_run_times=3),
+                 TaskNode(1, None, max_run_times=3)]
+        nodes[0].add_downstream_task(1)
+        nodes[1].add_upstream_task(0)
+        FleetExecutor(nodes, bus=bus, task_ranks={0: 0, 1: 1}).run(timeout=60)
+        for t in range(3):
+            out = bus.get(100, t, timeout=30)
+            assert np.array_equal(out, sent[t] * 2)
+        bus.stop()
+    finally:
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+        assert "PEER_DONE" in out
+
+
+# ---------------------------------------------------------------------------
+# Done-criterion: 2-process pipeline trainer matches single-process losses.
+# ---------------------------------------------------------------------------
+_STAGE_SCRIPT = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from paddle_tpu.distributed.fleet_executor import MessageBus
+from paddle_tpu.distributed.fleet_executor.pipeline_trainer import (
+    DistHostPipelineTrainer,
+)
+
+RANK = int(os.environ["PIPE_RANK"])
+EPS = os.environ["PIPE_EPS"].split(",")
+STEPS, NUM_MICRO, MB, DIN, DH, DOUT = 4, 4, 8, 6, 16, 3
+
+rng = np.random.default_rng(0)
+w1 = jnp.asarray(rng.standard_normal((DIN, DH)) * 0.3, jnp.float32)
+w2 = jnp.asarray(rng.standard_normal((DH, DOUT)) * 0.3, jnp.float32)
+
+def stage0(p, x):
+    return jnp.tanh(x @ p["w"])
+
+def stage1(p, x):
+    return x @ p["w"]
+
+def loss_fn(y, lbl):
+    return jnp.mean((y - lbl) ** 2)
+
+bus = MessageBus(RANK, EPS)
+if RANK == 0:
+    trainer = DistHostPipelineTrainer(stage0, {"w": w1}, loss_fn, 0.1,
+                                      rank=0, n_stages=2, bus=bus)
+else:
+    trainer = DistHostPipelineTrainer(stage1, {"w": w2}, loss_fn, 0.1,
+                                      rank=1, n_stages=2, bus=bus)
+
+data = np.random.default_rng(7)
+for s in range(STEPS):
+    xs = [jnp.asarray(data.standard_normal((MB, DIN)), jnp.float32)
+          for _ in range(NUM_MICRO)]
+    lbls = [jnp.asarray(data.standard_normal((MB, DOUT)), jnp.float32)
+            for _ in range(NUM_MICRO)]
+    if RANK == 0:
+        loss = trainer.train_batch(micro_xs=xs, num_micro=NUM_MICRO)
+        print(f"STEP {s} LOSS {loss:.8f}", flush=True)
+    else:
+        trainer.train_batch(micro_labels=lbls, num_micro=NUM_MICRO)
+bus.stop()
+"""
+
+
+@pytest.mark.slow
+def test_dist_pipeline_matches_single_process(tmp_path):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet_executor.pipeline_trainer import (
+        HostPipelineTrainer,
+    )
+
+    STEPS, NUM_MICRO, MB, DIN, DH, DOUT = 4, 4, 8, 6, 16, 3
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((DIN, DH)) * 0.3, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((DH, DOUT)) * 0.3, jnp.float32)
+
+    def stage0(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def stage1(p, x):
+        return x @ p["w"]
+
+    def loss_fn(y, lbl):
+        return jnp.mean((y - lbl) ** 2)
+
+    single = HostPipelineTrainer(
+        [stage0, stage1], [{"w": w1}, {"w": w2}], loss_fn, learning_rate=0.1,
+        devices=[jax.devices()[0]] * 2,
+    )
+    data = np.random.default_rng(7)
+    expected = []
+    for _ in range(STEPS):
+        xs = [jnp.asarray(data.standard_normal((MB, DIN)), jnp.float32)
+              for _ in range(NUM_MICRO)]
+        lbls = [jnp.asarray(data.standard_normal((MB, DOUT)), jnp.float32)
+                for _ in range(NUM_MICRO)]
+        expected.append(single.train_batch(xs, lbls))
+
+    p0, p1 = _free_ports(2)
+    eps = f"127.0.0.1:{p0},127.0.0.1:{p1}"
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu", "PIPE_EPS": eps})
+    procs = []
+    for r in range(2):
+        e = dict(env)
+        e["PIPE_RANK"] = str(r)
+        procs.append(subprocess.Popen([sys.executable, "-c", _STAGE_SCRIPT],
+                                      env=e, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-3000:]
+        outs.append(out)
+    got = [float(l.split()[3]) for l in outs[0].splitlines()
+           if l.startswith("STEP")]
+    assert len(got) == STEPS
+    for e, g in zip(expected, got):
+        assert abs(e - g) < 1e-5, (expected, got)
