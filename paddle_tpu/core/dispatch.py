@@ -116,6 +116,24 @@ def _cache_token(fn: Callable):
 
 
 def _jitted(fn: Callable, kw_items: Tuple, token=None) -> Optional[Callable]:
+    if token is not None:
+        # explicit token (to_static's per-config closures): store the jit
+        # wrapper ON the token object so its lifetime follows the token —
+        # module-global caching would pin the closure (and the params it
+        # captures) forever after the model is dropped
+        try:
+            store = token.__dict__.setdefault("_jst_jit_cache", {})
+        except AttributeError:
+            store = None
+        if store is not None:
+            try:
+                cached = store.get(kw_items)
+            except TypeError:
+                return None
+            if cached is None:
+                cached = jax.jit(functools.partial(fn, **dict(kw_items)))
+                store[kw_items] = cached
+            return cached
     token = token if token is not None else _cache_token(fn)
     if token is None:
         return None
@@ -147,10 +165,20 @@ def _hashable(v):
 _vjp_cache: Dict[Tuple, Callable] = {}
 
 
-def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token):
+def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token,
+                attach_to_token: bool = False):
+    store = _vjp_cache
     key = (token, kw_items, diff_idx)
+    if attach_to_token:
+        # explicit token (to_static closures): cache rides on the token so
+        # dropping the model frees its compiled programs (see _jitted)
+        try:
+            store = token.__dict__.setdefault("_jst_vjp_cache", {})
+            key = (kw_items, diff_idx)
+        except AttributeError:
+            pass  # token without __dict__ — fall back to the global store
     try:
-        cached = _vjp_cache.get(key)
+        cached = store.get(key)
     except TypeError:
         return None
     if cached is None:
@@ -167,7 +195,7 @@ def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token):
             return jax.vjp(partial_fn, *[all_vals[i] for i in diff_idx])
 
         cached = jax.jit(run)
-        _vjp_cache[key] = cached
+        store[key] = cached
     return cached
 
 
@@ -258,6 +286,7 @@ def apply(
     *args,
     op_name: Optional[str] = None,
     differentiable: bool = True,
+    cache_token=None,
     **kwargs,
 ):
     """Run op `fn` on Tensor/array args, recording autograd tape if needed.
@@ -299,7 +328,11 @@ def apply(
     record = differentiable and bool(diff_idx) and _grad_state().grad_enabled
 
     if not record:
-        jfn = _jitted(fn, kw_items) if flags.flag("eager_op_jit") else None
+        jfn = (
+            _jitted(fn, kw_items, token=cache_token)
+            if flags.flag("eager_op_jit")
+            else None
+        )
         if jfn is not None:
             out_vals = jfn(*vals)
         else:
@@ -310,16 +343,21 @@ def apply(
     # op is cacheable: linearization is staged once per (op, statics, diff
     # positions, shapes) instead of on every eager call — this is what
     # keeps per-op dispatch overhead near one compiled-call dispatch
-    token = _cache_token(fn)
+    token = cache_token if cache_token is not None else _cache_token(fn)
     jitted_vjp = (
-        _jitted_vjp(fn, kw_items, tuple(diff_idx), token)
+        _jitted_vjp(fn, kw_items, tuple(diff_idx), token,
+                    attach_to_token=cache_token is not None)
         if (flags.flag("eager_op_jit") and token is not None)
         else None
     )
     # partial_fn still routes through the jitted op: the first-order vjp
     # uses jitted_vjp, but create_graph's re-derivation replays partial_fn
     # and must keep the one-compiled-call primal
-    jfn = _jitted(fn, kw_items) if flags.flag("eager_op_jit") else None
+    jfn = (
+        _jitted(fn, kw_items, token=cache_token)
+        if flags.flag("eager_op_jit")
+        else None
+    )
 
     def partial_fn(*diff_vals):
         full = list(vals)

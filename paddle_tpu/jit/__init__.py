@@ -117,6 +117,13 @@ class StaticFunction:
 
     def __init__(self, function: Callable, input_spec=None, layer: Optional[Layer] = None):
         self._dygraph_function = function
+        # AST-convert data-dependent control flow (if/while/for-range over
+        # tensors → lax.cond/while_loop) — the Dy2Static pipeline's job
+        # (reference: loop_transformer.py:486, ifelse_transformer.py). Falls
+        # back to the original function when no source is available.
+        from .dy2static import convert_to_static
+
+        self._converted_function = convert_to_static(function)
         self._input_spec = input_spec
         self._layer = layer
         self._compiled: Dict[Tuple, Callable] = {}
@@ -162,7 +169,7 @@ class StaticFunction:
                 tensor_args.append(a if isinstance(a, Tensor) else Tensor(jnp.asarray(a)))
         kw_static = tuple(sorted(kwargs.items()))
 
-        fn = self._dygraph_function
+        fn = self._converted_function
         layer = self._layer
         training = layer.training if layer is not None else True
         template = tuple(
@@ -226,8 +233,13 @@ class StaticFunction:
                     print(f"[to_static] jaxpr dump failed: {e}")
 
         key_arr = _random.next_key()
+        # `pure` is a closure (uncacheable by code identity) but its OBJECT
+        # identity is stable per static config (held in self._compiled), so
+        # it serves as its own cache token — this is what makes to_static
+        # actually compile once and replay the XLA program on later calls
         outs = apply(
-            pure, *params, *buffers, key_arr, *tensor_args, op_name=pure.__name__
+            pure, *params, *buffers, key_arr, *tensor_args,
+            op_name=pure.__name__, cache_token=pure,
         )
         meta = pure._meta
         model_outs = outs[: meta["n_out"]]
@@ -268,8 +280,12 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
                 cache_name = "_static_forward_cache"
                 sf = getattr(inst, cache_name, None)
                 if sf is None:
+                    # bind THEN wrap: a MethodType converts through the
+                    # dy2static AST pipeline, a functools.partial would not
+                    import types as _types
+
                     sf = StaticFunction(
-                        functools.partial(fn, inst), input_spec, inst
+                        _types.MethodType(fn, inst), input_spec, inst
                     )
                     setattr(inst, cache_name, sf)
                 return sf(*args[1:], **kw)
@@ -463,7 +479,13 @@ def save(layer, path, input_spec=None, **configs):
     if isinstance(layer, Layer):
         fn = layer.forward
         if isinstance(fn, StaticFunction):
-            fn = fn.dygraph_function
+            # export the CONVERTED function: control flow a StaticFunction
+            # runs through lax.cond/while must export the same way
+            fn = fn._converted_function
+        else:
+            from .dy2static import convert_to_static
+
+            fn = convert_to_static(fn)
         params = [p for _, p in layer.named_parameters()]
         buffers = [b for _, b in layer.named_buffers()]
         state = [t._value for t in params + buffers]

@@ -1,0 +1,742 @@
+"""Dy2static AST conversion: data-dependent Python control flow → lax ops.
+
+Reference analogue: python/paddle/fluid/dygraph/dygraph_to_static/ —
+IfElseTransformer (ifelse_transformer.py), LoopTransformer
+(loop_transformer.py:486), LogicalTransformer, and the runtime dispatch
+helpers in convert_operators.py (convert_ifelse / convert_while_loop /
+convert_logical_and ...). The reference rewrites Python AST into
+cond_op/while_op program ops; here the SAME rewrite targets jax control
+primitives, so a data-dependent `if`/`while` over traced tensors compiles
+into `lax.cond` / `lax.while_loop` inside the one fused XLA program, while
+plain-Python conditions keep exact eager semantics (the helpers dispatch on
+whether the predicate is traced).
+
+Conversion contract (documented subset, same spirit as the reference's
+constraints):
+  - names assigned inside a converted branch/loop body must already be
+    bound before it (both branches of a traced cond must produce the same
+    pytree);
+  - `return`/`break`/`continue` inside a branch/loop body, and attribute
+    stores (self.x = ...), keep Python semantics: that statement's
+    `if`/`while` is left untransformed (a traced predicate there raises
+    jax's TracerBoolConversionError, pointing at the unsupported pattern);
+  - only the decorated function is converted (calls into helpers trace as
+    usual).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Callable, List, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_to_static", "jst"]
+
+
+def _is_traced(v) -> bool:
+    from ..core.tensor import Tensor
+
+    if isinstance(v, Tensor):
+        v = v._value
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap(v):
+    from ..core.tensor import Tensor
+
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _to_bool_value(v):
+    """Concrete predicate → python bool; traced → raw array."""
+    v = _unwrap(v)
+    if isinstance(v, jax.core.Tracer):
+        return v
+    if hasattr(v, "dtype"):
+        return bool(v)
+    return bool(v)
+
+
+class _Undefined:
+    """Placeholder for a name not yet bound when a converted region starts
+    (reference: dygraph_to_static UndefinedVar) — both branches must bind it
+    before the merged value is used."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEF = _Undefined()
+
+
+class _Runtime:
+    """Runtime dispatch helpers the transformed code calls (reference:
+    convert_operators.py). Injected as `__jst` into the function globals."""
+
+    UNDEF = UNDEF
+
+    @staticmethod
+    def load_or_undef(lcls, name):
+        return lcls.get(name, UNDEF)
+
+    @staticmethod
+    def convert_ifelse(pred, true_fn, false_fn, carry):
+        pred = _to_bool_value(pred)
+        if isinstance(pred, jax.core.Tracer):
+            from ..core.tensor import Tensor
+
+            # UNDEF slots (bound only inside the branches) can't be cond
+            # operands — they ride as closure constants and must come back
+            # as real values from BOTH branches
+            defined_idx = [i for i, c in enumerate(carry) if c is not UNDEF]
+            vals = tuple(_unwrap(carry[i]) for i in defined_idx)
+
+            def rebuild(vs):
+                full = list(carry)
+                for j, i in enumerate(defined_idx):
+                    full[i] = Tensor(vs[j], stop_gradient=True)
+                return tuple(full)
+
+            # UNDEF outputs encode as None (a structural pytree node): a
+            # temp left unbound by BOTH branches merges fine; bound by only
+            # one branch → lax.cond pytree-structure mismatch (caught below
+            # with a readable message)
+            def to_pytree(out):
+                return tuple(None if o is UNDEF else _unwrap(o) for o in out)
+
+            def t(vs):
+                return to_pytree(true_fn(rebuild(vs)))
+
+            def f(vs):
+                return to_pytree(false_fn(rebuild(vs)))
+
+            try:
+                outs = jax.lax.cond(
+                    jnp.asarray(pred).astype(bool).reshape(()), t, f, vals
+                )
+            except TypeError as e:
+                raise ValueError(
+                    "dy2static: both branches of a tensor-dependent if must "
+                    "produce the same variables with the same types (a "
+                    "variable bound in only one branch, or with mismatched "
+                    f"dtype/shape, cannot merge): {e}"
+                ) from None
+            return tuple(
+                UNDEF if o is None else Tensor(o, stop_gradient=True)
+                for o in outs
+            )
+        return true_fn(carry) if pred else false_fn(carry)
+
+    @staticmethod
+    def convert_while(cond_fn, body_fn, carry, droppable=None):
+        """droppable[i] marks body-local temps (written before read, unused
+        by the cond): when unbound at loop entry they ride OUTSIDE the lax
+        carry — the loop recomputes them every iteration anyway.
+
+        Dispatch is on the CONDITION only: a concrete (python) condition
+        unrolls as a plain python loop even over traced carries, preserving
+        body side effects and exact eager semantics; only a traced
+        condition needs lax.while_loop."""
+        from ..core.tensor import Tensor
+
+        droppable = droppable or (False,) * len(carry)
+        probe = cond_fn(carry)
+        if _is_traced(probe):
+            kept = [
+                i for i, c in enumerate(carry)
+                if not (c is UNDEF and droppable[i])
+            ]
+            if any(carry[i] is UNDEF for i in kept):
+                raise ValueError(
+                    "dy2static: a variable read by a tensor-dependent "
+                    "while (in its condition, or before assignment in its "
+                    "body) must be initialized before the loop "
+                    "(lax.while_loop needs a typed carry)"
+                )
+            vals = tuple(jnp.asarray(_unwrap(carry[i])) for i in kept)
+
+            def rebuild(vs):
+                full = list(carry)
+                for j, i in enumerate(kept):
+                    full[i] = Tensor(vs[j], stop_gradient=True)
+                return tuple(full)
+
+            def cond(vs):
+                r = cond_fn(rebuild(vs))
+                return jnp.asarray(_unwrap(r)).astype(bool).reshape(())
+
+            def body(vs):
+                out = body_fn(rebuild(vs))
+                return tuple(jnp.asarray(_unwrap(out[i])) for i in kept)
+
+            outs = jax.lax.while_loop(cond, body, vals)
+            full = list(carry)  # dropped temps stay UNDEF → deleted after
+            for j, i in enumerate(kept):
+                full[i] = Tensor(outs[j], stop_gradient=True)
+            return tuple(full)
+        while _to_bool_value(cond_fn(carry)):
+            carry = body_fn(carry)
+        return carry
+
+    @staticmethod
+    def convert_range_for(start, stop, step, body_fn, carry, droppable=None):
+        """`for i in range(start, stop, step)` with any traced bound.
+        body_fn(i, carry) -> carry. Returns (*carry, last_i): python `for`
+        leaves the loop variable bound to its last value (UNDEF when the
+        range is empty, matching the unbound-name semantics)."""
+        from ..core.tensor import Tensor
+
+        droppable = droppable or (False,) * len(carry)
+        if not (_is_traced(start) or _is_traced(stop) or _is_traced(step)):
+            last_i = UNDEF
+            for i in range(int(_unwrap(start)), int(_unwrap(stop)),
+                           int(_unwrap(step))):
+                carry = body_fn(i, carry)
+                last_i = i
+            return tuple(carry) + (last_i,)
+        kept = [
+            i for i, c in enumerate(carry)
+            if not (c is UNDEF and droppable[i])
+        ]
+        if any(carry[i] is UNDEF for i in kept):
+            raise ValueError(
+                "dy2static: a variable read before assignment inside a "
+                "tensor-bounded for-range must be initialized before the "
+                "loop (lax.while_loop needs a typed carry)"
+            )
+        vals = tuple(jnp.asarray(_unwrap(carry[i])) for i in kept)
+        i0 = jnp.asarray(_unwrap(start), jnp.int32).reshape(())
+        i1 = jnp.asarray(_unwrap(stop), jnp.int32).reshape(())
+        di = jnp.asarray(_unwrap(step), jnp.int32).reshape(())
+
+        def rebuild(vs):
+            full = list(carry)
+            for j, i in enumerate(kept):
+                full[i] = Tensor(vs[j], stop_gradient=True)
+            return tuple(full)
+
+        def cond(state):
+            i, _ = state
+            return jnp.where(di > 0, i < i1, i > i1)
+
+        def body(state):
+            i, vs = state
+            out = body_fn(Tensor(i, stop_gradient=True), rebuild(vs))
+            return (i + di, tuple(jnp.asarray(_unwrap(out[k])) for k in kept))
+
+        i_end, outs = jax.lax.while_loop(cond, body, (i0, vals))
+        full = list(carry)
+        for j, i in enumerate(kept):
+            full[i] = Tensor(outs[j], stop_gradient=True)
+        # last executed index; for an empty traced range this is start-step
+        # (a traced program cannot express "unbound")
+        return tuple(full) + (Tensor(i_end - di, stop_gradient=True),)
+
+    @staticmethod
+    def convert_logical_and(x, y_fn):
+        if _is_traced(x):
+            from ..core.tensor import Tensor
+
+            return Tensor(
+                jnp.logical_and(
+                    jnp.asarray(_unwrap(x)).astype(bool),
+                    jnp.asarray(_unwrap(y_fn())).astype(bool),
+                ),
+                stop_gradient=True,
+            )
+        return y_fn() if _to_bool_value(x) else x
+
+    @staticmethod
+    def convert_logical_or(x, y_fn):
+        if _is_traced(x):
+            from ..core.tensor import Tensor
+
+            return Tensor(
+                jnp.logical_or(
+                    jnp.asarray(_unwrap(x)).astype(bool),
+                    jnp.asarray(_unwrap(y_fn())).astype(bool),
+                ),
+                stop_gradient=True,
+            )
+        return x if _to_bool_value(x) else y_fn()
+
+    @staticmethod
+    def convert_logical_not(x):
+        if _is_traced(x):
+            from ..core.tensor import Tensor
+
+            return Tensor(
+                jnp.logical_not(jnp.asarray(_unwrap(x)).astype(bool)),
+                stop_gradient=True,
+            )
+        return not _to_bool_value(x)
+
+
+jst = _Runtime()
+
+
+# ---------------------------------------------------------------------------
+# static analysis: names a statement list assigns
+# ---------------------------------------------------------------------------
+def _assigned_names(body: Sequence[ast.stmt]) -> Set[str]:
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+            self.generic_visit(node)
+
+        # nested function/class bodies are their own scope
+        def visit_FunctionDef(self, node):
+            names.add(node.name)
+
+        def visit_AsyncFunctionDef(self, node):
+            names.add(node.name)
+
+        def visit_ClassDef(self, node):
+            names.add(node.name)
+
+    v = V()
+    for stmt in body:
+        v.visit(stmt)
+    # generated helper names are scaffolding, never carried state
+    return {n for n in names if not n.startswith("__jst")}
+
+
+def _contains_disallowed(body: Sequence[ast.stmt]) -> bool:
+    """Return/break/continue or attribute/subscript stores IN THIS SCOPE —
+    keep Python semantics for those statements (reference: Dy2Static's
+    unsupported patterns raise; we degrade gracefully instead). Nested
+    function bodies are separate scopes: their returns are legal (and the
+    generated __jst branch helpers always contain one)."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def _check(self, node):
+            nonlocal found
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+                found = True
+            if isinstance(node, (ast.Attribute, ast.Subscript)) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                found = True
+
+        def generic_visit(self, node):
+            self._check(node)
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                super().generic_visit(node)
+
+    v = V()
+    for stmt in body:
+        v.visit(stmt)
+    return found
+
+
+def _read_names(node) -> Set[str]:
+    reads: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            reads.add(n.id)
+    return reads
+
+
+def _read_before_write(body: Sequence[ast.stmt], name: str) -> bool:
+    """Statement-level approximation: does the body read `name` before (or
+    within the statement that first) writes it? `s = s + 1` counts as a
+    read; `h = f(x)` does not. Drives the droppable-temp analysis."""
+    for stmt in body:
+        if name in _read_names(stmt):
+            return True
+        if name in _assigned_names([stmt]):
+            return False
+    return False
+
+
+def _droppable_mask(carry: List[str], body: Sequence[ast.stmt],
+                    cond_expr=None) -> ast.expr:
+    """ast literal tuple: True per carry name that is a pure body temp
+    (written before read, unused by the loop condition)."""
+    cond_reads = _read_names(cond_expr) if cond_expr is not None else set()
+    flags = [
+        not (n in cond_reads or _read_before_write(body, n)) for n in carry
+    ]
+    return ast.Tuple(
+        elts=[ast.Constant(bool(f)) for f in flags], ctx=ast.Load()
+    )
+
+
+def _name_tuple(names: List[str], ctx) -> ast.expr:
+    return ast.Tuple(
+        elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx()
+    )
+
+
+def _pre_load_stmts(carry: List[str]) -> List[ast.stmt]:
+    """`name = __jst.load_or_undef(locals(), 'name')` per carry name, so a
+    name bound only inside the converted region enters as UNDEF instead of
+    tripping UnboundLocalError at the carry-tuple load."""
+    out = []
+    for n in carry:
+        out.append(
+            ast.Assign(
+                targets=[ast.Name(id=n, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id="__jst", ctx=ast.Load()),
+                        attr="load_or_undef", ctx=ast.Load(),
+                    ),
+                    args=[
+                        ast.Call(
+                            func=ast.Name(id="locals", ctx=ast.Load()),
+                            args=[], keywords=[],
+                        ),
+                        ast.Constant(n),
+                    ],
+                    keywords=[],
+                ),
+            )
+        )
+    return out
+
+
+def _post_del_stmts(carry: List[str]) -> List[ast.stmt]:
+    """`if name is __jst.UNDEF: del name` — restores exact unbound-name
+    Python semantics for names no branch ended up binding."""
+    out = []
+    for n in carry:
+        out.append(
+            ast.If(
+                test=ast.Compare(
+                    left=ast.Name(id=n, ctx=ast.Load()),
+                    ops=[ast.Is()],
+                    comparators=[
+                        ast.Attribute(
+                            value=ast.Name(id="__jst", ctx=ast.Load()),
+                            attr="UNDEF", ctx=ast.Load(),
+                        )
+                    ],
+                ),
+                body=[ast.Delete(targets=[ast.Name(id=n, ctx=ast.Del())])],
+                orelse=[],
+            )
+        )
+    return out
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For(range)/BoolOp/Not into __jst dispatch calls."""
+
+    def __init__(self):
+        self._counter = 0
+
+    def _fresh(self, kind: str) -> str:
+        self._counter += 1
+        return f"__jst_{kind}_{self._counter}"
+
+    # -- logical ops ---------------------------------------------------------
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        op = (
+            "convert_logical_and"
+            if isinstance(node.op, ast.And)
+            else "convert_logical_or"
+        )
+        expr = node.values[0]
+        for nxt in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="__jst", ctx=ast.Load()),
+                    attr=op, ctx=ast.Load(),
+                ),
+                args=[
+                    expr,
+                    ast.Lambda(
+                        args=ast.arguments(
+                            posonlyargs=[], args=[], kwonlyargs=[],
+                            kw_defaults=[], defaults=[],
+                        ),
+                        body=nxt,
+                    ),
+                ],
+                keywords=[],
+            )
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id="__jst", ctx=ast.Load()),
+                        attr="convert_logical_not", ctx=ast.Load(),
+                    ),
+                    args=[node.operand], keywords=[],
+                ),
+                node,
+            )
+        return node
+
+    # -- if/else -------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _contains_disallowed(node.body) or _contains_disallowed(node.orelse):
+            return node
+        carry = sorted(_assigned_names(node.body) | _assigned_names(node.orelse))
+        tname, fname = self._fresh("true"), self._fresh("false")
+
+        def branch(name: str, body: List[ast.stmt]) -> ast.FunctionDef:
+            stmts: List[ast.stmt] = []
+            if carry:
+                stmts.append(
+                    ast.Assign(
+                        targets=[_name_tuple(carry, ast.Store)],
+                        value=ast.Name(id="__jst_carry", ctx=ast.Load()),
+                    )
+                )
+            stmts.extend(body)
+            stmts.append(ast.Return(value=_name_tuple(carry, ast.Load)))
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg="__jst_carry")],
+                    kwonlyargs=[], kw_defaults=[], defaults=[],
+                ),
+                body=stmts, decorator_list=[], type_params=[],
+            )
+
+        t_def = branch(tname, node.body)
+        f_def = branch(fname, node.orelse or [ast.Pass()])
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id="__jst", ctx=ast.Load()),
+                attr="convert_ifelse", ctx=ast.Load(),
+            ),
+            args=[
+                node.test,
+                ast.Name(id=tname, ctx=ast.Load()),
+                ast.Name(id=fname, ctx=ast.Load()),
+                _name_tuple(carry, ast.Load),
+            ],
+            keywords=[],
+        )
+        assign: ast.stmt = (
+            ast.Assign(targets=[_name_tuple(carry, ast.Store)], value=call)
+            if carry
+            else ast.Expr(value=call)
+        )
+        out = _pre_load_stmts(carry) + [t_def, f_def, assign] + _post_del_stmts(carry)
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _contains_disallowed(node.body):
+            return node
+        carry = sorted(_assigned_names(node.body))
+        if not carry:
+            return node  # nothing evolves — either trivial or closure-driven
+        cname, bname = self._fresh("cond"), self._fresh("body")
+
+        unpack = ast.Assign(
+            targets=[_name_tuple(carry, ast.Store)],
+            value=ast.Name(id="__jst_carry", ctx=ast.Load()),
+        )
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg="__jst_carry")],
+                kwonlyargs=[], kw_defaults=[], defaults=[],
+            ),
+            body=[unpack, ast.Return(value=node.test)],
+            decorator_list=[], type_params=[],
+        )
+        body_def = ast.FunctionDef(
+            name=bname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg="__jst_carry")],
+                kwonlyargs=[], kw_defaults=[], defaults=[],
+            ),
+            body=[unpack] + list(node.body)
+            + [ast.Return(value=_name_tuple(carry, ast.Load))],
+            decorator_list=[], type_params=[],
+        )
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id="__jst", ctx=ast.Load()),
+                attr="convert_while", ctx=ast.Load(),
+            ),
+            args=[
+                ast.Name(id=cname, ctx=ast.Load()),
+                ast.Name(id=bname, ctx=ast.Load()),
+                _name_tuple(carry, ast.Load),
+                _droppable_mask(carry, node.body, node.test),
+            ],
+            keywords=[],
+        )
+        assign = ast.Assign(targets=[_name_tuple(carry, ast.Store)], value=call)
+        out = (_pre_load_stmts(carry) + [cond_def, body_def, assign]
+               + _post_del_stmts(carry))
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- for i in range(...) -------------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if (
+            node.orelse
+            or not isinstance(node.target, ast.Name)
+            or not isinstance(node.iter, ast.Call)
+            or not isinstance(node.iter.func, ast.Name)
+            or node.iter.func.id != "range"
+            or node.iter.keywords
+            or not 1 <= len(node.iter.args) <= 3
+            or _contains_disallowed(node.body)
+        ):
+            return node
+        carry = sorted(_assigned_names(node.body) - {node.target.id})
+        bname = self._fresh("forbody")
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        else:
+            start, stop, step = rargs
+
+        stmts: List[ast.stmt] = []
+        if carry:
+            stmts.append(
+                ast.Assign(
+                    targets=[_name_tuple(carry, ast.Store)],
+                    value=ast.Name(id="__jst_carry", ctx=ast.Load()),
+                )
+            )
+        stmts.extend(node.body)
+        stmts.append(ast.Return(value=_name_tuple(carry, ast.Load)))
+        body_def = ast.FunctionDef(
+            name=bname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=node.target.id), ast.arg(arg="__jst_carry")],
+                kwonlyargs=[], kw_defaults=[], defaults=[],
+            ),
+            body=stmts, decorator_list=[], type_params=[],
+        )
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id="__jst", ctx=ast.Load()),
+                attr="convert_range_for", ctx=ast.Load(),
+            ),
+            args=[start, stop, step, ast.Name(id=bname, ctx=ast.Load()),
+                  _name_tuple(carry, ast.Load),
+                  _droppable_mask(carry, node.body)],
+            keywords=[],
+        )
+        # python `for` leaves the loop variable bound after the loop —
+        # convert_range_for returns (*carry, last_i) to preserve that
+        out_names = carry + [node.target.id]
+        assign: ast.stmt = ast.Assign(
+            targets=[_name_tuple(out_names, ast.Store)], value=call
+        )
+        out = (_pre_load_stmts(carry) + [body_def, assign]
+               + _post_del_stmts(out_names))
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+
+@functools.lru_cache(maxsize=256)
+def _convert_cached(fn_key):
+    fn = fn_key
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    func_def = tree.body[0]
+    if not isinstance(func_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    func_def.decorator_list = []  # decorators already applied to the original
+    _ControlFlowTransformer().visit(func_def)
+    ast.fix_missing_locations(tree)
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # re-close over the original cells via a factory
+        factory = ast.FunctionDef(
+            name="__jst_factory",
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=v) for v in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[],
+            ),
+            body=[func_def,
+                  ast.Return(value=ast.Name(id=func_def.name, ctx=ast.Load()))],
+            decorator_list=[], type_params=[],
+        )
+        module = ast.Module(body=[factory], type_ignores=[])
+    else:
+        module = ast.Module(body=[func_def], type_ignores=[])
+    ast.fix_missing_locations(module)
+    env = dict(fn.__globals__)
+    env["__jst"] = jst
+    try:
+        code = compile(module, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, env)
+    except Exception:
+        return None
+    if freevars:
+        # bind the ORIGINAL closure cells (live, not value snapshots):
+        # call the factory with dummies to obtain the inner code object,
+        # then rebuild the function over fn.__closure__ — late-bound and
+        # nonlocal-rebound names keep exact python semantics, and empty
+        # cells (forward references) don't crash conversion
+        proto = env["__jst_factory"](*([None] * len(freevars)))
+        if proto.__code__.co_freevars != freevars:
+            return None  # cell order mismatch — safest is the fallback
+        new_fn = types.FunctionType(
+            proto.__code__, env, fn.__name__, fn.__defaults__, fn.__closure__
+        )
+    else:
+        new_fn = env[func_def.name]
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn
+
+
+def convert_to_static(fn: Callable):
+    """AST-convert `fn`; returns the converted function, or `fn` itself when
+    conversion isn't possible (builtins, no source, exotic syntax) — the
+    trace-only behavior is the graceful fallback."""
+    if isinstance(fn, types.MethodType):
+        conv = _convert_cached(fn.__func__)
+        if conv is None:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    if not isinstance(fn, types.FunctionType):
+        return fn
+    conv = _convert_cached(fn)
+    return fn if conv is None else conv

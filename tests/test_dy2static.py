@@ -1,0 +1,195 @@
+"""Dy2static data-dependent control flow (VERDICT r2 item 4).
+
+The AST pipeline (jit/dy2static.py, reference: dygraph_to_static/
+loop_transformer.py:486 + ifelse_transformer.py) must convert Python
+if/while/for-range over traced tensors into lax.cond/while_loop inside the
+ONE compiled to_static program, with eager/static parity and working grads.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(v, sg=True):
+    return paddle.to_tensor(np.asarray(v, np.float32), stop_gradient=sg)
+
+
+def test_data_dependent_if_both_paths():
+    trace_count = {"n": 0}
+
+    def f(x):
+        trace_count["n"] += 1
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    sf = paddle.jit.to_static(f)
+    a, b = _t([1.0, 2.0]), _t([-5.0, 1.0])
+    for _ in range(3):
+        ra, rb = sf(a), sf(b)
+    assert np.allclose(np.asarray(ra._value), [2, 4])
+    assert np.allclose(np.asarray(rb._value), [-6, 0])
+    # ONE trace serves both branch outcomes: the branch is lax.cond inside
+    # the compiled program, not a retrace per predicate value
+    assert trace_count["n"] == 1
+
+
+def test_if_gradients_flow_through_cond():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 3
+        else:
+            y = x * 5
+        return y.sum()
+
+    sf = paddle.jit.to_static(f)
+    for sign, expect in ((1.0, 3.0), (-1.0, 5.0)):
+        w = _t([sign, sign], sg=False)
+        sf(w).backward()
+        assert np.allclose(np.asarray(w.grad._value), expect)
+
+
+def test_data_dependent_while_variable_steps():
+    def decode(x):
+        s = x.sum() * 0
+        n = x.sum() * 0
+        while s < 10:
+            s = s + x.sum()
+            n = n + 1
+        return s, n
+
+    sf = paddle.jit.to_static(decode)
+    # eager-vs-static parity across inputs needing DIFFERENT step counts
+    for val, steps in ((3.0, 4), (1.5, 4), (0.5, 10)):
+        x = _t([val, val])
+        s, n = sf(x)
+        es, en = decode(_t([val, val]))
+        assert float(n) == float(en)
+        assert abs(float(s) - float(es)) < 1e-5
+
+
+def test_for_over_traced_range():
+    def f(x, n):
+        acc = x * 0
+        for _i in range(n):
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = _t([2.0])
+    for n in (3, 7):
+        out = sf(x, paddle.to_tensor(np.int32(n)))
+        assert float(out._value[0]) == 2.0 * n
+
+
+def test_bool_ops_on_tensors():
+    def f(x):
+        big = x.max() > 100
+        ok = (x.sum() > 0) and (not big)
+        if ok:
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    sf = paddle.jit.to_static(f)
+    assert np.allclose(np.asarray(sf(_t([1.0, 2.0]))._value), [2, 3])
+    assert np.allclose(np.asarray(sf(_t([-1.0, -2.0]))._value), [-2, -3])
+    assert np.allclose(np.asarray(sf(_t([1.0, 200.0]))._value), [0, 199])
+
+
+def test_nested_if_in_while():
+    def f(x):
+        s = x.sum() * 0
+        i = x.sum() * 0
+        while i < 5:
+            if s > 4:
+                s = s + 1
+            else:
+                s = s + 2
+            i = i + 1
+        return s
+
+    sf = paddle.jit.to_static(f)
+    out = sf(_t([0.0]))
+    # eager reference
+    exp = f(_t([0.0]))
+    assert float(out) == float(exp)
+
+
+def test_to_static_compiles_once():
+    """The compiled wrapper must trace once per config and replay the XLA
+    program afterwards (regression: a closure-defeated jit cache re-ran
+    the Python body every call)."""
+    runs = {"n": 0}
+
+    def f(x):
+        runs["n"] += 1
+        return x * 2 + 1
+
+    sf = paddle.jit.to_static(f)
+    x = _t([1.0, 2.0])
+    for _ in range(6):
+        out = sf(x)
+    assert runs["n"] == 1, f"python body ran {runs['n']} times — not compiled"
+    assert np.allclose(np.asarray(out._value), [3, 5])
+
+
+def test_loop_body_temporaries_not_carried():
+    """Temps written-before-read in a traced while body (h = f(x)) need no
+    pre-loop init — the droppable-mask analysis keeps them out of the lax
+    carry (the greedy-decode pattern)."""
+
+    def decode(tok, max_len):
+        steps = tok.sum() * 0
+        cur = tok
+        while steps < max_len:
+            h = cur * 2.0        # body-local temp
+            probe = h + 1.0      # another temp
+            cur = probe - h      # = ones
+            steps = steps + 1
+        return cur, steps
+
+    sf = paddle.jit.to_static(decode)
+    out, n = sf(_t([5.0]), paddle.to_tensor(np.float32(4)))
+    eo, en = decode(_t([5.0]), paddle.to_tensor(np.float32(4)))
+    assert float(n) == float(en) == 4.0
+    assert np.allclose(np.asarray(out._value), np.asarray(eo._value))
+
+
+def test_branch_only_temp_errors_clearly():
+    def f(x):
+        if x.sum() > 0:
+            tmp = x * 2
+            y = tmp + 1
+        else:
+            y = x - 1
+        return y
+
+    sf = paddle.jit.to_static(f)
+    with pytest.raises(Exception) as ei:
+        sf(_t([1.0]))
+    assert "branch" in str(ei.value) or "pytree" in str(ei.value).lower() or \
+        "structure" in str(ei.value).lower()
+
+
+def test_plain_python_conditions_unchanged():
+    """Non-tensor conditions keep exact Python semantics after conversion."""
+
+    def f(x, mode):
+        if mode == "double":
+            y = x * 2
+        else:
+            y = x + 10
+        k = 0
+        while k < 3:
+            y = y + 1
+            k += 1
+        return y
+
+    sf = paddle.jit.to_static(f)
+    assert np.allclose(np.asarray(sf(_t([1.0]), "double")._value), [5.0])
+    assert np.allclose(np.asarray(sf(_t([1.0]), "add")._value), [14.0])
