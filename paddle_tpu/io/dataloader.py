@@ -4,17 +4,24 @@ Reference analogue: python/paddle/fluid/reader.py:146 (DataLoader) and
 dataloader_iter.py:146/:338 (single-process and multi-process iterators with
 shared-memory worker queues, worker.py).
 
-The multi-process path uses a multiprocessing.Pool of index-batch workers
-feeding an ordered prefetch queue — same prefetch discipline as the
-reference's _DataLoaderIterMultiProcess but without LoDTensor shared-memory
-blobs (numpy through pipes; device upload happens downstream, overlapped by
-the jit path's async dispatch).
+num_workers > 0 spawns real worker PROCESSES (fork) with task/result
+queues — the reference's _DataLoaderIterMultiProcess: CPU-heavy
+transforms run outside the trainer's GIL, large arrays ride
+multiprocessing.shared_memory blocks instead of pickled pipe bytes
+(use_shared_memory, the reference's LoDTensor shared-mem path), batches
+reassemble in sampler order (or completion order with in_order=False),
+worker crashes/exceptions propagate with their tracebacks, and
+persistent_workers keeps the pool across epochs. A thread pool remains
+available via use_thread_workers=True for GIL-releasing datasets.
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as mp
+import os
 import queue
 import threading
+import traceback
 from typing import Callable, Optional
 
 import numpy as np
@@ -24,6 +31,9 @@ from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 _worker_info = threading.local()
+
+# arrays at least this large ride shared memory instead of the pickle pipe
+_SHM_MIN_BYTES = 1 << 16
 
 
 class WorkerInfo:
@@ -64,6 +74,179 @@ def default_convert_fn(batch):
     return batch
 
 
+# ---------------------------------------------------------------------------
+# multiprocess transport: Tensor-free trees over queues, big arrays via shm
+# ---------------------------------------------------------------------------
+def _tree_to_ipc(obj, shm_blocks, use_shm):
+    """Tensors/arrays → IPC-safe descriptors; big arrays → shared memory."""
+    if isinstance(obj, Tensor):
+        obj = np.asarray(obj.numpy())
+    if isinstance(obj, np.ndarray):
+        if use_shm and obj.nbytes >= _SHM_MIN_BYTES:
+            from multiprocessing import resource_tracker, shared_memory
+
+            shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+            # ownership transfers to the parent (which unlinks after copy);
+            # deregister from THIS process's tracker or it double-unlinks
+            # at worker exit and warns about the missing segment
+            try:
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
+            dst = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+            dst[...] = obj
+            shm_blocks.append(shm)
+            return ("shm", shm.name, obj.shape, str(obj.dtype))
+        return ("arr", obj)
+    if isinstance(obj, dict):
+        return ("dict", {k: _tree_to_ipc(v, shm_blocks, use_shm) for k, v in obj.items()})
+    if isinstance(obj, (tuple, list)):
+        return ("seq", type(obj) is tuple,
+                [_tree_to_ipc(v, shm_blocks, use_shm) for v in obj])
+    return ("raw", obj)
+
+
+def _discard_payload(desc):
+    """Unlink shared-memory blocks of a payload that will never be
+    consumed (abandoned iterator / shutdown drain) — without this the
+    /dev/shm segments outlive the process."""
+    kind = desc[0]
+    if kind == "shm":
+        from multiprocessing import shared_memory
+
+        try:
+            shm = shared_memory.SharedMemory(name=desc[1])
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    elif kind == "dict":
+        for v in desc[1].values():
+            _discard_payload(v)
+    elif kind == "seq":
+        for v in desc[2]:
+            _discard_payload(v)
+
+
+def _tree_from_ipc(desc, as_tensor=True):
+    kind = desc[0]
+    if kind == "shm":
+        from multiprocessing import shared_memory
+
+        _, name, shape, dtype = desc
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.array(np.ndarray(shape, dtype, buffer=shm.buf))  # copy out
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return to_tensor(arr) if as_tensor else arr
+    if kind == "arr":
+        return to_tensor(desc[1]) if as_tensor else desc[1]
+    if kind == "dict":
+        return {k: _tree_from_ipc(v, as_tensor) for k, v in desc[1].items()}
+    if kind == "seq":
+        vals = [_tree_from_ipc(v, as_tensor) for v in desc[2]]
+        return tuple(vals) if desc[1] else vals
+    return desc[1]
+
+
+def _np_collate(batch):
+    """default_collate_fn's numpy twin: forked workers must never touch
+    jax (the parent's XLA runtime does not survive fork), so worker-side
+    collation stacks numpy and the parent wraps Tensors."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [_np_collate(list(items)) for items in zip(*batch)]
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+def _mp_worker_main(wid, num_workers, dataset, collate_np, worker_init_fn,
+                    task_q, result_q, use_shm, base_seed):
+    """Worker process body (reference: fluid/dataloader/worker.py
+    _worker_loop): pull index batches, fetch (+collate when the default
+    collate is in use), ship results. collate_np=None ships raw sample
+    trees and the parent runs the user's custom collate_fn."""
+    seed = base_seed + wid  # fork copies the parent RNG state — reseed per
+    np.random.seed(seed % (2**32))  # worker or augmentations duplicate
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        seq, indices = task
+        shm_blocks = []
+        try:
+            samples = [dataset[i] for i in indices]
+            if collate_np is not None:
+                payload = _tree_to_ipc(collate_np(samples), shm_blocks, use_shm)
+                result_q.put((seq, "ok", payload))
+            else:
+                payload = _tree_to_ipc(list(samples), shm_blocks, use_shm)
+                result_q.put((seq, "samples", payload))
+        except Exception as e:
+            result_q.put((seq, "err",
+                          f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+        finally:
+            for shm in shm_blocks:
+                shm.close()  # parent copies then unlinks
+
+
+def _mp_worker_iterable(wid, num_workers, dataset, collate_np, worker_init_fn,
+                        batch_size, drop_last, result_q, use_shm, base_seed):
+    """IterableDataset worker: iterates ITS shard (the dataset uses
+    get_worker_info to split) and ships whole batches, completion-ordered."""
+    seed = base_seed + wid
+    np.random.seed(seed % (2**32))
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+
+    def ship(batch):
+        shm_blocks = []
+        try:
+            if collate_np is not None:
+                result_q.put(
+                    (-1, "ok", _tree_to_ipc(collate_np(batch), shm_blocks, use_shm))
+                )
+            else:
+                result_q.put(
+                    (-1, "samples", _tree_to_ipc(list(batch), shm_blocks, use_shm))
+                )
+        finally:
+            for shm in shm_blocks:
+                shm.close()
+
+    try:
+        batch = []
+        for sample in dataset:
+            batch.append(sample)
+            if len(batch) == batch_size:
+                ship(batch)
+                batch = []
+        if batch and not drop_last:
+            ship(batch)
+        result_q.put((-1, "done", wid))
+    except Exception as e:
+        result_q.put((-1, "err",
+                      f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
 class DataLoader:
     """reference: fluid/reader.py DataLoader (from_dataset/from_generator
     legacy constructors are served by paddle_tpu.static facade)."""
@@ -86,6 +269,10 @@ class DataLoader:
         timeout=0,
         worker_init_fn=None,
         persistent_workers=False,
+        use_thread_workers=False,
+        in_order=True,
+        worker_collate_fn=None,
+        return_numpy=False,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -93,6 +280,22 @@ class DataLoader:
         self.num_workers = int(num_workers)
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = bool(use_shared_memory)
+        self.timeout = float(timeout) if timeout else 0.0
+        self.persistent_workers = bool(persistent_workers)
+        # thread pool opt-in (GIL-releasing datasets); processes otherwise
+        self.use_thread_workers = bool(use_thread_workers)
+        # in_order=False yields batches in completion order (lower latency
+        # under skewed per-batch cost; batch order becomes nondeterministic)
+        self.in_order = bool(in_order)
+        # worker_collate_fn: numpy-only collate executed INSIDE worker
+        # processes (must not touch jax — forked children share no XLA
+        # runtime); the default collate's numpy twin runs there when unset.
+        # return_numpy=True skips the parent-side Tensor wrap (callers that
+        # feed a compiled step can upload arrays themselves).
+        self.worker_collate_fn = worker_collate_fn
+        self.return_numpy = bool(return_numpy)
+        self._pool = None  # persistent multiprocess pool state
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -112,10 +315,14 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
+            if self.num_workers > 0 and not self.use_thread_workers:
+                return self._iter_iterable_multiprocess()
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
-        return self._iter_threaded()
+        if self.use_thread_workers:
+            return self._iter_threaded()
+        return self._iter_multiprocess()
 
     def _fetch(self, indices):
         samples = [self.dataset[i] for i in indices]
@@ -134,6 +341,211 @@ class DataLoader:
                 batch = []
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
+
+    # -- multiprocess path (reference: _DataLoaderIterMultiProcess) ---------
+    def _worker_collate(self):
+        """Worker-side collate: explicit worker_collate_fn, else the numpy
+        twin of the default, else None for custom collate_fn (which runs in
+        the parent on worker-fetched samples)."""
+        if self.worker_collate_fn is not None:
+            return self.worker_collate_fn
+        return _np_collate if self.collate_fn is default_collate_fn else None
+
+    def _start_pool(self):
+        if self._pool is not None:
+            return self._pool
+        ctx = mp.get_context("fork")
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        seed = int(np.random.randint(0, 2**31 - 1))
+        procs = [
+            ctx.Process(
+                target=_mp_worker_main,
+                args=(wid, self.num_workers, self.dataset,
+                      self._worker_collate(), self.worker_init_fn,
+                      task_q, result_q, self.use_shared_memory, seed),
+                daemon=True,
+            )
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+        self._pool = (procs, task_q, result_q, itertools.count())
+        return self._pool
+
+    def _stop_pool(self):
+        if self._pool is None:
+            return
+        procs, task_q, result_q, _ = self._pool
+        for _ in procs:
+            task_q.put(None)
+        for p in procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+        # unlink shm of any results nobody consumed
+        while True:
+            try:
+                _, status, payload = result_q.get_nowait()
+            except (queue.Empty, OSError):
+                break
+            if status in ("ok", "samples"):
+                _discard_payload(payload)
+        self._pool = None
+
+    def _drain_outstanding(self, order, result_q, procs):
+        """Consume (and discard) results for every still-outstanding seq so
+        an abandoned iterator neither leaks /dev/shm segments nor poisons
+        the shared queues for the next epoch (persistent_workers)."""
+        deadline = 10.0
+        import time as _time
+
+        t0 = _time.monotonic()
+        while order and _time.monotonic() - t0 < deadline:
+            try:
+                seq, status, payload = result_q.get(timeout=1.0)
+            except queue.Empty:
+                if all(not p.is_alive() for p in procs):
+                    break
+                continue
+            if status in ("ok", "samples"):
+                _discard_payload(payload)
+            try:
+                order.remove(seq)
+            except ValueError:
+                pass
+
+    def _get_result(self, result_q, procs, done_ok=False):
+        """Next worker result. done_ok: workers may legitimately have
+        exited (iterable shards finishing early) — only a NONZERO exit
+        code counts as a crash."""
+        timeout = self.timeout or 5.0
+        while True:
+            try:
+                return result_q.get(timeout=timeout)
+            except queue.Empty:
+                crashed = [
+                    p for p in procs
+                    if not p.is_alive() and p.exitcode not in (0, None)
+                ]
+                if crashed:
+                    raise RuntimeError(
+                        f"DataLoader worker (pid {crashed[0].pid}) exited "
+                        f"unexpectedly with code {crashed[0].exitcode}"
+                    ) from None
+                if not done_ok and all(not p.is_alive() for p in procs):
+                    raise RuntimeError(
+                        "all DataLoader workers exited while batches were "
+                        "still expected"
+                    ) from None
+                if self.timeout:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout}s waiting "
+                        "for a worker batch"
+                    ) from None
+
+    def _finish_batch(self, status, payload):
+        if status == "err":
+            raise RuntimeError(f"DataLoader worker raised:\n{payload}")
+        if status == "samples":
+            return self.collate_fn(_tree_from_ipc(payload, as_tensor=False))
+        return _tree_from_ipc(payload, as_tensor=not self.return_numpy)
+
+    def _iter_multiprocess(self):
+        from collections import deque
+
+        procs, task_q, result_q, seq_counter = self._start_pool()
+        n_prefetch = max(1, self.num_workers * self.prefetch_factor)
+        sampler_iter = iter(self.batch_sampler)
+        pending = {}  # seq -> (status, payload) awaiting in-order yield
+        order = deque()  # submitted seqs in sampler order
+        try:
+            exhausted = False
+            while True:
+                while not exhausted and len(order) < n_prefetch:
+                    try:
+                        indices = next(sampler_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    seq = next(seq_counter)
+                    order.append(seq)
+                    task_q.put((seq, list(indices)))
+                if exhausted and not order:
+                    return
+                if self.in_order:
+                    target = order[0]
+                    while target not in pending:
+                        seq, status, payload = self._get_result(result_q, procs)
+                        pending[seq] = (status, payload)
+                    status, payload = pending.pop(target)
+                    order.popleft()
+                else:
+                    seq, status, payload = self._get_result(result_q, procs)
+                    order.remove(seq)
+                yield self._finish_batch(status, payload)
+        finally:
+            # account for every submitted batch: an abandoned iterator must
+            # not leak shm segments or poison queues for the next epoch
+            for status, payload in pending.values():
+                if status in ("ok", "samples"):
+                    _discard_payload(payload)
+            for seq in list(pending):
+                pending.pop(seq)
+                try:
+                    order.remove(seq)
+                except ValueError:
+                    pass
+            self._drain_outstanding(order, result_q, procs)
+            if not self.persistent_workers:
+                self._stop_pool()
+
+    def _iter_iterable_multiprocess(self):
+        ctx = mp.get_context("fork")
+        result_q = ctx.Queue()
+        seed = int(np.random.randint(0, 2**31 - 1))
+        procs = [
+            ctx.Process(
+                target=_mp_worker_iterable,
+                args=(wid, self.num_workers, self.dataset,
+                      self._worker_collate(), self.worker_init_fn,
+                      self.batch_size, self.drop_last, result_q,
+                      self.use_shared_memory, seed),
+                daemon=True,
+            )
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+        done = 0
+        try:
+            while done < len(procs):
+                _, status, payload = self._get_result(
+                    result_q, procs, done_ok=True
+                )
+                if status == "done":
+                    done += 1
+                    continue
+                yield self._finish_batch(status, payload)
+        finally:
+            # drain anything unconsumed (early break) before joining
+            while True:
+                try:
+                    _, status, payload = result_q.get_nowait()
+                except (queue.Empty, OSError):
+                    break
+                if status in ("ok", "samples"):
+                    _discard_payload(payload)
+            for p in procs:
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    p.terminate()
+
+    def __del__(self):
+        try:
+            self._stop_pool()
+        except Exception:
+            pass
 
     def _iter_threaded(self):
         """Prefetching pipeline: worker threads fetch+collate index batches,

@@ -281,6 +281,10 @@ class _Runtime:
 
 jst = _Runtime()
 
+# name under which the runtime is injected into the function's module
+# globals (unique enough to never collide with user names)
+_RT_NAME = "__paddle_tpu_jst__"
+
 
 # ---------------------------------------------------------------------------
 # static analysis: names a statement list assigns
@@ -392,7 +396,7 @@ def _pre_load_stmts(carry: List[str]) -> List[ast.stmt]:
                 targets=[ast.Name(id=n, ctx=ast.Store())],
                 value=ast.Call(
                     func=ast.Attribute(
-                        value=ast.Name(id="__jst", ctx=ast.Load()),
+                        value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                         attr="load_or_undef", ctx=ast.Load(),
                     ),
                     args=[
@@ -421,7 +425,7 @@ def _post_del_stmts(carry: List[str]) -> List[ast.stmt]:
                     ops=[ast.Is()],
                     comparators=[
                         ast.Attribute(
-                            value=ast.Name(id="__jst", ctx=ast.Load()),
+                            value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                             attr="UNDEF", ctx=ast.Load(),
                         )
                     ],
@@ -455,7 +459,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         for nxt in node.values[1:]:
             expr = ast.Call(
                 func=ast.Attribute(
-                    value=ast.Name(id="__jst", ctx=ast.Load()),
+                    value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                     attr=op, ctx=ast.Load(),
                 ),
                 args=[
@@ -478,7 +482,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return ast.copy_location(
                 ast.Call(
                     func=ast.Attribute(
-                        value=ast.Name(id="__jst", ctx=ast.Load()),
+                        value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                         attr="convert_logical_not", ctx=ast.Load(),
                     ),
                     args=[node.operand], keywords=[],
@@ -520,7 +524,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         f_def = branch(fname, node.orelse or [ast.Pass()])
         call = ast.Call(
             func=ast.Attribute(
-                value=ast.Name(id="__jst", ctx=ast.Load()),
+                value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                 attr="convert_ifelse", ctx=ast.Load(),
             ),
             args=[
@@ -577,7 +581,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         call = ast.Call(
             func=ast.Attribute(
-                value=ast.Name(id="__jst", ctx=ast.Load()),
+                value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                 attr="convert_while", ctx=ast.Load(),
             ),
             args=[
@@ -641,7 +645,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         call = ast.Call(
             func=ast.Attribute(
-                value=ast.Name(id="__jst", ctx=ast.Load()),
+                value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
                 attr="convert_range_for", ctx=ast.Load(),
             ),
             args=[start, stop, step, ast.Name(id=bname, ctx=ast.Load()),
@@ -699,28 +703,34 @@ def _convert_cached(fn_key):
     else:
         module = ast.Module(body=[func_def], type_ignores=[])
     ast.fix_missing_locations(module)
-    env = dict(fn.__globals__)
-    env["__jst"] = jst
+    # compile in a scratch env, then rebuild the function over the LIVE
+    # module globals (fn.__globals__): late-bound helpers, recursion, and
+    # rebound module state keep exact python semantics — a snapshot dict
+    # would freeze the module at decoration time. Only the __jst runtime
+    # object is injected (under a collision-proof name).
+    scratch = {}
     try:
         code = compile(module, filename=f"<dy2static {fn.__qualname__}>",
                        mode="exec")
-        exec(code, env)
+        exec(code, scratch)
     except Exception:
         return None
+    fn.__globals__.setdefault(_RT_NAME, jst)
     if freevars:
         # bind the ORIGINAL closure cells (live, not value snapshots):
         # call the factory with dummies to obtain the inner code object,
         # then rebuild the function over fn.__closure__ — late-bound and
         # nonlocal-rebound names keep exact python semantics, and empty
         # cells (forward references) don't crash conversion
-        proto = env["__jst_factory"](*([None] * len(freevars)))
+        proto = scratch["__jst_factory"](*([None] * len(freevars)))
         if proto.__code__.co_freevars != freevars:
             return None  # cell order mismatch — safest is the fallback
-        new_fn = types.FunctionType(
-            proto.__code__, env, fn.__name__, fn.__defaults__, fn.__closure__
-        )
     else:
-        new_fn = env[func_def.name]
+        proto = scratch[func_def.name]
+    new_fn = types.FunctionType(
+        proto.__code__, fn.__globals__, fn.__name__, fn.__defaults__,
+        fn.__closure__ if freevars else None,
+    )
     new_fn = functools.wraps(fn)(new_fn)
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__

@@ -1,0 +1,239 @@
+"""Multiprocess DataLoader tests (VERDICT r2 item 6).
+
+Reference: fluid/dataloader/dataloader_iter.py:338 _DataLoaderIterMultiProcess.
+Covers batch parity with the single-process loader, ordered vs completion
+order, shared-memory transport, custom collate, worker error propagation
+with tracebacks, persistent workers, IterableDataset sharding by
+get_worker_info, and the done-criterion: num_workers=4 with a CPU-heavy
+transform beats the threaded loader.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset, IterableDataset, get_worker_info
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, dim=8):
+        self.x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+        self.y = np.arange(n, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class SlowDataset(Dataset):
+    """CPU-heavy transform: pure-python work that HOLDS the GIL, so thread
+    workers serialize but process workers parallelize."""
+
+    def __init__(self, n=32, dim=16, spin=30_000):
+        self.n, self.dim, self.spin = n, dim, spin
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for k in range(self.spin):  # GIL-bound python loop
+            acc = (acc + i * k) % 1_000_003
+        return (np.full((self.dim,), float(i + acc % 2), np.float32),
+                np.int64(i))
+
+
+def _materialize(loader):
+    out = []
+    for xb, yb in loader:
+        out.append((np.asarray(xb._value), np.asarray(yb._value)))
+    return out
+
+
+def test_mp_batches_match_single_process():
+    ds = ArrayDataset(64, 8)
+    ref = _materialize(DataLoader(ds, batch_size=16, num_workers=0))
+    got = _materialize(DataLoader(ds, batch_size=16, num_workers=4))
+    assert len(ref) == len(got) == 4
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        assert np.array_equal(rx, gx)
+        assert np.array_equal(ry, gy)
+
+
+def test_mp_shared_memory_large_batches():
+    # 16*4096 floats = 256KB per batch → rides shared memory
+    ds = ArrayDataset(32, 4096)
+    ref = _materialize(DataLoader(ds, batch_size=16, num_workers=0))
+    got = _materialize(
+        DataLoader(ds, batch_size=16, num_workers=2, use_shared_memory=True)
+    )
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        assert np.array_equal(rx, gx) and np.array_equal(ry, gy)
+
+
+def test_mp_unordered_mode_same_multiset():
+    ds = ArrayDataset(64, 8)
+    got = _materialize(
+        DataLoader(ds, batch_size=8, num_workers=4, in_order=False)
+    )
+    ref = _materialize(DataLoader(ds, batch_size=8, num_workers=0))
+    key = lambda b: float(b[1][0])
+    assert sorted(map(key, got)) == sorted(map(key, ref))
+
+
+def test_mp_custom_collate_runs_in_parent():
+    ds = ArrayDataset(16, 4)
+
+    def collate(samples):
+        xs = np.stack([s[0] for s in samples])
+        return paddle.to_tensor(xs.sum(axis=1))
+
+    loader = DataLoader(ds, batch_size=4, num_workers=2, collate_fn=collate)
+    outs = [np.asarray(b._value) for b in loader]
+    ref = [np.asarray(b._value)
+           for b in DataLoader(ds, batch_size=4, num_workers=0,
+                               collate_fn=collate)]
+    for r, g in zip(ref, outs):
+        assert np.allclose(r, g)
+
+
+class ExplodingDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at index 5")
+        return np.float32(i)
+
+
+def test_mp_worker_error_propagates_with_traceback():
+    loader = DataLoader(ExplodingDataset(), batch_size=4, num_workers=2)
+    with pytest.raises(RuntimeError) as ei:
+        _materialize_scalars(loader)
+    assert "boom at index 5" in str(ei.value)
+    assert "ValueError" in str(ei.value)
+
+
+def _materialize_scalars(loader):
+    return [np.asarray(b._value) for b in loader]
+
+
+def test_mp_persistent_workers_across_epochs():
+    ds = ArrayDataset(32, 8)
+    loader = DataLoader(ds, batch_size=8, num_workers=2,
+                        persistent_workers=True)
+    e1 = _materialize(loader)
+    procs1 = [p.pid for p in loader._pool[0]]
+    e2 = _materialize(loader)
+    procs2 = [p.pid for p in loader._pool[0]]
+    assert procs1 == procs2  # same pool reused
+    for (rx, _), (gx, _) in zip(e1, e2):
+        assert np.array_equal(rx, gx)
+    loader._stop_pool()
+
+
+class ShardedIterable(IterableDataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        wid = info.id if info else 0
+        nw = info.num_workers if info else 1
+        for i in range(wid, self.n, nw):
+            yield np.float32(i)
+
+
+def test_mp_iterable_dataset_sharding():
+    loader = DataLoader(ShardedIterable(32), batch_size=4, num_workers=4)
+    seen = []
+    for b in loader:
+        seen.extend(np.asarray(b._value).tolist())
+    assert sorted(seen) == [float(i) for i in range(32)]
+
+
+def _shm_segments():
+    import os
+
+    try:
+        return {f for f in os.listdir("/dev/shm") if f.startswith("psm_")}
+    except FileNotFoundError:
+        return set()
+
+
+def test_mp_abandoned_iterator_leaks_nothing():
+    ds = ArrayDataset(64, 4096)  # big enough to ride shared memory
+    before = _shm_segments()
+    loader = DataLoader(ds, batch_size=16, num_workers=2)
+    for batch in loader:
+        break  # abandon mid-epoch with prefetched batches in flight
+    del loader
+    import gc
+
+    gc.collect()
+    leaked = _shm_segments() - before
+    assert not leaked, f"leaked /dev/shm segments: {leaked}"
+
+
+def test_mp_persistent_pool_survives_abandoned_epoch():
+    ds = ArrayDataset(64, 8)
+    for in_order in (True, False):
+        loader = DataLoader(ds, batch_size=8, num_workers=2,
+                            persistent_workers=True, in_order=in_order)
+        it = iter(loader)
+        next(it)
+        it.close()  # abandon epoch 1 with results in flight
+        # epoch 2 must be clean: right count, right content (ordered mode)
+        out = _materialize(loader)
+        assert len(out) == 8
+        if in_order:
+            ref = _materialize(DataLoader(ds, batch_size=8, num_workers=0))
+            for (rx, _), (gx, _) in zip(ref, out):
+                assert np.array_equal(rx, gx)
+        loader._stop_pool()
+
+
+def test_mp_worker_seeds_differ():
+    class SeedEcho(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            import time
+
+            from paddle_tpu.io import get_worker_info
+
+            time.sleep(0.1)  # slow enough that several workers participate
+            info = get_worker_info()
+            # (wid, seed) pairs: seeds must be distinct ACROSS workers
+            return np.asarray([info.id, info.seed], np.float64)
+
+    loader = DataLoader(SeedEcho(), batch_size=1, num_workers=4)
+    wid_seed = {}
+    for b in loader:
+        wid, seed = np.asarray(b._value)[0]
+        wid_seed[int(wid)] = float(seed)
+    assert len(wid_seed) >= 2  # several workers actually ran
+    assert len(set(wid_seed.values())) == len(wid_seed)  # distinct seeds
+
+
+@pytest.mark.slow
+def test_mp_beats_threads_on_gil_bound_transform():
+    ds = SlowDataset(n=32, dim=16, spin=250_000)
+
+    def run(**kw):
+        loader = DataLoader(ds, batch_size=4, **kw)
+        t0 = time.perf_counter()
+        out = _materialize(loader)
+        return time.perf_counter() - t0, out
+
+    t_threads, ref = run(num_workers=4, use_thread_workers=True)
+    t_procs, got = run(num_workers=4)
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        assert np.array_equal(rx, gx) and np.array_equal(ry, gy)
+    # GIL-bound transform: 4 processes must clearly beat 4 threads
+    assert t_procs < t_threads * 0.75, (t_procs, t_threads)
