@@ -84,8 +84,13 @@ class GPTAttention(nn.Layer):
         cfg = self.cfg
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [b, s, 3h] sharded on mp
-        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv.unstack(axis=2)
+        # heads-major fused-qkv layout (Megatron-style): 3h splits as
+        # H x 3 x hd so the mp sharding of the fused dim lands on the
+        # HEADS subdim (divisible by mp). The 3-major layout put mp on the
+        # size-3 subdim — GSPMD could only replicate-then-repartition,
+        # the 'Involuntary full rematerialization' churn in the backward.
+        qkv = qkv.reshape([b, s, self.num_heads, 3, self.head_dim])
+        q, k, v = qkv.unstack(axis=3)
         if cache is not None:
             # incremental decode over a PREALLOCATED fixed-shape cache:
             # every step reuses one compiled program (ops/nn_ops.py

@@ -316,7 +316,12 @@ class PipelinedTrainStep:
         from .sharding import suppress_sharding_constraints
 
         def body(repl_vals, stacked_locals, b_vals, key, x_mb, y_mb):
-            """Runs per-pp-rank (manual over pp, GSPMD-auto elsewhere)."""
+            """Runs per-(pp, dp, sharding)-rank; mp stays GSPMD-auto so TP
+            weight shardings propagate inside the stage. Making the batch
+            axes MANUAL pins every activation's dp sharding — GSPMD-auto dp
+            used to replicate-then-repartition activations between the scan
+            carries and the in-stage program ('Involuntary full
+            rematerialization' churn)."""
             with _random.rng_scope(key), suppress_sharding_constraints():
                 def stage_fn(locals_, h):
                     for i in range(L_per):
@@ -345,16 +350,22 @@ class PipelinedTrainStep:
                         lv = lv.mean()
                     return lv.astype(jnp.float32)
 
-                return gpipe_loss(
+                loss = gpipe_loss(
                     stage_fn, inject_fn, head_loss_fn, stacked_locals,
                     x_mb, y_mb, num_stages=S, num_micro=M, remat=remat,
                 )
+                # local-batch mean → global-batch mean (dp ranks hold
+                # disjoint microbatch slices under the manual batch axis;
+                # the 'sharding' slice of the batch stays GSPMD-auto because
+                # ZeRO-3 shards stage weights over it in-stage)
+                return jax.lax.pmean(loss, "dp")
 
         smapped = shard_map(
             body, mesh=mesh,
-            in_specs=(P(), P("pp"), P(), P(), P(), P()),
+            in_specs=(P(), P("pp"), P(), P(),
+                      P(None, "dp"), P(None, "dp")),
             out_specs=P(),
-            axis_names={"pp"}, check_vma=False,
+            axis_names={"pp", "dp"}, check_vma=False,
         )
 
         def step_fn(repl_vals, stacked_vals, repl_states, stacked_states,
