@@ -18,6 +18,7 @@
 //
 // Build: via paddle_tpu.utils.cpp_extension (g++ -shared -fPIC).
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -373,6 +374,14 @@ class MessageBus {
             pending_stop_ = true;  // surface the remote failure on attach
         }
       }
+    }
+    // deregister BEFORE closing: Stop() walks conn_fds_ and shutdown()s
+    // each entry — a stale number could be recycled by the kernel for an
+    // unrelated socket (e.g. a new outbound connection) in this process
+    {
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
     }
     ::close(fd);
   }

@@ -218,9 +218,15 @@ class DistHostPipelineTrainer:
 
     def __init__(self, stage_fn: Callable, params, loss_fn: Callable,
                  learning_rate: float, rank: int, n_stages: int,
-                 bus: MessageBus, schedule: str = "1f1b"):
+                 bus: MessageBus, schedule: str = "1f1b",
+                 admission_timeout: float = 30.0):
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"schedule must be 1f1b|gpipe, got {schedule!r}")
+        # the first train_batch includes XLA compilation of every stage's
+        # fwd/bwd across ranks, which can dwarf steady-state step time — a
+        # much larger window applies until the first step completes
+        self.admission_timeout = float(admission_timeout)
+        self._first_step_done = False
         self.rank = int(rank)
         self.n = int(n_stages)
         self.bus = bus
@@ -315,7 +321,9 @@ class DistHostPipelineTrainer:
         return self._step * 1_000_000 + t
 
     def _admit(self):
-        if self._window is not None and not self._window.acquire(timeout=30.0):
+        timeout = (self.admission_timeout if self._first_step_done
+                   else max(self.admission_timeout, 600.0))
+        if self._window is not None and not self._window.acquire(timeout=timeout):
             raise RuntimeError(
                 "1f1b admission window starved (a downstream stage likely "
                 "failed; its STOP aborts this step)"
@@ -367,4 +375,5 @@ class DistHostPipelineTrainer:
         if self.rank == 0 and loss is None:
             loss = float(self.bus.get(self.LOSS_CHAN, self._step))
         self._step += 1
+        self._first_step_done = True
         return loss

@@ -247,10 +247,30 @@ class TheOnePSRuntime:
     def __init__(self):
         self._tables = {}
         self._table_ids = {}
-        self._next_id = 1
         self._server = None
         self._client = None
         self._endpoints = []
+
+    def _table_id(self, name: str) -> int:
+        """Deterministic table id from the table NAME, so trainers that
+        create tables in different orders (or only on some ranks) still
+        address the same server table — a per-process creation counter
+        silently corrupts training in that case."""
+        import zlib
+
+        tid = self._table_ids.get(name)
+        if tid is None:
+            tid = (zlib.crc32(name.encode("utf-8")) & 0x7FFFFFFF) or 1
+            clash = next(
+                (n for n, t in self._table_ids.items() if t == tid), None
+            )
+            if clash is not None:
+                raise ValueError(
+                    f"table name {name!r} hash-collides with {clash!r}; "
+                    "rename one of them"
+                )
+            self._table_ids[name] = tid
+        return tid
 
     # -- role bootstrap ------------------------------------------------------
     def _init_server(self, *args, **kwargs):
@@ -358,8 +378,7 @@ class TheOnePSRuntime:
         if self._client is not None:
             from .service import DistributedSparseTable, GeoDistributedSparseTable
 
-            tid = self._table_ids.setdefault(name, self._next_id)
-            self._next_id += 1
+            tid = self._table_id(name)
             cls = GeoDistributedSparseTable if geo_steps > 0 else DistributedSparseTable
             extra = {"geo_steps": geo_steps} if geo_steps > 0 else {}
             t = cls(self._client, tid, emb_dim, **extra, **kwargs)
@@ -378,8 +397,7 @@ class TheOnePSRuntime:
                 "dense tables need the distributed PS (call _init_worker "
                 "with PADDLE_PSERVERS_IP_PORT_LIST set)"
             )
-        tid = self._table_ids.setdefault(name, self._next_id)
-        self._next_id += 1
+        tid = self._table_id(name)
         h = DenseTableHandle(
             self._client, tid, params, optimizer, learning_rate
         )

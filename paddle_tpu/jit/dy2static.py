@@ -186,16 +186,18 @@ class _Runtime:
         return carry
 
     @staticmethod
-    def convert_range_for(start, stop, step, body_fn, carry, droppable=None):
+    def convert_range_for(start, stop, step, body_fn, carry, droppable=None,
+                          prev_i=UNDEF):
         """`for i in range(start, stop, step)` with any traced bound.
         body_fn(i, carry) -> carry. Returns (*carry, last_i): python `for`
-        leaves the loop variable bound to its last value (UNDEF when the
-        range is empty, matching the unbound-name semantics)."""
+        leaves the loop variable bound to its last value; when the concrete
+        range is empty the PRIOR binding of the loop var (prev_i) is kept —
+        unbound stays unbound, a pre-existing value survives."""
         from ..core.tensor import Tensor
 
         droppable = droppable or (False,) * len(carry)
         if not (_is_traced(start) or _is_traced(stop) or _is_traced(step)):
-            last_i = UNDEF
+            last_i = prev_i
             for i in range(int(_unwrap(start)), int(_unwrap(stop)),
                            int(_unwrap(step))):
                 carry = body_fn(i, carry)
@@ -385,32 +387,46 @@ def _name_tuple(names: List[str], ctx) -> ast.expr:
     )
 
 
+def _load_or_undef_call(name: str) -> ast.expr:
+    return ast.Call(
+        func=ast.Attribute(
+            value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+            attr="load_or_undef", ctx=ast.Load(),
+        ),
+        args=[
+            ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                     args=[], keywords=[]),
+            ast.Constant(name),
+        ],
+        keywords=[],
+    )
+
+
+def _undef_safe_return(names: List[str]) -> ast.stmt:
+    """`return (__jst.load_or_undef(locals(), 'a'), ...)` — a nested
+    conversion's post-del scaffolding may have UNBOUND a carry name inside
+    this helper body (a name bound in only one branch of an inner `if`); a
+    bare Name load would raise UnboundLocalError where plain Python runs
+    fine, so carry-returns re-enter through load_or_undef and surface the
+    unbound state as UNDEF for the enclosing region to merge."""
+    return ast.Return(
+        value=ast.Tuple(
+            elts=[_load_or_undef_call(n) for n in names], ctx=ast.Load()
+        )
+    )
+
+
 def _pre_load_stmts(carry: List[str]) -> List[ast.stmt]:
     """`name = __jst.load_or_undef(locals(), 'name')` per carry name, so a
     name bound only inside the converted region enters as UNDEF instead of
     tripping UnboundLocalError at the carry-tuple load."""
-    out = []
-    for n in carry:
-        out.append(
-            ast.Assign(
-                targets=[ast.Name(id=n, ctx=ast.Store())],
-                value=ast.Call(
-                    func=ast.Attribute(
-                        value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
-                        attr="load_or_undef", ctx=ast.Load(),
-                    ),
-                    args=[
-                        ast.Call(
-                            func=ast.Name(id="locals", ctx=ast.Load()),
-                            args=[], keywords=[],
-                        ),
-                        ast.Constant(n),
-                    ],
-                    keywords=[],
-                ),
-            )
+    return [
+        ast.Assign(
+            targets=[ast.Name(id=n, ctx=ast.Store())],
+            value=_load_or_undef_call(n),
         )
-    return out
+        for n in carry
+    ]
 
 
 def _post_del_stmts(carry: List[str]) -> List[ast.stmt]:
@@ -509,7 +525,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     )
                 )
             stmts.extend(body)
-            stmts.append(ast.Return(value=_name_tuple(carry, ast.Load)))
+            stmts.append(_undef_safe_return(carry))
             return ast.FunctionDef(
                 name=name,
                 args=ast.arguments(
@@ -575,8 +591,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 posonlyargs=[], args=[ast.arg(arg="__jst_carry")],
                 kwonlyargs=[], kw_defaults=[], defaults=[],
             ),
-            body=[unpack] + list(node.body)
-            + [ast.Return(value=_name_tuple(carry, ast.Load))],
+            body=[unpack] + list(node.body) + [_undef_safe_return(carry)],
             decorator_list=[], type_params=[],
         )
         call = ast.Call(
@@ -633,7 +648,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 )
             )
         stmts.extend(node.body)
-        stmts.append(ast.Return(value=_name_tuple(carry, ast.Load)))
+        stmts.append(_undef_safe_return(carry))
         body_def = ast.FunctionDef(
             name=bname,
             args=ast.arguments(
@@ -650,11 +665,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ),
             args=[start, stop, step, ast.Name(id=bname, ctx=ast.Load()),
                   _name_tuple(carry, ast.Load),
-                  _droppable_mask(carry, node.body)],
+                  _droppable_mask(carry, node.body),
+                  _load_or_undef_call(node.target.id)],
             keywords=[],
         )
         # python `for` leaves the loop variable bound after the loop —
         # convert_range_for returns (*carry, last_i) to preserve that
+        # (last_i = the loop var's PRIOR binding when the range is empty)
         out_names = carry + [node.target.id]
         assign: ast.stmt = ast.Assign(
             targets=[_name_tuple(out_names, ast.Store)], value=call

@@ -64,7 +64,36 @@ def _sp(x, cfg, *spec):
     return with_sharding_constraint(x, *spec)
 
 
+def convert_legacy_qkv_state_dict(state_dict, num_heads: int):
+    """One-time converter for checkpoints saved before the fused-qkv layout
+    switched from 3-major ([h, 3, H, hd] over the output dim) to heads-major
+    ([h, H, 3, hd], Megatron-style — see GPTAttention.forward). Old
+    checkpoints LOAD WITHOUT ERROR but silently permute q/k/v; run them
+    through this once. Operates on any key containing 'qkv_proj'; returns a
+    new dict."""
+    import numpy as np
+
+    out = {}
+    for k, v in state_dict.items():
+        if "qkv_proj" in k:
+            arr = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+            three_h = arr.shape[-1]
+            hd = three_h // (3 * num_heads)
+            # [..., 3*H*hd] 3-major -> heads-major
+            arr = arr.reshape(arr.shape[:-1] + (3, num_heads, hd))
+            arr = np.swapaxes(arr, -3, -2).reshape(arr.shape[:-3] + (three_h,))
+            out[k] = arr
+        else:
+            out[k] = v
+    return out
+
+
 class GPTAttention(nn.Layer):
+    """Fused-qkv layout is heads-major (state_dict layout v2); checkpoints
+    from the 3-major era must pass through convert_legacy_qkv_state_dict."""
+
+    QKV_LAYOUT_VERSION = 2
+
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
