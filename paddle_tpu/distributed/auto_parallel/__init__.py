@@ -30,7 +30,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "get_mesh"]
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "get_mesh",
+           "Planner", "CostModel", "ModelDesc", "ClusterSpec", "DeviceSpec",
+           "Candidate", "Plan", "Converter", "reshard_state_dict"]
 
 _default_process_mesh: Optional["ProcessMesh"] = None
 
@@ -185,13 +187,21 @@ class Engine:
 
     def __init__(self, model=None, inputs_spec=None, labels_spec=None,
                  cluster=None, strategy=None, process_mesh=None,
-                 data_axis=None):
+                 data_axis=None, auto=False):
         self.model = model
         self.inputs_spec = inputs_spec
         self.labels_spec = labels_spec
         self.cluster = cluster
         self.strategy = strategy
-        self.process_mesh = process_mesh or _default_process_mesh
+        # auto=True (or strategy.auto): the Planner chooses the mesh
+        # factorization from the cost model instead of the user's
+        # process_mesh (reference: engine.py _plan → Planner.search)
+        self.auto = bool(auto or (strategy is not None
+                                  and getattr(strategy, "auto", False)))
+        self.plan = None
+        self.process_mesh = process_mesh or (
+            None if self.auto else _default_process_mesh
+        )
         # mesh axis the batch is sharded over; defaults to mesh dim 0 (the
         # conventional data axis) — pass data_axis when your mesh orders
         # model-parallel first
@@ -212,6 +222,8 @@ class Engine:
         self._loss = loss
         self._metrics = metrics
         self.mode = mode
+        if self.auto and self.process_mesh is None:
+            self.process_mesh = self._plan_mesh()
         if self.process_mesh is None:
             self.process_mesh = _default_process_mesh
         if self.process_mesh is not None:
@@ -222,6 +234,44 @@ class Engine:
             shard_params(self.model)
         self._prepared = True
         return self
+
+    def _plan_mesh(self) -> "ProcessMesh":
+        """auto=True: choose the mesh factorization with the cost-model
+        Planner (reference: engine.py _plan → planner_v2/Planner). The
+        chosen spec is logged and kept on `self.plan`. A zero_stage>0 plan
+        names its data axis 'sharding' — that is the axis param_spec/
+        _state_spec shard ZeRO state over (parallel/sharding.py)."""
+        import jax as _jax
+
+        from .planner import ClusterSpec, plan_for_model
+
+        batch, seq = self._data_shape_hint()
+        cluster = self.cluster if isinstance(self.cluster, ClusterSpec) \
+            else ClusterSpec(n_devices=len(_jax.devices()))
+        # Engine's compiled step expresses dp/mp/zero; pp needs the
+        # pipeline-block protocol, which the fleet path handles
+        self.plan = plan_for_model(self.model, seq_len=seq,
+                                   global_batch=batch, cluster=cluster,
+                                   allow_pp=False)
+        c = self.plan.candidate
+        ids = np.arange(cluster.n_devices).reshape(c.dp, c.mp)
+        data_dim = "sharding" if c.zero_stage > 0 else "dp"
+        return ProcessMesh(ids.tolist(), dim_names=[data_dim, "mp"])
+
+    def _data_shape_hint(self):
+        """(global_batch, seq_len) from inputs_spec, else a dp-wide default."""
+        import jax as _jax
+
+        shape = None
+        spec = self.inputs_spec
+        if spec:
+            first = spec[0] if isinstance(spec, (list, tuple)) else spec
+            shape = list(getattr(first, "shape", None) or [])
+        if not shape:
+            return len(_jax.devices()), 1
+        batch = shape[0] if shape[0] and shape[0] > 0 else len(_jax.devices())
+        seq = shape[1] if len(shape) > 1 and shape[1] else 1
+        return int(batch), int(seq)
 
     def _ensure_step(self):
         if not self._prepared:
@@ -236,9 +286,10 @@ class Engine:
             axis = self.data_axis or (
                 self.process_mesh.dim_names[0] if self.process_mesh else "dp"
             )
+            zero = self.plan.candidate.zero_stage if self.plan else 0
             self._train_step = ShardedTrainStep(
                 self.model, self._loss, self._optimizer, mesh=mesh,
-                batch_axes=(axis,),
+                batch_axes=(axis,), zero_stage=zero,
             )
         return self._train_step
 
@@ -316,3 +367,15 @@ class Engine:
 
             if os.path.exists(path + ".pdopt"):
                 self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))
+
+
+from .planner import (  # noqa: E402
+    Candidate,
+    ClusterSpec,
+    CostModel,
+    DeviceSpec,
+    ModelDesc,
+    Plan,
+    Planner,
+)
+from .converter import Converter, reshard_state_dict  # noqa: E402

@@ -116,6 +116,10 @@ def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStr
     hybrid = strategy.hybrid_configs
     dp = hybrid.get("dp_degree", 1)
     mp = hybrid.get("mp_degree", 1)
+    if strategy.tensor_parallel and mp == 1:
+        # reference: tensor_parallel meta-config — an alternative spelling
+        # of hybrid mp_degree for pure-TP scripts
+        mp = int(strategy.tensor_parallel_configs.get("tensor_parallel_degree", 1))
     pp = hybrid.get("pp_degree", 1)
     sharding = hybrid.get("sharding_degree", 1)
     sep = hybrid.get("sep_degree", 1)
@@ -162,11 +166,15 @@ def distributed_model(model: Layer):
 
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
-    """reference: fleet_base.py:875 — meta-optimizer selection. The TP/ZeRO
-    behavior lives in sharding specs; amp/recompute are handled by their own
-    modules; the comms-reducing meta-optimizers (LocalSGD, DGC) wrap here
-    exactly as the reference's StrategyCompiler chains them."""
-    if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
+    """reference: fleet_base.py:875 — meta-optimizer selection via the
+    StrategyCompiler (strategy_compiler.py): Lars/Lamb substitute the base,
+    LocalSGD/DGC wrap it, GradientMerge wraps outermost. TP/ZeRO live in
+    sharding specs; amp/recompute are consumed by distributed_train_step."""
+    from .gradient_merge import GradientMergeOptimizer
+    from .strategy_compiler import StrategyCompiler
+
+    if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer,
+                              GradientMergeOptimizer)):
         # idempotent: already wrapped. Refuse a conflicting re-wrap rather
         # than storing a strategy the existing wrapper doesn't reflect.
         if strategy is not None and strategy is not _state["strategy"]:
@@ -180,52 +188,9 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     if strategy is not None:
         _state["strategy"] = strategy
     st = _strategy()
+    optimizer, applied = StrategyCompiler().compile(st, optimizer)
     optimizer._fleet_strategy = st
-    if getattr(st, "localsgd", False) and getattr(st, "dgc", False):
-        raise ValueError(
-            "strategy.localsgd and strategy.dgc are mutually exclusive "
-            "(both reduce DP communication; pick one)"
-        )
-    if getattr(st, "localsgd", False):
-        if getattr(optimizer, "_parameters", None) is None:
-            raise ValueError("LocalSGD needs an optimizer with a parameter list")
-        cfg = getattr(st, "localsgd_configs", {}) or {}
-        optimizer = LocalSGDOptimizer(
-            optimizer,
-            k_steps=cfg.get("k_steps", 1),
-            begin_step=cfg.get("begin_step", 0),
-        )
-    elif getattr(st, "dgc", False):
-        import warnings
-
-        from ...optimizer import Momentum
-
-        # the reference's DGC meta-optimizer _can_apply gates on Momentum —
-        # silently turning Adam into momentum SGD would change training
-        if not isinstance(optimizer, Momentum):
-            warnings.warn(
-                "strategy.dgc applies only to Momentum (reference _can_apply "
-                f"rule); {type(optimizer).__name__} left unwrapped"
-            )
-            return optimizer
-        if getattr(optimizer, "_nesterov", False):
-            warnings.warn(
-                "DGC has no Nesterov variant; momentum applies non-Nesterov"
-            )
-        if optimizer._parameters is None:
-            raise ValueError("DGC needs an optimizer with a parameter list")
-        cfg = getattr(st, "dgc_configs", {}) or {}
-        optimizer = DGCMomentumOptimizer(
-            learning_rate=optimizer._learning_rate
-            if hasattr(optimizer, "_learning_rate") else optimizer.get_lr(),
-            momentum=optimizer._momentum,
-            rampup_begin_step=cfg.get("rampup_begin_step", 0),
-            rampup_step=cfg.get("rampup_step", 1),
-            sparsity=cfg.get("sparsity", (0.999,)),
-            parameters=optimizer._parameters,
-            grad_clip=optimizer._grad_clip,
-            weight_decay=getattr(optimizer, "_weight_decay", None),
-        )
+    optimizer._fleet_applied_meta_optimizers = applied
     return optimizer
 
 
@@ -237,6 +202,31 @@ def distributed_train_step(model, loss_fn, optimizer):
     from ...parallel.sharding import sharded_train_step
     from ...parallel.topology import axis_size
 
+    strategy = _strategy()
+    # a GradientMergeOptimizer unwraps into COMPILED accumulation: the step
+    # lax.scans value_and_grad over k microbatch chunks (same numerics as
+    # the eager wrapper, one-microbatch activation memory)
+    accumulate_steps = 1
+    from .gradient_merge import GradientMergeOptimizer
+
+    if isinstance(optimizer, GradientMergeOptimizer):
+        accumulate_steps = optimizer._k
+        if not optimizer._avg:
+            raise ValueError(
+                "compiled gradient merge always averages (avg=False only "
+                "exists on the eager wrapper path)"
+            )
+        optimizer = optimizer.inner_opt
+    elif strategy.gradient_merge:
+        cfg_gm = strategy.gradient_merge_configs or {}
+        accumulate_steps = int(cfg_gm.get("k_steps", 1))
+        if accumulate_steps > 1 and not cfg_gm.get("avg", True):
+            raise ValueError(
+                "compiled gradient merge always averages (avg=False only "
+                "exists on the eager wrapper path)"
+            )
+    # the guard must see THROUGH the merge wrapper: GradientMerge(LocalSGD)
+    # is a legal eager chain but no compiled step exists for it
     if isinstance(optimizer, (LocalSGDOptimizer, DGCMomentumOptimizer)):
         raise ValueError(
             "LocalSGD/DGC are EAGER multi-process meta-optimizers (their "
@@ -245,20 +235,159 @@ def distributed_train_step(model, loss_fn, optimizer):
             "loss.backward(); opt.step() directly instead of "
             "distributed_train_step"
         )
-    strategy = _strategy()
+    forward_ctx = None
+    if strategy.amp:
+        from ... import amp as _amp
+
+        cfg = strategy.amp_configs or {}
+        level = "O2" if (cfg.get("use_pure_fp16") or cfg.get("use_pure_bf16")) \
+            else "O1"
+        dtype = "float16" if cfg.get("use_pure_fp16") else "bfloat16"
+
+        def forward_ctx(_cfg=cfg, _level=level, _dtype=dtype):
+            return _amp.auto_cast(
+                enable=True,
+                custom_white_list=_cfg.get("custom_white_list") or None,
+                custom_black_list=_cfg.get("custom_black_list") or None,
+                level=_level, dtype=_dtype,
+            )
+    if strategy.recompute:
+        _apply_strategy_recompute(
+            model, (strategy.recompute_configs or {}).get("checkpoints") or []
+        )
+    if strategy.auto:
+        return _AutoPlannedStep(model, loss_fn, optimizer, strategy,
+                                forward_ctx, accumulate_steps)
     pp = axis_size("pp")
     if pp > 1:
         from ...parallel.pipeline import pipelined_train_step
 
+        if accumulate_steps > 1:
+            raise ValueError(
+                "with pp_degree > 1, gradient accumulation IS the pipeline "
+                "microbatching — set pipeline_configs['accumulate_steps'] "
+                "instead of strategy.gradient_merge (the reference's "
+                "GradientMergeOptimizer likewise excludes the pipeline path)"
+            )
+        _check_pp_loss_scale(strategy)
         target = model._layers if hasattr(model, "_layers") else model
         return pipelined_train_step(
             target, loss_fn, optimizer,
             num_micro=strategy.pipeline_configs.get("accumulate_steps", pp),
             zero_stage=strategy.sharding_stage,
+            forward_ctx=forward_ctx,
         )
     return sharded_train_step(
-        model, loss_fn, optimizer, zero_stage=_strategy().sharding_stage
+        model, loss_fn, optimizer, zero_stage=strategy.sharding_stage,
+        forward_ctx=forward_ctx, accumulate_steps=accumulate_steps,
+        loss_scale=_static_loss_scale(strategy),
     )
+
+
+class _AutoPlannedStep:
+    """strategy.auto=True: defer mesh choice to the cost-model Planner.
+
+    The first batch supplies (global_batch, seq_len); the Planner picks the
+    dp/mp/pp/zero factorization (auto_parallel/planner.py — the reference's
+    planner.py:826 search), the mesh is re-initialised to the plan, params
+    are re-sharded, and the matching compiled step is built. The chosen
+    spec is logged once."""
+
+    def __init__(self, model, loss_fn, optimizer, strategy, forward_ctx,
+                 accumulate_steps):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.strategy = strategy
+        self.forward_ctx = forward_ctx
+        self.accumulate_steps = accumulate_steps
+        self.plan = None
+        self._inner = None
+
+    def _build(self, batch):
+        from ..auto_parallel.planner import mesh_degrees_for, plan_for_model
+        from ...core.tensor import Tensor as _T
+        from ...parallel.sharding import sharded_train_step, shard_params
+
+        x = batch[0]
+        shape = (x._value if isinstance(x, _T) else x).shape
+        gb = int(shape[0])
+        seq = int(shape[1]) if len(shape) > 1 else 1
+        # gradient accumulation composes with pp only as pipeline
+        # microbatching (same rule as the explicit path below)
+        allow_pp = None if self.accumulate_steps == 1 else False
+        self.plan = plan_for_model(self.model, seq_len=seq, global_batch=gb,
+                                   allow_pp=allow_pp)
+        c = self.plan.candidate
+        init_mesh(**mesh_degrees_for(c))
+        shard_params(self.model, zero_stage=c.zero_stage)
+        if c.pp > 1:
+            from ...parallel.pipeline import pipelined_train_step
+
+            _check_pp_loss_scale(self.strategy)
+            target = self.model._layers if hasattr(self.model, "_layers") \
+                else self.model
+            self._inner = pipelined_train_step(
+                target, self.loss_fn, self.optimizer,
+                num_micro=c.micro_batches, zero_stage=c.zero_stage,
+                forward_ctx=self.forward_ctx,
+            )
+        else:
+            self._inner = sharded_train_step(
+                self.model, self.loss_fn, self.optimizer,
+                zero_stage=c.zero_stage, forward_ctx=self.forward_ctx,
+                accumulate_steps=self.accumulate_steps,
+                loss_scale=_static_loss_scale(self.strategy),
+            )
+
+    def __call__(self, *batch):
+        if self._inner is None:
+            self._build(batch)
+        return self._inner(*batch)
+
+
+def _static_loss_scale(strategy) -> float:
+    """Pure-fp16 compiled training needs loss scaling (bf16 — the TPU
+    default — does not): apply amp_configs.init_loss_scaling as a static
+    scale inside the compiled step (grads are unscaled before clipping)."""
+    cfg = strategy.amp_configs or {}
+    if strategy.amp and cfg.get("use_pure_fp16"):
+        return float(cfg.get("init_loss_scaling", 32768.0))
+    return 1.0
+
+
+def _check_pp_loss_scale(strategy):
+    """The pipelined step has no loss-scaling hook; running pure fp16
+    through it unscaled would silently underflow small gradients."""
+    if _static_loss_scale(strategy) != 1.0:
+        raise ValueError(
+            "pure-fp16 loss scaling is not wired into the pipeline-parallel "
+            "step; use bfloat16 (use_pure_bf16 — the TPU-native choice, no "
+            "scaling needed) or pp_degree=1"
+        )
+
+
+def _apply_strategy_recompute(model, checkpoints):
+    """Consume strategy.recompute: wrap each named sublayer's forward in
+    jax.checkpoint (reference: RecomputeOptimizer rewrites the program to
+    drop+recompute activations at the checkpoint vars; here the checkpoint
+    granularity is the named sublayer). Idempotent per layer."""
+    from ...incubate.recompute import recompute as _rc
+
+    target = model._layers if hasattr(model, "_layers") else model
+    layers = dict(target.named_sublayers()) if checkpoints else {}
+    for name in checkpoints:
+        layer = layers.get(name)
+        if layer is None:
+            raise ValueError(
+                f"recompute checkpoint {name!r} is not a named sublayer of "
+                f"the model (have: {sorted(layers)[:20]}...)"
+            )
+        if getattr(layer, "_fleet_recompute_wrapped", False):
+            continue
+        orig = layer.forward
+        layer.forward = (lambda *a, _orig=orig, **k: _rc(_orig, *a, **k))
+        layer._fleet_recompute_wrapped = True
 
 
 # role/worker queries (reference: fleet_base.py worker_index etc.)

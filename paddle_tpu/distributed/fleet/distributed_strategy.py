@@ -73,6 +73,17 @@ class DistributedStrategy:
         return int(self.sharding_configs.get("stage", 1))
 
     def __setattr__(self, key, value):
+        # unknown fields fail fast: a typo (`strategy.gradient_merg = True`)
+        # must not become a silent no-op (see strategy_compiler.FIELD_STATUS
+        # for the consumption map every real field carries)
+        if not key.startswith("_") and key not in self.__dict__:
+            from .strategy_compiler import FIELD_STATUS
+
+            if key not in FIELD_STATUS:
+                raise AttributeError(
+                    f"DistributedStrategy has no field {key!r} (unknown "
+                    "fields would be silently ignored; check the spelling)"
+                )
         # dict-valued configs merge instead of replace (reference setter
         # semantics: distributed_strategy.py assigns proto sub-messages)
         cur = self.__dict__.get(key)

@@ -1,0 +1,96 @@
+"""GradientMerge — k-step gradient accumulation as a meta-optimizer.
+
+Reference analogue: fleet/meta_optimizers/gradient_merge_optimizer.py:20
+(wraps the inner optimizer in a GradientMergeOptimizer program rewrite that
+accumulates @GRAD into @GradientMerge vars and applies the inner update
+every k_steps, optionally averaging). Here the same contract is an eager
+wrapper: `step()` folds the current `.grad`s into float32 accumulators and
+only invokes the inner optimizer on every k-th call — between boundaries
+parameters (and the LR schedule) do not move, so a k-step merged run is
+numerically a k×-batch run (tested in tests/test_gradient_merge.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import no_grad
+from ...core.tensor import Tensor
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    """Wrap any optimizer; apply the merged gradient every `k_steps`.
+
+    avg=True divides the accumulated gradient by k (the reference default),
+    making the boundary update identical to one step on the concatenated
+    batch for any mean-reduced loss.
+    """
+
+    def __init__(self, optimizer, k_steps: int = 1, avg: bool = True):
+        if int(k_steps) < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = optimizer
+        self._k = int(k_steps)
+        self._avg = bool(avg)
+        self._acc = {}          # id(param) -> (param, fp32 accumulator)
+        self._micro_count = 0
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    @no_grad()
+    def step(self):
+        params = [
+            p for p in self._inner._param_list()
+            if not p.stop_gradient and p.grad is not None
+        ]
+        self._micro_count += 1
+        boundary = self._micro_count % self._k == 0
+        for p in params:
+            g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
+            cur = self._acc.get(id(p))
+            acc = g.astype(jnp.float32) if cur is None \
+                else cur[1] + g.astype(jnp.float32)
+            self._acc[id(p)] = (p, acc)
+        if not boundary:
+            return
+        scale = 1.0 / self._k if self._avg else 1.0
+        for p, acc in self._acc.values():
+            gd = p.grad._value.dtype if isinstance(p.grad, Tensor) \
+                else jnp.asarray(p.grad).dtype
+            p.grad = Tensor((acc * scale).astype(gd), stop_gradient=True)
+        self._inner.step()
+        self._acc.clear()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero=set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        out = dict(self._inner.state_dict())
+        out["_gm_micro_count"] = self._micro_count
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        state.pop("_gm_micro_count", None)
+        # accumulators are NOT checkpointed — a restore starts a fresh
+        # accumulation window (restoring the count without the partial
+        # gradient sum would apply a mis-scaled update at the next boundary)
+        self._micro_count = 0
+        self._acc.clear()
+        self._inner.set_state_dict(state)
+
+    def __getattr__(self, name):
+        if name == "_inner":
+            raise AttributeError(name)
+        return getattr(self._inner, name)

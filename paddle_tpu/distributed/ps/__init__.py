@@ -375,6 +375,16 @@ class TheOnePSRuntime:
         stub (or geo replica when geo_steps>0) against the fleet."""
         if name in self._tables:
             raise ValueError(f"table {name!r} already exists")
+        if geo_steps == 0:
+            # strategy.a_sync with k_steps>0 selects geo-async push for all
+            # sparse tables (reference: a_sync_configs -> geo sgd mode)
+            from ..fleet import _state as _fleet_state
+
+            st = _fleet_state.get("strategy")
+            if st is not None and getattr(st, "a_sync", False):
+                geo_steps = max(0, int(
+                    (st.a_sync_configs or {}).get("k_steps", -1)
+                ))
         if self._client is not None:
             from .service import DistributedSparseTable, GeoDistributedSparseTable
 

@@ -146,10 +146,16 @@ class PipelinedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh: Optional[Mesh] = None,
-                 num_micro: int = 4, zero_stage: int = 0, remat: bool = True):
+                 num_micro: int = 4, zero_stage: int = 0, remat: bool = True,
+                 forward_ctx=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # zero-arg context-manager factory around every traced forward
+        # region (fleet wires strategy.amp through here)
+        import contextlib
+
+        self.forward_ctx = forward_ctx or contextlib.nullcontext
         self.mesh = mesh or get_mesh()
         if self.mesh is None:
             raise RuntimeError("pipeline parallelism requires an initialized mesh")
@@ -323,10 +329,13 @@ class PipelinedTrainStep:
             carries and the in-stage program ('Involuntary full
             rematerialization' churn)."""
             with _random.rng_scope(key), suppress_sharding_constraints():
+                fwd_ctx = self.forward_ctx
+
                 def stage_fn(locals_, h):
                     for i in range(L_per):
                         slice_vals = [v[i] for v in locals_]
-                        with _bind_values(t_objs, slice_vals), no_grad():
+                        with _bind_values(t_objs, slice_vals), no_grad(), \
+                                fwd_ctx():
                             h = self.template(
                                 Tensor(h, stop_gradient=True)
                             )._value
@@ -334,12 +343,14 @@ class PipelinedTrainStep:
 
                 def inject_fn(xt):
                     with _bind_values(repl_params + buffers,
-                                      list(repl_vals) + list(b_vals)), no_grad():
+                                      list(repl_vals) + list(b_vals)), \
+                            no_grad(), fwd_ctx():
                         return pre_fn(Tensor(xt, stop_gradient=True))._value
 
                 def head_loss_fn(h, y):
                     with _bind_values(repl_params + buffers,
-                                      list(repl_vals) + list(b_vals)), no_grad():
+                                      list(repl_vals) + list(b_vals)), \
+                            no_grad(), fwd_ctx():
                         out = post_fn(Tensor(h, stop_gradient=True))
                         loss = (
                             loss_fn(out, Tensor(y, stop_gradient=True))
@@ -492,7 +503,8 @@ class PipelinedTrainStep:
 
 
 def pipelined_train_step(model, loss_fn, optimizer, mesh=None, num_micro=4,
-                         zero_stage=0, remat=True):
+                         zero_stage=0, remat=True, forward_ctx=None):
     return PipelinedTrainStep(
-        model, loss_fn, optimizer, mesh, num_micro, zero_stage, remat
+        model, loss_fn, optimizer, mesh, num_micro, zero_stage, remat,
+        forward_ctx
     )

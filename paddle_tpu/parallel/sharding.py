@@ -137,10 +137,22 @@ class ShardedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=0,
-                 batch_axes=("dp", "sharding")):
+                 batch_axes=("dp", "sharding"), forward_ctx=None,
+                 accumulate_steps=1, loss_scale=1.0):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # zero-arg context-manager factory wrapped around the traced forward
+        # (fleet wires strategy.amp through here as an auto_cast factory)
+        self.forward_ctx = forward_ctx
+        # >1 = compiled gradient merge: the leading batch dim must divide
+        # into accumulate_steps microbatches (strategy.gradient_merge)
+        self.accumulate_steps = int(accumulate_steps)
+        if self.accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
+        # static loss scaling for pure-fp16 compute (1.0 = off); grads are
+        # unscaled before clipping/update inside the compiled step
+        self.loss_scale = float(loss_scale)
         self.mesh = mesh or get_mesh()
         self.zero_stage = zero_stage
         self.batch_axes = tuple(
@@ -194,20 +206,69 @@ class ShardedTrainStep:
         rule = type(opt)._update
         grad_clip = opt._grad_clip
 
+        import contextlib
+
+        fwd_ctx = self.forward_ctx or contextlib.nullcontext
+
+        accum_k = self.accumulate_steps
+        loss_scale = self.loss_scale
+
         def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
-            def loss_of(p_vals):
+            def loss_of(p_vals, b_vals, key, batch_vals):
                 ins = [Tensor(v, stop_gradient=True) for v in batch_vals]
                 with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
-                        no_grad(), _random.rng_scope(key):
+                        no_grad(), _random.rng_scope(key), fwd_ctx():
                     out = model(*ins[:-1]) if len(ins) > 1 else model(ins[0])
                     loss = loss_fn(out, ins[-1]) if loss_fn is not None else out
                     new_b = tuple(b._value for b in buffers)
                 lv = loss._value if isinstance(loss, Tensor) else loss
+                if loss_scale != 1.0:
+                    lv = lv * loss_scale
                 return lv, new_b
 
-            (loss, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                tuple(p_vals)
-            )
+            if accum_k > 1:
+                # compiled gradient merge (reference: GradientMergeOptimizer
+                # program rewrite): split the global batch into k chunks and
+                # lax.scan value_and_grad over them, accumulating fp32 grads
+                # — peak activation memory is one microbatch's, the update
+                # applies ONCE on the averaged gradient
+                chunks = tuple(
+                    v.reshape((accum_k, v.shape[0] // accum_k) + v.shape[1:])
+                    for v in batch_vals
+                )
+                keys = jax.random.split(key, accum_k)
+
+                def scan_body(carry, xs):
+                    g_acc, b_cur = carry
+                    k_i, chunk = xs[0], xs[1:]
+                    (lv, new_b), gs = jax.value_and_grad(
+                        loss_of, has_aux=True)(tuple(p_vals), b_cur, k_i, chunk)
+                    g_acc = tuple(
+                        a + g.astype(jnp.float32) for a, g in zip(g_acc, gs)
+                    )
+                    return (g_acc, new_b), lv
+
+                g0 = tuple(
+                    jnp.zeros(p.shape, jnp.float32) for p in p_vals
+                )
+                (g_acc, new_b), losses = jax.lax.scan(
+                    scan_body, (g0, tuple(b_vals)), (keys,) + chunks
+                )
+                grads = tuple(
+                    (g / accum_k).astype(p.dtype)
+                    for g, p in zip(g_acc, p_vals)
+                )
+                loss = jnp.mean(losses)
+            else:
+                (loss, new_b), grads = jax.value_and_grad(
+                    loss_of, has_aux=True
+                )(tuple(p_vals), tuple(b_vals), key, tuple(batch_vals))
+            if loss_scale != 1.0:
+                loss = loss / loss_scale
+                grads = tuple(
+                    (g.astype(jnp.float32) / loss_scale).astype(g.dtype)
+                    for g in grads
+                )
             if grad_clip is not None:
                 pairs = grad_clip(
                     [
@@ -236,6 +297,14 @@ class ShardedTrainStep:
 
     @no_grad()
     def __call__(self, *batch) -> Tensor:
+        if self.accumulate_steps > 1:
+            for b in batch:
+                n0 = (b._value if isinstance(b, Tensor) else np.asarray(b)).shape[0]
+                if n0 % self.accumulate_steps:
+                    raise ValueError(
+                        f"global batch {n0} is not divisible by gradient-"
+                        f"merge accumulate_steps={self.accumulate_steps}"
+                    )
         if self._step is None:
             self._opt_state = self._init_state()
             # physically place optimizer state per its (ZeRO) spec — jit
@@ -278,5 +347,8 @@ def _next_key():
 
 
 def sharded_train_step(model, loss_fn, optimizer, mesh=None, zero_stage=0,
-                       batch_axes=("dp", "sharding")):
-    return ShardedTrainStep(model, loss_fn, optimizer, mesh, zero_stage, batch_axes)
+                       batch_axes=("dp", "sharding"), forward_ctx=None,
+                       accumulate_steps=1, loss_scale=1.0):
+    return ShardedTrainStep(model, loss_fn, optimizer, mesh, zero_stage,
+                            batch_axes, forward_ctx, accumulate_steps,
+                            loss_scale)
