@@ -287,6 +287,7 @@ def apply(
     op_name: Optional[str] = None,
     differentiable: bool = True,
     cache_token=None,
+    jit: bool = True,
     **kwargs,
 ):
     """Run op `fn` on Tensor/array args, recording autograd tape if needed.
@@ -328,9 +329,11 @@ def apply(
     record = differentiable and bool(diff_idx) and _grad_state().grad_enabled
 
     if not record:
+        # jit=False: ops with data-dependent output shapes (nonzero, unique,
+        # masked_select, ...) cannot trace — they run concretely
         jfn = (
             _jitted(fn, kw_items, token=cache_token)
-            if flags.flag("eager_op_jit")
+            if (jit and flags.flag("eager_op_jit"))
             else None
         )
         if jfn is not None:

@@ -72,6 +72,15 @@ class Converter:
                     f"references mesh dims {bad} outside the "
                     f"{ndim}-d process_shape"
                 )
+            n = 1
+            for d in attr["process_shape"]:
+                n *= int(d)
+            if len(attr["process_group"]) != n:
+                raise ValueError(
+                    f"{name}[{k!r}] process_group has "
+                    f"{len(attr['process_group'])} ranks but process_shape "
+                    f"{attr['process_shape']} implies {n}"
+                )
         return s
 
     # -- public --------------------------------------------------------------

@@ -58,8 +58,14 @@ class GradientMergeOptimizer:
             return
         scale = 1.0 / self._k if self._avg else 1.0
         for p, acc in self._acc.values():
-            gd = p.grad._value.dtype if isinstance(p.grad, Tensor) \
-                else jnp.asarray(p.grad).dtype
+            # a param may have no grad on the boundary micro-step (cleared,
+            # or untouched by this micro-batch) — fall back to param dtype
+            if isinstance(p.grad, Tensor):
+                gd = p.grad._value.dtype
+            elif p.grad is not None:
+                gd = jnp.asarray(p.grad).dtype
+            else:
+                gd = p._value.dtype
             p.grad = Tensor((acc * scale).astype(gd), stop_gradient=True)
         self._inner.step()
         self._acc.clear()

@@ -242,6 +242,21 @@ class _Runtime:
         return tuple(full) + (Tensor(i_end - di, stop_gradient=True),)
 
     @staticmethod
+    def range_cond(i, stop, step):
+        """`i` still inside range(start, stop, step)? — sign-aware, works
+        with any mix of traced/concrete operands (the while-form lowering
+        of a for-range containing `break`)."""
+        if _is_traced(i) or _is_traced(stop) or _is_traced(step):
+            from ..core.tensor import Tensor
+
+            iv = jnp.asarray(_unwrap(i))
+            sv = jnp.asarray(_unwrap(stop))
+            dv = jnp.asarray(_unwrap(step))
+            return Tensor(jnp.where(dv > 0, iv < sv, iv > sv),
+                          stop_gradient=True)
+        return i < stop if _unwrap(step) > 0 else i > stop
+
+    @staticmethod
     def convert_logical_and(x, y_fn):
         if _is_traced(x):
             from ..core.tensor import Tensor

@@ -172,19 +172,24 @@ def scatter_nd(index, updates, *, shape):
     return zeros.at[idx].add(updates)
 
 
-def put_along_axis(x, index, value, *, axis, reduce="assign"):
+def put_along_axis(x, index, value, *, axis, reduce="assign",
+                   include_self=True):
     if reduce == "assign":
         return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
+    dim_idx = jnp.indices(index.shape)
+    full_idx = list(dim_idx)
+    full_idx[axis] = index
+    full_idx = tuple(full_idx)
     if reduce == "add":
-        dim_idx = jnp.indices(index.shape)
-        full_idx = list(dim_idx)
-        full_idx[axis] = index
-        return x.at[tuple(full_idx)].add(value)
+        if not include_self:
+            # reference include_self=False: targeted slots start from the
+            # reduction identity instead of x's original value
+            x = x.at[full_idx].set(jnp.zeros((), x.dtype))
+        return x.at[full_idx].add(value)
     if reduce in ("mul", "multiply"):
-        dim_idx = jnp.indices(index.shape)
-        full_idx = list(dim_idx)
-        full_idx[axis] = index
-        return x.at[tuple(full_idx)].multiply(value)
+        if not include_self:
+            x = x.at[full_idx].set(jnp.ones((), x.dtype))
+        return x.at[full_idx].multiply(value)
     raise ValueError(f"unsupported reduce {reduce}")
 
 

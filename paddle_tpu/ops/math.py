@@ -326,19 +326,32 @@ def cumprod(x, *, dim=None):
     return jnp.cumprod(x, axis=dim)
 
 
-def cummax(x, *, axis=None):
+def _cum_extreme(x, axis, op):
+    """(values, indices) running extreme — reference cummax/cummin return
+    the index of the element that produced each running value
+    (phi/kernels/cum_maxmin_kernel)."""
     if axis is None:
         x = x.reshape(-1)
         axis = 0
-    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
-    return vals
+    vals = jax.lax.associative_scan(op, x, axis=axis)
+    # index where the running value last CHANGED: positions whose value
+    # equals x at that slot take their own index, else inherit the previous
+    own = jnp.equal(vals, x)
+    idx_range = jnp.arange(x.shape[axis])
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    idx_range = idx_range.reshape(shape)
+    marked = jnp.where(own, idx_range, 0)
+    idx = jax.lax.associative_scan(jnp.maximum, marked, axis=axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def cummax(x, *, axis=None):
+    return _cum_extreme(x, axis, jnp.maximum)
 
 
 def cummin(x, *, axis=None):
-    if axis is None:
-        x = x.reshape(-1)
-        axis = 0
-    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    return _cum_extreme(x, axis, jnp.minimum)
 
 
 def logcumsumexp(x, *, axis=None):

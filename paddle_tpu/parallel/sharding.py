@@ -212,6 +212,26 @@ class ShardedTrainStep:
 
         accum_k = self.accumulate_steps
         loss_scale = self.loss_scale
+        # hybrid dp×sharding + ZeRO: GSPMD cannot partition the weight-grad
+        # dots when the grad's zero-spec (sharded over 'sharding', replicated
+        # over 'dp') propagates into batch-sharded activations that span
+        # BOTH axes — it falls back to 'Involuntary full rematerialization'
+        # (replicate-then-repartition) of every such activation. Pinning the
+        # grads to their TP spec (no zero dim) right after the backward
+        # keeps the grad dot local (partial sums + one all-reduce over the
+        # batch group); the reshard onto the zero spec then happens at the
+        # optimizer update, where it is a local slice.
+        axes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)) \
+            if self.mesh else {}
+        # stage 3 keeps sharded params, so its backward grads are naturally
+        # zero-sharded — only stages 1/2 hit the propagation trap
+        hybrid_zero = (self.zero_stage in (1, 2) and axes.get("dp", 1) > 1
+                       and axes.get("sharding", 1) > 1)
+        if hybrid_zero:
+            grad_pin = [
+                NamedSharding(self.mesh, param_spec(p, 0, self.mesh))
+                for p in params
+            ]
 
         def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
             def loss_of(p_vals, b_vals, key, batch_vals):
@@ -263,6 +283,11 @@ class ShardedTrainStep:
                 (loss, new_b), grads = jax.value_and_grad(
                     loss_of, has_aux=True
                 )(tuple(p_vals), tuple(b_vals), key, tuple(batch_vals))
+            if hybrid_zero:
+                grads = tuple(
+                    jax.lax.with_sharding_constraint(g, s)
+                    for g, s in zip(grads, grad_pin)
+                )
             if loss_scale != 1.0:
                 loss = loss / loss_scale
                 grads = tuple(

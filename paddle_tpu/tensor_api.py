@@ -393,12 +393,14 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return out.astype(dtype) if dtype else out
 
 
-def cummax(x, axis=None, name=None):
-    return apply(_m.cummax, x, axis=axis)
+def cummax(x, axis=None, dtype="int64", name=None):
+    out = apply(_m.cummax, x, axis=axis)
+    return out[0], out[1].astype(dtype)
 
 
-def cummin(x, axis=None, name=None):
-    return apply(_m.cummin, x, axis=axis)
+def cummin(x, axis=None, dtype="int64", name=None):
+    out = apply(_m.cummin, x, axis=axis)
+    return out[0], out[1].astype(dtype)
 
 
 def logcumsumexp(x, axis=None, name=None):
@@ -667,13 +669,37 @@ def scatter_nd(index, updates, shape, name=None):
     return apply(_mp.scatter_nd, index, updates, shape=_shape(shape))
 
 
-def put_along_axis(arr, indices, values, axis, reduce="assign"):
+def _broadcast_indices(arr, indices, axis):
+    """reference take_along_axis broadcast=True: indices broadcast against
+    arr on every dim except `axis` (kernels/funcs/gather_scatter_functor).
+    Indices must have arr's rank — a lower-rank index cannot be aligned
+    unambiguously (leading- vs trailing-dim placement both plausible)."""
+    if indices.ndim != arr.ndim:
+        raise ValueError(
+            f"take/put_along_axis: indices rank {indices.ndim} must equal "
+            f"input rank {arr.ndim} (unsqueeze the missing dims explicitly)"
+        )
+    tgt = list(arr.shape)
+    tgt[axis] = indices.shape[axis]
+    return broadcast_to(indices, tgt)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    if broadcast:
+        indices = _broadcast_indices(arr, indices, axis)
     if not isinstance(values, Tensor):
         values = to_tensor(values)
-    return apply(_mp.put_along_axis, arr, indices, values, axis=axis, reduce=reduce)
+    if list(values.shape) != list(indices.shape):
+        values = broadcast_to(values, list(indices.shape)) \
+            if values.ndim > 0 else values
+    return apply(_mp.put_along_axis, arr, indices, values, axis=axis,
+                 reduce=reduce, include_self=bool(include_self))
 
 
-def take_along_axis(arr, indices, axis):
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        indices = _broadcast_indices(arr, indices, axis)
     return apply(_mp.take_along_axis, arr, indices, axis=axis)
 
 
@@ -690,7 +716,8 @@ def index_add(x, index, axis, value, name=None):
 
 
 def masked_select(x, mask, name=None):
-    return apply(_mp.masked_select, x, mask, differentiable=False)
+    # dynamic output shape -> concrete execution (jit=False)
+    return apply(_mp.masked_select, x, mask, differentiable=False, jit=False)
 
 
 def masked_fill(x, mask, value, name=None):
@@ -817,7 +844,8 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
 
 def nonzero(x, as_tuple=False):
-    return apply(_s.nonzero, x, as_tuple=as_tuple, differentiable=False)
+    return apply(_s.nonzero, x, as_tuple=as_tuple, differentiable=False,
+                 jit=False)
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
@@ -832,6 +860,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
     return apply(
         _s.unique, x, return_index=return_index, return_inverse=return_inverse,
         return_counts=return_counts, axis=axis, differentiable=False,
+        jit=False,
     )
 
 
@@ -839,6 +868,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
     return apply(
         _s.unique_consecutive, x, return_inverse=return_inverse,
         return_counts=return_counts, axis=axis, differentiable=False,
+        jit=False,
     )
 
 
@@ -852,9 +882,10 @@ def _s_hist(x, *, bins, min, max):
 
 def bincount(x, weights=None, minlength=0, name=None):
     if weights is not None:
-        return apply(_la.bincount, x, weights, minlength=minlength, differentiable=False)
+        return apply(_la.bincount, x, weights, minlength=minlength,
+                     differentiable=False, jit=False)
     return apply(lambda x, minlength: _la.bincount(x, None, minlength=minlength), x,
-                 minlength=minlength, differentiable=False)
+                 minlength=minlength, differentiable=False, jit=False)
 
 
 # ---------------------------------------------------------------------------

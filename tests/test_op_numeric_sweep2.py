@@ -1,0 +1,798 @@
+"""OpTest-style numeric sweep, part 2: the broad surface.
+
+Reference analogue: unittests/op_test.py:1803 (check_output vs numpy +
+check_grad vs central finite differences) applied across manipulation /
+linalg / search / loss / norm / activation ops, with bf16-aware tolerance
+tiers. Together with test_op_numeric_sweep.py this forms the 300+-case
+parametrized sweep (VERDICT r3 task 3).
+
+Every case checks the FORWARD against a numpy reference (when one exists)
+and, for differentiable float ops, the ANALYTIC tape gradient against
+central finite differences of a randomly-weighted scalar loss — the
+weighting catches wrong off-diagonal Jacobian structure that a plain sum
+would miss.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+def _mk(spec):
+    """spec: (shape, kind) -> numpy array."""
+    shape, kind = spec
+    if kind == "std":
+        return RNG.standard_normal(shape).astype(np.float32)
+    if kind == "pos":
+        return RNG.uniform(0.2, 2.0, shape).astype(np.float32)
+    if kind == "unit":  # (-0.9, 0.9) for atanh/asin/logit domains
+        return RNG.uniform(-0.9, 0.9, shape).astype(np.float32)
+    if kind == "unit01":  # (0.05, 0.95) probabilities
+        return RNG.uniform(0.05, 0.95, shape).astype(np.float32)
+    if kind == "gt1":  # (1.1, 2.5) for acosh
+        return RNG.uniform(1.1, 2.5, shape).astype(np.float32)
+    if kind == "int":
+        return RNG.integers(0, 5, shape).astype(np.int64)
+    if kind == "int1":  # nonzero ints (divisors)
+        return RNG.integers(1, 6, shape).astype(np.int64)
+    if kind == "bool":
+        return RNG.integers(0, 2, shape).astype(bool)
+    if kind == "pd":  # positive definite
+        a = RNG.standard_normal(shape).astype(np.float32)
+        return a @ a.T + shape[0] * np.eye(shape[0], dtype=np.float32)
+    if kind == "spread":  # well-separated values: stable sort/median/FD
+        flat = np.arange(int(np.prod(shape)), dtype=np.float32)
+        RNG.shuffle(flat)
+        return (flat.reshape(shape) * 0.37 - 1.1).astype(np.float32)
+    raise ValueError(kind)
+
+
+def _weighted_loss(fn):
+    """fn(*tensors) -> weighted scalar; weights fixed per output shape."""
+    def loss(*tensors):
+        out = fn(*tensors)
+        arr = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+        w = np.linspace(0.3, 1.7, arr.size, dtype=np.float32).reshape(arr.shape)
+        return (out * paddle.to_tensor(w)).sum()
+    return loss
+
+
+def _fd(loss, arrays, wrt, eps):
+    """Central finite differences of loss wrt arrays[wrt]."""
+    base = [a.copy() for a in arrays]
+    x = base[wrt]
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = [a.copy() for a in base]
+        xm = [a.copy() for a in base]
+        xp[wrt][idx] += eps
+        xm[wrt][idx] -= eps
+        lp = float(loss(*[paddle.to_tensor(a) for a in xp]).numpy())
+        lm = float(loss(*[paddle.to_tensor(a) for a in xm]).numpy())
+        g[idx] = (lp - lm) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def run_case(fn, ref, specs, grad=True, rtol=1e-5, atol=1e-6,
+             grad_rtol=2e-2, grad_atol=2e-3, eps=1e-3, grad_wrt=(0,)):
+    arrays = [_mk(s) for s in specs]
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = fn(*tensors)
+    out_np = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    if ref is not None:
+        expect = np.asarray(ref(*arrays))
+        np.testing.assert_allclose(
+            out_np.astype(np.float64), expect.astype(np.float64),
+            rtol=rtol, atol=atol,
+        )
+    if grad:
+        loss = _weighted_loss(fn)
+        for w in grad_wrt:
+            if arrays[w].dtype not in (np.float32, np.float64):
+                continue
+            ts = [paddle.to_tensor(a, stop_gradient=(i != w))
+                  for i, a in enumerate(arrays)]
+            lv = loss(*ts)
+            lv.backward()
+            analytic = ts[w].grad.numpy()
+            numeric = _fd(loss, arrays, w, eps)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=grad_rtol, atol=grad_atol,
+                err_msg=f"grad mismatch wrt input {w}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# case tables — (id, fn, numpy ref or None, input specs, kwargs)
+# ---------------------------------------------------------------------------
+S = (2, 3)
+
+UNARY2 = [
+    ("asin", lambda x: paddle.asin(x), np.arcsin, [(S, "unit")], {}),
+    ("acos", lambda x: paddle.acos(x), np.arccos, [(S, "unit")], {}),
+    ("atan", lambda x: paddle.atan(x), np.arctan, [(S, "std")], {}),
+    ("tan", lambda x: paddle.tan(x), np.tan, [(S, "unit")], {}),
+    ("sinh", lambda x: paddle.sinh(x), np.sinh, [(S, "std")], {}),
+    ("cosh", lambda x: paddle.cosh(x), np.cosh, [(S, "std")], {}),
+    ("asinh", lambda x: paddle.asinh(x), np.arcsinh, [(S, "std")], {}),
+    ("acosh", lambda x: paddle.acosh(x), np.arccosh, [(S, "gt1")], {}),
+    ("atanh", lambda x: paddle.atanh(x), np.arctanh, [(S, "unit")], {}),
+    ("log2", lambda x: paddle.log2(x), np.log2, [(S, "pos")], {}),
+    ("log10", lambda x: paddle.log10(x), np.log10, [(S, "pos")], {}),
+    ("logit", lambda x: paddle.logit(x),
+     lambda v: np.log(v / (1 - v)), [(S, "unit01")], {}),
+    ("lgamma", lambda x: paddle.lgamma(x),
+     np.vectorize(math.lgamma, otypes=[np.float32]), [(S, "pos")], {}),
+    ("digamma", lambda x: paddle.digamma(x), None, [(S, "pos")], {}),
+    ("erfinv", lambda x: paddle.erfinv(x), None, [(S, "unit")], {}),
+    ("trunc", lambda x: paddle.trunc(x), np.trunc, [(S, "spread")],
+     dict(grad=False)),
+    ("frac", lambda x: paddle.frac(x), lambda v: v - np.trunc(v),
+     [(S, "spread")], {}),
+    ("rad2deg", lambda x: paddle.rad2deg(x), np.degrees, [(S, "std")], {}),
+    ("deg2rad", lambda x: paddle.deg2rad(x), np.radians, [(S, "std")], {}),
+    ("neg", lambda x: -x, np.negative, [(S, "std")], {}),
+    ("exponent_pow3", lambda x: paddle.pow(x, 3.0), lambda v: v ** 3,
+     [(S, "std")], {}),
+    ("rsqrt_grad", lambda x: paddle.rsqrt(x), lambda v: 1 / np.sqrt(v),
+     [(S, "pos")], {}),
+]
+
+ACTS = [
+    ("relu", F.relu, lambda v: np.maximum(v, 0), [(S, "spread")], {}),
+    ("relu6", F.relu6, lambda v: np.clip(v, 0, 6), [(S, "spread")], {}),
+    ("elu", F.elu, lambda v: np.where(v > 0, v, np.expm1(v)), [(S, "spread")], {}),
+    ("selu", F.selu, None, [(S, "spread")], {}),
+    ("celu", F.celu, lambda v: np.maximum(v, 0) + np.minimum(0, np.expm1(v)),
+     [(S, "spread")], {}),
+    ("silu", F.silu, lambda v: v / (1 + np.exp(-v)), [(S, "std")], {}),
+    ("gelu", F.gelu, lambda v: 0.5 * v * (1 + np.vectorize(math.erf)(v / np.sqrt(2))),
+     [(S, "std")], dict(rtol=1e-4, atol=1e-5)),
+    ("mish", F.mish, lambda v: v * np.tanh(np.log1p(np.exp(v))), [(S, "std")], {}),
+    ("softplus", F.softplus, lambda v: np.log1p(np.exp(v)), [(S, "std")], {}),
+    ("softsign", F.softsign, lambda v: v / (1 + np.abs(v)), [(S, "std")], {}),
+    ("hardtanh", F.hardtanh, lambda v: np.clip(v, -1, 1), [(S, "spread")], {}),
+    ("hardsigmoid", F.hardsigmoid, None, [(S, "spread")], {}),
+    ("hardswish", F.hardswish, None, [(S, "spread")], {}),
+    ("leaky_relu", lambda x: F.leaky_relu(x, 0.1),
+     lambda v: np.where(v > 0, v, 0.1 * v), [(S, "spread")], {}),
+    ("tanhshrink", F.tanhshrink, lambda v: v - np.tanh(v), [(S, "std")], {}),
+    ("softshrink", lambda x: F.softshrink(x, 0.3),
+     lambda v: np.where(v > 0.3, v - 0.3, np.where(v < -0.3, v + 0.3, 0)),
+     [(S, "spread")], {}),
+    ("hardshrink", lambda x: F.hardshrink(x, 0.3),
+     lambda v: np.where(np.abs(v) > 0.3, v, 0), [(S, "spread")], {}),
+    ("log_sigmoid", F.log_sigmoid,
+     lambda v: -np.log1p(np.exp(-v)), [(S, "std")], {}),
+    ("glu", lambda x: F.glu(x, axis=-1), None, [((2, 4), "std")], {}),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1),
+     lambda v: v - np.log(np.exp(v).sum(-1, keepdims=True))
+     - 0 * v, [(S, "std")], {}),
+]
+
+BINARY2 = [
+    ("floor_divide", lambda a, b: paddle.floor_divide(a, b),
+     np.floor_divide, [(S, "int"), (S, "int1")], dict(grad=False)),
+    ("remainder", lambda a, b: paddle.remainder(a, b), np.mod,
+     [(S, "pos"), (S, "pos")], dict(grad=False)),
+    ("fmin", lambda a, b: paddle.fmin(a, b), np.fmin,
+     [(S, "spread"), (S, "pos")], dict(grad_wrt=(0, 1))),
+    ("heaviside", lambda a, b: paddle.heaviside(a, b), np.heaviside,
+     [(S, "spread"), (S, "pos")], dict(grad=False)),
+    ("lerp", lambda a, b: paddle.lerp(a, b, 0.3),
+     lambda x, y: x + 0.3 * (y - x), [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0, 1))),
+    ("hypot", lambda a, b: (a ** 2 + b ** 2) ** 0.5, np.hypot,
+     [(S, "pos"), (S, "pos")], dict(grad_wrt=(0, 1))),
+    ("logaddexp", lambda a, b: paddle.logsumexp(paddle.stack([a, b]), axis=0),
+     np.logaddexp, [(S, "std"), (S, "std")], dict(grad_wrt=(0, 1))),
+    ("squared_diff", lambda a, b: (a - b) ** 2,
+     lambda x, y: (x - y) ** 2, [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0, 1))),
+    ("gcd", lambda a, b: paddle.gcd(a, b), np.gcd,
+     [(S, "int"), (S, "int")], dict(grad=False)),
+    ("lcm", lambda a, b: paddle.lcm(a, b), np.lcm,
+     [(S, "int"), (S, "int")], dict(grad=False)),
+]
+
+COMPARE = [
+    ("equal", paddle.equal, np.equal),
+    ("not_equal", paddle.not_equal, np.not_equal),
+    ("less_than", paddle.less_than, np.less),
+    ("less_equal", paddle.less_equal, np.less_equal),
+    ("greater_than", paddle.greater_than, np.greater),
+    ("greater_equal", paddle.greater_equal, np.greater_equal),
+]
+
+LOGICAL = [
+    ("logical_and", paddle.logical_and, np.logical_and),
+    ("logical_or", paddle.logical_or, np.logical_or),
+    ("logical_xor", paddle.logical_xor, np.logical_xor),
+]
+
+BITWISE = [
+    ("bitwise_and", paddle.bitwise_and, np.bitwise_and),
+    ("bitwise_or", paddle.bitwise_or, np.bitwise_or),
+    ("bitwise_xor", paddle.bitwise_xor, np.bitwise_xor),
+]
+
+REDUCE2 = [
+    ("amax", lambda x, ax: paddle.amax(x, axis=ax), np.max, "spread"),
+    ("amin", lambda x, ax: paddle.amin(x, axis=ax), np.min, "spread"),
+    ("nansum", lambda x, ax: paddle.nansum(x, axis=ax), np.nansum, "std"),
+    ("nanmean", lambda x, ax: paddle.nanmean(x, axis=ax), np.nanmean, "std"),
+    ("count_nonzero", lambda x, ax: paddle.count_nonzero(x, axis=ax),
+     np.count_nonzero, "int"),
+    ("median", lambda x, ax: paddle.median(x, axis=ax), np.median, "spread"),
+    ("cumprod_ax", lambda x, ax: paddle.cumprod(x, dim=0 if ax is None else ax),
+     lambda v, axis: np.cumprod(v, axis=0 if axis is None else axis), "pos"),
+    ("cummax_vals", lambda x, ax: paddle.cummax(
+        x, axis=0 if ax is None else ax)[0],
+     lambda v, axis: np.maximum.accumulate(v, axis=0 if axis is None else axis),
+     "spread"),
+    ("cummin_vals", lambda x, ax: paddle.cummin(
+        x, axis=0 if ax is None else ax)[0],
+     lambda v, axis: np.minimum.accumulate(v, axis=0 if axis is None else axis),
+     "spread"),
+]
+
+LINALG = [
+    ("matmul_2d", lambda a, b: paddle.matmul(a, b), np.matmul,
+     [((3, 4), "std"), ((4, 2), "std")], dict(grad_wrt=(0, 1), rtol=1e-4,
+                                              atol=1e-5)),
+    ("matmul_batched", lambda a, b: paddle.matmul(a, b), np.matmul,
+     [((2, 3, 4), "std"), ((2, 4, 2), "std")],
+     dict(grad_wrt=(0, 1), rtol=1e-4, atol=1e-5)),
+    ("matmul_tA", lambda a, b: paddle.matmul(a, b, transpose_x=True),
+     lambda x, y: x.T @ y, [((4, 3), "std"), ((4, 2), "std")],
+     dict(grad_wrt=(0, 1), rtol=1e-4, atol=1e-5)),
+    ("bmm", lambda a, b: paddle.bmm(a, b), np.matmul,
+     [((2, 3, 4), "std"), ((2, 4, 2), "std")],
+     dict(grad_wrt=(0, 1), rtol=1e-4, atol=1e-5)),
+    ("dot", lambda a, b: paddle.dot(a, b), np.dot,
+     [((5,), "std"), ((5,), "std")], dict(grad_wrt=(0, 1))),
+    ("outer", lambda a, b: paddle.outer(a, b), np.outer,
+     [((3,), "std"), ((4,), "std")], dict(grad_wrt=(0, 1))),
+    ("inner", lambda a, b: paddle.inner(a, b), np.inner,
+     [((2, 4), "std"), ((3, 4), "std")], dict(grad_wrt=(0, 1))),
+    ("trace", lambda x: paddle.trace(x), np.trace, [((4, 4), "std")], {}),
+    ("diag_vec", lambda x: paddle.diag(x), np.diag, [((4,), "std")], {}),
+    ("diagonal", lambda x: paddle.diagonal(x),
+     lambda v: np.diagonal(v), [((3, 4), "std")], {}),
+    ("cross", lambda a, b: paddle.cross(a, b), np.cross,
+     [((2, 3), "std"), ((2, 3), "std")], dict(grad_wrt=(0, 1))),
+    ("kron", lambda a, b: paddle.kron(a, b), np.kron,
+     [((2, 2), "std"), ((2, 3), "std")], dict(grad_wrt=(0, 1))),
+    ("norm_fro", lambda x: paddle.linalg.norm(x),
+     lambda v: np.linalg.norm(v), [((3, 4), "std")], {}),
+    ("norm_1", lambda x: paddle.linalg.norm(x, p=1, axis=1),
+     lambda v: np.abs(v).sum(1), [((3, 4), "spread")], {}),
+    ("norm_inf", lambda x: paddle.linalg.norm(x, p=np.inf, axis=1),
+     lambda v: np.abs(v).max(1), [((3, 4), "spread")], {}),
+    ("det", lambda x: paddle.linalg.det(x), np.linalg.det,
+     [((3, 3), "pd")], dict(rtol=1e-4, atol=1e-4, grad_rtol=4e-2)),
+    ("inv", lambda x: paddle.linalg.inv(x), np.linalg.inv,
+     [((3, 3), "pd")], dict(rtol=1e-4, atol=1e-4, grad_rtol=4e-2)),
+    ("cholesky", lambda x: paddle.linalg.cholesky(x), np.linalg.cholesky,
+     [((3, 3), "pd")], dict(rtol=1e-4, atol=1e-4, grad_rtol=4e-2)),
+    ("solve", lambda a, b: paddle.linalg.solve(a, b),
+     np.linalg.solve, [((3, 3), "pd"), ((3, 2), "std")],
+     dict(rtol=1e-4, atol=1e-4, grad_wrt=(1,), grad_rtol=4e-2)),
+    ("slogdet_logdet", lambda x: paddle.linalg.slogdet(x)[1],
+     lambda v: np.linalg.slogdet(v)[1], [((3, 3), "pd")],
+     dict(rtol=1e-4, atol=1e-4, grad_rtol=4e-2)),
+    ("eigvalsh", lambda x: paddle.linalg.eigvalsh(x), np.linalg.eigvalsh,
+     [((3, 3), "pd")], dict(rtol=1e-4, atol=1e-4, grad=False)),
+    ("svdvals", lambda x: paddle.linalg.svd(x)[1],
+     lambda v: np.linalg.svd(v, compute_uv=False), [((3, 4), "std")],
+     dict(rtol=1e-4, atol=1e-4, grad=False)),
+    ("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+     lambda v: np.linalg.matrix_power(v, 3), [((3, 3), "std")],
+     dict(rtol=1e-4, atol=1e-4, grad_rtol=4e-2, grad_atol=1e-2)),
+    ("pinv", lambda x: paddle.linalg.pinv(x), np.linalg.pinv,
+     [((4, 3), "std")], dict(rtol=1e-3, atol=1e-4, grad=False)),
+    ("multi_dot", lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
+     lambda x, y, z: x @ y @ z,
+     [((2, 3), "std"), ((3, 4), "std"), ((4, 2), "std")],
+     dict(grad_wrt=(0, 1, 2), rtol=1e-4, atol=1e-5)),
+    ("addmm", lambda a, b, c: paddle.addmm(a, b, c, alpha=0.5, beta=2.0),
+     lambda i, x, y: 2.0 * i + 0.5 * (x @ y),
+     [((2, 2), "std"), ((2, 3), "std"), ((3, 2), "std")],
+     dict(grad_wrt=(0, 1, 2), rtol=1e-4, atol=1e-5)),
+]
+
+EINSUM = [
+    ("einsum_ij_jk", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+     lambda x, y: x @ y, [((2, 3), "std"), ((3, 4), "std")],
+     dict(grad_wrt=(0, 1), rtol=1e-4, atol=1e-5)),
+    ("einsum_trace", lambda a: paddle.einsum("ii->", a), np.trace,
+     [((4, 4), "std")], {}),
+    ("einsum_transpose", lambda a: paddle.einsum("ij->ji", a),
+     lambda v: v.T, [((3, 4), "std")], {}),
+    ("einsum_outer", lambda a, b: paddle.einsum("i,j->ij", a, b), np.outer,
+     [((3,), "std"), ((4,), "std")], dict(grad_wrt=(0, 1))),
+    ("einsum_bhqk", lambda a, b: paddle.einsum("bqd,bkd->bqk", a, b),
+     lambda x, y: np.einsum("bqd,bkd->bqk", x, y),
+     [((2, 3, 4), "std"), ((2, 5, 4), "std")],
+     dict(grad_wrt=(0, 1), rtol=1e-4, atol=1e-5)),
+    ("einsum_sum", lambda a: paddle.einsum("ij->i", a),
+     lambda v: v.sum(1), [((3, 4), "std")], {}),
+]
+
+SEARCH = [
+    ("argmax", lambda x: paddle.argmax(x, axis=1),
+     lambda v: np.argmax(v, 1), [(S, "spread")], dict(grad=False)),
+    ("argmin", lambda x: paddle.argmin(x, axis=1),
+     lambda v: np.argmin(v, 1), [(S, "spread")], dict(grad=False)),
+    ("index_select", lambda x: paddle.index_select(
+        x, paddle.to_tensor(np.array([2, 0])), axis=1),
+     lambda v: v[:, [2, 0]], [(S, "std")], {}),
+    ("masked_select", lambda x: paddle.masked_select(
+        x, paddle.to_tensor(np.array([[True, False, True],
+                                      [False, True, False]]))),
+     lambda v: v[np.array([[True, False, True], [False, True, False]])],
+     [(S, "std")], dict(grad=False)),
+    ("nonzero", lambda x: paddle.nonzero(x),
+     lambda v: np.argwhere(v), [(S, "int")], dict(grad=False)),
+    ("unique", lambda x: paddle.unique(x), np.unique,
+     [((8,), "int")], dict(grad=False)),
+    ("searchsorted", lambda s, v: paddle.searchsorted(s, v),
+     np.searchsorted,
+     [((6,), None), ((4,), None)], dict(grad=False)),
+    ("bucketize", lambda v: paddle.bucketize(
+        v, paddle.to_tensor(np.array([0.0, 1.0, 2.0], np.float32))),
+     lambda v: np.searchsorted(np.array([0.0, 1.0, 2.0]), v),
+     [(S, "pos")], dict(grad=False)),
+    ("take_along_axis", lambda x: paddle.take_along_axis(
+        x, paddle.to_tensor(np.array([[0, 2, 1]])), axis=0, broadcast=False),
+     lambda v: np.take_along_axis(v, np.array([[0, 2, 1]]), 0),
+     [((3, 3), "std")], {}),
+    ("gather_nd", lambda x: paddle.gather_nd(
+        x, paddle.to_tensor(np.array([[0, 1], [1, 2]]))),
+     lambda v: v[[0, 1], [1, 2]], [(S, "std")], {}),
+    ("kthvalue", lambda x: paddle.kthvalue(x, 2, axis=1)[0],
+     lambda v: np.sort(v, 1)[:, 1], [(S, "spread")], {}),
+    ("mode_vals", lambda x: paddle.mode(x, axis=1)[0], None,
+     [(S, "int")], dict(grad=False)),
+    ("isclose", lambda a, b: paddle.isclose(a, b), np.isclose,
+     [(S, "std"), (S, "std")], dict(grad=False)),
+    ("diff", lambda x: paddle.diff(x, axis=1),
+     lambda v: np.diff(v, axis=1), [(S, "std")], {}),
+    ("histogram", lambda x: paddle.histogram(x, bins=4, min=-2.0, max=2.0),
+     lambda v: np.histogram(v, bins=4, range=(-2, 2))[0],
+     [((10,), "unit")], dict(grad=False)),
+    ("bincount", lambda x: paddle.bincount(x, minlength=6),
+     lambda v: np.bincount(v, minlength=6), [((10,), "int")],
+     dict(grad=False)),
+]
+# searchsorted needs sorted first input — special-case its arrays
+SEARCHSORTED_SORTED = np.sort(RNG.standard_normal(6).astype(np.float32))
+
+MANIP2 = [
+    ("stack", lambda a, b: paddle.stack([a, b], axis=1),
+     lambda x, y: np.stack([x, y], 1), [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0, 1))),
+    ("unstack0", lambda x: paddle.unstack(x, axis=0)[1],
+     lambda v: v[1], [(S, "std")], {}),
+    ("chunk", lambda x: paddle.chunk(x, 3, axis=1)[2],
+     lambda v: np.split(v, 3, 1)[2], [(S, "std")], {}),
+    ("expand", lambda x: paddle.expand(x, [4, 2, 3]),
+     lambda v: np.broadcast_to(v, (4, 2, 3)), [(S, "std")], {}),
+    ("broadcast_to", lambda x: paddle.broadcast_to(x, [2, 2, 3]),
+     lambda v: np.broadcast_to(v, (2, 2, 3)), [(S, "std")], {}),
+    ("flatten", lambda x: paddle.flatten(x),
+     lambda v: v.reshape(-1), [(S, "std")], {}),
+    ("repeat_interleave", lambda x: paddle.repeat_interleave(x, 2, axis=1),
+     lambda v: np.repeat(v, 2, 1), [(S, "std")], {}),
+    ("rot90", lambda x: paddle.rot90(x),
+     lambda v: np.rot90(v), [(S, "std")], {}),
+    ("moveaxis", lambda x: paddle.moveaxis(x, 0, 1),
+     lambda v: np.moveaxis(v, 0, 1), [(S, "std")], {}),
+    ("tril", lambda x: paddle.tril(x), np.tril, [((3, 3), "std")], {}),
+    ("triu", lambda x: paddle.triu(x), np.triu, [((3, 3), "std")], {}),
+    ("pad_constant", lambda x: F.pad(x, [1, 1], value=0.5),
+     lambda v: np.pad(v, ((0, 0), (1, 1)), constant_values=0.5),
+     [(S, "std")], {}),
+    ("pad2d_reflect", lambda x: F.pad(x, [1, 1, 1, 1], mode="reflect",
+                                      data_format="NCHW"),
+     lambda v: np.pad(v, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="reflect"),
+     [((1, 1, 3, 4), "std")], {}),
+    ("pad2d_replicate", lambda x: F.pad(x, [1, 1, 1, 1], mode="replicate",
+                                        data_format="NCHW"),
+     lambda v: np.pad(v, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="edge"),
+     [((1, 1, 3, 4), "std")], {}),
+    ("as_real_imag", lambda x: paddle.stack(
+        [x.sin(), x.cos()], axis=-1).sum(-1),
+     lambda v: np.sin(v) + np.cos(v), [(S, "std")], {}),
+    ("slice_strided", lambda x: x[:, ::2],
+     lambda v: v[:, ::2], [((2, 6), "std")], {}),
+    ("flip_all", lambda x: paddle.flip(x, axis=[0, 1]),
+     lambda v: v[::-1, ::-1], [(S, "std")], {}),
+    ("scatter", lambda x: paddle.scatter(
+        x, paddle.to_tensor(np.array([0, 1])),
+        paddle.to_tensor(np.zeros((2, 3), np.float32)), overwrite=True),
+     lambda v: np.concatenate([np.zeros((2, 3), np.float32)], 0)
+     if v.shape[0] == 2 else None, [(S, "std")], dict(ref=None, grad=True)),
+    ("put_along_axis", lambda x: paddle.put_along_axis(
+        x, paddle.to_tensor(np.array([[0], [1]])), 9.0, axis=1,
+        broadcast=False),
+     None, [(S, "std")], {}),
+    ("meshgrid_x", lambda a, b: paddle.meshgrid(a, b)[0],
+     lambda x, y: np.meshgrid(x, y, indexing="ij")[0],
+     [((3,), "std"), ((4,), "std")], {}),
+    ("tensordot", lambda a, b: paddle.tensordot(a, b, axes=1),
+     lambda x, y: np.tensordot(x, y, axes=1),
+     [((3, 4), "std"), ((4, 2), "std")],
+     dict(grad_wrt=(0, 1), rtol=1e-4, atol=1e-5)),
+]
+
+LOSSES = [
+    ("mse", lambda x, y: F.mse_loss(x, y),
+     lambda a, b: np.mean((a - b) ** 2), [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0,))),
+    ("l1", lambda x, y: F.l1_loss(x, y),
+     lambda a, b: np.mean(np.abs(a - b)), [(S, "spread"), (S, "pos")],
+     dict(grad_wrt=(0,))),
+    ("smooth_l1", lambda x, y: F.smooth_l1_loss(x, y), None,
+     [(S, "std"), (S, "std")], dict(grad_wrt=(0,))),
+    ("huber_like", lambda x, y: F.smooth_l1_loss(x, y, delta=0.5), None,
+     [(S, "std"), (S, "std")], dict(grad_wrt=(0,))),
+    ("bce", lambda x, y: F.binary_cross_entropy(x, y),
+     lambda p, t: np.mean(-(t * np.log(p) + (1 - t) * np.log(1 - p))),
+     [(S, "unit01"), (S, "unit01")], dict(grad_wrt=(0,))),
+    ("bce_logits", lambda x, y: F.binary_cross_entropy_with_logits(x, y),
+     lambda z, t: np.mean(np.maximum(z, 0) - z * t + np.log1p(np.exp(-np.abs(z)))),
+     [(S, "std"), (S, "unit01")], dict(grad_wrt=(0,))),
+    ("kl_div", lambda x, y: F.kl_div(x, y, reduction="mean"), None,
+     [(S, "std"), (S, "unit01")], dict(grad_wrt=(0,))),
+    ("log_loss", lambda x, y: F.log_loss(x, y).mean(), None,
+     [(S, "unit01"), (S, "unit01")], dict(grad_wrt=(0,))),
+    ("square_error_cost", lambda x, y: F.square_error_cost(x, y),
+     lambda a, b: (a - b) ** 2, [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0,))),
+    ("cosine_sim", lambda x, y: F.cosine_similarity(x, y, axis=1), None,
+     [(S, "std"), (S, "std")], dict(grad_wrt=(0, 1))),
+    ("margin_ranking", lambda a, b: F.margin_ranking_loss(
+        a, b, paddle.to_tensor(np.ones(S, np.float32)), margin=0.1), None,
+     [(S, "std"), (S, "std")], dict(grad_wrt=(0,))),
+]
+
+
+def _softmax_np(v, axis):
+    e = np.exp(v - v.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+NORMS = [
+    ("softmax_ax0", lambda x: F.softmax(x, axis=0),
+     lambda v: _softmax_np(v, 0), [(S, "std")], {}),
+    ("softmax_ax1", lambda x: F.softmax(x, axis=1),
+     lambda v: _softmax_np(v, 1), [(S, "std")], {}),
+    ("normalize_l2", lambda x: F.normalize(x, p=2, axis=1),
+     lambda v: v / np.linalg.norm(v, axis=1, keepdims=True), [(S, "std")], {}),
+    ("normalize_l1", lambda x: F.normalize(x, p=1, axis=1),
+     lambda v: v / np.abs(v).sum(1, keepdims=True), [(S, "pos")], {}),
+    ("layer_norm", lambda x: F.layer_norm(x, (3,)),
+     lambda v: (v - v.mean(-1, keepdims=True))
+     / np.sqrt(v.var(-1, keepdims=True) + 1e-5), [(S, "std")],
+     dict(rtol=1e-4, atol=1e-5)),
+    ("lrn", lambda x: F.local_response_norm(x, size=3), None,
+     [((1, 4, 3, 3), "pos")], {}),
+]
+
+
+EXTRA = [
+    ("clip_grad", lambda x: paddle.clip(x, -0.5, 0.8),
+     lambda v: np.clip(v, -0.5, 0.8), [(S, "spread")], {}),
+    ("lerp_tensor_w", lambda a, b, w: paddle.lerp(a, b, w),
+     lambda x, y, t: x + t * (y - x),
+     [(S, "std"), (S, "std"), (S, "unit01")], dict(grad_wrt=(0, 1, 2))),
+    ("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
+     lambda v: np.log(np.cumsum(np.exp(v), 1)), [(S, "std")], {}),
+    ("quantile_med", lambda x: paddle.quantile(x, 0.5, axis=1),
+     lambda v: np.quantile(v, 0.5, axis=1), [((3, 5), "spread")],
+     dict(grad=False)),
+    ("nanquantile", lambda x: paddle.nanquantile(x, 0.25, axis=1),
+     lambda v: np.nanquantile(v, 0.25, axis=1), [((3, 5), "spread")],
+     dict(grad=False)),
+    ("std_unbiased", lambda x: paddle.std(x, axis=1, unbiased=True),
+     lambda v: np.std(v, axis=1, ddof=1), [(S, "std")], {}),
+    ("var_biased", lambda x: paddle.var(x, axis=1, unbiased=False),
+     lambda v: np.var(v, axis=1), [(S, "std")], {}),
+    ("norm_p3", lambda x: paddle.linalg.norm(x, p=3, axis=1),
+     lambda v: (np.abs(v) ** 3).sum(1) ** (1 / 3), [(S, "pos")], {}),
+    ("concat_ax1", lambda a, b: paddle.concat([a, b], axis=1),
+     lambda x, y: np.concatenate([x, y], 1), [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0, 1))),
+    ("stack_ax2", lambda a, b: paddle.stack([a, b], axis=2),
+     lambda x, y: np.stack([x, y], 2), [(S, "std"), (S, "std")],
+     dict(grad_wrt=(0, 1))),
+    ("gather_ax1", lambda x: paddle.gather(
+        x, paddle.to_tensor(np.array([1, 0, 2])), axis=1),
+     lambda v: v[:, [1, 0, 2]], [(S, "std")], {}),
+    ("expand_as", lambda a, b: paddle.expand_as(a, b),
+     lambda x, y: np.broadcast_to(x, y.shape),
+     [((1, 3), "std"), ((4, 3), "std")], {}),
+    ("squeeze_axes", lambda x: paddle.squeeze(x, axis=[0, 2]),
+     lambda v: v.reshape(3, 4), [((1, 3, 1, 4), "std")], {}),
+    ("unsqueeze_axes", lambda x: paddle.unsqueeze(x, axis=[0, 3]),
+     lambda v: v.reshape(1, 2, 3, 1), [(S, "std")], {}),
+    ("addcmul_like", lambda a, b, c: a + 0.5 * b * c,
+     lambda x, y, z: x + 0.5 * y * z,
+     [(S, "std"), (S, "std"), (S, "std")], dict(grad_wrt=(0, 1, 2))),
+    ("maximum_grad_routing", lambda a, b: paddle.maximum(a, b), np.maximum,
+     [(S, "spread"), (S, "pos")], dict(grad_wrt=(0, 1))),
+    ("minimum_grad_routing", lambda a, b: paddle.minimum(a, b), np.minimum,
+     [(S, "spread"), (S, "pos")], dict(grad_wrt=(0, 1))),
+    ("prod_grad", lambda x: paddle.prod(x, axis=1),
+     lambda v: np.prod(v, 1), [(S, "pos")], {}),
+    ("cumsum_grad_ax0", lambda x: paddle.cumsum(x, axis=0),
+     lambda v: np.cumsum(v, 0), [(S, "std")], {}),
+    ("softmax_3d", lambda x: F.softmax(x, axis=1),
+     lambda v: _softmax_np(v, 1), [((2, 3, 4), "std")], {}),
+    ("dist_l2", lambda a, b: paddle.dist(a, b, p=2),
+     lambda x, y: np.linalg.norm((x - y).reshape(-1)),
+     [(S, "std"), (S, "std")], dict(grad_wrt=(0,))),
+    ("t_2d", lambda x: paddle.t(x), lambda v: v.T, [(S, "std")], {}),
+    ("mv", lambda a, b: paddle.mv(a, b),
+     lambda m, v: m @ v, [((3, 4), "std"), ((4,), "std")],
+     dict(grad_wrt=(0, 1))),
+    ("renorm_ax0", lambda x: paddle.renorm(x, p=2.0, axis=0, max_norm=1.0),
+     None, [((3, 4), "std")], {}),
+    ("angle_abs_complexless", lambda x: paddle.abs(x) * paddle.sign(x),
+     lambda v: v, [(S, "spread")], {}),
+]
+
+
+def _cases():
+    out = []
+
+    def add(table, prefix):
+        for entry in table:
+            name, fn, ref, specs, kw = entry
+            out.append((f"{prefix}:{name}", fn, ref, specs, dict(kw)))
+
+    add(UNARY2, "unary")
+    add(ACTS, "act")
+    add(BINARY2, "binary")
+    add(LINALG, "linalg")
+    add(EINSUM, "einsum")
+    add(SEARCH, "search")
+    add(MANIP2, "manip")
+    add(LOSSES, "loss")
+    add(NORMS, "norm")
+    add(EXTRA, "extra")
+    for name, fn, ref in COMPARE:
+        out.append((f"cmp:{name}", fn, ref,
+                    [(S, "int"), (S, "int")], dict(grad=False)))
+    for name, fn, ref in LOGICAL:
+        out.append((f"logic:{name}", fn, ref,
+                    [(S, "bool"), (S, "bool")], dict(grad=False)))
+    for name, fn, ref in BITWISE:
+        out.append((f"bit:{name}", fn, ref,
+                    [(S, "int"), (S, "int")], dict(grad=False)))
+    return out
+
+
+CASES = _cases()
+
+
+@pytest.mark.parametrize(
+    "name,fn,ref,specs,kw", CASES, ids=[c[0] for c in CASES]
+)
+def test_op_numeric(name, fn, ref, specs, kw):
+    kw = dict(kw)
+    kw.pop("ref", None)
+    if name == "search:searchsorted":
+        # sorted-sequence precondition
+        s = paddle.to_tensor(SEARCHSORTED_SORTED)
+        v = paddle.to_tensor(_mk(((4,), "std")))
+        np.testing.assert_array_equal(
+            fn(s, v).numpy(), np.searchsorted(SEARCHSORTED_SORTED, v.numpy())
+        )
+        return
+    run_case(fn, ref, specs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# reductions over axes
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("axis", [None, 0, 1], ids=["axN", "ax0", "ax1"])
+@pytest.mark.parametrize(
+    "name,fn,ref,kind", REDUCE2, ids=[r[0] for r in REDUCE2]
+)
+def test_reduce2(name, fn, ref, kind, axis):
+    x = _mk(((3, 4), kind))
+    out = fn(paddle.to_tensor(x), axis)
+    out_np = out.numpy()
+    expect = np.asarray(ref(x, axis=axis))
+    np.testing.assert_allclose(
+        out_np.astype(np.float64), expect.astype(np.float64),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("axis", [0, 1], ids=["ax0", "ax1"])
+@pytest.mark.parametrize(
+    "name", ["sum", "mean", "max", "min", "logsumexp", "amax", "amin"]
+)
+def test_reduce_grads(name, axis):
+    """check_grad for reductions (max/min route gradient to the argmax)."""
+    fns = {
+        "sum": lambda x: paddle.sum(x, axis=axis),
+        "mean": lambda x: paddle.mean(x, axis=axis),
+        "max": lambda x: paddle.max(x, axis=axis),
+        "min": lambda x: paddle.min(x, axis=axis),
+        "logsumexp": lambda x: paddle.logsumexp(x, axis=axis),
+        "amax": lambda x: paddle.amax(x, axis=axis),
+        "amin": lambda x: paddle.amin(x, axis=axis),
+    }
+    run_case(fns[name], None, [((3, 4), "spread")])
+
+
+# ---------------------------------------------------------------------------
+# losses with integer labels (cross entropy family)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_cross_entropy_hard_labels(reduction):
+    logits = RNG.standard_normal((4, 5)).astype(np.float32)
+    labels = RNG.integers(0, 5, (4,)).astype(np.int64)
+    x = paddle.to_tensor(logits, stop_gradient=False)
+    out = F.cross_entropy(x, paddle.to_tensor(labels), reduction=reduction)
+    p = _softmax_np(logits, 1)
+    expect = -np.log(p[np.arange(4), labels])
+    if reduction == "mean":
+        expect = expect.mean()
+    elif reduction == "sum":
+        expect = expect.sum()
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5, atol=1e-6)
+    (out.sum() if reduction == "none" else out).backward()
+    g = x.grad.numpy()
+    scale = 1 / 4 if reduction == "mean" else 1.0
+    expect_g = (p - np.eye(5)[labels]) * scale
+    np.testing.assert_allclose(g, expect_g, rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_soft_labels():
+    logits = RNG.standard_normal((3, 4)).astype(np.float32)
+    soft = _softmax_np(RNG.standard_normal((3, 4)).astype(np.float32), 1)
+    out = F.cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True
+    )
+    expect = -(soft * np.log(_softmax_np(logits, 1))).sum(1).mean()
+    np.testing.assert_allclose(float(out), expect, rtol=1e-5)
+
+
+def test_nll_loss_matches_manual():
+    logp = np.log(_softmax_np(
+        RNG.standard_normal((4, 5)).astype(np.float32), 1))
+    labels = RNG.integers(0, 5, (4,)).astype(np.int64)
+    out = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(labels))
+    np.testing.assert_allclose(
+        float(out), -logp[np.arange(4), labels].mean(), rtol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# bf16 tier: forward within bf16 tolerance of the f32 reference
+# ---------------------------------------------------------------------------
+BF16_OPS = [
+    ("matmul", lambda a, b: paddle.matmul(a, b),
+     [((8, 16), "std"), ((16, 8), "std")]),
+    ("softmax", lambda a, b: F.softmax(a, axis=-1), [((4, 8), "std"), None]),
+    ("gelu", lambda a, b: F.gelu(a), [((4, 8), "std"), None]),
+    ("tanh", lambda a, b: paddle.tanh(a), [((4, 8), "std"), None]),
+    ("exp", lambda a, b: paddle.exp(a), [((4, 8), "unit"), None]),
+    ("layer_norm", lambda a, b: F.layer_norm(a, (8,)),
+     [((4, 8), "std"), None]),
+    ("sigmoid", lambda a, b: F.sigmoid(a), [((4, 8), "std"), None]),
+    ("log_softmax", lambda a, b: F.log_softmax(a, axis=-1),
+     [((4, 8), "std"), None]),
+    ("add_mul", lambda a, b: a * b + a, [((4, 8), "std"), ((4, 8), "std")]),
+    ("mean_reduce", lambda a, b: a.mean(axis=-1), [((4, 8), "std"), None]),
+    ("silu", lambda a, b: F.silu(a), [((4, 8), "std"), None]),
+    ("cross_entropy", lambda a, b: F.cross_entropy(
+        a, paddle.to_tensor(np.array([0, 1, 2, 3]))),
+     [((4, 8), "std"), None]),
+]
+
+
+@pytest.mark.parametrize("name,fn,specs", BF16_OPS, ids=[b[0] for b in BF16_OPS])
+def test_bf16_forward_tolerance(name, fn, specs):
+    """bf16-aware tier (op_test.py bf16 path): bf16 result within ~1%% of
+    the f32 reference — bf16 has ~3 decimal digits (8 mantissa bits)."""
+    arrays = [None if s is None else _mk(s) for s in specs]
+    # reference runs in f32 on bf16-ROUNDED inputs, isolating accumulation
+    # error from input-quantization error (op_test.py bf16 path compares
+    # against the fp32 kernel the same way)
+    rounded = [
+        None if a is None
+        else paddle.to_tensor(a).astype("bfloat16").astype("float32")
+        for a in arrays
+    ]
+    bf16 = [None if a is None else paddle.to_tensor(a).astype("bfloat16")
+            for a in arrays]
+    out32 = fn(*rounded).numpy().astype(np.float64)
+    outbf = fn(*bf16).astype("float32").numpy().astype(np.float64)
+    np.testing.assert_allclose(outbf, out32, rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# embedding / one_hot (integer-input ops with grads to weights)
+# ---------------------------------------------------------------------------
+def test_embedding_forward_and_weight_grad():
+    w = RNG.standard_normal((6, 4)).astype(np.float32)
+    ids = np.array([[0, 3], [5, 3]], np.int64)
+    wt = paddle.to_tensor(w, stop_gradient=False)
+    out = F.embedding(paddle.to_tensor(ids), wt)
+    np.testing.assert_allclose(out.numpy(), w[ids], rtol=1e-6)
+    out.sum().backward()
+    expect = np.zeros_like(w)
+    for i in ids.flatten():
+        expect[i] += 1
+    np.testing.assert_allclose(wt.grad.numpy(), expect, rtol=1e-6)
+
+
+def test_one_hot_matches_eye():
+    ids = np.array([0, 2, 1], np.int64)
+    out = F.one_hot(paddle.to_tensor(ids), num_classes=4).numpy()
+    np.testing.assert_array_equal(out, np.eye(4, dtype=np.float32)[ids])
+
+
+def test_take_along_axis_rank_mismatch_raises():
+    x = paddle.to_tensor(RNG.standard_normal((3, 4)).astype(np.float32))
+    with pytest.raises(ValueError, match="rank"):
+        paddle.take_along_axis(x, paddle.to_tensor(np.array([0, 2])), axis=1)
+
+
+def test_put_along_axis_include_self_false():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32) * 10.0)
+    idx = paddle.to_tensor(np.array([[0], [1]]))
+    out = paddle.put_along_axis(x, idx, 2.0, axis=1, reduce="add",
+                                include_self=False, broadcast=False)
+    expect = np.ones((2, 3), np.float32) * 10.0
+    expect[0, 0] = 2.0   # identity(0) + 2, original 10 excluded
+    expect[1, 1] = 2.0
+    np.testing.assert_array_equal(out.numpy(), expect)
+    out2 = paddle.put_along_axis(x, idx, 2.0, axis=1, reduce="add",
+                                 include_self=True, broadcast=False)
+    expect2 = np.ones((2, 3), np.float32) * 10.0
+    expect2[0, 0] = 12.0
+    expect2[1, 1] = 12.0
+    np.testing.assert_array_equal(out2.numpy(), expect2)
+
+
+def test_cummax_indices_and_dtype():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 4.0], [0.0, 5.0, 2.0]],
+                                  np.float32))
+    vals, idx = paddle.cummax(x, axis=1, dtype="int32")
+    np.testing.assert_array_equal(vals.numpy(), [[3, 3, 4], [0, 5, 5]])
+    np.testing.assert_array_equal(idx.numpy(), [[0, 0, 2], [0, 1, 1]])
+    assert "int32" in str(idx.dtype)
+    vals2, idx2 = paddle.cummin(x, axis=0)
+    np.testing.assert_array_equal(vals2.numpy(), [[3, 1, 4], [0, 1, 2]])
+    np.testing.assert_array_equal(idx2.numpy(), [[0, 0, 0], [1, 0, 1]])
+
+
+def test_converter_group_shape_mismatch_raises():
+    from paddle_tpu.distributed.auto_parallel import Converter
+
+    with pytest.raises(ValueError, match="implies"):
+        Converter(
+            {"w": [np.zeros(2)] * 4},
+            {"w": {"process_shape": [2], "process_group": [0, 1, 2, 3],
+                   "dims_mapping": [0]}},
+            {"w": {"process_shape": [2], "process_group": [0, 1],
+                   "dims_mapping": [0]}},
+        )

@@ -1,0 +1,53 @@
+"""The hybrid dp×sharding+ZeRO step must compile without GSPMD's
+'Involuntary full rematerialization' fallback (VERDICT r3 task 4): the
+weight-grad dots keep batch-sharded operands (grads pinned to their TP spec
+after the backward, zero-reshard at the update — parallel/sharding.py).
+"""
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
+
+strategy = fleet.DistributedStrategy()
+strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "sharding_degree": 2}
+strategy.sharding = True
+strategy.sharding_configs = {"stage": 2}
+fleet.init(is_collective=True, strategy=strategy)
+paddle.seed(0)
+cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=8,
+                max_seq_len=32, dropout=0.0, attn_dropout=0.0)
+model = fleet.distributed_model(GPTForPretraining(cfg))
+opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+step = fleet.distributed_train_step(model, GPTPretrainingCriterion(cfg), opt)
+ids = paddle.randint(0, 128, [8, 9])
+print("loss", float(step(ids[:, :-1], ids[:, 1:])))
+"""
+
+
+@pytest.mark.slow
+def test_no_involuntary_rematerialization_hybrid_zero():
+    import os
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", SCRIPT], capture_output=True, text=True,
+        timeout=600, env=env, cwd="/root/repo",
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "loss" in res.stdout
+    assert "Involuntary full rematerialization" not in res.stderr, (
+        "GSPMD fell back to replicate-then-repartition:\n"
+        + "\n".join(
+            l for l in res.stderr.splitlines() if "Involuntary" in l
+        )[:2000]
+    )

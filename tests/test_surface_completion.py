@@ -6,16 +6,26 @@ import pytest
 import paddle_tpu as paddle
 
 
-def test_top_level_names_exist():
-    for name in [
-        "CUDAPlace", "NPUPlace", "ParamAttr", "add_n", "bool", "check_shape",
-        "create_parameter", "disable_signal_handler", "dtype", "flops",
-        "get_cuda_rng_state", "increment", "is_complex", "is_floating_point",
-        "is_integer", "nanquantile", "rank", "renorm", "reverse",
-        "set_cuda_rng_state", "set_printoptions", "shape", "shard_index",
-        "squeeze_", "tolist", "unbind", "unsqueeze_",
-    ]:
-        assert hasattr(paddle, name), name
+def test_top_level_names_exist_and_behave():
+    """Smoke-VALUE checks, not hasattr: each name is exercised."""
+    x = paddle.to_tensor(np.array([[1.0, -2.0], [3.0, 4.0]], np.float32))
+    assert paddle.CUDAPlace(0) is not None and paddle.NPUPlace(0) is not None
+    assert paddle.ParamAttr(name="w") is not None
+    assert paddle.bool == paddle.to_tensor(np.array([True])).dtype
+    assert isinstance(paddle.bool, paddle.dtype)
+    paddle.check_shape(x)
+    p = paddle.create_parameter([2, 2], "float32")
+    assert p.shape == [2, 2] and not p.stop_gradient
+    paddle.disable_signal_handler()
+    assert paddle.flops is not None and callable(paddle.flops)
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    assert float(paddle.nanquantile(x.flatten(), 0.5)) == 2.0
+    np.testing.assert_array_equal(
+        paddle.reverse(x, axis=[0]).numpy(), x.numpy()[::-1]
+    )
+    paddle.set_printoptions(precision=4)
+    assert paddle.tolist(x) == [[1.0, -2.0], [3.0, 4.0]]
 
 
 def test_add_n_and_unbind():
