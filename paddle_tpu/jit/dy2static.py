@@ -16,10 +16,19 @@ constraints):
   - names assigned inside a converted branch/loop body must already be
     bound before it (both branches of a traced cond must produce the same
     pytree);
-  - `return`/`break`/`continue` inside a branch/loop body, and attribute
-    stores (self.x = ...), keep Python semantics: that statement's
-    `if`/`while` is left untransformed (a traced predicate there raises
-    jax's TracerBoolConversionError, pointing at the unsupported pattern);
+  - `break`/`continue` in while/for-range bodies ARE converted (reference:
+    break_continue_transformer.py:87): they become loop-carried flags —
+    the loop condition absorbs the break flag, statements after a
+    potential break/continue are guarded, and a for-range containing them
+    lowers to the equivalent while;
+  - early `return` inside an `if` IS converted (reference:
+    return_transformer.py:136): trailing statements are absorbed into the
+    branches so every path ends in a return, then returns collapse into a
+    `_jst_retval` binding both branches produce;
+  - `return` inside a LOOP body, and attribute stores (self.x = ...),
+    keep Python semantics: that construct is left untransformed (a traced
+    predicate there raises jax's TracerBoolConversionError, pointing at
+    the unsupported pattern);
   - only the decorated function is converted (calls into helpers trace as
     usual).
 """
@@ -87,7 +96,7 @@ class _Runtime:
         return lcls.get(name, UNDEF)
 
     @staticmethod
-    def convert_ifelse(pred, true_fn, false_fn, carry):
+    def convert_ifelse(pred, true_fn, false_fn, carry, guard=False):
         pred = _to_bool_value(pred)
         if isinstance(pred, jax.core.Tracer):
             from ..core.tensor import Tensor
@@ -107,9 +116,23 @@ class _Runtime:
             # UNDEF outputs encode as None (a structural pytree node): a
             # temp left unbound by BOTH branches merges fine; bound by only
             # one branch → lax.cond pytree-structure mismatch (caught below
-            # with a readable message)
+            # with a readable message).
+            # guard=True (the break/continue remainder guard, whose else
+            # branch is empty by construction): a slot UNDEF at ENTRY stays
+            # UNDEF — the true branch's binding of a loop-local temp is
+            # consumed inside the branch and recomputed next iteration, so
+            # discarding it preserves semantics where strict merging would
+            # reject ordinary user code
+            undef_in = (
+                {i for i, c in enumerate(carry) if c is UNDEF}
+                if guard else frozenset()
+            )
+
             def to_pytree(out):
-                return tuple(None if o is UNDEF else _unwrap(o) for o in out)
+                return tuple(
+                    None if (o is UNDEF or i in undef_in) else _unwrap(o)
+                    for i, o in enumerate(out)
+                )
 
             def t(vs):
                 return to_pytree(true_fn(rebuild(vs)))
@@ -147,8 +170,8 @@ class _Runtime:
         from ..core.tensor import Tensor
 
         droppable = droppable or (False,) * len(carry)
-        probe = cond_fn(carry)
-        if _is_traced(probe):
+
+        def traced_loop(carry):
             kept = [
                 i for i, c in enumerate(carry)
                 if not (c is UNDEF and droppable[i])
@@ -181,9 +204,18 @@ class _Runtime:
             for j, i in enumerate(kept):
                 full[i] = Tensor(outs[j], stop_gradient=True)
             return tuple(full)
-        while _to_bool_value(cond_fn(carry)):
+
+        while True:
+            probe = cond_fn(carry)
+            if _is_traced(probe):
+                # traced from the start, or became traced mid-loop (e.g. a
+                # break flag assigned from a traced compare): the REMAINING
+                # iterations continue as one lax.while_loop from the
+                # current carry
+                return traced_loop(carry)
+            if not _to_bool_value(probe):
+                return carry
             carry = body_fn(carry)
-        return carry
 
     @staticmethod
     def convert_range_for(start, stop, step, body_fn, carry, droppable=None,
@@ -332,6 +364,267 @@ def _assigned_names(body: Sequence[ast.stmt]) -> Set[str]:
     return {n for n in names if not n.startswith("__jst")}
 
 
+_RETVAL = "_jst_retval"
+
+# every loop rewrite draws FRESH flag/induction names — nested loops with
+# their own break/continue must not share state
+_bc_counter = [0]
+
+
+def _bc_names():
+    _bc_counter[0] += 1
+    n = _bc_counter[0]
+    return {
+        "brk": f"_jst_brk{n}", "cont": f"_jst_cont{n}",
+        "i": f"_jst_fi{n}", "stop": f"_jst_fs{n}", "step": f"_jst_fd{n}",
+    }
+
+
+def _assign(name: str, value: ast.expr) -> ast.stmt:
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _has_own(body: Sequence[ast.stmt], kinds) -> bool:
+    """Any node of `kinds` belonging to THIS loop/function scope — does not
+    descend into nested functions; for Break/Continue also stops at nested
+    loops (they own their own break/continue)."""
+    stop_loops = any(k in (ast.Break, ast.Continue) for k in kinds)
+
+    def walk(stmts):
+        for s in stmts:
+            if isinstance(s, kinds):
+                return True
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if stop_loops and isinstance(s, (ast.While, ast.For)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                if walk(getattr(s, field, []) or []):
+                    return True
+        return False
+
+    return walk(list(body))
+
+
+# ---------------------------------------------------------------------------
+# break/continue → flag rewrite (reference: break_continue_transformer.py:87)
+# ---------------------------------------------------------------------------
+def _rewrite_bc_stmts(stmts: List[ast.stmt], names, flags: List[str]):
+    """Replace this loop's break/continue with flag sets; statements after a
+    possibly-flag-setting statement are guarded by `if not (flag or ...)`.
+    Nested loops keep their own break/continue untouched."""
+    out: List[ast.stmt] = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_assign(names["brk"], ast.Constant(True)))
+            return out  # code after break in the same block is dead
+        if isinstance(s, ast.Continue):
+            out.append(_assign(names["cont"], ast.Constant(True)))
+            return out
+        if isinstance(s, ast.If) and _has_own([s], (ast.Break, ast.Continue)):
+            new_if = ast.If(
+                test=s.test,
+                body=_rewrite_bc_stmts(list(s.body), names, flags)
+                or [ast.Pass()],
+                orelse=_rewrite_bc_stmts(list(s.orelse), names, flags),
+            )
+            out.append(new_if)
+            rest = _rewrite_bc_stmts(stmts[idx + 1:], names, flags)
+            if rest:
+                guard_test = ast.UnaryOp(
+                    op=ast.Not(),
+                    operand=(
+                        ast.BoolOp(op=ast.Or(), values=[
+                            ast.Name(id=f, ctx=ast.Load()) for f in flags
+                        ]) if len(flags) > 1
+                        else ast.Name(id=flags[0], ctx=ast.Load())
+                    ),
+                )
+                guard_if = ast.If(test=guard_test, body=rest, orelse=[])
+                # mark as a remainder guard: its (empty) else path keeps
+                # entry values, so entry-UNDEF temps may stay UNDEF instead
+                # of tripping the both-branches-must-bind rule
+                guard_if._jst_guard = True
+                out.append(guard_if)
+            return out
+        out.append(s)
+    return out
+
+
+def _rewrite_while_bc(node: ast.While):
+    """while with break/continue → flag-carrying while. Returns
+    (new_while, pre_stmts)."""
+    names = _bc_names()
+    has_brk = _has_own(node.body, (ast.Break,))
+    has_cont = _has_own(node.body, (ast.Continue,))
+    flags = [f for f, h in ((names["brk"], has_brk),
+                            (names["cont"], has_cont)) if h]
+    body = _rewrite_bc_stmts(list(node.body), names, flags)
+    pre: List[ast.stmt] = []
+    if has_cont:
+        body = [_assign(names["cont"], ast.Constant(False))] + body
+    test = node.test
+    if has_brk:
+        pre.append(_assign(names["brk"], ast.Constant(False)))
+        # `(not brk) and (test)` — brk first so a traced flag short-circuits
+        # through convert_logical_and after the conversion pass
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(),
+                        operand=ast.Name(id=names["brk"], ctx=ast.Load())),
+            test,
+        ])
+    return ast.While(test=test, body=body, orelse=[]), pre
+
+
+def _rewrite_for_bc(node: ast.For):
+    """for-range with break/continue → while form (the only shape whose
+    condition can absorb the break flag). Returns list of statements."""
+    names = _bc_names()
+    rargs = node.iter.args
+    if len(rargs) == 1:
+        start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+    elif len(rargs) == 2:
+        start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+    else:
+        start, stop, step = rargs
+    ivar, svar, dvar = names["i"], names["stop"], names["step"]
+    tgt = node.target.id
+    pre = [
+        _assign(ivar, start), _assign(svar, stop), _assign(dvar, step),
+        # pre-bind the loop var so it survives the traced carry (python's
+        # for leaves it at the last executed index; for an EMPTY range this
+        # pre-binding to start is the same already-documented deviation as
+        # convert_range_for's traced path)
+        _assign(tgt, ast.Name(id=ivar, ctx=ast.Load())),
+    ]
+    cond = ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                           attr="range_cond", ctx=ast.Load()),
+        args=[ast.Name(id=ivar, ctx=ast.Load()),
+              ast.Name(id=svar, ctx=ast.Load()),
+              ast.Name(id=dvar, ctx=ast.Load())],
+        keywords=[],
+    )
+    has_brk = _has_own(node.body, (ast.Break,))
+    has_cont = _has_own(node.body, (ast.Continue,))
+    flags = [f for f, h in ((names["brk"], has_brk),
+                            (names["cont"], has_cont)) if h]
+    user_body = _rewrite_bc_stmts(list(node.body), names, flags)
+    body = [_assign(tgt, ast.Name(id=ivar, ctx=ast.Load()))]
+    if has_cont:
+        body.append(_assign(names["cont"], ast.Constant(False)))
+    body += user_body
+    # the increment runs on EVERY iteration, OUTSIDE the continue/break
+    # guards (continue skips the rest of the user body, never the
+    # induction step)
+    body.append(_assign(
+        ivar, ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                        op=ast.Add(),
+                        right=ast.Name(id=dvar, ctx=ast.Load()))))
+    if has_brk:
+        pre.append(_assign(names["brk"], ast.Constant(False)))
+        cond = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(),
+                        operand=ast.Name(id=names["brk"], ctx=ast.Load())),
+            cond,
+        ])
+    loop = ast.While(test=cond, body=body, orelse=[])
+    # the loop var must stay in the lax carry even though the body writes
+    # it before reading (python keeps it bound after the loop)
+    loop._jst_keep_names = (tgt,)
+    return pre + [loop]
+
+
+# ---------------------------------------------------------------------------
+# early return → branch absorption (reference: return_transformer.py:136)
+# ---------------------------------------------------------------------------
+def _returnify(stmts: List[ast.stmt]):
+    """Rewrite a function-scope statement list so every path ends in an
+    explicit Return, absorbing trailing statements into return-containing
+    if-branches. Returns None (bail to plain-python semantics) when a
+    return sits inside a loop."""
+    import copy as _copy
+
+    stmts = list(stmts)
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            return stmts[:idx + 1]
+        if isinstance(s, (ast.While, ast.For)) and _has_own(
+                [s], (ast.Return,)):
+            return None
+        if isinstance(s, (ast.Try, ast.With)) and _has_own(
+                [s], (ast.Return,)):
+            return None
+        if isinstance(s, ast.If) and _has_own([s], (ast.Return,)):
+            rest = stmts[idx + 1:]
+            body = _returnify(list(s.body) + _copy.deepcopy(rest))
+            orelse = _returnify(list(s.orelse) + _copy.deepcopy(rest))
+            if body is None or orelse is None:
+                return None
+            return stmts[:idx] + [ast.If(test=s.test, body=body,
+                                         orelse=orelse)]
+    stmts.append(ast.Return(value=ast.Constant(None)))
+    return stmts
+
+
+def _strip_returns(stmts: List[ast.stmt]) -> List[ast.stmt]:
+    """After _returnify: replace every own-scope Return inside the final If
+    with `_jst_retval = value` so the If becomes convertible (both branches
+    bind the same name), and emit one trailing `return _jst_retval`."""
+    if not stmts:
+        return stmts
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return stmts
+    assert isinstance(last, ast.If), "after _returnify the tail is If|Return"
+
+    def strip(body):
+        out = []
+        for s in body:
+            if isinstance(s, ast.Return):
+                out.append(_assign(
+                    _RETVAL, s.value if s.value is not None
+                    else ast.Constant(None)))
+            elif isinstance(s, ast.If) and _has_own([s], (ast.Return,)):
+                new = ast.If(test=s.test, body=strip(s.body),
+                             orelse=strip(s.orelse))
+                # only _jst_retval survives this if (everything after it in
+                # the function was absorbed INTO it) — restricting the
+                # merge carry keeps branch-local trailing temps from
+                # tripping the both-branches-must-bind rule
+                new._jst_carry_names = [_RETVAL]
+                out.append(new)
+            else:
+                out.append(s)
+        return out
+
+    new_if = ast.If(test=last.test, body=strip(last.body),
+                    orelse=strip(last.orelse))
+    new_if._jst_carry_names = [_RETVAL]
+    return stmts[:-1] + [
+        new_if,
+        ast.Return(value=ast.Name(id=_RETVAL, ctx=ast.Load())),
+    ]
+
+
+def _rewrite_early_returns(func_def) -> bool:
+    """Apply the returnify+strip transform when the body has a return inside
+    an `if`. Returns True when rewritten."""
+    early = any(
+        isinstance(s, ast.If) and _has_own([s], (ast.Return,))
+        for s in func_def.body
+    )
+    if not early:
+        return False
+    new = _returnify(func_def.body)
+    if new is None:
+        return False  # return-in-loop etc.: plain python semantics
+    func_def.body = _strip_returns(new)
+    return True
+
+
 def _contains_disallowed(body: Sequence[ast.stmt]) -> bool:
     """Return/break/continue or attribute/subscript stores IN THIS SCOPE —
     keep Python semantics for those statements (reference: Dy2Static's
@@ -384,12 +677,14 @@ def _read_before_write(body: Sequence[ast.stmt], name: str) -> bool:
 
 
 def _droppable_mask(carry: List[str], body: Sequence[ast.stmt],
-                    cond_expr=None) -> ast.expr:
+                    cond_expr=None, keep=()) -> ast.expr:
     """ast literal tuple: True per carry name that is a pure body temp
-    (written before read, unused by the loop condition)."""
+    (written before read, unused by the loop condition). `keep` names are
+    never droppable (the for-with-break loop var must outlive the loop)."""
     cond_reads = _read_names(cond_expr) if cond_expr is not None else set()
     flags = [
-        not (n in cond_reads or _read_before_write(body, n)) for n in carry
+        not (n in cond_reads or n in keep or _read_before_write(body, n))
+        for n in carry
     ]
     return ast.Tuple(
         elts=[ast.Constant(bool(f)) for f in flags], ctx=ast.Load()
@@ -527,7 +822,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if _contains_disallowed(node.body) or _contains_disallowed(node.orelse):
             return node
-        carry = sorted(_assigned_names(node.body) | _assigned_names(node.orelse))
+        only = getattr(node, "_jst_carry_names", None)
+        carry = (list(only) if only is not None
+                 else sorted(_assigned_names(node.body)
+                             | _assigned_names(node.orelse)))
         tname, fname = self._fresh("true"), self._fresh("false")
 
         def branch(name: str, body: List[ast.stmt]) -> ast.FunctionDef:
@@ -564,7 +862,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 ast.Name(id=fname, ctx=ast.Load()),
                 _name_tuple(carry, ast.Load),
             ],
-            keywords=[],
+            keywords=(
+                [ast.keyword(arg="guard", value=ast.Constant(True))]
+                if getattr(node, "_jst_guard", False) else []
+            ),
         )
         assign: ast.stmt = (
             ast.Assign(targets=[_name_tuple(carry, ast.Store)], value=call)
@@ -579,12 +880,22 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while ---------------------------------------------------------------
     def visit_While(self, node: ast.While):
+        pre: List[ast.stmt] = []
+        if (not node.orelse
+                and _has_own(node.body, (ast.Break, ast.Continue))
+                and not _has_own(node.body, (ast.Return,))):
+            # semantics-preserving flag rewrite (pure python even if the
+            # conversion below still bails on other grounds)
+            node, pre = _rewrite_while_bc(node)
+            for s in pre + [node]:
+                ast.fix_missing_locations(s)
         self.generic_visit(node)
         if node.orelse or _contains_disallowed(node.body):
-            return node
+            return pre + [node] if pre else node
         carry = sorted(_assigned_names(node.body))
         if not carry:
-            return node  # nothing evolves — either trivial or closure-driven
+            # nothing evolves — either trivial or closure-driven
+            return pre + [node] if pre else node
         cname, bname = self._fresh("cond"), self._fresh("body")
 
         unpack = ast.Assign(
@@ -618,12 +929,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 ast.Name(id=cname, ctx=ast.Load()),
                 ast.Name(id=bname, ctx=ast.Load()),
                 _name_tuple(carry, ast.Load),
-                _droppable_mask(carry, node.body, node.test),
+                _droppable_mask(carry, node.body, node.test,
+                                keep=getattr(node, "_jst_keep_names", ())),
             ],
             keywords=[],
         )
         assign = ast.Assign(targets=[_name_tuple(carry, ast.Store)], value=call)
-        out = (_pre_load_stmts(carry) + [cond_def, body_def, assign]
+        out = (pre + _pre_load_stmts(carry) + [cond_def, body_def, assign]
                + _post_del_stmts(carry))
         for s in out:
             ast.copy_location(s, node)
@@ -632,6 +944,30 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- for i in range(...) -------------------------------------------------
     def visit_For(self, node: ast.For):
+        if (
+            not node.orelse
+            and isinstance(node.target, ast.Name)
+            and isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and 1 <= len(node.iter.args) <= 3
+            and _has_own(node.body, (ast.Break, ast.Continue))
+            and not _has_own(node.body, (ast.Return,))
+        ):
+            # for-range with break/continue: lower to the while form whose
+            # condition can absorb the break flag, then convert that
+            stmts = _rewrite_for_bc(node)
+            out: List[ast.stmt] = []
+            for s in stmts:
+                ast.copy_location(s, node)
+                ast.fix_missing_locations(s)
+                r = self.visit(s)
+                out.extend(r if isinstance(r, list) else [r])
+            for s in out:
+                ast.copy_location(s, node)
+                ast.fix_missing_locations(s)
+            return out
         self.generic_visit(node)
         if (
             node.orelse
@@ -714,6 +1050,11 @@ def _convert_cached(fn_key):
     if not isinstance(func_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     func_def.decorator_list = []  # decorators already applied to the original
+    # early `return` inside an `if`: absorb trailing code into the branches
+    # and strip returns to _jst_retval assignments so the If converts
+    # (reference: return_transformer.py:136)
+    _rewrite_early_returns(func_def)
+    ast.fix_missing_locations(func_def)
     _ControlFlowTransformer().visit(func_def)
     ast.fix_missing_locations(tree)
 

@@ -1,0 +1,313 @@
+"""Dy2static break/continue/early-return conversion (VERDICT r3 task 7).
+
+Reference analogues: dygraph_to_static/break_continue_transformer.py:87
+(loop-flag fusion) and return_transformer.py:136 (return guard
+accumulation). Each test checks traced-predicate parity against the plain
+eager execution of the SAME function body.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+
+def _eager_vs_static(fn, *args):
+    """Run the raw python fn and its to_static conversion; both must agree."""
+    eager = fn(*[paddle.to_tensor(a) for a in args])
+    static = to_static(fn)(*[paddle.to_tensor(a) for a in args])
+    np.testing.assert_allclose(
+        np.asarray(eager.numpy() if hasattr(eager, "numpy") else eager),
+        np.asarray(static.numpy() if hasattr(static, "numpy") else static),
+        rtol=1e-6,
+    )
+    return static
+
+
+# -- break ---------------------------------------------------------------------
+def test_break_in_traced_while():
+    def fn(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 100:  # traced bound
+            if s > 10.0:
+                break
+            s = s + x
+            i = i + 1
+        return s + i.astype("float32")
+
+    _eager_vs_static(fn, np.float32(3.0))
+
+
+def test_break_compiles_to_one_program():
+    # the traced while with break must become ONE lax.while_loop, not an
+    # unrolled TracerBoolConversionError path
+    import jax
+
+    def fn(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 50:
+            if s > x * 4.0:
+                break
+            s = s + x
+            i = i + 1
+        return s
+
+    conv = to_static(fn)
+    out = jax.jit(lambda v: conv(paddle.to_tensor(v))._value)(2.0)
+    assert float(out) > 8.0
+
+
+def test_break_in_concrete_while_keeps_python_semantics():
+    def fn(x):
+        s = paddle.zeros([])
+        n = 0
+        while n < 10:  # concrete
+            if n == 3:
+                break
+            s = s + x
+            n = n + 1
+        return s + n
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+def test_break_in_for_range():
+    def fn(x):
+        s = paddle.zeros([])
+        for i in range(8):
+            if s > 4.0:
+                break
+            s = s + x
+        return s + i  # python: i keeps its break-iteration value
+
+    _eager_vs_static(fn, np.float32(2.0))
+
+
+def test_break_in_traced_for_range():
+    def fn(x, n):
+        s = paddle.zeros([])
+        for i in range(n):  # traced bound
+            if s > 5.0:
+                break
+            s = s + x
+        return s
+
+    eager = fn(paddle.to_tensor(np.float32(2.0)), 100)
+    static = to_static(fn)(
+        paddle.to_tensor(np.float32(2.0)),
+        paddle.to_tensor(np.int32(100)),
+    )
+    np.testing.assert_allclose(eager.numpy(), static.numpy(), rtol=1e-6)
+
+
+# -- continue ------------------------------------------------------------------
+def test_continue_in_while():
+    def fn(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 10:
+            i = i + 1
+            if i.astype("float32") % 2.0 < 0.5:
+                continue
+            s = s + x  # odd iterations only
+        return s
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+def test_continue_in_for_range():
+    def fn(x):
+        s = paddle.zeros([])
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            s = s + x * i
+        return s
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+def test_break_and_continue_together():
+    def fn(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 20:
+            i = i + 1
+            if (i % 3) == 0:
+                continue
+            if s > 7.0:
+                break
+            s = s + x
+        return s + i.astype("float32")
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+# -- early return --------------------------------------------------------------
+def test_early_return_traced_if():
+    def fn(x):
+        if x > 0:
+            return x * 2.0
+        return x - 1.0
+
+    _eager_vs_static(fn, np.float32(3.0))
+    _eager_vs_static(fn, np.float32(-3.0))
+
+
+def test_early_return_traced_if_compiles():
+    import jax
+
+    def fn(x):
+        if x > 0:
+            return x * 2.0
+        return x - 1.0
+
+    conv = to_static(fn)
+    jfn = jax.jit(lambda v: conv(paddle.to_tensor(v))._value)
+    np.testing.assert_allclose(float(jfn(3.0)), 6.0)
+    np.testing.assert_allclose(float(jfn(-3.0)), -4.0)  # same compiled fn
+
+
+def test_early_return_with_trailing_statements():
+    def fn(x):
+        y = x + 1.0
+        if y > 2.0:
+            return y * 10.0
+        z = y * 2.0
+        return z + x
+
+    _eager_vs_static(fn, np.float32(5.0))
+    _eager_vs_static(fn, np.float32(0.0))
+
+
+def test_nested_early_returns():
+    def fn(x):
+        if x > 10.0:
+            if x > 20.0:
+                return x * 3.0
+            return x * 2.0
+        return x
+
+    for v in (25.0, 15.0, 5.0):
+        _eager_vs_static(fn, np.float32(v))
+
+
+def test_early_return_none_path():
+    # a CONCRETE predicate keeps exact python semantics incl. returning None
+    def fn(x, flag):
+        if flag:
+            return None
+        return x + 1.0
+
+    out = to_static(fn)(paddle.to_tensor(np.float32(1.0)), False)
+    np.testing.assert_allclose(float(out), 2.0)
+
+    # a TRACED predicate cannot merge None with an array — readable error
+    def fn2(x):
+        if x > 100.0:
+            return None
+        return x + 1.0
+
+    with pytest.raises(ValueError, match="same variables"):
+        to_static(fn2)(paddle.to_tensor(np.float32(1.0)))
+
+
+def test_return_in_loop_keeps_python_semantics():
+    # documented subset: return inside a loop body stays python-only (the
+    # loop and its predicate must be concrete)
+    def fn(x):
+        s = paddle.zeros([])
+        for i in range(5):  # concrete loop: plain python
+            s = s + x
+            if i >= 2:  # concrete predicate
+                return s
+        return s - 1.0
+
+    out = to_static(fn)(paddle.to_tensor(np.float32(1.0)))
+    np.testing.assert_allclose(float(out), 3.0)
+
+
+# -- interaction with the UNDEF machinery -------------------------------------
+def test_break_with_branch_bound_temp():
+    def fn(x, flag):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 5:
+            if flag:  # concrete False
+                dbg = x * 0.0
+                s = s + dbg
+            if s > 100.0:
+                break
+            s = s + x
+            i = i + 1
+        return s
+
+    out = to_static(fn)(paddle.to_tensor(np.float32(1.0)), False)
+    np.testing.assert_allclose(float(out), 5.0)
+
+
+# -- review regressions (r4) ---------------------------------------------------
+def test_nested_loops_with_independent_breaks():
+    # inner break must not leak into the outer loop's flag/induction state
+    def fn(x):
+        total = paddle.zeros([])
+        for i in range(5):
+            for j in range(4):
+                if j >= 2:
+                    break
+                total = total + x
+        return total  # 5 outer x 2 inner = 10
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+def test_nested_while_breaks_traced():
+    def fn(x):
+        total = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 4:
+            k = paddle.zeros([], dtype="int32")
+            while k < 10:
+                if k >= 2:
+                    break
+                total = total + x
+                k = k + 1
+            i = i + 1
+        return total  # 4 x 2 = 8
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+def test_loop_var_survives_traced_for_break():
+    def fn(x, n):
+        s = paddle.zeros([])
+        for i in range(n):
+            if s > 5.0:
+                break
+            s = s + x
+        return s + i  # python: i keeps the break-iteration index
+
+    eager = fn(paddle.to_tensor(np.float32(2.0)), 100)
+    static = to_static(fn)(
+        paddle.to_tensor(np.float32(2.0)), paddle.to_tensor(np.int32(100))
+    )
+    np.testing.assert_allclose(eager.numpy(), static.numpy(), rtol=1e-6)
+
+
+def test_temp_first_assigned_after_break_guard():
+    # dbg is born after the potential break — the remainder guard must not
+    # reject it for being unbound on the (empty) else path
+    def fn(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([], dtype="int32")
+        while i < 100:
+            if s > 10.0:
+                break
+            dbg = x * 2.0
+            s = s + dbg
+            i = i + 1
+        return s
+
+    _eager_vs_static(fn, np.float32(1.0))
