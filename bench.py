@@ -130,6 +130,37 @@ def bench_ps_table(iters=10, batch=65536, dim=64):
             "value": round(batch * iters * 2 / dt / 1e6, 2), "unit": "M lookups/s"}
 
 
+def bench_ps_wire(iters=10, batch=65536, dim=64):
+    """PS WIRE path: DistributedSparseTable pull+push through PsClient's
+    framed-TCP protocol against 2 local servers (the r3 verdict's point:
+    the in-process table number never touched the wire)."""
+    from paddle_tpu.distributed.ps import (
+        DistributedSparseTable, PsClient, PsServer,
+    )
+
+    s0 = PsServer(port=0, server_id=0, n_servers=2, n_trainers=1)
+    s1 = PsServer(port=0, server_id=1, n_servers=2, n_trainers=1)
+    c = PsClient([f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"],
+                 trainer_id=0)
+    try:
+        t = DistributedSparseTable(c, 1, emb_dim=dim, shard_num=32,
+                                   init_range=0.01)
+        rng = np.random.default_rng(0)
+        keys = rng.integers(0, 10_000_000, batch)
+        grads = rng.standard_normal((batch, dim)).astype(np.float32)
+        t.pull(keys)  # warm (creates entries, opens connections)
+        t0 = time.time()
+        for _ in range(iters):
+            t.pull(keys)
+            t.push(keys, grads)
+        dt = time.time() - t0
+        return {"metric": "ps_wire_pull_push_m_lookups_per_sec",
+                "value": round(batch * iters * 2 / dt / 1e6, 2),
+                "unit": "M lookups/s"}
+    finally:
+        c.stop_servers()
+
+
 def bench_gpt_longseq(steps=6, bsz=2, seq=4096):
     """Long-context GPT: seq 4096 through the Pallas flash-attention path —
     the capability the reference lacks (SURVEY §5). Recompute off: 345M at
@@ -324,6 +355,7 @@ def main():
             ("gpt_longseq", bench_gpt_longseq),
             ("mnist", bench_mnist_eager),
             ("ps_table", bench_ps_table),
+            ("ps_wire", bench_ps_wire),
             ("dataloader", bench_dataloader),
         ):
             try:

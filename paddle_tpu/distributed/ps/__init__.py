@@ -72,10 +72,41 @@ def _load_lib():
         _lib.ps_table_load.restype = ctypes.c_int
         _lib.ps_table_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         _lib.ps_table_set_lr.argtypes = [ctypes.c_void_p, ctypes.c_float]
+        _lib.ps_table_set_ctr.argtypes = [ctypes.c_void_p] + [ctypes.c_float] * 5
+        _lib.ps_table_push_ctr.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib.ps_table_shrink.restype = ctypes.c_int64
+        _lib.ps_table_shrink.argtypes = [ctypes.c_void_p]
+        _lib.ps_table_ctr_stats.restype = ctypes.c_int
+        _lib.ps_table_ctr_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
     return _lib
 
 
-_OPT_IDS = {"sgd": 0, "adagrad": 1}
+_OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+class CtrAccessorConfig:
+    """CTR product semantics on a sparse table (reference:
+    ps/table/ctr_accessor.h CtrCommonAccessor): show/click counters folded
+    in on push, time decay, and score-based feature eviction where
+    score = show_coeff*(show-click) + click_coeff*click."""
+
+    def __init__(self, show_coeff: float = 0.25, click_coeff: float = 1.0,
+                 decay_rate: float = 0.98, delete_threshold: float = 0.8,
+                 delete_after_unseen_days: float = 30.0):
+        self.show_coeff = float(show_coeff)
+        self.click_coeff = float(click_coeff)
+        self.decay_rate = float(decay_rate)
+        self.delete_threshold = float(delete_threshold)
+        self.delete_after_unseen_days = float(delete_after_unseen_days)
+
+    def as_floats(self):
+        return (self.show_coeff, self.click_coeff, self.decay_rate,
+                self.delete_threshold, self.delete_after_unseen_days)
 
 
 class MemorySparseTable:
@@ -83,7 +114,7 @@ class MemorySparseTable:
 
     def __init__(self, emb_dim: int, shard_num: int = 16, optimizer: str = "adagrad",
                  learning_rate: float = 0.05, init_range: float = 0.01,
-                 seed: int = 0):
+                 seed: int = 0, ctr: Optional["CtrAccessorConfig"] = None):
         if optimizer not in _OPT_IDS:
             raise ValueError(f"optimizer must be one of {sorted(_OPT_IDS)}")
         self.emb_dim = emb_dim
@@ -93,6 +124,11 @@ class MemorySparseTable:
             ctypes.c_float(learning_rate), ctypes.c_float(init_range),
             ctypes.c_uint64(seed),
         )
+        self.ctr = ctr
+        if ctr is not None:
+            self._lib.ps_table_set_ctr(
+                self._h, *[ctypes.c_float(v) for v in ctr.as_floats()]
+            )
 
     def __del__(self):
         try:
@@ -130,6 +166,32 @@ class MemorySparseTable:
 
     def set_lr(self, lr: float):
         self._lib.ps_table_set_lr(self._h, ctypes.c_float(lr))
+
+    def push_ctr(self, keys: np.ndarray, shows: np.ndarray,
+                 clicks: np.ndarray, grads: np.ndarray):
+        """CTR push: fold show/click counts in, reset the unseen clock,
+        apply the SGD rule (reference ctr_accessor.cc Update)."""
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        shows = np.ascontiguousarray(shows, np.float32).reshape(-1)
+        clicks = np.ascontiguousarray(clicks, np.float32).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            keys.size, self.emb_dim
+        )
+        self._lib.ps_table_push_ctr(
+            self._h, keys.ctypes.data, keys.size, shows.ctypes.data,
+            clicks.ctypes.data, grads.ctypes.data,
+        )
+
+    def shrink(self) -> int:
+        """One decay+eviction pass (one 'day'); returns evicted count."""
+        return int(self._lib.ps_table_shrink(self._h))
+
+    def ctr_stats(self, key: int):
+        """(show, click, unseen_days, score) or None when absent."""
+        out = np.zeros(4, np.float32)
+        if self._lib.ps_table_ctr_stats(self._h, int(key), out.ctypes.data) != 0:
+            return None
+        return tuple(float(v) for v in out)
 
     def __len__(self):
         return int(self._lib.ps_table_size(self._h))
@@ -432,7 +494,7 @@ class TheOnePSRuntime:
         for name, t in self._tables.items():
             t.load(os.path.join(dirname, f"{name}.sparse"))
 
-from . import service  # noqa: E402,F401
+from . import service  # noqa: E402,F401  (CtrAccessorConfig defined above)
 from .service import (  # noqa: E402,F401
     Communicator,
     DenseTableHandle,

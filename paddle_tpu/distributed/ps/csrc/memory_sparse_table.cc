@@ -50,4 +50,31 @@ void ps_table_set_lr(void* h, float lr) {
   static_cast<SparseTable*>(h)->lr = lr;
 }
 
+// -- CTR accessor surface (reference: ctr_accessor.h CtrCommonAccessor) ----
+void ps_table_set_ctr(void* h, float show_coeff, float click_coeff,
+                      float decay_rate, float delete_threshold,
+                      float delete_after_unseen_days) {
+  auto* t = static_cast<SparseTable*>(h);
+  t->ctr.enabled = true;
+  t->ctr.show_coeff = show_coeff;
+  t->ctr.click_coeff = click_coeff;
+  t->ctr.decay_rate = decay_rate;
+  t->ctr.delete_threshold = delete_threshold;
+  t->ctr.delete_after_unseen_days = delete_after_unseen_days;
+}
+
+void ps_table_push_ctr(void* h, const int64_t* keys, int64_t n,
+                       const float* shows, const float* clicks,
+                       const float* grads) {
+  static_cast<SparseTable*>(h)->push_ctr(keys, n, shows, clicks, grads);
+}
+
+int64_t ps_table_shrink(void* h) {
+  return static_cast<SparseTable*>(h)->shrink();
+}
+
+int ps_table_ctr_stats(void* h, int64_t key, float* out4) {
+  return static_cast<SparseTable*>(h)->ctr_stats(key, out4) ? 0 : -1;
+}
+
 }  // extern "C"

@@ -370,6 +370,80 @@ int ps_client_set_lr(void* h, uint32_t table_id, float lr) {
   return static_cast<ps::Client*>(h)->broadcast(hd, &lr) ? 0 : -1;
 }
 
+// -- CTR accessor (reference: ctr_accessor.h via BrpcPsClient push) --------
+int ps_client_set_ctr(void* h, uint32_t table_id, float show_coeff,
+                      float click_coeff, float decay_rate,
+                      float delete_threshold, float delete_after_unseen) {
+  float cfg[5] = {show_coeff, click_coeff, decay_rate, delete_threshold,
+                  delete_after_unseen};
+  ps::Header hd{0, ps::CMD_SET_CTR, table_id, 0, 0, sizeof(cfg)};
+  return static_cast<ps::Client*>(h)->broadcast(hd, cfg) ? 0 : -1;
+}
+
+int ps_client_push_ctr(void* h, uint32_t table_id, const int64_t* keys,
+                       int64_t n, int emb_dim, const float* shows,
+                       const float* clicks, const float* grads) {
+  auto* c = static_cast<ps::Client*>(h);
+  const int S = c->n_servers();
+  std::vector<std::vector<int64_t>> pos(S);
+  std::vector<int> involved;
+  for (int64_t i = 0; i < n; ++i)
+    pos[ps::server_of(keys[i], S)].push_back(i);
+  for (int s = 0; s < S; ++s)
+    if (!pos[s].empty()) involved.push_back(s);
+  bool ok = c->fan_out(involved, [&](int s) {
+    const auto& ps_idx = pos[s];
+    const size_t m = ps_idx.size();
+    std::vector<char> payload(m * sizeof(int64_t) + 2 * m * sizeof(float) +
+                              m * sizeof(float) * emb_dim);
+    int64_t* sk = reinterpret_cast<int64_t*>(payload.data());
+    float* sshow =
+        reinterpret_cast<float*>(payload.data() + m * sizeof(int64_t));
+    float* sclick = sshow + m;
+    float* sg = sclick + m;
+    for (size_t j = 0; j < m; ++j) {
+      sk[j] = keys[ps_idx[j]];
+      sshow[j] = shows[ps_idx[j]];
+      sclick[j] = clicks[ps_idx[j]];
+      std::memcpy(sg + j * emb_dim, grads + ps_idx[j] * emb_dim,
+                  sizeof(float) * emb_dim);
+    }
+    ps::Header hd{0, ps::CMD_PUSH_CTR, table_id, 0,
+                  static_cast<int64_t>(m),
+                  static_cast<int64_t>(payload.size())};
+    return c->request(s, hd, payload.data(), nullptr);
+  });
+  return ok ? 0 : -1;
+}
+
+// decay + eviction pass on every server; returns total evicted (or -1)
+int64_t ps_client_shrink(void* h, uint32_t table_id) {
+  auto* c = static_cast<ps::Client*>(h);
+  int64_t total = 0;
+  for (int i = 0; i < c->n_servers(); ++i) {
+    ps::Header hd{0, ps::CMD_SHRINK, table_id, 0, 0, 0};
+    std::vector<char> resp;
+    if (!c->request(i, hd, nullptr, &resp) || resp.size() < sizeof(int64_t))
+      return -1;
+    int64_t e;
+    std::memcpy(&e, resp.data(), sizeof(e));
+    total += e;
+  }
+  return total;
+}
+
+int ps_client_ctr_stats(void* h, uint32_t table_id, int64_t key,
+                        float* out4) {
+  auto* c = static_cast<ps::Client*>(h);
+  int s = ps::server_of(key, c->n_servers());
+  ps::Header hd{0, ps::CMD_CTR_STATS, table_id, 0, 1, sizeof(key)};
+  std::vector<char> resp;
+  if (!c->request(s, hd, &key, &resp) || resp.size() < 4 * sizeof(float))
+    return -1;
+  std::memcpy(out4, resp.data(), 4 * sizeof(float));
+  return 0;
+}
+
 int ps_client_stop_servers(void* h) {
   ps::Header hd{0, ps::CMD_STOP, 0, 0, 0, 0};
   return static_cast<ps::Client*>(h)->broadcast(hd, nullptr) ? 0 : -1;

@@ -40,6 +40,10 @@ enum Cmd : uint32_t {
   CMD_SET_LR = 12,
   CMD_STOP = 13,
   CMD_SET_DENSE = 14,
+  CMD_SET_CTR = 15,    // configure the CTR accessor on a sparse table
+  CMD_PUSH_CTR = 16,   // push with show/click counts (ctr_accessor Update)
+  CMD_SHRINK = 17,     // decay + score-based eviction pass
+  CMD_CTR_STATS = 18,  // show/click/unseen/score for one key (tests)
 };
 
 // flags bits

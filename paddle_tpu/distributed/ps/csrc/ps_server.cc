@@ -238,6 +238,70 @@ struct Server {
         reply(fd, h, kStatusOk, nullptr, 0);
         return true;
       }
+      case CMD_SET_CTR: {
+        // payload: f32 show_coeff, click_coeff, decay, threshold, unseen
+        SparseTable* t = get_sparse(h.table_id);
+        if (!t || payload.size() < 5 * sizeof(float)) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        const float* p = reinterpret_cast<const float*>(payload.data());
+        t->ctr.enabled = true;
+        t->ctr.show_coeff = p[0];
+        t->ctr.click_coeff = p[1];
+        t->ctr.decay_rate = p[2];
+        t->ctr.delete_threshold = p[3];
+        t->ctr.delete_after_unseen_days = p[4];
+        reply(fd, h, kStatusOk, nullptr, 0);
+        return true;
+      }
+      case CMD_PUSH_CTR: {
+        // payload: i64 keys[n], f32 shows[n], f32 clicks[n], f32 grads[n*dim]
+        SparseTable* t = get_sparse(h.table_id);
+        const int64_t n = h.n;
+        if (!t || payload.size() <
+                      static_cast<size_t>(n) *
+                          (sizeof(int64_t) + 2 * sizeof(float) +
+                           sizeof(float) * t->emb_dim)) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        const char* p = payload.data();
+        const int64_t* keys = reinterpret_cast<const int64_t*>(p);
+        const float* shows =
+            reinterpret_cast<const float*>(p + sizeof(int64_t) * n);
+        const float* clicks = shows + n;
+        const float* grads = clicks + n;
+        t->push_ctr(keys, n, shows, clicks, grads);
+        reply(fd, h, kStatusOk, nullptr, 0);
+        return true;
+      }
+      case CMD_SHRINK: {
+        SparseTable* t = get_sparse(h.table_id);
+        if (!t) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        int64_t evicted = t->shrink();
+        reply(fd, h, kStatusOk, &evicted, sizeof(evicted));
+        return true;
+      }
+      case CMD_CTR_STATS: {
+        SparseTable* t = get_sparse(h.table_id);
+        if (!t || payload.size() < sizeof(int64_t)) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        int64_t key;
+        std::memcpy(&key, payload.data(), sizeof(key));
+        float out[4] = {0, 0, 0, 0};
+        if (!t->ctr_stats(key, out)) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        reply(fd, h, kStatusOk, out, sizeof(out));
+        return true;
+      }
       case CMD_PULL_DENSE: {
         DenseTable* t = get_dense(h.table_id);
         if (!t) {

@@ -25,11 +25,30 @@
 
 namespace ps {
 
-enum OptType : int32_t { OPT_SGD = 0, OPT_ADAGRAD = 1 };
+// pluggable per-feature SGD rules (reference: ps/table/sparse_sgd_rule.h —
+// SparseNaiveSGDRule / SparseAdaGradSGDRule / SparseAdamSGDRule)
+enum OptType : int32_t { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2 };
 
 struct Entry {
   std::vector<float> emb;
-  std::vector<float> g2sum;  // adagrad accumulator (empty for sgd)
+  std::vector<float> g2sum;  // adagrad accumulator / adam moment1
+  std::vector<float> m2;     // adam moment2 (empty otherwise)
+  float b1p = 1.f, b2p = 1.f;  // adam bias-correction powers
+  // CTR accessor state (reference: ctr_accessor.h CtrCommonFeatureValue —
+  // show/click/unseen_days drive time decay + score-based eviction)
+  float show = 0.f, click = 0.f, unseen_days = 0.f;
+};
+
+// reference: CtrCommonAccessor config (table_accessor proto fields
+// show_click_decay_rate, delete_threshold, delete_after_unseen_days and
+// ShowClickScore's nonclk/click coefficients)
+struct CtrParams {
+  bool enabled = false;
+  float show_coeff = 0.25f;    // reference nonclk_coeff
+  float click_coeff = 1.0f;
+  float decay_rate = 0.98f;    // per-shrink show/click decay
+  float delete_threshold = 0.8f;
+  float delete_after_unseen_days = 30.f;
 };
 
 struct Shard {
@@ -44,8 +63,10 @@ struct SparseTable {
   float lr;
   float init_range;  // uniform(-init_range, init_range); 0 => zeros
   float adagrad_eps;
-  uint64_t seed;
+  float beta1, beta2;  // adam
+  CtrParams ctr;
   std::vector<Shard> shards;
+  uint64_t seed;
 
   SparseTable(int dim, int nshard, int32_t opt, float lr_, float range,
               uint64_t seed_)
@@ -55,8 +76,10 @@ struct SparseTable {
         lr(lr_),
         init_range(range),
         adagrad_eps(1e-6f),
-        seed(seed_),
-        shards(nshard) {}
+        beta1(0.9f),
+        beta2(0.999f),
+        shards(nshard),
+        seed(seed_) {}
 
   int shard_of(int64_t key) const {
     uint64_t h = (static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ULL) >> 32;
@@ -74,6 +97,37 @@ struct SparseTable {
       for (int i = 0; i < emb_dim; ++i) e->emb[i] = dist(gen);
     }
     if (opt_type == OPT_ADAGRAD) e->g2sum.assign(emb_dim, 0.f);
+    if (opt_type == OPT_ADAM) {
+      e->g2sum.assign(emb_dim, 0.f);  // moment1
+      e->m2.assign(emb_dim, 0.f);
+    }
+  }
+
+  // one SGD-rule application on an entry (reference: sparse_sgd_rule.cc
+  // UpdateValueWork per rule)
+  void apply_rule(Entry& e, const float* g) {
+    if (opt_type == OPT_ADAGRAD) {
+      for (int i = 0; i < emb_dim; ++i) {
+        e.g2sum[i] += g[i] * g[i];
+        e.emb[i] -= lr * g[i] / (std::sqrt(e.g2sum[i]) + adagrad_eps);
+      }
+    } else if (opt_type == OPT_ADAM) {
+      e.b1p *= beta1;
+      e.b2p *= beta2;
+      for (int i = 0; i < emb_dim; ++i) {
+        e.g2sum[i] = beta1 * e.g2sum[i] + (1.f - beta1) * g[i];
+        e.m2[i] = beta2 * e.m2[i] + (1.f - beta2) * g[i] * g[i];
+        float mh = e.g2sum[i] / (1.f - e.b1p);
+        float vh = e.m2[i] / (1.f - e.b2p);
+        e.emb[i] -= lr * mh / (std::sqrt(vh) + adagrad_eps);
+      }
+    } else {
+      for (int i = 0; i < emb_dim; ++i) e.emb[i] -= lr * g[i];
+    }
+  }
+
+  float show_click_score(const Entry& e) const {
+    return ctr.show_coeff * (e.show - e.click) + ctr.click_coeff * e.click;
   }
 
   // gather rows for keys; missing keys are created (reference PullSparse
@@ -114,15 +168,73 @@ struct SparseTable {
       const float* g = grads + idx * emb_dim;
       if (raw) {
         for (int i = 0; i < emb_dim; ++i) e.emb[i] += g[i];
-      } else if (opt_type == OPT_ADAGRAD) {
-        for (int i = 0; i < emb_dim; ++i) {
-          e.g2sum[i] += g[i] * g[i];
-          e.emb[i] -= lr * g[i] / (std::sqrt(e.g2sum[i]) + adagrad_eps);
-        }
       } else {
-        for (int i = 0; i < emb_dim; ++i) e.emb[i] -= lr * g[i];
+        apply_rule(e, g);
       }
     });
+  }
+
+  // CTR push (reference: ctr_accessor.cc Update — fold per-impression
+  // show/click counts into the feature value, reset its unseen clock, then
+  // apply the SGD rule on the gradient)
+  void push_ctr(const int64_t* keys, int64_t n, const float* shows,
+                const float* clicks, const float* grads) {
+    run_sharded(keys, n, [&](Shard& sh, int64_t idx) {
+      int64_t key = keys[idx];
+      auto it = sh.map.find(key);
+      if (it == sh.map.end()) {
+        Entry e;
+        init_entry(key, &e);
+        it = sh.map.emplace(key, std::move(e)).first;
+      }
+      Entry& e = it->second;
+      e.show += shows[idx];
+      e.click += clicks[idx];
+      e.unseen_days = 0.f;
+      apply_rule(e, grads + idx * emb_dim);
+    });
+  }
+
+  // one decay+eviction pass = one "day" (reference: ctr_accessor.cc
+  // UpdateTimeDecay + Shrink): show/click decay, unseen clocks advance,
+  // and features whose score fell under delete_threshold — or that were
+  // unseen too long — are evicted. Returns the evicted count.
+  int64_t shrink() {
+    // without the CTR accessor every entry scores 0 — a stray shrink()
+    // must not wipe a plain embedding table
+    if (!ctr.enabled) return 0;
+    int64_t evicted = 0;
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      for (auto it = sh.map.begin(); it != sh.map.end();) {
+        Entry& e = it->second;
+        e.show *= ctr.decay_rate;
+        e.click *= ctr.decay_rate;
+        e.unseen_days += 1.f;
+        if (e.unseen_days > ctr.delete_after_unseen_days ||
+            show_click_score(e) < ctr.delete_threshold) {
+          it = sh.map.erase(it);
+          ++evicted;
+        } else {
+          ++it;
+        }
+      }
+    }
+    return evicted;
+  }
+
+  // out[4] = show, click, unseen_days, score; false when key absent
+  bool ctr_stats(int64_t key, float* out) {
+    Shard& sh = shards[shard_of(key)];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    auto it = sh.map.find(key);
+    if (it == sh.map.end()) return false;
+    const Entry& e = it->second;
+    out[0] = e.show;
+    out[1] = e.click;
+    out[2] = e.unseen_days;
+    out[3] = show_click_score(e);
+    return true;
   }
 
   // shard-parallel execution: keys are bucketed by shard in one pass, each
@@ -171,21 +283,34 @@ struct SparseTable {
     FILE* f = std::fopen(path, "wb");
     if (!f) return false;
     int64_t n = size();
-    int32_t has_g2 = (opt_type == OPT_ADAGRAD) ? 1 : 0;
+    // state code: low bits = opt rule (0 sgd / 1 adagrad / 2 adam),
+    // +4 = ctr fields present. Codes 0/1 match the pre-ctr format.
+    int32_t code = opt_type | (ctr.enabled ? 4 : 0);
     bool ok = std::fwrite(&emb_dim, sizeof(emb_dim), 1, f) == 1 &&
-              std::fwrite(&has_g2, sizeof(has_g2), 1, f) == 1 &&
+              std::fwrite(&code, sizeof(code), 1, f) == 1 &&
               std::fwrite(&n, sizeof(n), 1, f) == 1;
     for (auto& sh : shards) {
       if (!ok) break;
       std::lock_guard<std::mutex> lk(sh.mu);
       for (const auto& kv : sh.map) {
+        const Entry& e = kv.second;
         ok = ok && std::fwrite(&kv.first, sizeof(int64_t), 1, f) == 1 &&
-             std::fwrite(kv.second.emb.data(), sizeof(float), emb_dim, f) ==
+             std::fwrite(e.emb.data(), sizeof(float), emb_dim, f) ==
                  static_cast<size_t>(emb_dim);
-        if (has_g2)
-          ok = ok &&
-               std::fwrite(kv.second.g2sum.data(), sizeof(float), emb_dim,
-                           f) == static_cast<size_t>(emb_dim);
+        if (opt_type != OPT_SGD)
+          ok = ok && std::fwrite(e.g2sum.data(), sizeof(float), emb_dim,
+                                 f) == static_cast<size_t>(emb_dim);
+        if (opt_type == OPT_ADAM) {
+          ok = ok && std::fwrite(e.m2.data(), sizeof(float), emb_dim, f) ==
+                   static_cast<size_t>(emb_dim) &&
+               std::fwrite(&e.b1p, sizeof(float), 1, f) == 1 &&
+               std::fwrite(&e.b2p, sizeof(float), 1, f) == 1;
+        }
+        if (ctr.enabled) {
+          ok = ok && std::fwrite(&e.show, sizeof(float), 1, f) == 1 &&
+               std::fwrite(&e.click, sizeof(float), 1, f) == 1 &&
+               std::fwrite(&e.unseen_days, sizeof(float), 1, f) == 1;
+        }
         if (!ok) break;
       }
     }
@@ -211,6 +336,8 @@ struct SparseTable {
       std::lock_guard<std::mutex> lk(sh.mu);
       sh.map.clear();
     }
+    const int32_t file_opt = has_g2 & 3;  // state code: rule bits + ctr bit
+    const bool file_ctr = (has_g2 & 4) != 0;
     bool ok = true;
     for (int64_t i = 0; i < n; ++i) {
       int64_t key;
@@ -225,15 +352,35 @@ struct SparseTable {
         ok = false;
         break;
       }
-      if (has_g2) {
+      if (file_opt != OPT_SGD) {
         e.g2sum.resize(emb_dim);
         if (std::fread(e.g2sum.data(), sizeof(float), emb_dim, f) !=
             static_cast<size_t>(emb_dim)) {
           ok = false;
           break;
         }
-      } else if (opt_type == OPT_ADAGRAD) {
+      } else if (opt_type != OPT_SGD) {
         e.g2sum.assign(emb_dim, 0.f);
+      }
+      if (file_opt == OPT_ADAM) {
+        e.m2.resize(emb_dim);
+        if (std::fread(e.m2.data(), sizeof(float), emb_dim, f) !=
+                static_cast<size_t>(emb_dim) ||
+            std::fread(&e.b1p, sizeof(float), 1, f) != 1 ||
+            std::fread(&e.b2p, sizeof(float), 1, f) != 1) {
+          ok = false;
+          break;
+        }
+      } else if (opt_type == OPT_ADAM) {
+        e.m2.assign(emb_dim, 0.f);
+      }
+      if (file_ctr) {
+        if (std::fread(&e.show, sizeof(float), 1, f) != 1 ||
+            std::fread(&e.click, sizeof(float), 1, f) != 1 ||
+            std::fread(&e.unseen_days, sizeof(float), 1, f) != 1) {
+          ok = false;
+          break;
+        }
       }
       Shard& sh = shards[shard_of(key)];
       std::lock_guard<std::mutex> lk(sh.mu);

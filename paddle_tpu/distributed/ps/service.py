@@ -122,11 +122,27 @@ def _load_client_lib():
         ]
         lib.ps_client_stop_servers.restype = ctypes.c_int
         lib.ps_client_stop_servers.argtypes = [ctypes.c_void_p]
+        lib.ps_client_set_ctr.restype = ctypes.c_int
+        lib.ps_client_set_ctr.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+        ] + [ctypes.c_float] * 5
+        lib.ps_client_push_ctr.restype = ctypes.c_int
+        lib.ps_client_push_ctr.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.ps_client_shrink.restype = ctypes.c_int64
+        lib.ps_client_shrink.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.ps_client_ctr_stats.restype = ctypes.c_int
+        lib.ps_client_ctr_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_void_p,
+        ]
         _client_lib = lib
     return _client_lib
 
 
-_OPT_IDS = {"sgd": 0, "adagrad": 1}
+_OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2}
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
 
@@ -292,6 +308,43 @@ class PsClient:
         """Set the optimizer lr of one table, or of every table (id 0)."""
         self._lib.ps_client_set_lr(self._h, table_id, ctypes.c_float(lr))
 
+    # -- CTR accessor (reference: ctr_accessor.h over the wire) --------------
+    def set_ctr(self, table_id: int, ctr) -> None:
+        """Enable the CTR accessor on a fleet table (CtrAccessorConfig)."""
+        if self._lib.ps_client_set_ctr(
+            self._h, table_id,
+            *[ctypes.c_float(v) for v in ctr.as_floats()],
+        ) != 0:
+            raise ConnectionError("set_ctr failed")
+
+    def push_ctr(self, table_id: int, keys: np.ndarray, shows: np.ndarray,
+                 clicks: np.ndarray, grads: np.ndarray):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        shows = np.ascontiguousarray(shows, np.float32).reshape(-1)
+        clicks = np.ascontiguousarray(clicks, np.float32).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32)
+        emb_dim = grads.size // max(keys.size, 1)
+        if self._lib.ps_client_push_ctr(
+            self._h, table_id, keys.ctypes.data, keys.size, emb_dim,
+            shows.ctypes.data, clicks.ctypes.data, grads.ctypes.data,
+        ) != 0:
+            raise ConnectionError("push_ctr failed")
+
+    def shrink(self, table_id: int) -> int:
+        """Fleet-wide decay+eviction pass; returns total evicted."""
+        n = self._lib.ps_client_shrink(self._h, table_id)
+        if n < 0:
+            raise ConnectionError("shrink failed")
+        return int(n)
+
+    def ctr_stats(self, table_id: int, key: int):
+        out = np.zeros(4, np.float32)
+        if self._lib.ps_client_ctr_stats(
+            self._h, table_id, int(key), out.ctypes.data
+        ) != 0:
+            return None
+        return tuple(float(v) for v in out)
+
 
 class DistributedSparseTable:
     """MemorySparseTable-compatible facade over the server fleet, so
@@ -301,18 +354,30 @@ class DistributedSparseTable:
     def __init__(self, client: PsClient, table_id: int, emb_dim: int,
                  shard_num: int = 16, optimizer: str = "adagrad",
                  learning_rate: float = 0.05, init_range: float = 0.01,
-                 seed: int = 0, create: bool = True):
+                 seed: int = 0, create: bool = True, ctr=None):
         self.client = client
         self.table_id = table_id
         self.emb_dim = emb_dim
+        self.ctr = ctr
         if create:
             client.create_sparse_table(
                 table_id, emb_dim, shard_num, optimizer, learning_rate,
                 init_range, seed,
             )
+        if ctr is not None:
+            client.set_ctr(table_id, ctr)
 
     def pull(self, keys: np.ndarray, create: bool = True) -> np.ndarray:
         return self.client.pull_sparse(self.table_id, keys, self.emb_dim, create)
+
+    def push_ctr(self, keys, shows, clicks, grads):
+        self.client.push_ctr(self.table_id, keys, shows, clicks, grads)
+
+    def shrink(self) -> int:
+        return self.client.shrink(self.table_id)
+
+    def ctr_stats(self, key: int):
+        return self.client.ctr_stats(self.table_id, key)
 
     def push(self, keys: np.ndarray, grads: np.ndarray):
         self.client.push_sparse(self.table_id, keys, grads)

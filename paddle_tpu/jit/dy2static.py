@@ -85,6 +85,9 @@ class _Undefined:
 UNDEF = _Undefined()
 
 
+_PROBING = [False]  # type-probe mode: see convert_while's traced_loop
+
+
 class _Runtime:
     """Runtime dispatch helpers the transformed code calls (reference:
     convert_operators.py). Injected as `__jst` into the function globals."""
@@ -96,10 +99,24 @@ class _Runtime:
         return lcls.get(name, UNDEF)
 
     @staticmethod
-    def convert_ifelse(pred, true_fn, false_fn, carry, guard=False):
+    def convert_ifelse(pred, true_fn, false_fn, carry, guard=False,
+                       both=None):
         pred = _to_bool_value(pred)
         if isinstance(pred, jax.core.Tracer):
             from ..core.tensor import Tensor
+
+            if _PROBING[0]:
+                # type-probe pass: no lax.cond — run both branches. Slots
+                # bound at ENTRY keep their entry value (so a probe never
+                # flips a control flag and short-circuits later guards);
+                # entry-UNDEF slots take whichever branch bound them —
+                # only their shapes/dtypes are consumed by the prober.
+                t_out = true_fn(carry)
+                f_out = false_fn(carry)
+                return tuple(
+                    c if c is not UNDEF else (t if t is not UNDEF else f)
+                    for c, t, f in zip(carry, t_out, f_out)
+                )
 
             # UNDEF slots (bound only inside the branches) can't be cond
             # operands — they ride as closure constants and must come back
@@ -117,14 +134,17 @@ class _Runtime:
             # temp left unbound by BOTH branches merges fine; bound by only
             # one branch → lax.cond pytree-structure mismatch (caught below
             # with a readable message).
-            # guard=True (the break/continue remainder guard, whose else
-            # branch is empty by construction): a slot UNDEF at ENTRY stays
-            # UNDEF — the true branch's binding of a loop-local temp is
-            # consumed inside the branch and recomputed next iteration, so
-            # discarding it preserves semantics where strict merging would
-            # reject ordinary user code
+            # guard=True (break/continue remainder guards and early-return
+            # ifs): a slot UNDEF at ENTRY that is NOT statically bound by
+            # both branches stays UNDEF — its binding is consumed inside
+            # the branch (or recomputed next iteration), so discarding it
+            # preserves semantics where strict merging would reject
+            # ordinary user code. both[i]=True marks slots every branch
+            # binds (e.g. _jst_retval), which merge normally.
+            both = both or (False,) * len(carry)
             undef_in = (
-                {i for i, c in enumerate(carry) if c is UNDEF}
+                {i for i, c in enumerate(carry)
+                 if c is UNDEF and not both[i]}
                 if guard else frozenset()
             )
 
@@ -172,6 +192,14 @@ class _Runtime:
         droppable = droppable or (False,) * len(carry)
 
         def traced_loop(carry):
+            if _PROBING[0]:
+                # nested loop inside an outer type probe: one body pass
+                # stands in for the whole loop (slots it leaves UNDEF keep
+                # their entry value)
+                out = body_fn(tuple(carry))
+                return tuple(
+                    o if o is not UNDEF else c for o, c in zip(out, carry)
+                )
             kept = [
                 i for i, c in enumerate(carry)
                 if not (c is UNDEF and droppable[i])
@@ -183,6 +211,34 @@ class _Runtime:
                     "body) must be initialized before the loop "
                     "(lax.while_loop needs a typed carry)"
                 )
+            # type-probe droppable temps (body-local names with no value at
+            # loop entry): one traced body pass reveals their shapes/dtypes,
+            # letting them JOIN the carry zero-initialised — so a temp
+            # computed in the loop stays bound after it, like python (the
+            # probe's compute is dead code XLA eliminates). A temp the
+            # probe leaves UNDEF (e.g. bound only under a concrete-False
+            # branch) keeps the old ride-outside behavior.
+            dropped = [
+                i for i, c in enumerate(carry)
+                if c is UNDEF and droppable[i]
+            ]
+            if dropped:
+                _PROBING[0] = True
+                try:
+                    probe = body_fn(tuple(carry))
+                finally:
+                    _PROBING[0] = False
+                carry = list(carry)
+                for i in dropped:
+                    o = probe[i]
+                    if o is not UNDEF:
+                        carry[i] = Tensor(
+                            jnp.zeros_like(jnp.asarray(_unwrap(o))),
+                            stop_gradient=True,
+                        )
+                        kept.append(i)
+                carry = tuple(carry)
+                kept.sort()
             vals = tuple(jnp.asarray(_unwrap(carry[i])) for i in kept)
 
             def rebuild(vs):
@@ -590,11 +646,11 @@ def _strip_returns(stmts: List[ast.stmt]) -> List[ast.stmt]:
             elif isinstance(s, ast.If) and _has_own([s], (ast.Return,)):
                 new = ast.If(test=s.test, body=strip(s.body),
                              orelse=strip(s.orelse))
-                # only _jst_retval survives this if (everything after it in
-                # the function was absorbed INTO it) — restricting the
-                # merge carry keeps branch-local trailing temps from
-                # tripping the both-branches-must-bind rule
-                new._jst_carry_names = [_RETVAL]
+                # guard semantics: branch-local trailing temps (bound in
+                # only one branch, UNDEF at entry) are discarded instead of
+                # tripping the both-branches-must-bind rule; _jst_retval is
+                # bound by every path so it merges normally
+                new._jst_guard = True
                 out.append(new)
             else:
                 out.append(s)
@@ -602,7 +658,7 @@ def _strip_returns(stmts: List[ast.stmt]) -> List[ast.stmt]:
 
     new_if = ast.If(test=last.test, body=strip(last.body),
                     orelse=strip(last.orelse))
-    new_if._jst_carry_names = [_RETVAL]
+    new_if._jst_guard = True
     return stmts[:-1] + [
         new_if,
         ast.Return(value=ast.Name(id=_RETVAL, ctx=ast.Load())),
@@ -822,10 +878,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if _contains_disallowed(node.body) or _contains_disallowed(node.orelse):
             return node
-        only = getattr(node, "_jst_carry_names", None)
-        carry = (list(only) if only is not None
-                 else sorted(_assigned_names(node.body)
-                             | _assigned_names(node.orelse)))
+        body_names = _assigned_names(node.body)
+        orelse_names = _assigned_names(node.orelse)
+        carry = sorted(body_names | orelse_names)
+        is_guard = getattr(node, "_jst_guard", False)
         tname, fname = self._fresh("true"), self._fresh("false")
 
         def branch(name: str, body: List[ast.stmt]) -> ast.FunctionDef:
@@ -863,8 +919,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 _name_tuple(carry, ast.Load),
             ],
             keywords=(
-                [ast.keyword(arg="guard", value=ast.Constant(True))]
-                if getattr(node, "_jst_guard", False) else []
+                [
+                    ast.keyword(arg="guard", value=ast.Constant(True)),
+                    ast.keyword(arg="both", value=ast.Tuple(
+                        elts=[
+                            ast.Constant(n in body_names and n in orelse_names)
+                            for n in carry
+                        ],
+                        ctx=ast.Load(),
+                    )),
+                ]
+                if is_guard else []
             ),
         )
         assign: ast.stmt = (
