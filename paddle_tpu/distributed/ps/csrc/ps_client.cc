@@ -60,6 +60,8 @@ struct Client {
       case CMD_SET_DENSE:
       case CMD_STAT:
       case CMD_SET_LR:
+      case CMD_SET_CTR:
+      case CMD_CTR_STATS:
       case CMD_SAVE:
       case CMD_LOAD:
         return true;

@@ -32,8 +32,12 @@ __all__ = [
     "PostTrainingQuantization",
     "QuantedLinear",
     "QuantedConv2D",
+    "QuantedEmbedding",
     "fake_quant_abs_max",
     "fake_quant_channel_wise_abs_max",
+    "observers",
+    "passes",
+    "int8",
 ]
 
 
@@ -164,7 +168,89 @@ class QuantedConv2D(Layer):
         )
 
 
-_QUANT_MAP = {"Linear": QuantedLinear, "Conv2D": QuantedConv2D}
+class QuantedEmbedding(Layer):
+    """Weight-only fake-quant embedding (reference: qat.py
+    QuantizedEmbedding — ids carry no activation scale; grads flow to the
+    float weight through the STE)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max"):
+        super().__init__()
+        self._embedding = layer
+        self.weight_bits = weight_bits
+
+    def forward(self, ids):
+        wq = fake_quant_abs_max(self._embedding.weight, self.weight_bits)
+        return nn.functional.embedding(
+            ids, wq, padding_idx=getattr(self._embedding, "_padding_idx", None)
+        )
+
+
+class _QuantedParallelEmbedding(Layer):
+    """PTQ wrapper for VocabParallelEmbedding: runs the ORIGINAL forward
+    (keeping its sharding constraint — a plain embedding lookup would let
+    XLA replicate the vocab-sharded table) with the fake-quant weight
+    bound in. Inference/PTQ only, like _QuantedParallelLinear."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max"):
+        super().__init__()
+        self._inner = layer
+        self.weight_bits = weight_bits
+
+    def forward(self, ids):
+        if self.training:
+            raise RuntimeError(
+                "QAT training through VocabParallelEmbedding is not "
+                "supported (the quantized-weight bind bypasses the tape); "
+                "use PTQ (model.eval()) or quantize before distributing"
+            )
+        from ..jit import _bind_values
+
+        wq = fake_quant_abs_max(self._inner.weight, self.weight_bits)
+        with _bind_values([self._inner.weight], [wq._value]):
+            return self._inner(ids)
+
+
+class _QuantedParallelLinear(Layer):
+    """PTQ wrapper for TP linears: fake-quants the input/weight, then runs
+    the ORIGINAL layer's forward (with its sharding constraints and
+    collectives) on the quantized weight via a temporary value bind.
+    Inference/PTQ only — the bind bypasses the tape, so QAT training
+    through this wrapper is refused rather than silently unquantized."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._inner = layer
+        self.weight_bits = weight_bits
+        self.fq_act = _FakeQuantAct(activation_bits, moving_rate)
+
+    def forward(self, x):
+        if self.training:
+            raise RuntimeError(
+                "QAT training through a tensor-parallel linear is not "
+                "supported (the quantized-weight bind bypasses the tape); "
+                "use PTQ (model.eval()) or quantize before distributing"
+            )
+        from ..jit import _bind_values
+
+        xq = self.fq_act(x)
+        wq = fake_quant_channel_wise_abs_max(
+            self._inner.weight, self.weight_bits, axis=-1
+        )
+        with _bind_values([self._inner.weight], [wq._value]):
+            return self._inner(xq)
+
+
+_QUANT_MAP = {
+    "Linear": QuantedLinear,
+    "Conv2D": QuantedConv2D,
+    "Embedding": QuantedEmbedding,
+    "VocabParallelEmbedding": _QuantedParallelEmbedding,
+    "ColumnParallelLinear": _QuantedParallelLinear,
+    "RowParallelLinear": _QuantedParallelLinear,
+}
 
 
 class ImperativeQuantAware:
@@ -205,56 +291,55 @@ class ImperativeQuantAware:
 
 
 class PostTrainingQuantization:
-    """PTQ (reference: post_training_quantization.py): run calibration data
-    through the float model, record per-activation abs-max ranges, attach
-    frozen scales."""
+    """Pass-driven PTQ (reference: post_training_quantization.py over the
+    quantization_pass.py pipeline): InsertObservers → Calibrate →
+    FreezeScales (→ ConvertToInt8 when int8_inference=True). `algo` picks
+    the activation observer: abs_max | avg | hist | mse."""
 
-    def __init__(self, model: Layer, quantizable_layer_type=("Conv2D", "Linear"),
-                 weight_bits=8, activation_bits=8):
+    def __init__(self, model: Layer,
+                 quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_bits=8, activation_bits=8, algo: str = "abs_max"):
+        from .passes import QuantConfig
+
         self.model = model
-        self.types = tuple(quantizable_layer_type)
-        self.weight_bits = weight_bits
-        self.activation_bits = activation_bits
-        self._ranges = {}
-
-    def quantize(self, data_loader, batch_nums: Optional[int] = None) -> Layer:
-        # hooks record input abs-max per quantizable layer
-        handles = []
-        names = {}
-        for name, layer in self.model.named_sublayers():
-            if type(layer).__name__ in self.types:
-                names[id(layer)] = name
-
-                def hook(lyr, inputs, _name=name):
-                    x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
-                    m = float(jnp.max(jnp.abs(x._value)))
-                    self._ranges[_name] = max(self._ranges.get(_name, 0.0), m)
-
-                handles.append(layer.register_forward_pre_hook(hook))
-        self.model.eval()
-        with no_grad():
-            for i, batch in enumerate(data_loader):
-                if batch_nums is not None and i >= batch_nums:
-                    break
-                x = batch[0] if isinstance(batch, (tuple, list)) else batch
-                self.model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(np.asarray(x))))
-        for h in handles:
-            h.remove()
-        # freeze: swap in wrappers with calibrated (non-moving) scales
-        q = ImperativeQuantAware(
-            quantizable_layer_type=self.types,
-            weight_bits=self.weight_bits, activation_bits=self.activation_bits,
+        self.config = QuantConfig(
+            quantizable_layer_type=quantizable_layer_type,
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            algo=algo,
         )
-        q.quantize(self.model)
-        for name, layer in self.model.named_sublayers():
-            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
-                base = name
-                scale = self._ranges.get(base, 0.0)
-                if scale > 0:
-                    with no_grad():
-                        layer.fq_act.scale._value = jnp.asarray(scale, jnp.float32)
+        self._report = {}
+        self._scales = {}
+
+    def quantize(self, data_loader, batch_nums: Optional[int] = None,
+                 int8_inference: bool = False) -> Layer:
+        from .passes import (
+            CalibratePass,
+            ConvertToInt8Pass,
+            FreezeScalesPass,
+            InsertObserversPass,
+            QuantPassManager,
+        )
+
+        passes = [
+            InsertObserversPass(),
+            CalibratePass(data_loader, batch_nums),
+            FreezeScalesPass(),
+        ]
+        if int8_inference:
+            passes.append(ConvertToInt8Pass())
+        st = QuantPassManager(passes).run(self.model, self.config)
+        self._report = st.report
+        self._scales = dict(st.scales)
         return self.model
 
     @property
     def activation_ranges(self):
-        return dict(self._ranges)
+        return dict(self._scales)
+
+    @property
+    def pass_report(self):
+        return dict(self._report)
+
+
+from . import int8, observers, passes  # noqa: E402,F401
+from .int8 import Int8Linear, int8_matmul, quantize_weight_int8  # noqa: E402,F401

@@ -1,0 +1,141 @@
+"""Activation/weight observers for calibration.
+
+Reference analogue: slim/quantization's calibration machinery —
+post_training_quantization.py collects abs_max / histogram ranges
+(algo="abs_max" | "KL" | "hist" | "mse" | "avg") per tensor before
+computing the frozen quantization scales. Each observer here consumes
+calibration batches via `collect(x)` and yields a scalar (or per-channel)
+`scale()`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AbsMaxObserver", "EMAAbsMaxObserver", "HistObserver",
+           "MSEObserver", "make_observer"]
+
+
+class AbsMaxObserver:
+    """Running max of |x| (reference algo='abs_max')."""
+
+    def __init__(self, bits: int = 8):
+        self.bits = bits
+        self._max = 0.0
+
+    def collect(self, x: np.ndarray):
+        self._max = max(self._max, float(np.max(np.abs(x))))
+
+    def scale(self) -> float:
+        return max(self._max, 1e-8)
+
+
+class EMAAbsMaxObserver:
+    """Exponential moving average of per-batch abs-max (reference
+    algo='avg' family / moving_average_abs_max)."""
+
+    def __init__(self, bits: int = 8, rate: float = 0.9):
+        self.bits = bits
+        self.rate = rate
+        self._state = None
+
+    def collect(self, x: np.ndarray):
+        cur = float(np.max(np.abs(x)))
+        self._state = cur if self._state is None else (
+            self.rate * self._state + (1 - self.rate) * cur
+        )
+
+    def scale(self) -> float:
+        return max(self._state or 0.0, 1e-8)
+
+
+class HistObserver:
+    """Percentile-of-histogram range (reference algo='hist'): clips the
+    long activation tail that abs-max would waste quantization bins on."""
+
+    def __init__(self, bits: int = 8, bins: int = 2048,
+                 percentile: float = 0.9999):
+        self.bits = bits
+        self.bins = bins
+        self.percentile = percentile
+        self._hist = np.zeros(bins, np.float64)
+        self._max = 0.0
+
+    def collect(self, x: np.ndarray):
+        a = np.abs(np.asarray(x, np.float32)).reshape(-1)
+        m = float(a.max()) if a.size else 0.0
+        if m == 0.0:
+            return
+        if m > self._max:
+            # remap the existing histogram onto the wider range: old bin i
+            # (center (i+0.5)*old_max/bins) lands in new bin
+            # floor((i+0.5)*old_max/new_max)
+            if self._max > 0.0:
+                ratio = self._max / m
+                old = self._hist
+                self._hist = np.zeros(self.bins, np.float64)
+                idx = np.clip(
+                    ((np.arange(self.bins) + 0.5) * ratio).astype(np.int64),
+                    0, self.bins - 1,
+                )
+                np.add.at(self._hist, idx, old)
+            self._max = m
+        h, _ = np.histogram(a, bins=self.bins, range=(0.0, self._max))
+        self._hist += h
+
+    def scale(self) -> float:
+        total = self._hist.sum()
+        if total <= 0:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cdf, self.percentile))
+        return max((idx + 1) / self.bins * self._max, 1e-8)
+
+
+class MSEObserver:
+    """Scale minimizing quantization MSE over a retained sample
+    (reference algo='mse': grid-search candidate clips)."""
+
+    def __init__(self, bits: int = 8, sample: int = 65536, steps: int = 40):
+        self.bits = bits
+        self.sample = sample
+        self.steps = steps
+        self._data = None
+        self._max = 0.0
+
+    def collect(self, x: np.ndarray):
+        a = np.asarray(x, np.float32).reshape(-1)
+        self._max = max(self._max, float(np.max(np.abs(a))) if a.size else 0.0)
+        if a.size > self.sample:
+            stride = a.size // self.sample
+            a = a[::stride][: self.sample]
+        self._data = a if self._data is None else np.concatenate(
+            [self._data, a]
+        )[-self.sample:]
+
+    def scale(self) -> float:
+        if self._data is None or self._max == 0.0:
+            return 1e-8
+        qmax = 2 ** (self.bits - 1) - 1
+        best, best_err = self._max, np.inf
+        for k in range(1, self.steps + 1):
+            s = self._max * k / self.steps
+            q = np.clip(np.round(self._data / s * qmax), -qmax, qmax) \
+                / qmax * s
+            err = float(np.mean((q - self._data) ** 2))
+            if err < best_err:
+                best, best_err = s, err
+        return max(best, 1e-8)
+
+
+_OBSERVERS = {
+    "abs_max": AbsMaxObserver,
+    "avg": EMAAbsMaxObserver,
+    "hist": HistObserver,
+    "mse": MSEObserver,
+}
+
+
+def make_observer(algo: str, bits: int = 8):
+    if algo not in _OBSERVERS:
+        raise ValueError(f"algo must be one of {sorted(_OBSERVERS)}")
+    return _OBSERVERS[algo](bits=bits)

@@ -1,0 +1,185 @@
+"""Pass-driven post-training quantization.
+
+Reference analogue: slim/quantization/quantization_pass.py — the static
+PTQ pipeline is a sequence of program passes (QuantizationTransformPass
+inserts quant/dequant + observer ops, the calibration run fills ranges,
+QuantizationFreezePass folds scales in, and the int8 conversion pass
+lowers to quantized kernels). The TPU build's "program" is the layer
+graph; each pass below rewrites it with the same division of labor:
+
+    InsertObserversPass  -> hook an observer on every quantizable layer
+    CalibratePass        -> stream calibration batches through the model
+    FreezeScalesPass     -> swap layers for fake-quant wrappers with the
+                            calibrated scales frozen in
+    ConvertToInt8Pass    -> (optional, inference) lower calibrated Linears
+                            to Int8Linear running the int8 MXU dot
+
+`QuantPassManager.run()` applies them in order; every pass reports what it
+touched so nothing happens silently.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .observers import make_observer
+
+__all__ = ["QuantConfig", "InsertObserversPass", "CalibratePass",
+           "FreezeScalesPass", "ConvertToInt8Pass", "QuantPassManager"]
+
+
+class QuantConfig:
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear",
+                                               "Embedding",
+                                               "ColumnParallelLinear",
+                                               "RowParallelLinear"),
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 algo: str = "abs_max"):
+        self.types = tuple(quantizable_layer_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.algo = algo
+
+
+class _PassState:
+    """What flows between passes: per-layer observers and frozen scales."""
+
+    def __init__(self, model: Layer, config: QuantConfig):
+        self.model = model
+        self.config = config
+        self.observers: Dict[str, object] = {}
+        self.scales: Dict[str, float] = {}
+        self._handles: List = []
+        self.report: Dict[str, object] = {}
+
+
+class InsertObserversPass:
+    """Attach an activation observer ahead of every quantizable layer
+    (reference: QuantizationTransformPass's observer insertion)."""
+
+    name = "insert_observers"
+
+    def apply(self, st: _PassState):
+        cfg = st.config
+        n = 0
+        for name, layer in st.model.named_sublayers():
+            if type(layer).__name__ not in cfg.types:
+                continue
+            obs = make_observer(cfg.algo, bits=cfg.activation_bits)
+            st.observers[name] = obs
+
+            def hook(lyr, inputs, _obs=obs):
+                x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+                _obs.collect(np.asarray(x._value))
+
+            st._handles.append(layer.register_forward_pre_hook(hook))
+            n += 1
+        st.report[self.name] = n
+        if n == 0:
+            raise ValueError(
+                f"no quantizable layers of types {cfg.types} found"
+            )
+
+
+class CalibratePass:
+    """Stream calibration batches through the float model."""
+
+    name = "calibrate"
+
+    def __init__(self, data_loader, batch_nums: Optional[int] = None):
+        self.loader = data_loader
+        self.batch_nums = batch_nums
+
+    def apply(self, st: _PassState):
+        import jax.numpy as jnp
+
+        st.model.eval()
+        seen = 0
+        with no_grad():
+            for i, batch in enumerate(self.loader):
+                if self.batch_nums is not None and i >= self.batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                if not isinstance(x, Tensor):
+                    x = Tensor(jnp.asarray(np.asarray(x)))
+                st.model(x)
+                seen += 1
+        for h in st._handles:
+            h.remove()
+        st._handles.clear()
+        for name, obs in st.observers.items():
+            st.scales[name] = float(obs.scale())
+        st.report[self.name] = seen
+        if seen == 0:
+            raise ValueError("calibration loader yielded no batches")
+
+
+class FreezeScalesPass:
+    """Swap quantizable layers for fake-quant wrappers carrying the frozen
+    calibrated scales (reference: QuantizationFreezePass)."""
+
+    name = "freeze_scales"
+
+    def apply(self, st: _PassState):
+        import jax.numpy as jnp
+
+        from . import _QUANT_MAP
+
+        cfg = st.config
+        n = 0
+        names = {id(l): nm for nm, l in st.model.named_sublayers()}
+        for parent in st.model.sublayers(include_self=True):
+            for cname, child in list(parent._sub_layers.items()):
+                tname = type(child).__name__
+                if tname not in cfg.types or tname not in _QUANT_MAP:
+                    continue
+                full = names.get(id(child), "")
+                wrapped = _QUANT_MAP[tname](
+                    child, cfg.weight_bits, cfg.activation_bits,
+                )
+                scale = st.scales.get(full, 0.0)
+                if scale > 0 and hasattr(wrapped, "fq_act"):
+                    with no_grad():
+                        wrapped.fq_act.scale._value = jnp.asarray(
+                            scale, jnp.float32
+                        )
+                setattr(parent, cname, wrapped)
+                n += 1
+        st.report[self.name] = n
+
+
+class ConvertToInt8Pass:
+    """Lower calibrated QuantedLinear layers to Int8Linear — int8-stored
+    weights + the int8 MXU dot (inference only)."""
+
+    name = "convert_int8"
+
+    def apply(self, st: _PassState):
+        from . import QuantedLinear
+        from .int8 import Int8Linear
+
+        n = 0
+        for parent in st.model.sublayers(include_self=True):
+            for cname, child in list(parent._sub_layers.items()):
+                if isinstance(child, QuantedLinear):
+                    setattr(parent, cname, Int8Linear.from_quanted(child))
+                    n += 1
+        st.report[self.name] = n
+
+
+class QuantPassManager:
+    """Apply quantization passes in order (reference: the pass pipeline in
+    post_training_quantization.py quantize())."""
+
+    def __init__(self, passes: List):
+        self.passes = list(passes)
+
+    def run(self, model: Layer, config: QuantConfig) -> "_PassState":
+        st = _PassState(model, config)
+        for p in self.passes:
+            p.apply(st)
+        return st
