@@ -16,17 +16,23 @@ __all__ = ["AbsMaxObserver", "EMAAbsMaxObserver", "HistObserver",
 
 
 class AbsMaxObserver:
-    """Running max of |x| (reference algo='abs_max')."""
+    """Running max of |x| (reference algo='abs_max').
+
+    scale() is 0.0 until data arrives — an uncalibrated layer must be
+    DISTINGUISHABLE (FreezeScalesPass skips it loudly) rather than get a
+    degenerate epsilon scale that crushes its outputs."""
 
     def __init__(self, bits: int = 8):
         self.bits = bits
         self._max = 0.0
+        self._seen = False
 
     def collect(self, x: np.ndarray):
         self._max = max(self._max, float(np.max(np.abs(x))))
+        self._seen = True
 
     def scale(self) -> float:
-        return max(self._max, 1e-8)
+        return max(self._max, 1e-8) if self._seen else 0.0
 
 
 class EMAAbsMaxObserver:
@@ -45,7 +51,9 @@ class EMAAbsMaxObserver:
         )
 
     def scale(self) -> float:
-        return max(self._state or 0.0, 1e-8)
+        if self._state is None:
+            return 0.0
+        return max(self._state, 1e-8)
 
 
 class HistObserver:
@@ -85,7 +93,7 @@ class HistObserver:
     def scale(self) -> float:
         total = self._hist.sum()
         if total <= 0:
-            return 1e-8
+            return 0.0  # uncalibrated — see AbsMaxObserver
         cdf = np.cumsum(self._hist) / total
         idx = int(np.searchsorted(cdf, self.percentile))
         return max((idx + 1) / self.bins * self._max, 1e-8)
@@ -95,12 +103,14 @@ class MSEObserver:
     """Scale minimizing quantization MSE over a retained sample
     (reference algo='mse': grid-search candidate clips)."""
 
-    def __init__(self, bits: int = 8, sample: int = 65536, steps: int = 40):
+    def __init__(self, bits: int = 8, sample: int = 65536, steps: int = 40,
+                 seed: int = 0):
         self.bits = bits
         self.sample = sample
         self.steps = steps
         self._data = None
         self._max = 0.0
+        self._rng = np.random.default_rng(seed)
 
     def collect(self, x: np.ndarray):
         a = np.asarray(x, np.float32).reshape(-1)
@@ -108,13 +118,21 @@ class MSEObserver:
         if a.size > self.sample:
             stride = a.size // self.sample
             a = a[::stride][: self.sample]
-        self._data = a if self._data is None else np.concatenate(
-            [self._data, a]
-        )[-self.sample:]
+        if self._data is None:
+            self._data = a
+        else:
+            # random down-sample of the POOLED data — keeping only the
+            # last batch (a sliding window) would fit the clip to the
+            # final batch's distribution alone
+            pool = np.concatenate([self._data, a])
+            if pool.size > self.sample:
+                idx = self._rng.choice(pool.size, self.sample, replace=False)
+                pool = pool[idx]
+            self._data = pool
 
     def scale(self) -> float:
         if self._data is None or self._max == 0.0:
-            return 1e-8
+            return 0.0  # uncalibrated — see AbsMaxObserver
         qmax = 2 ** (self.bits - 1) - 1
         best, best_err = self._max, np.inf
         for k in range(1, self.steps + 1):

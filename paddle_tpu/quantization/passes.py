@@ -99,18 +99,22 @@ class CalibratePass:
 
         st.model.eval()
         seen = 0
-        with no_grad():
-            for i, batch in enumerate(self.loader):
-                if self.batch_nums is not None and i >= self.batch_nums:
-                    break
-                x = batch[0] if isinstance(batch, (tuple, list)) else batch
-                if not isinstance(x, Tensor):
-                    x = Tensor(jnp.asarray(np.asarray(x)))
-                st.model(x)
-                seen += 1
-        for h in st._handles:
-            h.remove()
-        st._handles.clear()
+        try:
+            with no_grad():
+                for i, batch in enumerate(self.loader):
+                    if self.batch_nums is not None and i >= self.batch_nums:
+                        break
+                    x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                    if not isinstance(x, Tensor):
+                        x = Tensor(jnp.asarray(np.asarray(x)))
+                    st.model(x)
+                    seen += 1
+        finally:
+            # a failing calibration batch must not leak observer hooks
+            # onto the float model
+            for h in st._handles:
+                h.remove()
+            st._handles.clear()
         for name, obs in st.observers.items():
             st.scales[name] = float(obs.scale())
         st.report[self.name] = seen
@@ -129,8 +133,12 @@ class FreezeScalesPass:
 
         from . import _QUANT_MAP
 
+        import warnings
+
         cfg = st.config
         n = 0
+        skipped = []
+        was_training = st.model.training
         names = {id(l): nm for nm, l in st.model.named_sublayers()}
         for parent in st.model.sublayers(include_self=True):
             for cname, child in list(parent._sub_layers.items()):
@@ -138,18 +146,34 @@ class FreezeScalesPass:
                 if tname not in cfg.types or tname not in _QUANT_MAP:
                     continue
                 full = names.get(id(child), "")
+                scale = st.scales.get(full, 0.0)
+                if full in st.scales and scale <= 0.0:
+                    # the calibration data never reached this layer — a
+                    # 0-scale wrapper would silently crush its outputs
+                    warnings.warn(
+                        f"layer {full!r} received no calibration data; "
+                        "left unquantized"
+                    )
+                    skipped.append(full)
+                    continue
                 wrapped = _QUANT_MAP[tname](
                     child, cfg.weight_bits, cfg.activation_bits,
                 )
-                scale = st.scales.get(full, 0.0)
                 if scale > 0 and hasattr(wrapped, "fq_act"):
                     with no_grad():
                         wrapped.fq_act.scale._value = jnp.asarray(
                             scale, jnp.float32
                         )
+                # wrappers are born training=True; match the model (PTQ
+                # returns an inference-ready model — a training-mode
+                # fq_act would overwrite the frozen scale on first use)
+                if not was_training:
+                    wrapped.eval()
                 setattr(parent, cname, wrapped)
                 n += 1
         st.report[self.name] = n
+        if skipped:
+            st.report[self.name + "_skipped_uncalibrated"] = skipped
 
 
 class ConvertToInt8Pass:

@@ -294,3 +294,57 @@ def test_hist_observer_rescale_keeps_percentile():
     o.collect(np.full(10000, 0.5, np.float32))
     o.collect(np.array([2.0], np.float32))
     assert o.scale() < 1.0  # 99th percentile stays near 0.5, not 2.0
+
+
+def test_ptq_returns_inference_ready_model():
+    # quantize() output must be usable WITHOUT a manual eval(): a
+    # training-mode fq_act would clobber the frozen scale on first use
+    paddle.seed(0)
+    m = LeNetish()
+    m.eval()
+    ptq = PostTrainingQuantization(m)
+    ptq.quantize(_calib_batches())
+    frozen = float(m.fc1.fq_act.scale)
+    x = paddle.to_tensor(rng.normal(size=(4, 1, 8, 8)).astype(np.float32))
+    with paddle.no_grad():
+        m(x)
+    assert float(m.fc1.fq_act.scale) == frozen  # not a moving average
+
+
+def test_uncalibrated_layer_left_float_with_warning():
+    import warnings as _w
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.used = nn.Linear(8, 4)
+            self.unused = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.used(x)  # `unused` never sees calibration data
+
+    paddle.seed(0)
+    m = TwoHead()
+    m.eval()
+    batches = [
+        (paddle.to_tensor(rng.normal(size=(4, 8)).astype(np.float32)),)
+    ]
+    ptq = PostTrainingQuantization(m, quantizable_layer_type=("Linear",))
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        ptq.quantize(batches)
+    assert any("unused" in str(r.message) for r in rec)
+    assert isinstance(m.used, QuantedLinear)
+    assert isinstance(m.unused, nn.Linear)  # left float, not crushed
+
+
+def test_calibrate_pass_removes_hooks_on_failure():
+    paddle.seed(0)
+    m = LeNetish()
+    bad = [("not a tensor at all",)]
+    ptq = PostTrainingQuantization(m)
+    with pytest.raises(Exception):
+        ptq.quantize(bad)
+    # no observer hooks remain on the float model
+    for _, layer in m.named_sublayers():
+        assert not getattr(layer, "_forward_pre_hooks", None), layer
