@@ -1,0 +1,259 @@
+"""Tree-index retrieval structures (TDM — tree-based deep match).
+
+Reference analogue: paddle/fluid/distributed/index_dataset/
+(index_wrapper.{h,cc} TreeIndex over a protobuf tree file,
+index_sampler.{h,cc} LayerWiseSampler) and the python facade
+python/paddle/distributed/fleet/dataset/index_dataset.py.
+
+TPU-native design: the tree is a complete `branch`-ary array-coded tree in
+numpy (code c's children are c*branch+1 .. c*branch+branch, the reference's
+coding), built directly from item ids instead of a serialized proto — the
+training-side consumers (travel codes, ancestor lookups, layer-wise
+negative sampling) are host-side batch producers feeding the device step.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Index", "TreeIndex"]
+
+
+class Index:
+    def __init__(self, name: str):
+        self._name = name
+
+
+class TreeIndex(Index):
+    """Complete branch-ary tree over item ids.
+
+    Build from ids (`TreeIndex.build`) or load a saved tree
+    (`TreeIndex(name, path)` — the reference's constructor shape).
+    Codes: root 0; children of c are c*branch+1..c*branch+branch; layer L
+    spans codes [(branch^L - 1)/(branch-1), ...) — identical coding to the
+    reference (index_wrapper.h).
+    """
+
+    def __init__(self, name: str, path: Optional[str] = None):
+        super().__init__(name)
+        self._layerwise_sampler = None
+        if path is not None:
+            self._load(path)
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def build(cls, name: str, ids: Sequence[int], branch: int = 2,
+              codes: Optional[Sequence[int]] = None) -> "TreeIndex":
+        """Build a complete tree whose leaves hold `ids` (sorted for
+        locality, like the reference's kmeans-clustered builder output
+        ordering). `codes` optionally pins each id's leaf code."""
+        self = cls(name)
+        ids = np.asarray(list(ids), np.int64)
+        if ids.size == 0:
+            raise ValueError("TreeIndex.build needs at least one id")
+        branch = int(branch)
+        if branch < 2:
+            raise ValueError("branch must be >= 2")
+        n = ids.size
+        height = 1
+        while branch ** (height - 1) < n:
+            height += 1
+        self._branch = branch
+        self._height = height  # layers 0..height-1; leaves on height-1
+        first_leaf = (branch ** (height - 1) - 1) // (branch - 1)
+        if codes is not None:
+            codes = np.asarray(list(codes), np.int64)
+            if codes.size != n:
+                raise ValueError("codes length must match ids")
+        else:
+            codes = first_leaf + np.arange(n, dtype=np.int64)
+        order = np.argsort(ids, kind="stable")
+        self._ids = ids[order]
+        self._codes = codes[order]
+        self._id_to_code: Dict[int, int] = {
+            int(i): int(c) for i, c in zip(self._ids, self._codes)
+        }
+        self._code_to_id: Dict[int, int] = {
+            c: i for i, c in self._id_to_code.items()
+        }
+        return self
+
+    def save(self, path: str):
+        np.savez(path, ids=self._ids, codes=self._codes,
+                 branch=self._branch, height=self._height)
+
+    def _load(self, path: str):
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path)
+        self._ids = data["ids"]
+        self._codes = data["codes"]
+        self._branch = int(data["branch"])
+        self._height = int(data["height"])
+        self._id_to_code = {
+            int(i): int(c) for i, c in zip(self._ids, self._codes)
+        }
+        self._code_to_id = {c: i for i, c in self._id_to_code.items()}
+
+    # -- reference surface ---------------------------------------------------
+    def height(self) -> int:
+        return self._height
+
+    def branch(self) -> int:
+        return self._branch
+
+    def total_node_nums(self) -> int:
+        b, h = self._branch, self._height
+        return (b ** h - 1) // (b - 1)
+
+    def emb_size(self) -> int:
+        return int(self._ids.size)
+
+    def get_all_leafs(self) -> List[int]:
+        return [int(i) for i in self._ids]
+
+    def get_nodes(self, codes: Sequence[int]) -> List[Optional[int]]:
+        """Item id stored at each code (None for internal/empty nodes —
+        the reference returns node protos; ids are what consumers use)."""
+        return [self._code_to_id.get(int(c)) for c in codes]
+
+    def _layer_range(self, level: int):
+        b = self._branch
+        lo = (b ** level - 1) // (b - 1)
+        hi = (b ** (level + 1) - 1) // (b - 1)
+        return lo, hi
+
+    def get_layer_codes(self, level: int) -> List[int]:
+        if not 0 <= level < self._height:
+            raise ValueError(f"level must be in [0, {self._height})")
+        lo, hi = self._layer_range(level)
+        if level == self._height - 1:
+            return [int(c) for c in self._codes]
+        # internal layer: only ancestors of live leaves exist
+        codes = set()
+        for c in self._codes:
+            c = int(c)
+            while c >= hi:
+                c = (c - 1) // self._branch
+            codes.add(c)
+        return sorted(codes)
+
+    def get_travel_codes(self, id: int, start_level: int = 0) -> List[int]:
+        """Leaf-to-root ancestor codes of an item, stopping above
+        start_level (reference: get_travel_codes — ordered leaf first)."""
+        c = self._id_to_code.get(int(id))
+        if c is None:
+            raise KeyError(f"id {id} is not in tree {self._name!r}")
+        out = []
+        level = self._height - 1
+        while level >= start_level:
+            out.append(int(c))
+            c = (c - 1) // self._branch
+            level -= 1
+        return out
+
+    def get_ancestor_codes(self, ids: Sequence[int], level: int) -> List[int]:
+        out = []
+        for i in ids:
+            c = self._id_to_code.get(int(i))
+            if c is None:
+                raise KeyError(f"id {i} is not in tree {self._name!r}")
+            cur = self._height - 1
+            while cur > level:
+                c = (c - 1) // self._branch
+                cur -= 1
+            out.append(int(c))
+        return out
+
+    def get_children_codes(self, ancestor: int, level: int) -> List[int]:
+        """Codes at `level` under `ancestor` that lead to live leaves."""
+        lo, hi = self._layer_range(level)
+        out = []
+        for c in self.get_layer_codes(level):
+            a = c
+            while a > ancestor:
+                a = (a - 1) // self._branch
+            if a == ancestor:
+                out.append(c)
+        return out
+
+    def get_travel_path(self, child: int, ancestor: int) -> List[int]:
+        res = []
+        while child > ancestor:
+            res.append(int(child))
+            child = (child - 1) // self._branch
+        return res
+
+    def get_pi_relation(self, ids: Sequence[int], level: int):
+        codes = self.get_ancestor_codes(ids, level)
+        return dict(zip([int(i) for i in ids], codes))
+
+    # -- layerwise sampler ---------------------------------------------------
+    def init_layerwise_sampler(self, layer_sample_counts: Sequence[int],
+                               start_sample_layer: int = 1, seed: int = 0):
+        """reference: index_sampler.h LayerWiseSampler —
+        layer_sample_counts[k] negatives per sampled layer, starting at
+        start_sample_layer."""
+        if self._layerwise_sampler is not None:
+            raise AssertionError("layerwise sampler already initialized")
+        n_layers = self._height - start_sample_layer
+        if len(layer_sample_counts) != n_layers:
+            raise ValueError(
+                f"layer_sample_counts needs {n_layers} entries "
+                f"(layers {start_sample_layer}..{self._height - 1})"
+            )
+        self._layerwise_sampler = _LayerWiseSampler(
+            self, list(layer_sample_counts), start_sample_layer, seed
+        )
+
+    def layerwise_sample(self, user_input, index_input,
+                         with_hierarchy: bool = False):
+        if self._layerwise_sampler is None:
+            raise ValueError("please init layerwise_sampler first.")
+        return self._layerwise_sampler.sample(
+            user_input, index_input, with_hierarchy
+        )
+
+
+class _LayerWiseSampler:
+    """Per-layer positive + sampled-negative batches for TDM training:
+    for each (user, target-item) pair and each layer, emit the target's
+    ancestor as the positive (label 1) and `count` other codes from the
+    same layer as negatives (label 0)."""
+
+    def __init__(self, tree: TreeIndex, counts: List[int],
+                 start_layer: int, seed: int):
+        self.tree = tree
+        self.counts = counts
+        self.start = start_layer
+        self.rng = np.random.default_rng(seed)
+        self._layer_codes = {
+            lvl: np.asarray(tree.get_layer_codes(lvl), np.int64)
+            for lvl in range(start_layer, tree.height())
+        }
+
+    def sample(self, user_input, index_input, with_hierarchy=False):
+        """Returns (user_rows, code_col, label_col) — the reference's
+        flattened sample layout: one row per (pair, layer, pos|neg)."""
+        users_out, codes_out, labels_out = [], [], []
+        for user, item in zip(user_input, index_input):
+            travel = self.tree.get_travel_codes(int(item), self.start)
+            # travel is leaf->start; walk layers top-down like the ref
+            for k, lvl in enumerate(range(self.start, self.tree.height())):
+                pos = travel[self.tree.height() - 1 - lvl]
+                layer = self._layer_codes[lvl]
+                count = self.counts[k]
+                users_out.append(list(user))
+                codes_out.append(int(pos))
+                labels_out.append(1)
+                pool = layer[layer != pos]
+                if pool.size and count > 0:
+                    take = self.rng.choice(
+                        pool, size=min(count, pool.size), replace=False
+                    )
+                    for c in take:
+                        users_out.append(list(user))
+                        codes_out.append(int(c))
+                        labels_out.append(0)
+        return users_out, codes_out, labels_out

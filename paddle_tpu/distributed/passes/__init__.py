@@ -12,5 +12,7 @@ from ..compat import (  # noqa: F401
     new_pass,
     register_pass,
 )
+from .builtin import DistProgram  # noqa: F401  (registers builtin passes)
 
-__all__ = ["new_pass", "PassManager", "PassContext"]
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase",
+           "register_pass", "DistProgram"]

@@ -1,0 +1,173 @@
+"""Built-in distributed passes.
+
+Reference analogue: python/paddle/distributed/passes/{auto_parallel_fp16,
+auto_parallel_gradient_merge, auto_parallel_recompute, fuse_all_reduce}.py
+— each is a Program-rewrite registered with @register_pass and chained by
+PassManager.
+
+TPU-native design: the unit a pass rewrites is the DistProgram — the
+mutable pre-compile description of a training step (model, loss, optimizer,
+precision context, accumulation, sharding knobs). GSPMD owns the op-level
+rewriting the reference passes do by hand; what remains pass-shaped is
+everything that must be DECIDED before the one XLA compile: precision
+policy, gradient accumulation, recompute boundaries, and which parameters
+are too small to be worth sharding (the fuse_all_reduce/fuse_grad_size
+bucketing capability).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..compat import PassBase, PassContext, register_pass
+
+__all__ = ["DistProgram"]
+
+
+class DistProgram:
+    """What a distributed pass rewrites: the step description that
+    `build()` hands to the compiled SPMD pipeline (parallel/sharding.py).
+    Plays the role of the reference's (main_program, startup_program)
+    pair."""
+
+    def __init__(self, model, loss_fn, optimizer, zero_stage: int = 0,
+                 accumulate_steps: int = 1,
+                 forward_ctx: Optional[Callable] = None,
+                 loss_scale: float = 1.0):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.zero_stage = zero_stage
+        self.accumulate_steps = accumulate_steps
+        self.forward_ctx = forward_ctx
+        self.loss_scale = loss_scale
+        self.applied_passes: List[str] = []
+
+    def build(self):
+        from ...parallel.sharding import sharded_train_step
+
+        return sharded_train_step(
+            self.model, self.loss_fn, self.optimizer,
+            zero_stage=self.zero_stage, forward_ctx=self.forward_ctx,
+            accumulate_steps=self.accumulate_steps,
+            loss_scale=self.loss_scale,
+        )
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(PassBase):
+    """Install the low-precision forward policy (reference:
+    auto_parallel_fp16.py rewrites every op to fp16 with black/white
+    lists; here the policy is an autocast context compiled into the step).
+    attrs: dtype ('bfloat16'|'float16'), init_loss_scaling,
+    custom_white_list, custom_black_list."""
+
+    def check_before_apply(self, main_program, startup_program, context):
+        return isinstance(main_program, DistProgram) and \
+            self.get_attr("dtype", "bfloat16") in ("bfloat16", "float16")
+
+    def _apply_single(self, prog, startup, context):
+        from ... import amp as _amp
+
+        dtype = self.get_attr("dtype", "bfloat16")
+        white = self.get_attr("custom_white_list", None)
+        black = self.get_attr("custom_black_list", None)
+
+        def ctx(_d=dtype, _w=white, _b=black):
+            return _amp.auto_cast(enable=True, custom_white_list=_w,
+                                  custom_black_list=_b, level="O2", dtype=_d)
+
+        prog.forward_ctx = ctx
+        if dtype == "float16":
+            prog.loss_scale = float(
+                self.get_attr("init_loss_scaling", 32768.0)
+            )
+        prog.applied_passes.append(self.name)
+
+    def apply(self, main_programs, startup_programs, context=None):
+        context = context or PassContext()
+        mains = main_programs if isinstance(main_programs, list) \
+            else [main_programs]
+        for m in mains:
+            self._apply_single(m, None, context)
+        return context
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """k-step compiled gradient accumulation (reference:
+    auto_parallel_gradient_merge.py). attrs: k_steps."""
+
+    def check_before_apply(self, main_program, startup_program, context):
+        return isinstance(main_program, DistProgram) and \
+            int(self.get_attr("k_steps", 1)) >= 1
+
+    def apply(self, main_programs, startup_programs, context=None):
+        context = context or PassContext()
+        mains = main_programs if isinstance(main_programs, list) \
+            else [main_programs]
+        for m in mains:
+            m.accumulate_steps = int(self.get_attr("k_steps", 1))
+            m.applied_passes.append(self.name)
+        return context
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Wrap the named sublayers in jax.checkpoint (reference:
+    auto_parallel_recompute.py inserts recompute subgraphs at the
+    checkpoint vars). attrs: checkpoints = [sublayer names]."""
+
+    def check_before_apply(self, main_program, startup_program, context):
+        return isinstance(main_program, DistProgram)
+
+    def apply(self, main_programs, startup_programs, context=None):
+        from ..fleet import _apply_strategy_recompute
+
+        context = context or PassContext()
+        mains = main_programs if isinstance(main_programs, list) \
+            else [main_programs]
+        cps = list(self.get_attr("checkpoints", []) or [])
+        for m in mains:
+            _apply_strategy_recompute(m.model, cps)
+            m.applied_passes.append(self.name)
+        context.set_attr("recompute_wrapped", len(cps))
+        return context
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(PassBase):
+    """Small-parameter coalescing (reference: fuse_all_reduce.py groups
+    gradients into fused buckets so tiny tensors don't pay per-collective
+    latency). On TPU XLA already fuses same-spec collectives, so the
+    remaining lever is the SPEC: parameters smaller than `size_threshold`
+    bytes get pinned to a REPLICATED spec — their grads ride the one big
+    fused all-reduce instead of each paying a ZeRO gather/scatter pair.
+    attrs: size_threshold (bytes, default 1 MiB = fuse_grad_size_in_MB's
+    unit)."""
+
+    def check_before_apply(self, main_program, startup_program, context):
+        return isinstance(main_program, DistProgram)
+
+    def apply(self, main_programs, startup_programs, context=None):
+        context = context or PassContext()
+        mains = main_programs if isinstance(main_programs, list) \
+            else [main_programs]
+        threshold = int(self.get_attr("size_threshold", 1 << 20))
+        pinned = []
+        for m in mains:
+            for name, p in m.model.named_parameters():
+                if p.stop_gradient:
+                    continue
+                nbytes = int(np.prod(p.shape)) * 4
+                has_tp = getattr(p, "dist_spec", None) is not None and any(
+                    s is not None for s in tuple(p.dist_spec)
+                )
+                if nbytes < threshold and not has_tp:
+                    # param_spec honors this pin ahead of ZeRO sharding
+                    p.fuse_replicated = True
+                    pinned.append(name)
+            m.applied_passes.append(self.name)
+        context.set_attr("replicated_params", pinned)
+        return context

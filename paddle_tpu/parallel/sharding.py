@@ -34,6 +34,10 @@ def param_spec(p: Tensor, zero_stage: int = 0, mesh: Optional[Mesh] = None) -> P
     (TP layers set `dist_spec`), else ZeRO-3 shards the first divisible dim
     over `sharding`, else replicated."""
     mesh = mesh or get_mesh()
+    if getattr(p, "fuse_replicated", False):
+        # pinned by the fuse_all_reduce pass: too small to be worth
+        # sharding — ride the fused replicated all-reduce
+        return P(*([None] * p.ndim))
     spec = getattr(p, "dist_spec", None)
     if spec is not None:
         spec = P(*spec) if not isinstance(spec, P) else spec

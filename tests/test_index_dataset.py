@@ -1,0 +1,106 @@
+"""TreeIndex / layerwise sampler (VERDICT r3 task 10).
+
+Reference analogues: paddle/fluid/distributed/index_dataset/
+index_wrapper.{h,cc} + index_sampler.{h,cc}, python facade
+fleet/dataset/index_dataset.py, test_dist_tree_index.py.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.index_dataset import TreeIndex
+
+
+def _tree(n=8, branch=2):
+    return TreeIndex.build("t", list(range(100, 100 + n)), branch=branch)
+
+
+def test_build_shape_and_leafs():
+    t = _tree(8, 2)
+    assert t.height() == 4 and t.branch() == 2
+    assert t.total_node_nums() == 15
+    assert t.emb_size() == 8
+    assert t.get_all_leafs() == list(range(100, 108))
+
+
+def test_travel_codes_walk_to_root():
+    t = _tree(8, 2)
+    travel = t.get_travel_codes(100)
+    assert travel[0] == 7  # first leaf code of a 4-layer binary tree
+    assert travel == [7, 3, 1, 0]
+    assert t.get_travel_codes(100, start_level=2) == [7, 3]
+    with pytest.raises(KeyError):
+        t.get_travel_codes(999)
+
+
+def test_ancestor_and_children_are_inverse():
+    t = _tree(8, 2)
+    anc = t.get_ancestor_codes([100, 107], level=1)
+    assert anc == [1, 2]
+    kids = t.get_children_codes(1, level=2)
+    assert kids == [3, 4]
+    # pi relation maps each id to its ancestor at the level
+    pi = t.get_pi_relation([100, 101], level=2)
+    assert pi == {100: 3, 101: 3} or pi == {100: 3, 101: 4}
+
+
+def test_layer_codes_only_live_nodes():
+    t = TreeIndex.build("t", list(range(5)), branch=2)  # 5 leaves, 8 slots
+    leaf_level = t.height() - 1
+    assert len(t.get_layer_codes(leaf_level)) == 5
+    assert t.get_layer_codes(0) == [0]
+
+
+def test_ternary_tree():
+    t = _tree(9, branch=3)
+    assert t.height() == 3 and t.total_node_nums() == 13
+    travel = t.get_travel_codes(100)
+    assert len(travel) == 3 and travel[-1] == 0
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = _tree(8, 2)
+    path = str(tmp_path / "tree")
+    t.save(path)
+    t2 = TreeIndex("t2", path)
+    assert t2.get_all_leafs() == t.get_all_leafs()
+    assert t2.get_travel_codes(103) == t.get_travel_codes(103)
+
+
+def test_layerwise_sampler_shapes_and_labels():
+    t = _tree(8, 2)
+    t.init_layerwise_sampler([1, 2, 3], start_sample_layer=1, seed=0)
+    users = [[1.0, 2.0], [3.0, 4.0]]
+    items = [100, 107]
+    u, c, l = t.layerwise_sample(users, items)
+    assert len(u) == len(c) == len(l)
+    # per pair: layer1 1 pos + 1 neg, layer2 1+2, layer3 1+3 = 9 rows
+    assert len(l) == 2 * 9
+    l = np.asarray(l)
+    assert l.sum() == 2 * 3  # one positive per (pair, layer)
+    # positives are the target's ancestors
+    pos_codes = [ci for ci, li in zip(c, l) if li == 1][:3]
+    assert pos_codes == t.get_travel_codes(100)[::-1][1:]
+
+
+def test_layerwise_sampler_wrong_counts_raises():
+    t = _tree(8, 2)
+    with pytest.raises(ValueError, match="entries"):
+        t.init_layerwise_sampler([1], start_sample_layer=1)
+
+
+def test_sampler_negatives_differ_from_positive():
+    t = _tree(8, 2)
+    t.init_layerwise_sampler([2, 2, 2], start_sample_layer=1, seed=1)
+    u, c, l = t.layerwise_sample([[0.0]], [104])
+    rows = list(zip(c, l))
+    assert rows[0][1] == 1  # each layer's block starts with its positive
+    positives = {ci for ci, li in rows if li == 1}
+    ancestors = set(t.get_travel_codes(104)[:-1])  # root excluded (start 1)
+    assert positives == ancestors
+    # no negative collides with that layer's positive
+    cur_pos = None
+    for ci, li in rows:
+        if li == 1:
+            cur_pos = ci
+        else:
+            assert ci != cur_pos
