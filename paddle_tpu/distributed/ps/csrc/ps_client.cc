@@ -334,6 +334,27 @@ int ps_client_set_dense(void* h, uint32_t table_id, const float* vals,
   return dense_scatter(h, table_id, vals, len, ps::CMD_SET_DENSE);
 }
 
+// fused push+pull: grads out, updated values back, ONE round trip per
+// server chunk (reference: the communicator's batched dense sync)
+int ps_client_push_pull_dense(void* h, uint32_t table_id,
+                              const float* grads, float* out, int64_t len) {
+  auto* c = static_cast<ps::Client*>(h);
+  bool ok = c->fan_out(all_servers(c), [&](int i) {
+    int64_t s, e;
+    ps::dense_chunk(len, c->n_servers(), i, &s, &e);
+    if (e == s) return true;
+    ps::Header hd{0, ps::CMD_PUSH_PULL_DENSE, table_id, 0, e - s,
+                  static_cast<int64_t>(sizeof(float) * (e - s))};
+    std::vector<char> resp;
+    if (!c->request(i, hd, grads + s, &resp) ||
+        resp.size() != sizeof(float) * static_cast<size_t>(e - s))
+      return false;
+    std::memcpy(out + s, resp.data(), resp.size());
+    return true;
+  });
+  return ok ? 0 : -1;
+}
+
 // global barrier across trainers, coordinated by server 0 (reference:
 // BarrierTable lives on one server)
 int ps_client_barrier(void* h, int trainer_id) {

@@ -44,6 +44,8 @@ enum Cmd : uint32_t {
   CMD_PUSH_CTR = 16,   // push with show/click counts (ctr_accessor Update)
   CMD_SHRINK = 17,     // decay + score-based eviction pass
   CMD_CTR_STATS = 18,  // show/click/unseen/score for one key (tests)
+  CMD_PUSH_PULL_DENSE = 19,  // fused: apply grads, reply updated values
+                             // (one round trip instead of push+pull)
 };
 
 // flags bits

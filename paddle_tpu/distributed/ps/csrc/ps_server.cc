@@ -325,6 +325,23 @@ struct Server {
         reply(fd, h, kStatusOk, nullptr, 0);
         return true;
       }
+      case CMD_PUSH_PULL_DENSE: {
+        // fused round trip (the reference communicator's batched
+        // send_and_recv): apply this trainer's grads, reply the updated
+        // chunk — halves the per-step round trips of push-then-pull
+        DenseTable* t = get_dense(h.table_id);
+        if (!t || payload.size() < sizeof(float) * t->data.size()) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        t->push(reinterpret_cast<const float*>(payload.data()));
+        std::vector<float> out(t->data.size());
+        t->pull(out.data());
+        reply(fd, h, kStatusOk, out.data(),
+              static_cast<int64_t>(out.size() * sizeof(float)),
+              static_cast<int64_t>(out.size()));
+        return true;
+      }
       case CMD_SET_DENSE: {
         DenseTable* t = get_dense(h.table_id);
         if (!t || payload.size() < sizeof(float) * t->data.size()) {

@@ -108,6 +108,11 @@ def _load_client_lib():
         lib.ps_client_set_dense.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64,
         ]
+        lib.ps_client_push_pull_dense.restype = ctypes.c_int
+        lib.ps_client_push_pull_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
         lib.ps_client_barrier.restype = ctypes.c_int
         lib.ps_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.ps_client_save.restype = ctypes.c_int
@@ -282,6 +287,17 @@ class PsClient:
             self._h, table_id, values.ctypes.data, values.size
         ) != 0:
             raise ConnectionError("set_dense failed")
+
+    def push_pull_dense(self, table_id: int, grads: np.ndarray) -> np.ndarray:
+        """Fused round trip: apply grads server-side, return the updated
+        values — half the wire latency of push_dense + pull_dense."""
+        grads = np.ascontiguousarray(grads, np.float32).reshape(-1)
+        out = np.empty(grads.size, np.float32)
+        if self._lib.ps_client_push_pull_dense(
+            self._h, table_id, grads.ctypes.data, out.ctypes.data, grads.size
+        ) != 0:
+            raise ConnectionError("push_pull_dense failed")
+        return out
 
     # -- coordination --------------------------------------------------------
     def barrier(self):
@@ -536,10 +552,16 @@ class DenseTableHandle:
         self.client.push_dense(self.table_id, flat)
 
     def push_pull(self, grads: Optional[List] = None):
-        """Push then immediately pull — the fully-async single-trainer
-        convenience; multi-trainer sync loops should push / barrier / pull."""
-        self.push(grads)
-        self.pull_into_params()
+        """FUSED push+pull (one wire round trip per server chunk) — the
+        fully-async single-trainer path; multi-trainer sync loops should
+        push / barrier / pull so every contribution lands first."""
+        if grads is None:
+            grads = [p.grad for p in self.params]
+        flat = self._flat(
+            [g._value if hasattr(g, "_value") else g for g in grads]
+        )
+        out = self.client.push_pull_dense(self.table_id, flat)
+        self._scatter(out)
 
 
 class Communicator:

@@ -169,3 +169,50 @@ def test_ctr_over_the_wire():
         assert t.ctr_stats(3) is None and t.ctr_stats(4) is not None
     finally:
         c.stop_servers()
+
+
+@pytest.mark.slow
+def test_fused_dense_push_pull_matches_separate():
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    s0 = PsServer(port=0, server_id=0, n_servers=2, n_trainers=1)
+    s1 = PsServer(port=0, server_id=1, n_servers=2, n_trainers=1)
+    c = PsClient([f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"],
+                 trainer_id=0)
+    try:
+        rng = np.random.default_rng(0)
+        n = 10_001  # odd length exercises the range split
+        init = rng.normal(size=n).astype(np.float32)
+        g1 = rng.normal(size=n).astype(np.float32)
+        g2 = rng.normal(size=n).astype(np.float32)
+        # table A: separate push + pull
+        c.create_dense_table(1, n, "sgd", 0.1, init=init)
+        c.push_dense(1, g1)
+        sep = c.pull_dense(1, n)
+        # table B: fused round trip from the same start
+        c.create_dense_table(2, n, "sgd", 0.1, init=init)
+        fused = c.push_pull_dense(2, g1)
+        np.testing.assert_allclose(fused, sep, rtol=1e-6)
+        # second step keeps them in lockstep
+        c.push_dense(1, g2)
+        np.testing.assert_allclose(
+            c.push_pull_dense(2, g2), c.pull_dense(1, n), rtol=1e-6
+        )
+        # fused is one round trip: time both paths (informational; assert
+        # only that fused is not SLOWER by more than noise)
+        import time as _t
+
+        t0 = _t.perf_counter()
+        for _ in range(20):
+            c.push_dense(1, g1)
+            c.pull_dense(1, n)
+        sep_dt = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        for _ in range(20):
+            c.push_pull_dense(2, g1)
+        fused_dt = _t.perf_counter() - t0
+        print(f"dense wire: separate {sep_dt * 50:.2f} ms/step, "
+              f"fused {fused_dt * 50:.2f} ms/step")
+        assert fused_dt < sep_dt * 1.2
+    finally:
+        c.stop_servers()
