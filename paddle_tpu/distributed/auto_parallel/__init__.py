@@ -379,3 +379,4 @@ from .planner import (  # noqa: E402
     Planner,
 )
 from .converter import Converter, reshard_state_dict  # noqa: E402
+from .tuner import ProfileTuner, cluster_from_json, map_processes  # noqa: E402,F401

@@ -1,0 +1,138 @@
+"""Cluster description loading, process mapping, and the profile tuner.
+
+Reference analogues:
+- cluster.py: Cluster.build_from_file parsing a machines/devices/links
+  JSON into a capability graph consumed by the cost model;
+- mapper.py: mapping(dist_program, cluster) — place logical ranks onto
+  physical devices so the chattiest communicators share the best links;
+- tuner/: OptimizationTuner — try candidate strategies, MEASURE, keep the
+  best (profile-guided, versus the planner's analytic model).
+
+TPU-native: the capability graph collapses to ClusterSpec (regular pod
+topologies); mapping collapses to axis ORDERING over jax.devices() (mp
+innermost so TP collectives ride intra-host ICI); the tuner compiles and
+times each candidate mesh on the real devices and keeps the fastest —
+measurement beats any model when the hardware is in hand.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .planner import Candidate, ClusterSpec, DeviceSpec
+
+__all__ = ["cluster_from_json", "map_processes", "ProfileTuner"]
+
+
+def cluster_from_json(path: str) -> ClusterSpec:
+    """Parse the reference's cluster JSON (machines[].devices[] with
+    gflops/memory, links[] with bandwidth) into a ClusterSpec.
+
+    Unknown/missing fields fall back to the v5e defaults; heterogeneous
+    clusters take the MINIMUM capability (the straggler sets the pace)."""
+    with open(path) as f:
+        doc = json.load(f)
+    machines = doc.get("machines", [])
+    if not machines:
+        raise ValueError(f"{path}: no machines in cluster file")
+    n_devices = 0
+    per_host = []
+    flops = []
+    mem = []
+    for m in machines:
+        devs = [d for d in m.get("devices", [])
+                if d.get("type", "GPU") not in ("CPU",)]
+        per_host.append(len(devs))
+        n_devices += len(devs)
+        for d in devs:
+            # reference stores double-precision gflops; sp_gflops when given
+            g = d.get("sp_gflops") or d.get("dp_gflops")
+            if g:
+                flops.append(float(g) * 1e9)
+            if d.get("memory"):
+                mem.append(float(d["memory"]) * 1e9)
+    intra = [float(l["bandwidth"]) * 1e9
+             for l in doc.get("links", [])
+             if l.get("type") in ("NVL", "PHB", "ICI")]
+    inter = [float(l["bandwidth"]) * 1e9
+             for l in doc.get("links", []) if l.get("type") == "NET"]
+    dev = DeviceSpec()
+    if flops:
+        dev = DeviceSpec(flops_bf16=min(flops),
+                         hbm_bytes=min(mem) if mem else DeviceSpec().hbm_bytes)
+    return ClusterSpec(
+        n_devices=n_devices,
+        devices_per_host=max(per_host) if per_host else n_devices,
+        ici_bw=min(intra) if intra else ClusterSpec().ici_bw,
+        dcn_bw=min(inter) if inter else ClusterSpec().dcn_bw,
+        device=dev,
+    )
+
+
+def map_processes(candidate: Candidate, devices=None):
+    """Order physical devices for the candidate's mesh so the chattiest
+    axis sits innermost (reference mapper.py places ranks by link
+    bandwidth; on a pod the same goal is axis ordering: mp varies fastest
+    over adjacent — intra-host — devices, dp slowest so it can cross
+    DCN). Returns an ndarray shaped [pp, dp, sep, mp] of devices."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    c = candidate
+    n = c.dp * c.mp * c.pp * c.sep
+    if len(devices) < n:
+        raise ValueError(f"candidate needs {n} devices, have {len(devices)}")
+    arr = np.empty(n, dtype=object)
+    arr[:] = devices[:n]
+    # axis order outer->inner: pp, dp, sep, mp (mp adjacency first)
+    return arr.reshape(c.pp, c.dp, c.sep, c.mp)
+
+
+class ProfileTuner:
+    """Measure candidate parallelization configs on the real devices and
+    keep the fastest (reference: tuner/optimization_tuner.py's
+    profile-based trial loop, minus the subprocess farm — one jit per
+    candidate in-process)."""
+
+    def __init__(self, model_fn, candidates: Sequence[Candidate],
+                 warmup: int = 1, iters: int = 3):
+        """model_fn(candidate) -> (step_callable, example_batch_tuple);
+        the callable must be ready to run (mesh installed, params placed).
+        """
+        self.model_fn = model_fn
+        self.candidates = list(candidates)
+        self.warmup = warmup
+        self.iters = iters
+        self.records: List[Dict] = []
+
+    def tune(self, verbose: bool = False) -> Candidate:
+        best = None
+        for cand in self.candidates:
+            try:
+                step, batch = self.model_fn(cand)
+                for _ in range(max(self.warmup, 1)):
+                    out = step(*batch)
+                float(out)  # sync
+                t0 = time.perf_counter()
+                for _ in range(self.iters):
+                    out = step(*batch)
+                    float(out)  # per-step sync: tunnel-safe timing
+                dt = (time.perf_counter() - t0) / self.iters
+                self.records.append({"candidate": str(cand), "ms": dt * 1e3})
+                if verbose:
+                    print(f"[tuner] {cand}: {dt * 1e3:.2f} ms/step")
+                if best is None or dt < best[0]:
+                    best = (dt, cand)
+            except Exception as e:  # infeasible candidate: record, move on
+                self.records.append({"candidate": str(cand),
+                                     "error": repr(e)})
+                if verbose:
+                    print(f"[tuner] {cand}: failed ({e})")
+        if best is None:
+            raise RuntimeError(
+                f"profile tuner: every candidate failed: {self.records}"
+            )
+        return best[1]

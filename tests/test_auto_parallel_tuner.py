@@ -1,0 +1,115 @@
+"""Cluster JSON loader, process mapper, profile tuner (the remaining
+reference auto_parallel modules: cluster.py build_from_file, mapper.py
+mapping, tuner/optimization_tuner.py).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import (
+    Candidate,
+    ProfileTuner,
+    cluster_from_json,
+    map_processes,
+)
+
+
+def test_cluster_from_json(tmp_path):
+    doc = {
+        "machines": [
+            {"hostname": "h0", "devices": [
+                {"global_id": 0, "type": "GPU", "sp_gflops": 19500,
+                 "memory": 40},
+                {"global_id": 1, "type": "GPU", "sp_gflops": 19500,
+                 "memory": 40},
+                {"global_id": 2, "type": "CPU"},
+            ]},
+            {"hostname": "h1", "devices": [
+                {"global_id": 3, "type": "GPU", "sp_gflops": 19500,
+                 "memory": 40},
+                {"global_id": 4, "type": "GPU", "sp_gflops": 19500,
+                 "memory": 40},
+            ]},
+        ],
+        "links": [
+            {"source_global_id": 0, "target_global_id": 1,
+             "type": "NVL", "bandwidth": 235},
+            {"source_global_id": 0, "target_global_id": 3,
+             "type": "NET", "bandwidth": 24},
+        ],
+    }
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(doc))
+    spec = cluster_from_json(str(p))
+    assert spec.n_devices == 4            # CPUs excluded
+    assert spec.devices_per_host == 2
+    np.testing.assert_allclose(spec.ici_bw, 235e9)
+    np.testing.assert_allclose(spec.dcn_bw, 24e9)
+    np.testing.assert_allclose(spec.device.flops_bf16, 19500e9)
+    np.testing.assert_allclose(spec.device.hbm_bytes, 40e9)
+    (tmp_path / "empty.json").write_text(json.dumps({"machines": []}))
+    with pytest.raises(ValueError, match="no machines"):
+        cluster_from_json(str(tmp_path / "empty.json"))
+
+
+def test_map_processes_mp_innermost():
+    import jax
+
+    arr = map_processes(Candidate(dp=2, mp=2, pp=2))
+    assert arr.shape == (2, 2, 1, 2)
+    devs = jax.devices()
+    # mp pairs are ADJACENT device ids (intra-host ICI)
+    assert arr[0, 0, 0, 0] is devs[0] and arr[0, 0, 0, 1] is devs[1]
+    with pytest.raises(ValueError, match="needs"):
+        map_processes(Candidate(dp=16))
+
+
+def test_profile_tuner_picks_faster_candidate():
+    from paddle_tpu.parallel.sharding import sharded_train_step
+    from paddle_tpu.parallel.topology import init_mesh
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(32, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(32, 4)).astype(np.float32))
+
+    def model_fn(cand):
+        init_mesh(dp=cand.dp, mp=cand.mp)
+        paddle.seed(0)
+        m = nn.Linear(16, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        step = sharded_train_step(m, lambda o, t: ((o - t) ** 2).mean(), opt)
+        return step, (x, y)
+
+    cands = [Candidate(dp=8), Candidate(dp=4, mp=2)]
+    tuner = ProfileTuner(model_fn, cands, warmup=1, iters=2)
+    best = tuner.tune(verbose=False)
+    assert best in cands
+    assert len(tuner.records) == 2
+    assert all("ms" in r for r in tuner.records)
+
+
+def test_profile_tuner_survives_failing_candidate():
+    def model_fn(cand):
+        if cand.mp > 1:
+            raise RuntimeError("boom")
+        return (lambda: paddle.to_tensor(np.float32(0.0))), ()
+
+    # zero-arg step: adapt by wrapping
+    def model_fn2(cand):
+        step, batch = model_fn(cand)
+        return (lambda *a: step()), batch
+
+    cands = [Candidate(dp=8), Candidate(dp=4, mp=2)]
+    tuner = ProfileTuner(model_fn2, cands, warmup=0, iters=1)
+    best = tuner.tune()
+    assert best == cands[0]
+    assert any("error" in r for r in tuner.records)
+
+    def all_fail(cand):
+        raise RuntimeError("nope")
+
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        ProfileTuner(all_fail, cands).tune()
