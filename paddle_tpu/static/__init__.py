@@ -147,6 +147,112 @@ class Program:
     def __repr__(self):
         return f"Program(feeds={list(self.feed_vars)}, builder={self.builder})"
 
+    # -- op-level introspection (reference: Program.global_block().ops) ------
+    @property
+    def ops(self):
+        """OpDesc-like views of the traced program's operations.
+
+        The reference exposes mutable proto OpDescs; here the program IS
+        the traced jaxpr, so this surface is read-only introspection (op
+        type, input/output shapes+dtypes) — rewriting belongs to XLA and
+        the layer-level pass frameworks (distributed/passes, quantization/
+        passes). Requires feed shapes: every static.data var declared on
+        this program. Traced once and cached per feed signature."""
+        return [_OpDesc(eqn) for eqn in _flat_eqns(self._traced_jaxpr())]
+
+    def _traced_jaxpr(self):
+        from ..core.dispatch import no_grad
+        from ..core.dtype import to_np_dtype
+        from ..core.tensor import Tensor
+
+        if self.builder is None:
+            raise RuntimeError(
+                "program has no builder; run layers under this program "
+                "(or set_builder) before inspecting ops"
+            )
+        items = sorted(self.feed_vars.items())
+        sig = tuple((n, tuple(v.shape), str(v.dtype)) for n, v in items)
+        cached = self._compiled_cache.get(("jaxpr", sig))
+        if cached is not None:
+            return cached
+        names = [n for n, _ in items]
+        shapes = [
+            tuple(max(int(d), 1) if d not in (None, -1) else 1
+                  for d in v.shape)
+            for _, v in items
+        ]
+        dtypes = [to_np_dtype(v.dtype) for _, v in items]
+
+        # warm EAGERLY first, like Executor.run: static.nn parameters must
+        # materialize outside any trace (params born under make_jaxpr would
+        # be cached leaked tracers crashing later executions), and layer
+        # caches must resolve against THIS program, not the current default
+        if not getattr(self, "_warmed", False):
+            self._warmed = True
+            with program_guard(self), no_grad():
+                self.builder({
+                    n: Tensor(jnp.zeros(s, d), stop_gradient=True)
+                    for n, s, d in zip(names, shapes, dtypes)
+                })
+
+        def fn(*vals):
+            feed = {
+                n: Tensor(v, stop_gradient=True)
+                for n, v in zip(names, vals)
+            }
+            with program_guard(self), no_grad():
+                out = self.builder(feed)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._value if hasattr(o, "_value") else o for o in outs]
+
+        specs = [
+            jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)
+        ]
+        jaxpr = jax.make_jaxpr(fn)(*specs).jaxpr
+        self._compiled_cache[("jaxpr", sig)] = jaxpr
+        return jaxpr
+
+
+def _flat_eqns(jaxpr):
+    """Flatten call-like eqns (the per-op jit cache wraps every framework
+    op in pjit) so `ops` lists the REAL primitives, like the reference's
+    flat op list."""
+    out = []
+    for eqn in jaxpr.eqns:
+        inner = None
+        for key in ("jaxpr", "call_jaxpr"):
+            v = eqn.params.get(key)
+            if v is not None:
+                inner = getattr(v, "jaxpr", v)
+                break
+        if inner is not None:
+            out.extend(_flat_eqns(inner))
+        else:
+            out.append(eqn)
+    return out
+
+
+class _OpDesc:
+    """Read-only view of one traced operation (reference: proto OpDesc)."""
+
+    def __init__(self, eqn):
+        self._eqn = eqn
+
+    @property
+    def type(self) -> str:
+        return self._eqn.primitive.name
+
+    def input_shapes(self):
+        return [tuple(getattr(v.aval, "shape", ())) for v in self._eqn.invars]
+
+    def output_shapes(self):
+        return [tuple(getattr(v.aval, "shape", ()))
+                for v in self._eqn.outvars]
+
+    def __repr__(self):
+        return (f"op {self.type}: {self.input_shapes()} -> "
+                f"{self.output_shapes()}")
+
 
 _default_main = [Program()]
 _default_startup = [Program()]

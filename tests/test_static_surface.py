@@ -283,3 +283,45 @@ class TestReviewFixes:
         conv(sp).values.sum().backward()
         assert conv.weight.grad is not None
         assert np.isfinite(conv.weight.grad.numpy()).all()
+
+
+def test_program_ops_introspection():
+    """reference: Program.global_block().ops — op-level views of the
+    traced program (read-only here; jaxpr is the IR)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+
+        def build(feed):
+            h = static.nn.fc(feed["x"], size=16)
+            return paddle.tanh(h)
+
+        prog.set_builder(build)
+    ops = prog.ops
+    types = [o.type for o in ops]
+    assert any("dot" in t or "matmul" in t for t in types), types
+    assert "tanh" in types, types
+    matmuls = [o for o in ops if "dot" in o.type]
+    assert matmuls[0].output_shapes()[0] == (4, 16)
+    assert "op " in repr(ops[0]) and "Program(" in repr(prog)
+    # cached: second access returns without retracing
+    assert len(prog.ops) == len(ops)
+    # introspection must NOT poison later executions (leaked-tracer guard)
+    import numpy as np
+    exe = static.Executor()
+    out = exe.run(prog, feed={"x": np.ones((4, 8), np.float32)},
+                  fetch_list=None)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+    out2 = exe.run(prog, feed={"x": np.ones((4, 8), np.float32)},
+                   fetch_list=None)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out2[0]))
+    # the default program was not polluted with this program's layers
+    assert not getattr(static.default_main_program(), "_static_layers", {})
+    # no builder -> clear error
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="no builder"):
+        static.Program().ops
