@@ -187,7 +187,7 @@ class Engine:
 
     def __init__(self, model=None, inputs_spec=None, labels_spec=None,
                  cluster=None, strategy=None, process_mesh=None,
-                 data_axis=None, auto=False):
+                 data_axis=None, auto=False, tune=False):
         self.model = model
         self.inputs_spec = inputs_spec
         self.labels_spec = labels_spec
@@ -195,9 +195,12 @@ class Engine:
         self.strategy = strategy
         # auto=True (or strategy.auto): the Planner chooses the mesh
         # factorization from the cost model instead of the user's
-        # process_mesh (reference: engine.py _plan → Planner.search)
+        # process_mesh (reference: engine.py _plan → Planner.search);
+        # tune=True additionally MEASURES the planner's top candidates on
+        # the devices and keeps the fastest (reference: OptimizationTuner)
         self.auto = bool(auto or (strategy is not None
                                   and getattr(strategy, "auto", False)))
+        self.tune = bool(tune)
         self.plan = None
         self.process_mesh = process_mesh or (
             None if self.auto else _default_process_mesh
@@ -253,10 +256,106 @@ class Engine:
         self.plan = plan_for_model(self.model, seq_len=seq,
                                    global_batch=batch, cluster=cluster,
                                    allow_pp=False)
+        if self.tune:
+            tuned = self._tune_plan(cluster)
+            if tuned is not None:
+                self.plan = tuned
         c = self.plan.candidate
         ids = np.arange(cluster.n_devices).reshape(c.dp, c.mp)
         data_dim = "sharding" if c.zero_stage > 0 else "dp"
         return ProcessMesh(ids.tolist(), dim_names=[data_dim, "mp"])
+
+    def _tune_plan(self, cluster):
+        """Measure the planner's top candidates on the devices and keep the
+        fastest (reference: tuner/optimization_tuner.py). Needs concrete
+        inputs_spec+labels_spec to synthesize a trial batch; parameter
+        values are snapshotted and restored so trial steps don't perturb
+        the init."""
+        import warnings
+
+        import jax.numpy as jnp
+
+        from ...parallel.sharding import shard_params, sharded_train_step
+        from ...parallel.topology import init_mesh
+        from .planner import Planner, ModelDesc
+        from .tuner import ProfileTuner
+
+        if not (self.inputs_spec and self.labels_spec and self._loss
+                and self._optimizer):
+            warnings.warn(
+                "Engine(tune=True) needs inputs_spec, labels_spec, loss and "
+                "optimizer to synthesize trial batches; keeping the "
+                "analytic plan"
+            )
+            return None
+        batch, seq = self._data_shape_hint()
+        desc = ModelDesc.from_model(self.model, seq_len=seq,
+                                    global_batch=batch)
+        has_tp = any(
+            type(sub).__name__ in ("ColumnParallelLinear",
+                                   "RowParallelLinear",
+                                   "VocabParallelEmbedding")
+            for _, sub in self.model.named_sublayers()
+        )
+        plans = Planner(desc, cluster, allow_pp=False,
+                        allow_mp=has_tp).plan_topk(3)
+        if len(plans) < 2:
+            return plans[0] if plans else None
+
+        def synth(spec):
+            first = spec[0] if isinstance(spec, (list, tuple)) else spec
+            shape = [batch if (d in (None, -1) or i == 0) else int(d)
+                     for i, d in enumerate(first.shape)]
+            dtype = str(getattr(first, "dtype", "float32"))
+            if "int" in dtype:
+                return Tensor(jnp.zeros(shape, jnp.int32),
+                              stop_gradient=True)
+            return Tensor(jnp.zeros(shape, jnp.float32),
+                          stop_gradient=True)
+
+        x, y = synth(self.inputs_spec), synth(self.labels_spec)
+        # snapshot to HOST memory: the trial steps donate the device
+        # buffers, so device-array references would be invalidated
+        snapshot = [
+            (p, np.asarray(jax.device_get(p._value)))
+            for p in self.model.parameters()
+        ]
+        opt_snapshot = {
+            pid: {k: np.asarray(jax.device_get(v)) for k, v in st.items()}
+            for pid, st in getattr(self._optimizer, "_accumulators",
+                                   {}).items()
+        }
+        opt_steps = getattr(self._optimizer, "_step_count", 0)
+
+        def model_fn(cand):
+            from .planner import mesh_degrees_for
+
+            init_mesh(**mesh_degrees_for(cand))
+            shard_params(self.model, zero_stage=cand.zero_stage)
+            step = sharded_train_step(
+                self.model, self._loss, self._optimizer,
+                zero_stage=cand.zero_stage,
+                batch_axes=("dp", "sharding"),
+            )
+            return step, (x, y)
+
+        try:
+            tuner = ProfileTuner(model_fn,
+                                 [p.candidate for p in plans], iters=2)
+            best = tuner.tune(verbose=True)
+        finally:
+            for p, v in snapshot:
+                p._value = jnp.asarray(v)
+            if hasattr(self._optimizer, "_accumulators"):
+                self._optimizer._accumulators = {
+                    pid: {k: jnp.asarray(v) for k, v in st.items()}
+                    for pid, st in opt_snapshot.items()
+                }
+                self._optimizer._step_count = opt_steps
+        for p in plans:
+            if p.candidate is best:
+                return p
+        return plans[0]
 
     def _data_shape_hint(self):
         """(global_batch, seq_len) from inputs_spec, else a dp-wide default."""

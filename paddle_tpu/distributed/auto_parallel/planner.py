@@ -317,7 +317,13 @@ class Planner:
         return out
 
     def plan(self, verbose: bool = False) -> Plan:
-        best = None
+        return self.plan_topk(1, verbose=verbose)[0]
+
+    def plan_topk(self, k: int, verbose: bool = False) -> List[Plan]:
+        """The k cheapest feasible plans, best first — the candidate list a
+        ProfileTuner can then MEASURE (reference: the planner hands its
+        shortlist to the OptimizationTuner's trial loop)."""
+        scored = []
         rejected: List[Tuple[Candidate, str]] = []
         for c in self.candidates():
             cost, breakdown, mem = self.cost_model.estimate(self.model, c)
@@ -329,20 +335,23 @@ class Planner:
             cost *= 1.0 + 0.01 * (
                 (c.mp > 1) + (c.pp > 1) + (c.sep > 1) + (c.zero_stage > 0)
             )
-            if best is None or cost < best[0]:
-                best = (cost, c, breakdown, mem)
-        if best is None:
+            scored.append((cost, c, breakdown, mem))
+        if not scored:
             raise RuntimeError(
                 "auto-parallel planner: no feasible candidate — model does "
                 "not fit HBM at any factorization; add chips or shrink the "
                 f"model (rejections: {rejected[:5]})"
             )
-        cost, c, breakdown, mem = best
-        plan = Plan(candidate=c, cost_ms=cost, breakdown=breakdown,
-                    mem_bytes=mem, rejected=rejected)
+        scored.sort(key=lambda t: t[0])
+        plans = [
+            Plan(candidate=c, cost_ms=cost, breakdown=bd, mem_bytes=mem,
+                 rejected=rejected)
+            for cost, c, bd, mem in scored[:max(k, 1)]
+        ]
         if verbose:
-            print(plan.log())
-        return plan
+            for p in plans:
+                print(p.log())
+        return plans
 
 
 def _divisors(n: int) -> List[int]:
