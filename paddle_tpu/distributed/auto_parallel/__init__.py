@@ -253,33 +253,34 @@ class Engine:
             else ClusterSpec(n_devices=len(_jax.devices()))
         # Engine's compiled step expresses dp/mp/zero; pp needs the
         # pipeline-block protocol, which the fleet path handles
-        self.plan = plan_for_model(self.model, seq_len=seq,
-                                   global_batch=batch, cluster=cluster,
-                                   allow_pp=False)
+        plans = plan_for_model(self.model, seq_len=seq, global_batch=batch,
+                               cluster=cluster, allow_pp=False,
+                               topk=3 if self.tune else 1)
         if self.tune:
-            tuned = self._tune_plan(cluster)
-            if tuned is not None:
-                self.plan = tuned
+            self.plan = self._tune_plan(plans, batch)
+        else:
+            self.plan = plans
         c = self.plan.candidate
         ids = np.arange(cluster.n_devices).reshape(c.dp, c.mp)
         data_dim = "sharding" if c.zero_stage > 0 else "dp"
         return ProcessMesh(ids.tolist(), dim_names=[data_dim, "mp"])
 
-    def _tune_plan(self, cluster):
+    def _tune_plan(self, plans, batch):
         """Measure the planner's top candidates on the devices and keep the
         fastest (reference: tuner/optimization_tuner.py). Needs concrete
-        inputs_spec+labels_spec to synthesize a trial batch; parameter
-        values are snapshotted and restored so trial steps don't perturb
-        the init."""
+        single-tensor inputs_spec+labels_spec to synthesize a trial batch;
+        parameter/buffer/optimizer state is snapshotted and restored so
+        trial steps don't perturb the init. Any failure falls back to the
+        analytic best plan with a warning."""
         import warnings
 
         import jax.numpy as jnp
 
         from ...parallel.sharding import shard_params, sharded_train_step
         from ...parallel.topology import init_mesh
-        from .planner import Planner, ModelDesc
         from .tuner import ProfileTuner
 
+        analytic = plans[0]
         if not (self.inputs_spec and self.labels_spec and self._loss
                 and self._optimizer):
             warnings.warn(
@@ -287,20 +288,16 @@ class Engine:
                 "optimizer to synthesize trial batches; keeping the "
                 "analytic plan"
             )
-            return None
-        batch, seq = self._data_shape_hint()
-        desc = ModelDesc.from_model(self.model, seq_len=seq,
-                                    global_batch=batch)
-        has_tp = any(
-            type(sub).__name__ in ("ColumnParallelLinear",
-                                   "RowParallelLinear",
-                                   "VocabParallelEmbedding")
-            for _, sub in self.model.named_sublayers()
-        )
-        plans = Planner(desc, cluster, allow_pp=False,
-                        allow_mp=has_tp).plan_topk(3)
+            return analytic
+        if any(isinstance(s, (list, tuple)) and len(s) > 1
+               for s in (self.inputs_spec, self.labels_spec)):
+            warnings.warn(
+                "Engine(tune=True) supports single-tensor inputs/labels "
+                "specs; keeping the analytic plan"
+            )
+            return analytic
         if len(plans) < 2:
-            return plans[0] if plans else None
+            return analytic
 
         def synth(spec):
             first = spec[0] if isinstance(spec, (list, tuple)) else spec
@@ -315,10 +312,15 @@ class Engine:
 
         x, y = synth(self.inputs_spec), synth(self.labels_spec)
         # snapshot to HOST memory: the trial steps donate the device
-        # buffers, so device-array references would be invalidated
+        # buffers, so device-array references would be invalidated.
+        # Buffers included — BatchNorm running stats etc. also move during
+        # trial steps.
         snapshot = [
             (p, np.asarray(jax.device_get(p._value)))
             for p in self.model.parameters()
+        ] + [
+            (b, np.asarray(jax.device_get(b._value)))
+            for _, b in self.model.named_buffers()
         ]
         opt_snapshot = {
             pid: {k: np.asarray(jax.device_get(v)) for k, v in st.items()}
@@ -339,10 +341,15 @@ class Engine:
             )
             return step, (x, y)
 
+        best = None
         try:
             tuner = ProfileTuner(model_fn,
                                  [p.candidate for p in plans], iters=2)
             best = tuner.tune(verbose=True)
+        except RuntimeError as e:
+            warnings.warn(
+                f"profile tuning failed ({e}); keeping the analytic plan"
+            )
         finally:
             for p, v in snapshot:
                 p._value = jnp.asarray(v)
@@ -355,7 +362,7 @@ class Engine:
         for p in plans:
             if p.candidate is best:
                 return p
-        return plans[0]
+        return analytic
 
     def _data_shape_hint(self):
         """(global_batch, seq_len) from inputs_spec, else a dp-wide default."""

@@ -360,11 +360,13 @@ def _divisors(n: int) -> List[int]:
 
 def plan_for_model(model, seq_len: int, global_batch: int,
                    cluster: Optional[ClusterSpec] = None,
-                   allow_pp: Optional[bool] = None) -> Plan:
+                   allow_pp: Optional[bool] = None, topk: int = 1):
     """Shared auto-plan entry used by Engine(auto=True) and the fleet's
     strategy.auto path: introspect the model (TP-annotated weights gate mp;
     the pipeline-block protocol gates pp), build the ModelDesc, run the
-    Planner, log the chosen spec."""
+    Planner, log the chosen spec. topk=1 returns the best Plan; topk>1
+    returns the k cheapest Plans best-first (one introspection pass serves
+    both the analytic choice and the profile tuner's shortlist)."""
     import jax
 
     desc = ModelDesc.from_model(model, seq_len=seq_len,
@@ -378,9 +380,10 @@ def plan_for_model(model, seq_len: int, global_batch: int,
     )
     has_pp = hasattr(model, "pp_blocks") if allow_pp is None else allow_pp
     cluster = cluster or ClusterSpec(n_devices=len(jax.devices()))
-    plan = Planner(desc, cluster, allow_pp=has_pp, allow_mp=has_tp).plan()
-    print(plan.log())
-    return plan
+    plans = Planner(desc, cluster, allow_pp=has_pp,
+                    allow_mp=has_tp).plan_topk(topk)
+    print(plans[0].log())
+    return plans[0] if topk == 1 else plans
 
 
 def mesh_degrees_for(candidate: Candidate) -> Dict[str, int]:

@@ -157,3 +157,57 @@ def test_planner_topk_sorted():
     costs = [p.cost_ms for p in plans]
     assert costs == sorted(costs)
     assert len({str(p.candidate) for p in plans}) == 3
+
+
+def test_engine_tune_restores_buffers_and_falls_back():
+    from types import SimpleNamespace
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel import Engine
+
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 8)
+            self.bn = nn.BatchNorm1D(8)
+            self.head = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.head(self.bn(self.fc(x)))
+
+    paddle.seed(0)
+    m = BNNet()
+    buf_before = {n: b.numpy().copy() for n, b in m.named_buffers()}
+    eng = Engine(
+        model=m, auto=True, tune=True,
+        inputs_spec=SimpleNamespace(shape=[32, 16], dtype="float32"),
+        labels_spec=SimpleNamespace(shape=[32, 4], dtype="float32"),
+    )
+    eng.prepare(
+        optimizer=paddle.optimizer.Adam(0.01, parameters=m.parameters()),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+    )
+    # trial steps must not perturb BN running stats
+    for n, b in m.named_buffers():
+        np.testing.assert_array_equal(b.numpy(), buf_before[n]), n
+    # adam moments restored to pristine (empty pre-trial state)
+    assert eng._optimizer._step_count == 0
+
+    # multi-input specs: warn + keep the analytic plan, never crash
+    import warnings as _w
+
+    eng2 = Engine(
+        model=nn.Linear(4, 2), auto=True, tune=True,
+        inputs_spec=[SimpleNamespace(shape=[8, 4], dtype="float32"),
+                     SimpleNamespace(shape=[8, 4], dtype="float32")],
+        labels_spec=SimpleNamespace(shape=[8, 2], dtype="float32"),
+    )
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        eng2.prepare(
+            optimizer=paddle.optimizer.SGD(
+                0.1, parameters=eng2.model.parameters()),
+            loss=lambda o, y: ((o - y) ** 2).mean(),
+        )
+    assert eng2.plan is not None
+    assert any("analytic plan" in str(r.message) for r in rec)
