@@ -330,6 +330,29 @@ class _Runtime:
         return tuple(full) + (Tensor(i_end - di, stop_gradient=True),)
 
     @staticmethod
+    def convert_call(fn):
+        """Transitive conversion (reference: convert_call in
+        convert_operators.py — called functions are converted too, so a
+        helper with tensor-dependent control flow compiles instead of
+        raising). Conservative gate: plain Python functions from USER
+        modules only; framework/library calls pass through untouched; any
+        conversion failure silently returns the original."""
+        import types as _types
+
+        if not isinstance(fn, (_types.FunctionType, _types.MethodType)):
+            return fn
+        target = fn.__func__ if isinstance(fn, _types.MethodType) else fn
+        mod = getattr(target, "__module__", "") or ""
+        if mod.split(".")[0] in _NOCONVERT_MODULES:
+            return fn
+        if getattr(target, "_jst_converted", False):
+            return fn
+        try:
+            return convert_to_static(fn)
+        except Exception:
+            return fn
+
+    @staticmethod
     def range_cond(i, stop, step):
         """`i` still inside range(start, stop, step)? — sign-aware, works
         with any mix of traced/concrete operands (the while-form lowering
@@ -385,6 +408,14 @@ class _Runtime:
 
 
 jst = _Runtime()
+
+# top-level packages whose functions are never converted (framework and
+# library internals trace as usual; conversion targets USER code)
+_NOCONVERT_MODULES = frozenset({
+    "paddle_tpu", "jax", "jaxlib", "numpy", "np", "builtins", "math",
+    "functools", "itertools", "operator", "typing", "collections", "os",
+    "sys", "flax", "optax", "orbax", "einops", "torch",
+})
 
 # name under which the runtime is injected into the function's module
 # globals (unique enough to never collide with user names)
@@ -829,6 +860,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self._counter += 1
         return f"__jst_{kind}_{self._counter}"
 
+    # -- transitive call conversion (reference: convert_call) ---------------
+    _CALL_SKIP = frozenset({
+        "range", "locals", "globals", "super", "print", "len", "isinstance",
+        "getattr", "setattr", "hasattr", "type", "iter", "next", "zip",
+        "enumerate", "int", "float", "bool", "str", "list", "tuple", "dict",
+        "set",
+    })
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in self._CALL_SKIP:
+            return node
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == _RT_NAME):
+            return node
+        node.func = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                               attr="convert_call", ctx=ast.Load()),
+            args=[f], keywords=[],
+        )
+        ast.copy_location(node.func, node)
+        ast.fix_missing_locations(node.func)
+        return node
+
     # -- logical ops ---------------------------------------------------------
     def visit_BoolOp(self, node: ast.BoolOp):
         self.generic_visit(node)
@@ -1172,6 +1228,7 @@ def _convert_cached(fn_key):
     new_fn = functools.wraps(fn)(new_fn)
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn._jst_converted = True  # convert_call must not re-convert
     return new_fn
 
 

@@ -373,3 +373,43 @@ def test_shrink_on_non_ctr_table_is_noop():
     assert len(t) == 100
     assert t.shrink() == 0
     assert len(t) == 100
+
+
+# -- transitive conversion (reference: convert_call) ---------------------------
+def _helper_with_traced_while(x):
+    s = paddle.zeros([])
+    i = paddle.zeros([], dtype="int32")
+    while i < 4:  # traced -> must convert even though only CALLED
+        s = s + x
+        i = i + 1
+    return s
+
+
+def test_convert_call_converts_user_helpers():
+    def fn(x):
+        return _helper_with_traced_while(x) * 2.0
+
+    out = to_static(fn)(paddle.to_tensor(np.float32(1.5)))
+    np.testing.assert_allclose(float(out), 12.0, rtol=1e-6)
+
+
+def test_convert_call_skips_framework_and_builtins():
+    def fn(x):
+        ys = [x + float(i) for i in range(3)]  # builtins untouched
+        return paddle.stack(ys).sum()          # framework untouched
+
+    out = to_static(fn)(paddle.to_tensor(np.float32(1.0)))
+    np.testing.assert_allclose(float(out), 6.0, rtol=1e-6)
+
+
+def test_convert_call_recursive_helper():
+    def fact_like(x, n):
+        if n <= 0:  # concrete
+            return x
+        return fact_like(x + 1.0, n - 1)
+
+    def fn(x):
+        return fact_like(x, 3)
+
+    out = to_static(fn)(paddle.to_tensor(np.float32(0.0)))
+    np.testing.assert_allclose(float(out), 3.0)
