@@ -113,6 +113,9 @@ for _pkg in (
     "utils",
     "cost_model",
     "quantization",
+    "reader",
+    "compat",
+    "dataset",
 ):
     try:
         globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)

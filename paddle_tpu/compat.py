@@ -1,0 +1,89 @@
+"""paddle.compat — type conversion helpers.
+
+Reference analogue: python/paddle/compat.py (to_text/to_bytes recursive
+string conversion, banker's-rounding round, C-style floor_division,
+get_exception_message) — kept for scripts that import them.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Recursively decode bytes to str (reference: compat.py:25)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_text(e, encoding) for e in obj]
+            return obj
+        return [_to_text(e, encoding) for e in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_to_text(e, encoding) for e in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return {_to_text(e, encoding) for e in obj}
+    if isinstance(obj, dict):
+        return {
+            _to_text(k, encoding): _to_text(v, encoding)
+            for k, v in obj.items()
+        }
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, str):
+        return obj
+    return str(obj) if isinstance(obj, (bool, float)) else obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Recursively encode str to bytes (reference: compat.py:121)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_bytes(e, encoding) for e in obj]
+            return obj
+        return [_to_bytes(e, encoding) for e in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_to_bytes(e, encoding) for e in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return {_to_bytes(e, encoding) for e in obj}
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return obj
+
+
+def round(x, d=0):  # noqa: A001 — the reference shadows the builtin too
+    """Python-2-style round-half-away-from-zero (reference: compat.py:206)."""
+    if x is None:
+        raise TypeError("x must not be None")
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+
+
+def floor_division(x, y):
+    """C-style truncating division (reference: compat.py:232)."""
+    return abs(x) // abs(y) * (1 if x * y > 0 else -1)
+
+
+def get_exception_message(exc):
+    """reference: compat.py:249."""
+    return str(exc)

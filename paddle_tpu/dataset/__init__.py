@@ -1,0 +1,16 @@
+"""paddle.dataset — the legacy reader-creator dataset package.
+
+Reference analogue: python/paddle/dataset/ (mnist.py, cifar.py, imdb.py,
+uci_housing.py, common.py ...) — each module exposes reader creators
+(`train()`, `test()`) yielding numpy samples, composed with paddle.reader
+combinators and fed through paddle.io / fleet datasets.
+
+Zero-egress environment: the download mirrors are unreachable, so every
+reader is backed by DETERMINISTIC synthetic data with the exact shapes,
+dtypes, and value ranges of the originals (the same strategy as
+paddle_tpu.vision.datasets). Sample counts are scaled down; pass
+`n=` to size them explicitly.
+"""
+from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "common"]

@@ -1,0 +1,52 @@
+"""reference: python/paddle/dataset/cifar.py — train10/test10 (10-way)
+and train100/test100 (100-way) readers yielding (3072-float32 in [0, 1],
+int label). Synthetic-backed here."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader(n, classes, seed):
+    def reader():
+        rng = np.random.default_rng(seed)
+        for i in range(n):
+            label = i % classes
+            img = rng.uniform(0.0, 1.0, 3072).astype(np.float32)
+            img[(label % 32) * 96:(label % 32 + 1) * 96] *= 0.2
+            yield img, int(label)
+
+    return reader
+
+
+def train10(cycle: bool = False, n: int = 1024):
+    base = _reader(n, 10, seed=0)
+    if not cycle:
+        return base
+
+    def cycled():
+        while True:
+            yield from base()
+
+    return cycled
+
+
+def test10(cycle: bool = False, n: int = 256):
+    base = _reader(n, 10, seed=1)
+    if not cycle:
+        return base
+
+    def cycled():
+        while True:
+            yield from base()
+
+    return cycled
+
+
+def train100(n: int = 1024):
+    return _reader(n, 100, seed=2)
+
+
+def test100(n: int = 256):
+    return _reader(n, 100, seed=3)

@@ -1,0 +1,60 @@
+"""reference: python/paddle/dataset/common.py — download/cache helpers.
+Zero-egress: download() raises with a clear message; the hashing and
+cluster-split helpers work as in the reference."""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+__all__ = ["DATA_HOME", "md5file", "download", "split", "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str, save_name=None):
+    raise RuntimeError(
+        f"paddle.dataset.common.download({url!r}) is unavailable in this "
+        "zero-egress environment; the paddle_tpu.dataset readers are "
+        "synthetic-backed and need no downloads"
+    )
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split a reader's samples into pickle files of line_count each
+    (reference: common.py split)."""
+    indx = 0
+    batch = []
+    for d in reader():
+        batch.append(d)
+        if len(batch) == line_count:
+            with open(suffix % indx, "wb") as f:
+                dumper(batch, f)
+            batch = []
+            indx += 1
+    if batch:
+        with open(suffix % indx, "wb") as f:
+            dumper(batch, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Read this trainer's share of split files (reference: common.py)."""
+    import glob
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my = flist[trainer_id::trainer_count]
+        for fn in my:
+            with open(fn, "rb") as f:
+                yield from loader(f)
+
+    return reader

@@ -1,0 +1,45 @@
+"""reference: python/paddle/dataset/imdb.py — word_dict() plus
+train(word_idx)/test(word_idx) readers yielding (word-id list, 0/1 label).
+Synthetic-backed here with a small fixed vocabulary."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "word_dict"]
+
+_POS = ["great", "excellent", "wonderful", "loved", "best", "amazing"]
+_NEG = ["terrible", "awful", "boring", "hated", "worst", "poor"]
+_FILL = ["movie", "film", "plot", "acting", "scene", "story", "the", "a"]
+
+
+def word_dict():
+    """word -> id; id len(dict) is reserved for <unk> like the reference."""
+    words = sorted(set(_POS + _NEG + _FILL))
+    return {w: i for i, w in enumerate(words)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.default_rng(seed)
+        wd = word_dict()
+        for i in range(n):
+            label = i % 2
+            pool = _POS if label else _NEG
+            length = int(rng.integers(5, 30))
+            doc = [
+                wd[pool[int(rng.integers(len(pool)))]]
+                if rng.random() < 0.4
+                else wd[_FILL[int(rng.integers(len(_FILL)))]]
+                for _ in range(length)
+            ]
+            yield doc, label
+
+    return reader
+
+
+def train(word_idx=None, n: int = 512):
+    return _reader(n, seed=0)
+
+
+def test(word_idx=None, n: int = 128):
+    return _reader(n, seed=1)

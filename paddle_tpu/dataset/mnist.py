@@ -1,0 +1,28 @@
+"""reference: python/paddle/dataset/mnist.py — train()/test() readers
+yielding (784-float32 in [-1, 1], int label). Synthetic-backed here."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.default_rng(seed)
+        for i in range(n):
+            label = i % 10
+            img = rng.normal(0.0, 0.3, 784).astype(np.float32)
+            # class-dependent blob so models can actually learn
+            img[label * 70:(label + 1) * 70] += 1.0
+            yield np.clip(img, -1.0, 1.0), int(label)
+
+    return reader
+
+
+def train(n: int = 1024):
+    return _reader(n, seed=0)
+
+
+def test(n: int = 256):
+    return _reader(n, seed=1)

@@ -1,0 +1,35 @@
+"""reference: python/paddle/dataset/uci_housing.py — train()/test()
+readers yielding (13-float32 normalized features, 1-float32 price).
+Synthetic-backed with a fixed linear ground truth + noise so regression
+examples converge like the real data."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD",
+    "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+_W = np.linspace(-1.5, 2.0, 13).astype(np.float32)
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            x = rng.normal(0.0, 1.0, 13).astype(np.float32)
+            y = np.float32(x @ _W + 22.5 + rng.normal(0.0, 0.5))
+            yield x, np.array([y], np.float32)
+
+    return reader
+
+
+def train(n: int = 404):
+    return _reader(n, seed=0)
+
+
+def test(n: int = 102):
+    return _reader(n, seed=1)

@@ -1,0 +1,162 @@
+"""paddle.reader — reader-creator combinators.
+
+Reference analogue: python/paddle/reader/decorator.py — a reader is a
+zero-arg callable returning an iterable of samples; these combinators
+compose readers (cache/shuffle/batch windows/parallel map). Pure host-side
+python; the TPU path consumes the composed reader through paddle.io /
+fleet datasets.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+from typing import Callable
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers"]
+
+
+def cache(reader: Callable) -> Callable:
+    """Cache the FIRST pass in memory; later passes replay it (reference:
+    decorator.py:52)."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        return iter(all_data)
+
+    return cached_reader
+
+
+def map_readers(func: Callable, *readers) -> Callable:
+    """Zip readers and map func over the per-reader samples (reference:
+    decorator.py:92)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader: Callable, buf_size: int) -> Callable:
+    """Window shuffle with a buf_size reservoir (reference:
+    decorator.py:134)."""
+
+    def reader_():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def chain(*readers) -> Callable:
+    """Concatenate readers (reference: decorator.py:183)."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs) -> Callable:
+    """Zip readers into flattened tuples (reference: decorator.py:248).
+    check_alignment=True (default) raises when readers are uneven."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {sorted(kwargs)}")
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise ValueError(
+                    "outputs of readers are not aligned (use "
+                    "check_alignment=False to truncate)"
+                )
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader: Callable, size: int) -> Callable:
+    """Producer-thread buffering up to `size` samples (reference:
+    decorator.py:308) — overlaps the reader's IO with the consumer."""
+
+    class _End:
+        pass
+
+    def reader_():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return reader_
+
+
+def firstn(reader: Callable, n: int) -> Callable:
+    """First n samples (reference: decorator.py:367)."""
+
+    def reader_():
+        return itertools.islice(reader(), n)
+
+    return reader_
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False) -> Callable:
+    """Thread-pool map over a reader (reference: decorator.py:412 — the
+    'process_num' workers are threads there too). order=True preserves
+    sample order."""
+
+    def reader_():
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            if order:
+                yield from pool.map(mapper, reader())
+            else:
+                futures = []
+                for sample in reader():
+                    futures.append(pool.submit(mapper, sample))
+                    if len(futures) >= buffer_size:
+                        done = [f for f in futures if f.done()]
+                        if not done:
+                            done = [futures[0]]
+                        for f in done:
+                            futures.remove(f)
+                            yield f.result()
+                for f in futures:
+                    yield f.result()
+
+    return reader_

@@ -1,0 +1,153 @@
+"""paddle.reader combinators, legacy paddle.dataset readers, paddle.compat
+(reference: python/paddle/reader/decorator.py, python/paddle/dataset/,
+python/paddle/compat.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import compat, dataset, reader
+
+
+# -- reader combinators --------------------------------------------------------
+def _r(n):
+    def rd():
+        return iter(range(n))
+
+    return rd
+
+
+def test_cache_replays_first_pass():
+    calls = []
+
+    def rd():
+        calls.append(1)
+        return iter([1, 2, 3])
+
+    c = reader.cache(rd)
+    assert list(c()) == [1, 2, 3] and list(c()) == [1, 2, 3]
+    assert len(calls) == 1
+
+
+def test_map_readers_and_chain():
+    m = reader.map_readers(lambda a, b: a + b, _r(3), _r(3))
+    assert list(m()) == [0, 2, 4]
+    assert list(reader.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+
+
+def test_shuffle_is_permutation():
+    out = list(reader.shuffle(_r(100), buf_size=32)())
+    assert sorted(out) == list(range(100)) and out != list(range(100))
+
+
+def test_compose_alignment():
+    c = reader.compose(_r(3), _r(3))
+    assert list(c()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(ValueError, match="aligned"):
+        list(reader.compose(_r(2), _r(3))())
+    ok = reader.compose(_r(2), _r(3), check_alignment=False)
+    assert list(ok()) == [(0, 0), (1, 1)]
+
+
+def test_buffered_and_firstn():
+    assert list(reader.buffered(_r(10), 4)()) == list(range(10))
+    assert list(reader.firstn(_r(10), 3)()) == [0, 1, 2]
+
+
+@pytest.mark.parametrize("order", [True, False])
+def test_xmap_readers(order):
+    out = list(reader.xmap_readers(lambda x: x * 2, _r(20), 4, 8,
+                                   order=order)())
+    if order:
+        assert out == [x * 2 for x in range(20)]
+    else:
+        assert sorted(out) == [x * 2 for x in range(20)]
+
+
+# -- legacy datasets -----------------------------------------------------------
+def test_mnist_reader_contract():
+    samples = list(dataset.mnist.train(n=32)())
+    assert len(samples) == 32
+    img, label = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert img.min() >= -1.0 and img.max() <= 1.0
+    assert 0 <= label < 10
+    # deterministic
+    again = list(dataset.mnist.train(n=32)())
+    np.testing.assert_array_equal(again[5][0], samples[5][0])
+
+
+def test_cifar_reader_contract():
+    s10 = list(dataset.cifar.train10(n=16)())
+    img, label = s10[0]
+    assert img.shape == (3072,) and 0 <= label < 10
+    s100 = list(dataset.cifar.train100(n=16)())
+    assert any(l >= 10 for _, l in s100) or len(s100) < 11
+    # cycle=True wraps
+    import itertools
+
+    cyc = list(itertools.islice(dataset.cifar.train10(cycle=True, n=4)(), 10))
+    assert len(cyc) == 10
+
+
+def test_imdb_reader_and_word_dict():
+    wd = dataset.imdb.word_dict()
+    assert isinstance(wd, dict) and len(wd) > 10
+    docs = list(dataset.imdb.train(wd, n=8)())
+    doc, label = docs[0]
+    assert all(isinstance(w, int) and w in wd.values() for w in doc)
+    assert label in (0, 1)
+
+
+def test_uci_housing_trains_a_regressor():
+    import paddle_tpu.nn as nn
+
+    xs, ys = zip(*list(dataset.uci_housing.train(n=128)()))
+    x = paddle.to_tensor(np.stack(xs))
+    y = paddle.to_tensor(np.stack(ys))
+    paddle.seed(0)
+    m = nn.Linear(13, 1)
+    opt = paddle.optimizer.Adam(0.5, parameters=m.parameters())
+    first = None
+    for _ in range(250):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.05  # synthetic data is learnable
+
+
+def test_dataset_common_split_and_cluster_reader(tmp_path):
+    import os
+
+    pat = str(tmp_path / "part-%05d.pickle")
+    dataset.common.split(_r(10), 3, suffix=pat)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 4  # 3+3+3+1
+    r0 = dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), trainer_count=2, trainer_id=0)
+    r1 = dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), trainer_count=2, trainer_id=1)
+    assert sorted(list(r0()) + list(r1())) == list(range(10))
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        dataset.common.download("http://x", "m", "00")
+
+
+# -- compat --------------------------------------------------------------------
+def test_compat_to_text_to_bytes():
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert compat.to_text({b"k": b"v"}) == {"k": "v"}
+    assert compat.to_bytes("abc") == b"abc"
+    assert compat.to_bytes(["a", "b"]) == [b"a", b"b"]
+    lst = [b"x"]
+    assert compat.to_text(lst, inplace=True) is lst and lst == ["x"]
+
+
+def test_compat_round_and_floor_division():
+    assert compat.round(0.5) == 1.0
+    assert compat.round(-0.5) == -1.0
+    assert compat.round(2.675, 2) == 2.68
+    assert compat.floor_division(7, 2) == 3
+    assert compat.floor_division(-7, 2) == -3  # C-style truncation
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
