@@ -1,0 +1,81 @@
+"""Auto-parallel training: let the cost-model planner pick the mesh.
+
+Two entry points into the same planning stack (reference:
+python/paddle/distributed/auto_parallel/ planner/tuner/engine):
+
+  1. fleet path — `strategy.auto = True`: the first batch's shapes feed
+     the Planner; the mesh is re-initialised to the chosen factorization
+     and the compiled SPMD step is built on it.
+  2. Engine path — `Engine(auto=True, tune=True)`: the Planner's top
+     candidates are MEASURED on the devices and the fastest wins.
+
+Run anywhere:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_auto_parallel.py
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from types import SimpleNamespace
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.auto_parallel import Engine
+
+
+def make_batches(n, bsz=32):
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 4)).astype(np.float32)
+    xs = rng.normal(size=(n, bsz, 16)).astype(np.float32)
+    return [
+        (paddle.to_tensor(x), paddle.to_tensor(x @ w))
+        for x in xs
+    ]
+
+
+def fleet_auto():
+    print("== fleet strategy.auto ==")
+    strategy = fleet.DistributedStrategy()
+    strategy.auto = True
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 128), nn.ReLU(), nn.Linear(128, 4))
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(1e-2, parameters=model.parameters()),
+        strategy=strategy,
+    )
+    step = fleet.distributed_train_step(
+        model, lambda o, y: ((o - y) ** 2).mean(), opt
+    )
+    for i, (x, y) in enumerate(make_batches(6)):
+        loss = step(x, y)  # first call plans + logs the chosen spec
+        print(f"  step {i}: loss {float(loss):.4f}")
+
+
+def engine_auto_tune():
+    print("== Engine(auto=True, tune=True) ==")
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 128), nn.ReLU(), nn.Linear(128, 4))
+    eng = Engine(
+        model=model, auto=True, tune=True,
+        inputs_spec=SimpleNamespace(shape=[32, 16], dtype="float32"),
+        labels_spec=SimpleNamespace(shape=[32, 4], dtype="float32"),
+    )
+    eng.prepare(
+        optimizer=paddle.optimizer.Adam(1e-2, parameters=model.parameters()),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+    )
+    hist = eng.fit(make_batches(6), epochs=1)
+    print(f"  losses: {[round(h, 4) for h in hist]}")
+
+
+if __name__ == "__main__":
+    fleet_auto()
+    engine_auto_tune()
+    print("auto-parallel example OK")

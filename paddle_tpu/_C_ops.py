@@ -1,0 +1,25 @@
+"""paddle._C_ops — the raw op-call namespace.
+
+Reference analogue: python/paddle/_C_ops.py (re-exports the pybind'd op
+entry points; user code and generated layers call `_C_ops.matmul(...)`
+directly). Here every lookup forwards to the public op surface — the
+`final_state_` prefix the reference's generated code uses is stripped.
+"""
+from __future__ import annotations
+
+
+def __getattr__(name):
+    from . import nn, tensor_api
+
+    base = name[len("final_state_"):] if name.startswith("final_state_") \
+        else name
+    for mod in (tensor_api, nn.functional):
+        fn = getattr(mod, base, None)
+        if fn is not None:
+            return fn
+    import paddle_tpu as _p
+
+    fn = getattr(_p, base, None)
+    if fn is not None and callable(fn):
+        return fn
+    raise AttributeError(f"paddle._C_ops has no op {name!r}")
