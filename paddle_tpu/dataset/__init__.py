@@ -11,6 +11,15 @@ dtypes, and value ranges of the originals (the same strategy as
 paddle_tpu.vision.datasets). Sample counts are scaled down; pass
 `n=` to size them explicitly.
 """
-from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401
+from . import (  # noqa: F401
+    cifar,
+    common,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    uci_housing,
+)
 
-__all__ = ["mnist", "cifar", "imdb", "uci_housing", "common"]
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens",
+           "uci_housing", "common"]

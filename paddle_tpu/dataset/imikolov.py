@@ -1,0 +1,59 @@
+"""reference: python/paddle/dataset/imikolov.py — PTB language-model
+readers: build_dict() then train(word_idx, n)/test(word_idx, n) yielding
+n-gram tuples of word ids (or (src, trg) sequence pairs with
+data_type=SEQ). Synthetic-backed here."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["build_dict", "train", "test"]
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+_WORDS = [
+    "the", "of", "and", "to", "in", "a", "is", "that", "for", "it",
+    "market", "stock", "trade", "company", "year", "share", "price",
+    "bank", "rate", "government",
+]
+
+
+def build_dict(min_word_freq: int = 50):
+    """word -> id; <unk> and <e> reserved like the reference."""
+    d = {w: i for i, w in enumerate(_WORDS)}
+    d["<unk>"] = len(d)
+    d["<e>"] = len(d)
+    return d
+
+
+def _sentences(n, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        length = int(rng.integers(4, 12))
+        yield [int(rng.integers(0, len(_WORDS))) for _ in range(length)]
+
+
+def _reader(word_idx, n, data_type, count, seed):
+    def reader():
+        for sent in _sentences(count, seed):
+            if data_type == DataType.NGRAM:
+                if len(sent) >= n:
+                    for i in range(n - 1, len(sent)):
+                        yield tuple(sent[i - n + 1:i + 1])
+            else:
+                yield sent[:-1], sent[1:]
+
+    return reader
+
+
+def train(word_idx=None, n: int = 5, data_type=DataType.NGRAM,
+          count: int = 256):
+    return _reader(word_idx, n, data_type, count, seed=0)
+
+
+def test(word_idx=None, n: int = 5, data_type=DataType.NGRAM,
+         count: int = 64):
+    return _reader(word_idx, n, data_type, count, seed=1)

@@ -1,0 +1,105 @@
+"""reference: python/paddle/dataset/movielens.py — ML-1M readers yielding
+(user_id, gender, age, job, movie_id, categories, title_ids, rating) rows
+plus movie/user info accessors. Synthetic-backed here with the original
+category vocabulary and field ranges."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "get_movie_title_dict", "movie_categories",
+           "max_movie_id", "max_user_id", "max_job_id", "age_table",
+           "MovieInfo", "UserInfo"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+_TITLE_WORDS = ["the", "of", "movie", "night", "day", "man", "story",
+                "city", "love", "war"]
+_N_USERS = 200
+_N_MOVIES = 400
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        cat_d = movie_categories()
+        title_d = get_movie_title_dict()
+        return [
+            self.index,
+            [cat_d[c] for c in self.categories],
+            [title_d[w] for w in self.title.lower().split()],
+        ]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {w: i for i, w in enumerate(_TITLE_WORDS)}
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_job_id():
+    return 20
+
+
+def _movie(mid, rng):
+    cats = [
+        _CATEGORIES[int(c)]
+        for c in rng.choice(len(_CATEGORIES), size=1 + int(mid) % 3,
+                            replace=False)
+    ]
+    title = " ".join(
+        _TITLE_WORDS[int(w)]
+        for w in rng.choice(len(_TITLE_WORDS), size=3, replace=False)
+    )
+    return MovieInfo(mid, cats, title)
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            uid = int(rng.integers(1, _N_USERS + 1))
+            user = UserInfo(uid, "M" if uid % 2 else "F",
+                            age_table[uid % len(age_table)], uid % 21)
+            movie = _movie(int(rng.integers(1, _N_MOVIES + 1)), rng)
+            rating = float(rng.integers(1, 6))
+            yield user.value() + movie.value() + [[rating]]
+
+    return reader
+
+
+def train(n: int = 512):
+    return _reader(n, seed=0)
+
+
+def test(n: int = 128):
+    return _reader(n, seed=1)

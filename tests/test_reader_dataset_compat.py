@@ -151,3 +151,30 @@ def test_compat_round_and_floor_division():
     assert compat.floor_division(7, 2) == 3
     assert compat.floor_division(-7, 2) == -3  # C-style truncation
     assert compat.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_imikolov_ngram_and_seq():
+    wd = dataset.imikolov.build_dict()
+    assert "<unk>" in wd and "<e>" in wd
+    grams = list(dataset.imikolov.train(wd, n=5, count=32)())
+    assert all(len(g) == 5 for g in grams)
+    assert all(isinstance(w, int) for g in grams for w in g)
+    seqs = list(dataset.imikolov.train(
+        wd, data_type=dataset.imikolov.DataType.SEQ, count=8)())
+    src, trg = seqs[0]
+    assert len(src) == len(trg) and src[1:] == trg[:-1]
+
+
+def test_movielens_row_contract():
+    rows = list(dataset.movielens.train(n=16)())
+    row = rows[0]
+    # user_id, gender, age_idx, job, movie_id, categories, title_ids, [rating]
+    assert len(row) == 8
+    uid, gender, age, job, mid, cats, title, rating = row
+    assert 1 <= uid <= dataset.movielens.max_user_id()
+    assert gender in (0, 1)
+    assert 0 <= age < len(dataset.movielens.age_table)
+    assert 1 <= mid <= dataset.movielens.max_movie_id()
+    assert all(0 <= c < 18 for c in cats) and len(title) == 3
+    assert 1.0 <= rating[0] <= 5.0
+    assert len(dataset.movielens.movie_categories()) == 18
