@@ -8,8 +8,9 @@ combinators and fed through paddle.io / fleet datasets.
 Zero-egress environment: the download mirrors are unreachable, so every
 reader is backed by DETERMINISTIC synthetic data with the exact shapes,
 dtypes, and value ranges of the originals (the same strategy as
-paddle_tpu.vision.datasets). Sample counts are scaled down; pass
-`n=` to size them explicitly.
+paddle_tpu.vision.datasets). Sample counts are scaled down; every
+reader takes an explicit sizing knob (`n=` for the image/tabular readers,
+`count=` for imikolov, where `n` is the n-gram order).
 """
 from . import (  # noqa: F401
     cifar,

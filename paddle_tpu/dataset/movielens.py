@@ -8,7 +8,7 @@ import numpy as np
 
 __all__ = ["train", "test", "get_movie_title_dict", "movie_categories",
            "max_movie_id", "max_user_id", "max_job_id", "age_table",
-           "MovieInfo", "UserInfo"]
+           "movie_info", "user_info", "MovieInfo", "UserInfo"]
 
 age_table = [1, 18, 25, 35, 45, 50, 56]
 
@@ -30,12 +30,10 @@ class MovieInfo:
         self.title = title
 
     def value(self):
-        cat_d = movie_categories()
-        title_d = get_movie_title_dict()
         return [
             self.index,
-            [cat_d[c] for c in self.categories],
-            [title_d[w] for w in self.title.lower().split()],
+            [_CAT_DICT[c] for c in self.categories],
+            [_TITLE_DICT[w] for w in self.title.lower().split()],
         ]
 
 
@@ -51,11 +49,11 @@ class UserInfo:
 
 
 def movie_categories():
-    return {c: i for i, c in enumerate(_CATEGORIES)}
+    return dict(_CAT_DICT)
 
 
 def get_movie_title_dict():
-    return {w: i for i, w in enumerate(_TITLE_WORDS)}
+    return dict(_TITLE_DICT)
 
 
 def max_movie_id():
@@ -68,6 +66,25 @@ def max_user_id():
 
 def max_job_id():
     return 20
+
+
+_CAT_DICT = {c: i for i, c in enumerate(_CATEGORIES)}
+_TITLE_DICT = {w: i for i, w in enumerate(_TITLE_WORDS)}
+
+
+def movie_info():
+    """movie_id -> MovieInfo (reference: movielens.py movie_info)."""
+    rng = np.random.default_rng(42)
+    return {mid: _movie(mid, rng) for mid in range(1, _N_MOVIES + 1)}
+
+
+def user_info():
+    """user_id -> UserInfo (reference: movielens.py user_info)."""
+    return {
+        uid: UserInfo(uid, "M" if uid % 2 else "F",
+                      age_table[uid % len(age_table)], uid % 21)
+        for uid in range(1, _N_USERS + 1)
+    }
 
 
 def _movie(mid, rng):

@@ -178,3 +178,14 @@ def test_movielens_row_contract():
     assert all(0 <= c < 18 for c in cats) and len(title) == 3
     assert 1.0 <= rating[0] <= 5.0
     assert len(dataset.movielens.movie_categories()) == 18
+
+
+def test_movielens_info_accessors():
+    movies = dataset.movielens.movie_info()
+    users = dataset.movielens.user_info()
+    assert len(movies) == dataset.movielens.max_movie_id()
+    assert len(users) == dataset.movielens.max_user_id()
+    mi = movies[1]
+    assert mi.value()[0] == 1 and len(mi.value()) == 3
+    ui = users[1]
+    assert ui.value()[0] == 1 and ui.value()[1] in (0, 1)
