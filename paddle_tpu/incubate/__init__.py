@@ -10,6 +10,7 @@ from . import moe  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import checkpoint  # noqa: F401
 # NOTE: incubate.multiprocessing is intentionally NOT imported here — it
 # registers a global ForkingPickler reducer for Tensor as an import side
 # effect, which must stay opt-in (import paddle.incubate.multiprocessing),

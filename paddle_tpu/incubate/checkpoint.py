@@ -1,0 +1,20 @@
+"""paddle.incubate.checkpoint — auto-checkpoint import surface.
+
+Reference analogue: python/paddle/incubate/checkpoint/__init__.py
+(re-exporting fluid.incubate.checkpoint.auto_checkpoint, whose heart is
+`train_epoch_range` — resume-aware epoch iteration with automatic
+checkpointing). The capability lives in distributed/checkpoint.py here;
+this module provides the reference import path.
+"""
+from types import SimpleNamespace
+
+from ..distributed.checkpoint import (  # noqa: F401
+    AsyncCheckpointer,
+    train_epoch_range,
+)
+
+# `from paddle.incubate.checkpoint import auto_checkpoint as acp;
+#  acp.train_epoch_range(...)` — the reference's usage shape
+auto_checkpoint = SimpleNamespace(train_epoch_range=train_epoch_range)
+
+__all__ = ["auto_checkpoint", "train_epoch_range", "AsyncCheckpointer"]
