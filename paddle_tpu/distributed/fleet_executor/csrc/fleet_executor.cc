@@ -400,8 +400,9 @@ class MessageBus {
       if (out_fds_[r] < 0) {
         out_fds_[r] = ps::connect_to(peers_[r].first, peers_[r].second);
         if (out_fds_[r] < 0) {
-          // peer may still be binding; brief retry loop
-          for (int i = 0; i < 50 && out_fds_[r] < 0 && running_.load(); ++i) {
+          // peer may still be binding — or still importing its python
+          // runtime (~5s with jax on a loaded host); retry up to 15s
+          for (int i = 0; i < 150 && out_fds_[r] < 0 && running_.load(); ++i) {
             std::this_thread::sleep_for(std::chrono::milliseconds(100));
             out_fds_[r] = ps::connect_to(peers_[r].first, peers_[r].second);
           }

@@ -3,18 +3,27 @@
 Reference analogue: python/paddle/incubate/checkpoint/__init__.py
 (re-exporting fluid.incubate.checkpoint.auto_checkpoint, whose heart is
 `train_epoch_range` — resume-aware epoch iteration with automatic
-checkpointing). The capability lives in distributed/checkpoint.py here;
-this module provides the reference import path.
+checkpointing). The capability lives in distributed/checkpoint.py.
+
+Everything resolves LAZILY (PEP 562): distributed/checkpoint.py imports
+orbax, which costs ~2.5s — eagerly chaining it into `import paddle_tpu`
+doubled framework import time and strained subprocess-startup timing
+budgets (the cross-process bus tests).
 """
-from types import SimpleNamespace
-
-from ..distributed.checkpoint import (  # noqa: F401
-    AsyncCheckpointer,
-    train_epoch_range,
-)
-
-# `from paddle.incubate.checkpoint import auto_checkpoint as acp;
-#  acp.train_epoch_range(...)` — the reference's usage shape
-auto_checkpoint = SimpleNamespace(train_epoch_range=train_epoch_range)
+from __future__ import annotations
 
 __all__ = ["auto_checkpoint", "train_epoch_range", "AsyncCheckpointer"]
+
+
+def __getattr__(name):
+    if name in ("train_epoch_range", "AsyncCheckpointer"):
+        from ..distributed import checkpoint as _ckpt
+
+        return getattr(_ckpt, name)
+    if name == "auto_checkpoint":
+        from types import SimpleNamespace
+
+        from ..distributed import checkpoint as _ckpt
+
+        return SimpleNamespace(train_epoch_range=_ckpt.train_epoch_range)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
