@@ -15,12 +15,15 @@ reader takes an explicit sizing knob (`n=` for the image/tabular readers,
 from . import (  # noqa: F401
     cifar,
     common,
+    conll05,
     imdb,
     imikolov,
     mnist,
     movielens,
     uci_housing,
+    wmt14,
+    wmt16,
 )
 
 __all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens",
-           "uci_housing", "common"]
+           "uci_housing", "common", "wmt14", "wmt16", "conll05"]

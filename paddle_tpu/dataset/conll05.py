@@ -1,0 +1,120 @@
+"""reference: python/paddle/dataset/conll05.py — CoNLL-2005 semantic-role
+-labeling reader. test() yields 9-slot samples
+(word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark, label_idx)
+— the five predicate-context columns and the predicate column are
+broadcast to sentence length, mark flags the ±2 window around the verb,
+and labels use the B-/I-/O tagging with exactly one B-V at the predicate.
+Synthetic-backed (zero-egress) with the reference's exact slot layout and
+context/mark derivation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+UNK_IDX = 0
+
+_WORDS = [
+    "the", "company", "said", "it", "will", "buy", "shares", "from",
+    "investors", "board", "approved", "plan", "to", "sell", "unit",
+    "profit", "rose", "in", "quarter", "analysts",
+]
+_VERBS = ["said", "buy", "approved", "sell", "rose"]
+_LABELS = [
+    "B-A0", "I-A0", "B-A1", "I-A1", "B-A2", "B-AM-TMP", "B-AM-LOC",
+    "B-V", "O",
+]
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — <unk> is id 0 in word_dict
+    like the reference's wordDict.txt."""
+    word_dict = {"<unk>": UNK_IDX}
+    for w in _WORDS:
+        word_dict[w] = len(word_dict)
+    verb_dict = {v: i for i, v in enumerate(_VERBS)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding(dim: int = 32):
+    """The reference returns a path to trained wikipedia embeddings; here:
+    a deterministic (len(word_dict), dim) float32 array."""
+    word_dict, _, _ = get_dict()
+    rng = np.random.default_rng(5)
+    return rng.standard_normal((len(word_dict), dim)).astype(np.float32) * 0.1
+
+
+def _sentences(count, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(count):
+        length = int(rng.integers(5, 14))
+        sent = [_WORDS[int(rng.integers(0, len(_WORDS)))] for _ in range(length)]
+        verb_index = int(rng.integers(0, length))
+        verb = _VERBS[int(rng.integers(0, len(_VERBS)))]
+        sent[verb_index] = verb
+        labels = []
+        for i in range(length):
+            if i == verb_index:
+                labels.append("B-V")
+            else:
+                labels.append(_LABELS[int(rng.integers(0, len(_LABELS) - 2))])
+        yield sent, verb, labels
+
+
+def reader_creator(count, seed):
+    word_dict, predicate_dict, label_dict = get_dict()
+
+    def reader():
+        for sentence, predicate, labels in _sentences(count, seed):
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+
+            # ±2 context window around the predicate; out-of-range slots
+            # read bos/eos sentinels (reference conll05.py:151-198)
+            mark = [0] * len(labels)
+            if verb_index > 0:
+                mark[verb_index - 1] = 1
+                ctx_n1 = sentence[verb_index - 1]
+            else:
+                ctx_n1 = "bos"
+            if verb_index > 1:
+                mark[verb_index - 2] = 1
+                ctx_n2 = sentence[verb_index - 2]
+            else:
+                ctx_n2 = "bos"
+            mark[verb_index] = 1
+            ctx_0 = sentence[verb_index]
+            if verb_index < len(labels) - 1:
+                mark[verb_index + 1] = 1
+                ctx_p1 = sentence[verb_index + 1]
+            else:
+                ctx_p1 = "eos"
+            if verb_index < len(labels) - 2:
+                mark[verb_index + 2] = 1
+                ctx_p2 = sentence[verb_index + 2]
+            else:
+                ctx_p2 = "eos"
+
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctx_n2_idx = [word_dict.get(ctx_n2, UNK_IDX)] * sen_len
+            ctx_n1_idx = [word_dict.get(ctx_n1, UNK_IDX)] * sen_len
+            ctx_0_idx = [word_dict.get(ctx_0, UNK_IDX)] * sen_len
+            ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
+            ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
+            pred_idx = [predicate_dict.get(predicate)] * sen_len
+            label_idx = [label_dict.get(w) for w in labels]
+
+            yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx, ctx_p1_idx,
+                   ctx_p2_idx, pred_idx, mark, label_idx)
+
+    return reader
+
+
+def test(count: int = 64):
+    return reader_creator(count, seed=3)
+
+
+def fetch():
+    return None

@@ -1,0 +1,95 @@
+"""reference: python/paddle/dataset/wmt14.py — WMT14 en→fr translation
+readers. train/test/gen(dict_size) yield (src_ids, trg_ids, trg_ids_next)
+where src is wrapped in <s>…<e>, trg_ids is <s>-prefixed and trg_ids_next
+is <e>-suffixed, and pairs longer than 80 tokens are dropped.
+Synthetic-backed (zero-egress): deterministic sentence pairs with the
+reference's exact tuple structure and special-token conventions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "gen", "get_dict"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+# the reference's dicts reserve ids 0/1/2 for <s>/<e>/<unk>
+_RESERVED = {START: 0, END: 1, UNK: 2}
+
+_SRC_WORDS = [
+    "the", "house", "is", "on", "a", "hill", "river", "runs", "through",
+    "town", "market", "opens", "at", "dawn", "children", "play", "in",
+    "park", "old", "bridge",
+]
+_TRG_WORDS = [
+    "la", "maison", "est", "sur", "une", "colline", "riviere", "traverse",
+    "ville", "le", "marche", "ouvre", "aube", "enfants", "jouent", "dans",
+    "parc", "vieux", "pont", "grand",
+]
+
+_MAX_LEN = 80  # the reference drops train pairs longer than this
+
+
+def _dict(words, dict_size):
+    d = dict(_RESERVED)
+    for w in words[: max(0, dict_size - len(_RESERVED))]:
+        d[w] = len(d)
+    return d
+
+
+def _pairs(count, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(count):
+        n_src = int(rng.integers(3, 10))
+        n_trg = int(rng.integers(3, 10))
+        src = [_SRC_WORDS[int(rng.integers(0, len(_SRC_WORDS)))] for _ in range(n_src)]
+        trg = [_TRG_WORDS[int(rng.integers(0, len(_TRG_WORDS)))] for _ in range(n_trg)]
+        yield src, trg
+
+
+def reader_creator(dict_size, count, seed):
+    def reader():
+        src_dict = _dict(_SRC_WORDS, dict_size)
+        trg_dict = _dict(_TRG_WORDS, dict_size)
+        for src_words, trg_words in _pairs(count, seed):
+            src_ids = [src_dict.get(w, UNK_IDX) for w in [START] + src_words + [END]]
+            trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_words]
+            if len(src_ids) > _MAX_LEN or len(trg_ids) > _MAX_LEN:
+                continue
+            trg_ids_next = trg_ids + [trg_dict[END]]
+            trg_ids = [trg_dict[START]] + trg_ids
+            yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(dict_size, count: int = 256):
+    """Each sample: (src word-id seq, <s>-prefixed trg seq, next-word seq)."""
+    return reader_creator(dict_size, count, seed=0)
+
+
+def test(dict_size, count: int = 64):
+    return reader_creator(dict_size, count, seed=1)
+
+
+def gen(dict_size, count: int = 64):
+    """The reference's held-out generation split."""
+    return reader_creator(dict_size, count, seed=2)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); reverse=True returns id→word maps like the
+    reference (used to print generated translations)."""
+    src_dict = _dict(_SRC_WORDS, dict_size)
+    trg_dict = _dict(_TRG_WORDS, dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
+
+
+def fetch():
+    return None

@@ -1,0 +1,128 @@
+"""reference: python/paddle/dataset/wmt16.py — WMT16 en↔de multimodal
+translation readers. train/test/validation(src_dict_size, trg_dict_size,
+src_lang) yield (src_ids, trg_ids, trg_ids_next); start/end/unk ids are
+shared across languages; dict sizes are capped at the corpus vocabulary
+(TOTAL_EN_WORDS / TOTAL_DE_WORDS). Synthetic-backed (zero-egress) with
+the reference's exact tuple structure, language routing, and caps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+_EN_WORDS = [
+    "a", "man", "in", "an", "orange", "hat", "starring", "at", "something",
+    "boston", "terrier", "is", "running", "on", "lush", "green", "grass",
+    "front", "of", "white", "fence", "girl", "karate", "uniform", "breaking",
+]
+_DE_WORDS = [
+    "ein", "mann", "mit", "einem", "orangefarbenen", "hut", "der", "etwas",
+    "anstarrt", "boston", "terrier", "lauft", "uber", "saftig", "grunes",
+    "gras", "vor", "weisen", "zaun", "madchen", "im", "karateanzug",
+    "bricht", "ein", "brett",
+]
+
+
+def _words(lang):
+    return _EN_WORDS if lang == "en" else _DE_WORDS
+
+
+def _total(lang):
+    return TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS
+
+
+def _load_dict(lang, dict_size, reverse=False):
+    # ids 0/1/2 are <s>/<e>/<unk> in every wmt16 dict (reference
+    # __build_dict writes the three marks first)
+    d = {START_MARK: 0, END_MARK: 1, UNK_MARK: 2}
+    for w in _words(lang)[: max(0, dict_size - 3)]:
+        if w not in d:
+            d[w] = len(d)
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
+    src_dict_size = min(src_dict_size, _total(src_lang))
+    trg_dict_size = min(trg_dict_size, _total("de" if src_lang == "en" else "en"))
+    return src_dict_size, trg_dict_size
+
+
+def _pairs(count, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(count):
+        n_en = int(rng.integers(3, 12))
+        n_de = int(rng.integers(3, 12))
+        en = [_EN_WORDS[int(rng.integers(0, len(_EN_WORDS)))] for _ in range(n_en)]
+        de = [_DE_WORDS[int(rng.integers(0, len(_DE_WORDS)))] for _ in range(n_de)]
+        yield en, de
+
+
+def reader_creator(src_dict_size, trg_dict_size, src_lang, count, seed):
+    def reader():
+        src_dict = _load_dict(src_lang, src_dict_size)
+        trg_dict = _load_dict("de" if src_lang == "en" else "en", trg_dict_size)
+        start_id = src_dict[START_MARK]
+        end_id = src_dict[END_MARK]
+        unk_id = src_dict[UNK_MARK]
+        for en, de in _pairs(count, seed):
+            src_words, trg_words = (en, de) if src_lang == "en" else (de, en)
+            src_ids = (
+                [start_id] + [src_dict.get(w, unk_id) for w in src_words] + [end_id]
+            )
+            trg_ids = [trg_dict.get(w, unk_id) for w in trg_words]
+            trg_ids_next = trg_ids + [end_id]
+            trg_ids = [start_id] + trg_ids
+            yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def _check_lang(src_lang):
+    if src_lang not in ("en", "de"):
+        raise ValueError(
+            "An error language type. Only support: en (for English); "
+            "de (for Germany)."
+        )
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en", count: int = 256):
+    _check_lang(src_lang)
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang
+    )
+    return reader_creator(src_dict_size, trg_dict_size, src_lang, count, seed=0)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en", count: int = 64):
+    _check_lang(src_lang)
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang
+    )
+    return reader_creator(src_dict_size, trg_dict_size, src_lang, count, seed=1)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en", count: int = 64):
+    _check_lang(src_lang)
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang
+    )
+    return reader_creator(src_dict_size, trg_dict_size, src_lang, count, seed=2)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    dict_size = min(dict_size, _total(lang))
+    return _load_dict(lang, dict_size, reverse=reverse)
+
+
+def fetch():
+    return None

@@ -15,7 +15,7 @@ import threading
 from typing import Callable
 
 __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
-           "buffered", "firstn", "xmap_readers"]
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
 
 
 def cache(reader: Callable) -> Callable:
@@ -160,3 +160,139 @@ def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
                     yield f.result()
 
     return reader_
+
+
+def multiprocess_reader(readers, use_pipe: bool = True,
+                        queue_size: int = 1000) -> Callable:
+    """Merge readers, one OS process per reader (reference:
+    decorator.py:505). One sentinel per worker ends the merge; a worker
+    that dies mid-stream sends an error marker so the consumer raises
+    instead of hanging.
+
+    Workers are started via the 'fork' context like the reference —
+    samples stream back over a Queue (use_pipe=False) or one Pipe per
+    worker (use_pipe=True, the default). Samples must be picklable.
+    Prefer spawning the composed reader BEFORE any jax device work: fork
+    duplicates the parent's threads' locks (the usual fork-vs-jax
+    caveat)."""
+    if not isinstance(readers, (list, tuple)) or not readers:
+        raise TypeError("`readers` must be a non-empty list or tuple")
+    import multiprocessing as _mp
+    import pickle as _pickle
+
+    ctx = _mp.get_context("fork")
+    _ERR = "__multiprocess_reader_error__"
+
+    def _read_into_queue(reader, q):
+        try:
+            for sample in reader():
+                if sample is None:
+                    raise ValueError("sample has None")
+                q.put(sample)
+            q.put(None)
+        except Exception:
+            q.put(_ERR)
+            raise
+
+    def _cleanup(procs, clean_exit):
+        # early exit / error: workers may be blocked in put()/send() on a
+        # full channel — terminate FIRST, then reap (join-first would burn
+        # its full timeout per blocked worker)
+        for p in procs:
+            if not clean_exit and p.is_alive():
+                p.terminate()
+            p.join(timeout=5)
+            if p.is_alive():
+                p.kill()
+
+    def queue_reader():
+        q = ctx.Queue(queue_size)
+        procs = [
+            ctx.Process(target=_read_into_queue, args=(r, q), daemon=True)
+            for r in readers
+        ]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                try:
+                    sample = q.get(timeout=1.0)
+                except _queue.Empty:
+                    # a worker hard-killed (OOM/segfault) never sends its
+                    # sentinel — detect death instead of blocking forever
+                    dead = [p for p in procs if not p.is_alive()
+                            and p.exitcode not in (0, None)]
+                    if dead and q.empty():
+                        raise ValueError(
+                            "multiprocess_reader: a worker process died "
+                            f"(exitcode {dead[0].exitcode})"
+                        )
+                    continue
+                if sample is None:
+                    finished += 1
+                elif isinstance(sample, str) and sample == _ERR:
+                    raise ValueError(
+                        "multiprocess_reader: a worker reader raised"
+                    )
+                else:
+                    yield sample
+        finally:
+            _cleanup(procs, clean_exit=finished >= len(readers))
+
+    def _read_into_pipe(reader, conn):
+        try:
+            for sample in reader():
+                if sample is None:
+                    raise ValueError("sample has None")
+                conn.send(_pickle.dumps(sample))
+            conn.send(_pickle.dumps(None))
+        except Exception:
+            conn.send(_pickle.dumps(_ERR))
+            raise
+        finally:
+            conn.close()
+
+    def pipe_reader():
+        conns = []
+        procs = []
+        for r in readers:
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=_read_into_pipe, args=(r, child),
+                            daemon=True)
+            p.start()
+            child.close()
+            conns.append(parent)
+            procs.append(p)
+        clean = False
+        try:
+            live = list(conns)
+            while live:
+                for conn in _mp.connection.wait(live):
+                    try:
+                        buf = conn.recv()
+                    except EOFError:
+                        # pipe closed WITHOUT the pickled None sentinel:
+                        # the worker died mid-stream — raising beats
+                        # silently truncating the merged dataset
+                        p = procs[conns.index(conn)]
+                        p.join(timeout=5)
+                        raise ValueError(
+                            "multiprocess_reader: a worker process died "
+                            f"mid-stream (exitcode {p.exitcode})"
+                        )
+                    sample = _pickle.loads(buf)
+                    if sample is None:
+                        live.remove(conn)
+                        conn.close()
+                    elif isinstance(sample, str) and sample == _ERR:
+                        raise ValueError(
+                            "multiprocess_reader: a worker reader raised"
+                        )
+                    else:
+                        yield sample
+            clean = True
+        finally:
+            _cleanup(procs, clean_exit=clean)
+
+    return pipe_reader if use_pipe else queue_reader

@@ -189,3 +189,141 @@ def test_movielens_info_accessors():
     assert mi.value()[0] == 1 and len(mi.value()) == 3
     ui = users[1]
     assert ui.value()[0] == 1 and ui.value()[1] in (0, 1)
+
+
+# -- round-5 additions: wmt14 / wmt16 / conll05 / multiprocess_reader ----------
+def test_wmt14_sample_contract():
+    from paddle_tpu.dataset import wmt14
+
+    samples = list(wmt14.train(dict_size=30)())
+    assert samples
+    for src, trg, trg_next in samples:
+        # src wrapped in <s>(0) ... <e>(1); trg <s>-prefixed; next <e>-suffixed
+        assert src[0] == 0 and src[-1] == 1
+        assert trg[0] == 0 and trg_next[-1] == 1
+        assert trg[1:] == trg_next[:-1]
+        assert len(src) <= 80 and len(trg) <= 80
+    sd, td = wmt14.get_dict(30, reverse=True)
+    assert sd[0] == "<s>" and sd[1] == "<e>" and sd[2] == "<unk>"
+    sd2, _ = wmt14.get_dict(30, reverse=False)
+    assert sd2["<s>"] == 0
+    # deterministic + split-distinct
+    again = list(wmt14.train(dict_size=30)())
+    assert samples == again
+    assert list(wmt14.test(dict_size=30)()) != samples[: 64]
+
+
+def test_wmt14_small_dict_maps_to_unk():
+    from paddle_tpu.dataset import wmt14
+
+    # dict_size=3 keeps only the reserved marks: every real word -> UNK_IDX
+    for src, trg, trg_next in wmt14.train(dict_size=3, count=8)():
+        assert all(i == wmt14.UNK_IDX for i in src[1:-1])
+        assert all(i in (0, wmt14.UNK_IDX) for i in trg)
+
+
+def test_wmt16_language_routing_and_caps():
+    from paddle_tpu.dataset import wmt16
+
+    en_first = list(wmt16.train(100, 100, src_lang="en", count=16)())
+    de_first = list(wmt16.train(100, 100, src_lang="de", count=16)())
+    assert en_first and de_first
+    for src, trg, trg_next in en_first:
+        assert src[0] == 0 and src[-1] == 1
+        assert trg[0] == 0 and trg_next[-1] == 1
+        assert trg[1:] == trg_next[:-1]
+    # en->de vs de->en swap columns of the same pairs
+    assert en_first != de_first
+    with pytest.raises(ValueError, match="language"):
+        wmt16.train(100, 100, src_lang="fr")
+    d = wmt16.get_dict("en", 10 ** 9)
+    assert len(d) <= wmt16.TOTAL_EN_WORDS
+    rd = wmt16.get_dict("en", 10, reverse=True)
+    assert rd[0] == "<s>"
+    assert list(wmt16.validation(100, 100)()) != list(wmt16.test(100, 100)())
+
+
+def test_conll05_nine_slot_contract():
+    from paddle_tpu.dataset import conll05
+
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    assert word_dict["<unk>"] == conll05.UNK_IDX
+    bv = label_dict["B-V"]
+    samples = list(conll05.test(count=32)())
+    assert samples
+    for s in samples:
+        assert len(s) == 9
+        (word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+         label_idx) = s
+        n = len(word_idx)
+        # every broadcast column has sentence length
+        for col in (ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+                    label_idx):
+            assert len(col) == n
+        # exactly one B-V; mark flags the +-2 window around it
+        assert label_idx.count(bv) == 1
+        vi = label_idx.index(bv)
+        assert mark[vi] == 1
+        assert sum(mark) == len(
+            [i for i in range(vi - 2, vi + 3) if 0 <= i < n]
+        )
+        # ctx_0 broadcasts the predicate word itself
+        assert all(c == word_idx[vi] for c in ctx_0)
+        assert all(p == pred_idx[0] for p in pred_idx)
+    emb = conll05.get_embedding()
+    assert emb.shape == (len(word_dict), 32) and emb.dtype == np.float32
+
+
+@pytest.mark.parametrize("use_pipe", [True, False])
+def test_multiprocess_reader_merges_all(use_pipe):
+    mp = reader.multiprocess_reader(
+        [_r(10), _r(5)], use_pipe=use_pipe, queue_size=8
+    )
+    out = sorted(mp())
+    assert out == sorted(list(range(10)) + list(range(5)))
+
+
+def test_multiprocess_reader_propagates_worker_error():
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    mp = reader.multiprocess_reader([bad], use_pipe=True)
+    with pytest.raises(ValueError, match="worker reader raised"):
+        list(mp())
+
+
+def test_multiprocess_reader_rejects_empty():
+    with pytest.raises(TypeError):
+        reader.multiprocess_reader([])
+
+
+@pytest.mark.parametrize("use_pipe", [True, False])
+def test_multiprocess_reader_detects_killed_worker(use_pipe):
+    def dying():
+        yield 1
+        import os
+        os._exit(9)  # hard death: no sentinel, no error marker
+
+    mp = reader.multiprocess_reader([dying], use_pipe=use_pipe)
+    with pytest.raises(ValueError, match="died"):
+        list(mp())
+
+
+def test_multiprocess_reader_early_exit_is_fast():
+    import itertools, time
+
+    def big():
+        def r():
+            for i in range(100000):
+                yield i
+        return r
+
+    mp = reader.multiprocess_reader([big()], use_pipe=False, queue_size=4)
+    # consume a couple of samples, then drop the generator: cleanup must
+    # terminate the blocked producer instead of join-timeout'ing
+    t0 = time.time()
+    it = mp()
+    assert next(it) is not None
+    it.close()
+    assert time.time() - t0 < 4.0
