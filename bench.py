@@ -39,6 +39,23 @@ def _best_window(run_window, reps=None):
     return best
 
 
+def _timed(step_fn, steps, reps=None, sync=float):
+    """Best-of-N duration of `steps` calls to step_fn. `sync` forces the
+    async chain (host read via float by default; None for host-only work)
+    so the timer covers real execution, not queueing."""
+
+    def window():
+        t0 = time.time()
+        last = None
+        for _ in range(steps):
+            last = step_fn()
+        if sync is not None:
+            sync(last)
+        return time.time() - t0
+
+    return _best_window(window, reps)
+
+
 def bench_resnet50(steps=8, bsz=256):
     """BASELINE config 2: ResNet-50, AMP O2 bf16, compiled train step.
 
@@ -67,16 +84,7 @@ def bench_resnet50(steps=8, bsz=256):
     yt = paddle.Tensor(y, stop_gradient=True)
     float(step(xt, yt))  # compile
     float(step(xt, yt))
-
-    def window():
-        t0 = time.time()
-        last = None
-        for _ in range(steps):
-            last = step(xt, yt)
-        float(last)
-        return time.time() - t0
-
-    dt = _best_window(window)
+    dt = _timed(lambda: step(xt, yt), steps)
     return {"metric": "resnet50_amp_o2_imgs_per_sec_per_chip",
             "value": round(bsz * steps / dt, 1), "unit": "imgs/s/chip"}
 
@@ -120,16 +128,7 @@ def bench_bert(steps=6, bsz=8, seq=512):
     y = paddle.Tensor(packed, stop_gradient=True)
     float(step(x, y))
     float(step(x, y))
-
-    def window():
-        t0 = time.time()
-        last = None
-        for _ in range(steps):
-            last = step(x, y)
-        float(last)
-        return time.time() - t0
-
-    dt = _best_window(window)
+    dt = _timed(lambda: step(x, y), steps)
     return {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
             "value": round(bsz * seq * steps / dt, 1), "unit": "tokens/s/chip"}
 
@@ -143,15 +142,8 @@ def bench_ps_table(iters=10, batch=65536, dim=64):
     keys = rng.integers(0, 10_000_000, batch)
     grads = rng.standard_normal((batch, dim)).astype(np.float32)
     t.pull(keys)  # warm (creates entries)
-
-    def window():
-        t0 = time.time()
-        for _ in range(iters):
-            t.pull(keys)
-            t.push(keys, grads)
-        return time.time() - t0
-
-    dt = _best_window(window)
+    dt = _timed(lambda: (t.pull(keys), t.push(keys, grads)), iters,
+                sync=None)
     return {"metric": "ps_sparse_pull_push_m_lookups_per_sec",
             "value": round(batch * iters * 2 / dt / 1e6, 2), "unit": "M lookups/s"}
 
@@ -175,15 +167,8 @@ def bench_ps_wire(iters=10, batch=65536, dim=64):
         keys = rng.integers(0, 10_000_000, batch)
         grads = rng.standard_normal((batch, dim)).astype(np.float32)
         t.pull(keys)  # warm (creates entries, opens connections)
-
-        def window():
-            t0 = time.time()
-            for _ in range(iters):
-                t.pull(keys)
-                t.push(keys, grads)
-            return time.time() - t0
-
-        dt = _best_window(window)
+        dt = _timed(lambda: (t.pull(keys), t.push(keys, grads)), iters,
+                    sync=None)
         return {"metric": "ps_wire_pull_push_m_lookups_per_sec",
                 "value": round(batch * iters * 2 / dt / 1e6, 2),
                 "unit": "M lookups/s"}
@@ -222,16 +207,7 @@ def bench_gpt_longseq(steps=6, bsz=2, seq=4096):
     y = paddle.Tensor(ids[:, 1:], stop_gradient=True)
     float(step(x, y))
     float(step(x, y))
-
-    def window():
-        t0 = time.time()
-        last = None
-        for _ in range(steps):
-            last = step(x, y)
-        float(last)
-        return time.time() - t0
-
-    dt = _best_window(window)
+    dt = _timed(lambda: step(x, y), steps)
     return {"metric": "gpt2_345m_seq4096_tokens_per_sec_per_chip",
             "value": round(bsz * seq * steps / dt, 1), "unit": "tokens/s/chip"}
 
@@ -294,20 +270,17 @@ def bench_mnist_eager(steps=30, bsz=64):
         opt.clear_grad()
     float(loss)
 
-    def window():
-        t0 = time.time()
-        loss = None
-        for _ in range(steps):
-            loss = loss_fn(model(x), y)
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
-        float(loss)
-        return time.time() - t0
+    def eager_step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
 
     # eager per-op dispatch rides the relay hardest (one program round per
     # op): use more windows so at least one lands in a quiet period
-    dt = _best_window(window, reps=int(os.environ.get("BENCH_REPS", 4)))
+    dt = _timed(eager_step, steps,
+                reps=int(os.environ.get("BENCH_REPS", 4)))
     return {"metric": "mnist_lenet_eager_steps_per_sec",
             "value": round(steps / dt, 1), "unit": "steps/s"}
 
@@ -370,18 +343,14 @@ def main():
     # warmup one more (cache hit path)
     float(step(x, y))
 
-    last_loss = first_loss
+    synced = [first_loss]
 
-    def window():
-        nonlocal last_loss
-        t1 = time.time()
-        last = None
-        for _ in range(steps):
-            last = step(x, y)
-        last_loss = float(last)  # forces execution of the whole dependent chain
-        return time.time() - t1
+    def hard_sync(t):
+        # host read = the only reliable sync through the relay
+        synced.append(float(t))
 
-    dt = _best_window(window)
+    dt = _timed(lambda: step(x, y), steps, sync=hard_sync)
+    last_loss = synced[-1]
 
     tokens_per_step = bsz * seq
     tps = tokens_per_step * steps / dt

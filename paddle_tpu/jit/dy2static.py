@@ -25,10 +25,19 @@ constraints):
     return_transformer.py:136): trailing statements are absorbed into the
     branches so every path ends in a return, then returns collapse into a
     `_jst_retval` binding both branches produce;
-  - `return` inside a LOOP body, and attribute stores (self.x = ...),
-    keep Python semantics: that construct is left untransformed (a traced
-    predicate there raises jax's TracerBoolConversionError, pointing at
-    the unsupported pattern);
+  - `return` inside a LOOP body IS converted (reference:
+    return_transformer.py:136): the return value is captured into a fresh
+    temp, a return-tag is set and the loop breaks (riding the
+    break-flag machinery); after the loop a tag-dispatch if re-emits the
+    returns, which the early-return absorption then collapses. Loops with
+    an `else:` clause or a return under try/with keep Python semantics;
+  - attribute stores on never-rebound PARAMETERS (`self.x = ...`) ARE
+    converted (reference: ifelse_transformer attr handling): each stored
+    (param, attr) pair is localized to a carried `_jst_attr_*` name and
+    written back in a function-wide try/finally, so stores inside traced
+    branches/loops merge like ordinary locals. Nested-target stores
+    (`self.a.b = ...`), `del self.x`, and params captured by inner
+    functions keep Python semantics;
   - conversion is TRANSITIVE (reference: convert_call): plain Python
     functions from user modules called inside a converted function are
     converted on first use; framework/library calls and builtins pass
@@ -101,8 +110,44 @@ class _Runtime:
         return lcls.get(name, UNDEF)
 
     @staticmethod
+    def attr_get(obj, name):
+        """Localized attribute entry value; UNDEF when the attribute does
+        not exist yet (a store creates it on flush)."""
+        return getattr(obj, name, UNDEF)
+
+    @staticmethod
+    def attr_flush(obj, name, value, entry=UNDEF):
+        """Write-back of a localized `param.attr` store.
+
+        `entry` is the object snapshotted when the local was loaded:
+        identity-equal means no path rebound the local, so NO write
+        happens (python ran zero setattrs on that path — spurious
+        __setattr__/property invocations would be observable).
+
+        Eager: plain setattr — exact python rebinding semantics. Under a
+        jit trace, rebinding object state would leak tracers out of the
+        trace; instead, when the existing attribute is a Tensor already
+        BOUND into this trace (a to_static parameter/buffer — its _value
+        is a tracer), the store lands in-place so the functionalized
+        buffer read-back picks it up. Stores to unbound attributes under
+        tracing follow jax's python-side-effect rule (dropped after the
+        first trace)."""
+        if value is UNDEF or value is entry:
+            return
+        from ..core.tensor import Tensor
+
+        raw = value._value if isinstance(value, Tensor) else value
+        if isinstance(raw, jax.core.Tracer):
+            old = getattr(obj, name, None)
+            if isinstance(old, Tensor) and isinstance(
+                    old._value, jax.core.Tracer):
+                old._value = raw
+            return
+        setattr(obj, name, value)
+
+    @staticmethod
     def convert_ifelse(pred, true_fn, false_fn, carry, guard=False,
-                       both=None):
+                       both=None, zerofill=None):
         pred = _to_bool_value(pred)
         if isinstance(pred, jax.core.Tracer):
             from ..core.tensor import Tensor
@@ -162,17 +207,48 @@ class _Runtime:
             def f(vs):
                 return to_pytree(false_fn(rebuild(vs)))
 
+            pv = jnp.asarray(pred).astype(bool).reshape(())
             try:
-                outs = jax.lax.cond(
-                    jnp.asarray(pred).astype(bool).reshape(()), t, f, vals
-                )
+                outs = jax.lax.cond(pv, t, f, vals)
             except TypeError as e:
-                raise ValueError(
-                    "dy2static: both branches of a tensor-dependent if must "
-                    "produce the same variables with the same types (a "
-                    "variable bound in only one branch, or with mismatched "
-                    f"dtype/shape, cannot merge): {e}"
-                ) from None
+                # generated return-capture temps (_jst_rv*) may be bound by
+                # only one branch on the FIRST unrolled iteration of a
+                # concrete loop (entry-UNDEF): the missing branch takes a
+                # zeros placeholder — the value is only ever read when the
+                # return tag says its branch fired, so the fill is dead
+                # data. User slots keep the strict merge error.
+                zf = zerofill or (False,) * len(carry)
+                outs = None
+                if any(zf):
+                    t_struct = jax.eval_shape(t, vals)
+                    f_struct = jax.eval_shape(f, vals)
+
+                    def filled(fn_, other):
+                        def g(vs):
+                            out = list(fn_(vs))
+                            for i, z in enumerate(zf):
+                                if (z and out[i] is None
+                                        and other[i] is not None):
+                                    out[i] = jnp.zeros(
+                                        other[i].shape, other[i].dtype
+                                    )
+                            return tuple(out)
+                        return g
+
+                    try:
+                        outs = jax.lax.cond(
+                            pv, filled(t, f_struct), filled(f, t_struct),
+                            vals,
+                        )
+                    except TypeError:
+                        outs = None
+                if outs is None:
+                    raise ValueError(
+                        "dy2static: both branches of a tensor-dependent if "
+                        "must produce the same variables with the same types "
+                        "(a variable bound in only one branch, or with "
+                        f"mismatched dtype/shape, cannot merge): {e}"
+                    ) from None
             return tuple(
                 UNDEF if o is None else Tensor(o, stop_gradient=True)
                 for o in outs
@@ -698,6 +774,120 @@ def _strip_returns(stmts: List[ast.stmt]) -> List[ast.stmt]:
     ]
 
 
+_RTAG = "_jst_rtag"
+
+
+def _scope_stmts(body):
+    """Yield every statement in this function scope (does not descend into
+    nested function/class bodies)."""
+    for s in body:
+        yield s
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            yield from _scope_stmts(getattr(s, field, []) or [])
+
+
+def _rewrite_loop_returns(func_def) -> bool:
+    """`return` inside a loop body → value capture + tag + break
+    (reference: return_transformer.py:136's RETURN_NO_VALUE flag design).
+
+    Each in-loop `return expr` becomes
+        _jst_rv<k> = expr ; _jst_rtag = k ; break
+    and right after every rewritten loop a tag dispatch re-emits the
+    returns (`if _jst_rtag == k: return _jst_rv<k>`, or `break` when the
+    loop is itself nested in a loop). The break rides the existing
+    break-flag machinery; the dispatch ifs are absorbed by the
+    early-return pass. The `_jst_rv*` temps are body-local
+    (written-before-read), so the while converter's droppable/type-probe
+    machinery carries them out of a traced loop zero-initialised — no
+    pre-loop typed initializer is needed. Value capture (not expression
+    re-emission) keeps side-effecting return expressions single-executed.
+
+    Returns True when rewritten. Bails (python semantics) on loops with an
+    `else:` clause and on returns under try/with inside the loop."""
+    if not any(
+        isinstance(s, (ast.While, ast.For)) and _has_own([s], (ast.Return,))
+        for s in _scope_stmts(func_def.body)
+    ):
+        return False
+    for s in _scope_stmts(func_def.body):
+        if isinstance(s, (ast.While, ast.For)) and _has_own(
+                [s], (ast.Return,)):
+            if s.orelse:
+                return False
+            for t in _scope_stmts(s.body):
+                if isinstance(t, (ast.Try, ast.With, ast.AsyncWith)
+                              ) and _has_own([t], (ast.Return,)):
+                    return False
+
+    rv_exprs = {}  # tag -> captured-value name
+
+    def _rv(k):
+        return f"_jst_rv{k}"
+
+    def tag_cmp(op, k):
+        return ast.Compare(
+            left=ast.Name(id=_RTAG, ctx=ast.Load()), ops=[op],
+            comparators=[ast.Constant(k)],
+        )
+
+    def dispatch_chain(tags):
+        # every path returns; tag is one of `tags` when this runs
+        if len(tags) == 1:
+            return [ast.Return(value=ast.Name(id=_rv(tags[0]),
+                                              ctx=ast.Load()))]
+        return [ast.If(
+            test=tag_cmp(ast.Eq(), tags[0]),
+            body=[ast.Return(value=ast.Name(id=_rv(tags[0]),
+                                            ctx=ast.Load()))],
+            orelse=dispatch_chain(tags[1:]),
+        )]
+
+    def rewrite_block(stmts, in_loop):
+        out, tags = [], []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Return) and in_loop:
+                k = len(rv_exprs) + 1
+                rv_exprs[k] = s.value if s.value is not None \
+                    else ast.Constant(None)
+                out.append(_assign(_rv(k), rv_exprs[k]))
+                out.append(_assign(_RTAG, ast.Constant(k)))
+                out.append(ast.Break())
+                tags.append(k)
+                break  # code after return in the same block is dead
+            if isinstance(s, ast.If) and _has_own([s], (ast.Return,)):
+                nb, tb = rewrite_block(list(s.body), in_loop)
+                no, to = rewrite_block(list(s.orelse), in_loop)
+                s.body, s.orelse = (nb or [ast.Pass()]), no
+                tags += tb + to
+                out.append(s)
+                continue
+            if isinstance(s, (ast.While, ast.For)) and _has_own(
+                    [s], (ast.Return,)):
+                nb, tb = rewrite_block(list(s.body), True)
+                s.body = nb
+                out.append(s)
+                if in_loop:
+                    # unwind: the enclosing loop breaks too, and ITS
+                    # post-loop dispatch (or the function-level one)
+                    # handles the return
+                    out.append(ast.If(test=tag_cmp(ast.NotEq(), 0),
+                                      body=[ast.Break()], orelse=[]))
+                else:
+                    out.append(ast.If(test=tag_cmp(ast.NotEq(), 0),
+                                      body=dispatch_chain(tb), orelse=[]))
+                tags += tb
+                continue
+            out.append(s)
+        return out, tags
+
+    new_body, _ = rewrite_block(list(func_def.body), False)
+    func_def.body = [_assign(_RTAG, ast.Constant(0))] + new_body
+    return True
+
+
 def _rewrite_early_returns(func_def) -> bool:
     """Apply the returnify+strip transform when the body has a return inside
     an `if`. Returns True when rewritten."""
@@ -711,6 +901,258 @@ def _rewrite_early_returns(func_def) -> bool:
     if new is None:
         return False  # return-in-loop etc.: plain python semantics
     func_def.body = _strip_returns(new)
+    return True
+
+
+def _attr_local(root: str, attr: str) -> str:
+    # single-underscore prefix: __jst* names are scaffolding that
+    # _assigned_names excludes from region carries, and these MUST carry
+    return f"_jst_attr_{root}_{attr}"
+
+
+def _localize_attr_stores(func_def) -> bool:
+    """`param.attr = v` → carried local + try/finally write-back
+    (reference: ifelse_transformer's attribute handling localizes stores
+    the same way before building cond branches).
+
+    Only attributes of never-rebound parameters are localized (covers the
+    `self.x = ...` method pattern). Every load/store of a stored (param,
+    attr) pair is renamed to one `_jst_attr_*` local, initialized from the
+    real attribute before the body and flushed back in a `finally:` — so
+    EVERY exit path (tail return, early return, exception) restores the
+    object state exactly once. Stores inside converted branches/loops then
+    merge like ordinary locals. Bails per-root on `del param.attr` and on
+    parameters referenced by nested functions (aliasing)."""
+    args = func_def.args
+    params = {a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)}
+    if args.vararg:
+        params.add(args.vararg.arg)
+    if args.kwarg:
+        params.add(args.kwarg.arg)
+    roots = params - _assigned_names(func_def.body)
+    if not roots:
+        return False
+
+    # a param captured by a nested function/lambda must keep real
+    # attribute access (the inner function aliases the live object)
+    nested_reads: Set[str] = set()
+
+    class _Nested(ast.NodeVisitor):
+        def _scan(self, node):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    nested_reads.add(n.id)
+
+        visit_FunctionDef = visit_AsyncFunctionDef = _scan
+        visit_Lambda = visit_ClassDef = _scan
+
+    nv = _Nested()
+    for s in func_def.body:
+        nv.visit(s)
+    roots -= nested_reads
+    if not roots:
+        return False
+
+    stored: Set = set()
+    deleted_roots: Set[str] = set()
+
+    class _Scan(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = \
+            visit_FunctionDef
+
+        def visit_Attribute(self, node):
+            v = node.value
+            if isinstance(v, ast.Name) and v.id in roots:
+                if isinstance(node.ctx, ast.Store):
+                    stored.add((v.id, node.attr))
+                elif isinstance(node.ctx, ast.Del):
+                    deleted_roots.add(v.id)
+            self.generic_visit(node)
+
+    sc = _Scan()
+    for s in func_def.body:
+        sc.visit(s)
+    roots -= deleted_roots
+    roots &= {r for (r, _a) in stored}
+    if not roots:
+        return False
+
+    # aliasing: a localized store is invisible to (and the finally flush
+    # would clobber) any OTHER live reference to the object — a method
+    # call on the root (`self.probe()` reads/writes the real attrs), the
+    # root escaping as a call argument / return value / container
+    # element. `self.sub(...)` counts too: `sub` may be a same-class
+    # method. Handling: an aliasing use in a TOP-LEVEL simple statement
+    # gets a flush-before / reload-after wrap (the real object is exactly
+    # python-consistent at the alias point); an aliasing use nested
+    # inside a compound statement (a converted region may carry the local
+    # through it), or one whose statement ALSO touches a localized
+    # attribute (the read/store and the callee's view cannot both win),
+    # disables localization for that root.
+    def _escapes_in(node) -> Set[str]:
+        found: Set[str] = set()
+
+        class _E(ast.NodeVisitor):
+            def visit_FunctionDef(self, n):
+                pass
+
+            visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = \
+                visit_FunctionDef
+
+            def visit_Call(self, n):
+                f = n.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in roots):
+                    found.add(f.value.id)  # root.method(...) aliases root
+                self.generic_visit(n)
+
+            def visit_Attribute(self, n):
+                # the Name directly under an Attribute is sanctioned
+                # attribute access — skip it, visit everything else
+                if not isinstance(n.value, ast.Name):
+                    self.visit(n.value)
+                for c in ast.iter_child_nodes(n):
+                    if c is not n.value:
+                        self.visit(c)
+
+            def visit_Name(self, n):
+                if n.id in roots:
+                    found.add(n.id)  # bare use: the object escapes
+
+        _E().visit(node)
+        return found
+
+    def _touched_pairs(node) -> Set:
+        """stored (root, attr) pairs this statement loads or stores."""
+        found: Set = set()
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and (n.value.id, n.attr) in stored):
+                found.add((n.value.id, n.attr))
+        return found
+
+    _SIMPLE = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign,
+               ast.Return, ast.Assert)
+    wrap_roots: dict = {}  # id(stmt) -> set of roots to flush around it
+    deep: Set[str] = set()
+    for s in func_def.body:
+        esc = _escapes_in(s)
+        if not esc:
+            continue
+        if isinstance(s, _SIMPLE):
+            # an aliasing statement that also reads/stores a localized
+            # attr of that root: the stale local and the callee's view
+            # can't be reconciled statement-internally — bail the root
+            mixed = {r for (r, _a) in _touched_pairs(s)} & esc
+            deep |= mixed
+            wrap_roots[id(s)] = esc - mixed
+        else:
+            deep |= esc  # aliasing inside a compound statement: bail root
+    roots -= deep
+    wrap_roots = {
+        k: (v & roots) for k, v in wrap_roots.items() if v & roots
+    }
+    if not roots:
+        return False
+    pairs = {(r, a) for (r, a) in stored if r in roots}
+    if not pairs:
+        return False
+
+    class _Repl(ast.NodeTransformer):
+        def visit_FunctionDef(self, node):
+            return node
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = \
+            visit_FunctionDef
+
+        def visit_Attribute(self, node):
+            self.generic_visit(node)
+            v = node.value
+            if (isinstance(v, ast.Name) and (v.id, node.attr) in pairs
+                    and not isinstance(node.ctx, ast.Del)):
+                return ast.copy_location(
+                    ast.Name(id=_attr_local(v.id, node.attr),
+                             ctx=type(node.ctx)()),
+                    node,
+                )
+            return node
+
+    ordered = sorted(pairs)
+
+    def _entry_name(r, a):
+        return f"_jst_attre_{r}_{a}"
+
+    def _load_stmts(r, a):
+        # local = attr_get(...); entry snapshot = local — the snapshot's
+        # OBJECT IDENTITY is the dirty bit: the finally flush only
+        # setattrs when some path rebound the local (so untouched attrs
+        # never see a spurious __setattr__ / property write)
+        return [
+            _assign(
+                _attr_local(r, a),
+                ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                        attr="attr_get", ctx=ast.Load(),
+                    ),
+                    args=[ast.Name(id=r, ctx=ast.Load()), ast.Constant(a)],
+                    keywords=[],
+                ),
+            ),
+            _assign(_entry_name(r, a),
+                    ast.Name(id=_attr_local(r, a), ctx=ast.Load())),
+        ]
+
+    def _flush_stmt(r, a):
+        return ast.Expr(value=ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                attr="attr_flush", ctx=ast.Load(),
+            ),
+            args=[
+                ast.Name(id=r, ctx=ast.Load()), ast.Constant(a),
+                _load_or_undef_call(_attr_local(r, a)),
+                _load_or_undef_call(_entry_name(r, a)),
+            ],
+            keywords=[],
+        ))
+
+    def _undef_stmt(r, a):
+        # gap marker between flush-before and reload-after: if the
+        # aliased callee raises, the finally sees UNDEF and leaves the
+        # callee's own writes in place instead of re-flushing stale state
+        return _assign(
+            _attr_local(r, a),
+            ast.Attribute(value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                          attr="UNDEF", ctx=ast.Load()),
+        )
+
+    rp = _Repl()
+    new_body = []
+    for s in func_def.body:
+        esc = wrap_roots.get(id(s))
+        s2 = rp.visit(s)
+        if esc:
+            around = [(r, a) for (r, a) in ordered if r in esc]
+            for r, a in around:
+                new_body.append(_flush_stmt(r, a))
+                new_body.append(_undef_stmt(r, a))
+            new_body.append(s2)
+            # reload after the alias point (dead after a Return — fine)
+            for r, a in around:
+                new_body += _load_stmts(r, a)
+        else:
+            new_body.append(s2)
+    pre = [st for r, a in ordered for st in _load_stmts(r, a)]
+    flush = [_flush_stmt(r, a) for r, a in ordered]
+    func_def.body = pre + [
+        ast.Try(body=new_body, handlers=[], orelse=[], finalbody=flush)
+    ]
     return True
 
 
@@ -988,6 +1430,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     )),
                 ]
                 if is_guard else []
+            ) + (
+                [ast.keyword(arg="zerofill", value=ast.Tuple(
+                    elts=[ast.Constant(n.startswith("_jst_rv"))
+                          for n in carry],
+                    ctx=ast.Load(),
+                ))]
+                if any(n.startswith("_jst_rv") for n in carry) else []
             ),
         )
         assign: ast.stmt = (
@@ -1173,10 +1622,17 @@ def _convert_cached(fn_key):
     if not isinstance(func_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     func_def.decorator_list = []  # decorators already applied to the original
+    # pass order matters: loop returns become post-loop dispatch ifs that
+    # the early-return absorption then collapses; attr localization wraps
+    # the return-normalized body in try/finally (returnify would bail on a
+    # pre-existing Try), and must precede region conversion so regions see
+    # plain Name stores
+    _rewrite_loop_returns(func_def)
     # early `return` inside an `if`: absorb trailing code into the branches
     # and strip returns to _jst_retval assignments so the If converts
     # (reference: return_transformer.py:136)
     _rewrite_early_returns(func_def)
+    _localize_attr_stores(func_def)
     ast.fix_missing_locations(func_def)
     _ControlFlowTransformer().visit(func_def)
     ast.fix_missing_locations(tree)

@@ -214,21 +214,29 @@ def multiprocess_reader(readers, use_pipe: bool = True,
         for p in procs:
             p.start()
         finished = 0
+        strikes = 0
         try:
             while finished < len(readers):
                 try:
                     sample = q.get(timeout=1.0)
                 except _queue.Empty:
-                    # a worker hard-killed (OOM/segfault) never sends its
-                    # sentinel — detect death instead of blocking forever
-                    dead = [p for p in procs if not p.is_alive()
-                            and p.exitcode not in (0, None)]
-                    if dead and q.empty():
-                        raise ValueError(
-                            "multiprocess_reader: a worker process died "
-                            f"(exitcode {dead[0].exitcode})"
-                        )
+                    # a worker that died without its sentinel (hard kill,
+                    # sys.exit — ANY exitcode) would hang the merge: more
+                    # dead workers than sentinels received means at least
+                    # one such death. Two consecutive empty timeouts guard
+                    # against a sentinel still in the feeder pipe.
+                    dead = [p for p in procs if not p.is_alive()]
+                    if len(dead) > finished and q.empty():
+                        strikes += 1
+                        if strikes >= 2:
+                            codes = [p.exitcode for p in dead]
+                            raise ValueError(
+                                "multiprocess_reader: a worker process "
+                                "died without finishing (exitcodes "
+                                f"{codes})"
+                            )
                     continue
+                strikes = 0
                 if sample is None:
                     finished += 1
                 elif isinstance(sample, str) and sample == _ERR:

@@ -215,8 +215,8 @@ def test_early_return_none_path():
 
 
 def test_return_in_loop_keeps_python_semantics():
-    # documented subset: return inside a loop body stays python-only (the
-    # loop and its predicate must be concrete)
+    # concrete loop + concrete predicate: the r5 tag/break rewrite must
+    # preserve exact python semantics on the all-concrete path
     def fn(x):
         s = paddle.zeros([])
         for i in range(5):  # concrete loop: plain python
@@ -413,3 +413,438 @@ def test_convert_call_recursive_helper():
 
     out = to_static(fn)(paddle.to_tensor(np.float32(0.0)))
     np.testing.assert_allclose(float(out), 3.0)
+
+
+# -- round 5: return inside converted loops -----------------------------------
+def test_return_in_traced_while():
+    def fn(x):
+        while paddle.sum(x) < 10.0:
+            x = x * 2.0
+            if paddle.max(x) > 5.0:
+                return x + 1.0
+        return x - 1.0
+
+    _eager_vs_static(fn, np.array([1.0, 2.0], np.float32))
+    # ref-by-hand: [1,2]->[2,4]->[4,8] max>5 -> [5,9]
+    out = to_static(fn)(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [5.0, 9.0])
+
+
+def test_return_in_traced_while_compiles_to_one_program():
+    import jax
+
+    def fn(x):
+        while paddle.sum(x) < 100.0:
+            x = x * 2.0
+            if paddle.max(x) > 50.0:
+                return x * 0.5
+        return x
+
+    conv = to_static(fn)
+    jaxpr = jax.make_jaxpr(
+        lambda a: conv(paddle.Tensor(a, stop_gradient=True))._value
+    )(np.array([1.0], np.float32))
+
+    def prims(jx, acc):
+        for e in jx.eqns:
+            acc.add(str(e.primitive))
+            for v in e.params.values():
+                if hasattr(v, "jaxpr"):
+                    prims(v.jaxpr, acc)
+        return acc
+
+    assert "while" in prims(jaxpr.jaxpr, set())  # one lax.while_loop
+
+
+def test_return_in_traced_for_range():
+    def fn(x):
+        for i in range(6):
+            x = x + 1.0
+            if paddle.sum(x) > 3.0:
+                return x * 10.0
+        return x
+
+    _eager_vs_static(fn, np.float32(0.5))
+
+
+def test_multiple_returns_in_loop():
+    def fn(x):
+        while paddle.sum(x) < 20.0:
+            x = x * 2.0
+            if paddle.max(x) > 16.0:
+                return x + 100.0
+            if paddle.min(x) > 4.0:
+                return x - 100.0
+        return x
+
+    for v in ([1.0, 2.0], [5.0, 5.0], [30.0, 1.0]):
+        _eager_vs_static(fn, np.array(v, np.float32))
+
+
+def test_return_in_nested_loop_unwinds_both():
+    def fn(x):
+        for i in range(3):
+            for j in range(3):
+                x = x + 1.0
+                if paddle.sum(x) > 4.0:
+                    return x * 2.0
+        return x - 1.0
+
+    _eager_vs_static(fn, np.float32(0.0))
+
+
+def test_return_value_captured_not_reexecuted():
+    # value capture at the return point: on the eager (concrete) path a
+    # side-effecting return expression must run exactly once
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    calls = []
+
+    def noisy(v):
+        calls.append(1)
+        return v
+
+    def fn(x):
+        while float(paddle.sum(x)) < 10.0:
+            x = x * 4.0
+            if float(paddle.max(x)) > 3.0:
+                return noisy(x)
+        return x
+
+    out = convert_to_static(fn)(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    assert len(calls) == 1
+
+
+def test_loop_exit_without_return_takes_tail():
+    def fn(x):
+        while paddle.sum(x) < 4.0:
+            x = x + 1.0
+            if paddle.max(x) > 100.0:
+                return x * 0.0
+        return x + 0.5
+
+    _eager_vs_static(fn, np.float32(1.0))
+
+
+def test_return_in_loop_with_trailing_code():
+    def fn(x):
+        s = paddle.zeros([])
+        while paddle.sum(x) < 8.0:
+            x = x * 2.0
+            if paddle.max(x) > 4.0:
+                return x
+        s = s + x  # only on the fall-through path
+        return s * 3.0
+
+    for v in (1.0, 9.0):
+        _eager_vs_static(fn, np.float32(v))
+
+
+def test_return_in_loop_with_else_keeps_python_semantics():
+    # documented bail: loop with an else clause stays python (concrete
+    # predicates still give the right answer)
+    def fn(x):
+        while float(paddle.sum(x)) < 3.0:
+            x = x + 1.0
+            if float(paddle.max(x)) > 10.0:
+                return x
+        else:
+            x = x + 0.25
+        return x
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    out = convert_to_static(fn)(paddle.to_tensor(np.float32(0.0)))
+    np.testing.assert_allclose(float(out), 3.25)
+
+
+# -- round 5: attribute stores on parameters ----------------------------------
+def test_method_attr_store_converted_branch():
+    class Counter:
+        def __init__(self):
+            self.n = paddle.to_tensor(np.float32(0.0))
+
+        def bump(self, x):
+            if paddle.sum(x) > 0:
+                self.n = self.n + 1.0
+            else:
+                self.n = self.n - 1.0
+            return self.n
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    c = Counter()
+    m = convert_to_static(c.bump)
+    out = m(paddle.to_tensor(np.array([1.0], np.float32)))
+    assert float(out) == 1.0 and float(c.n) == 1.0
+    out = m(paddle.to_tensor(np.array([-1.0], np.float32)))
+    assert float(out) == 0.0 and float(c.n) == 0.0
+
+
+def test_method_attr_store_compiles_branch():
+    import jax
+
+    class Gate:
+        def __init__(self):
+            self.state = paddle.to_tensor(np.float32(0.0))
+
+        def step(self, x):
+            if paddle.sum(x) > 0:
+                self.state = self.state + x.sum()
+            return self.state
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    g = Gate()
+    m = convert_to_static(g.step)
+    jaxpr = jax.make_jaxpr(
+        lambda a: m(paddle.Tensor(a, stop_gradient=True))._value
+    )(np.array([1.0], np.float32))
+    prims = {str(e.primitive) for e in jaxpr.jaxpr.eqns}
+    assert "cond" in prims  # the self.state branch became lax.cond
+
+
+def test_method_attr_store_in_loop():
+    class Accum:
+        def __init__(self):
+            self.s = paddle.to_tensor(np.float32(0.0))
+
+        def run(self, x, k):
+            for i in range(k):
+                self.s = self.s + x
+            return self.s
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    a = Accum()
+    out = convert_to_static(a.run)(paddle.to_tensor(np.float32(2.0)), 3)
+    assert float(out) == 6.0 and float(a.s) == 6.0
+
+
+def test_attr_store_flushed_on_exception():
+    class E:
+        def __init__(self):
+            self.v = 0
+
+        def go(self):
+            self.v = 41
+            self.v = self.v + 1
+            raise RuntimeError("boom")
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    e = E()
+    with pytest.raises(RuntimeError, match="boom"):
+        convert_to_static(E.go)(e)
+    assert e.v == 42
+
+
+def test_attr_store_plus_return_in_loop():
+    class M:
+        def __init__(self):
+            self.hits = paddle.to_tensor(np.float32(0.0))
+
+        def scan(self, x):
+            while paddle.sum(x) < 50.0:
+                x = x * 3.0
+                self.hits = self.hits + 1.0
+                if paddle.max(x) > 20.0:
+                    return x
+            return -x
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    m = M()
+    out = convert_to_static(m.scan)(
+        paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [27.0])
+    assert float(m.hits) == 3.0
+
+
+def test_attr_new_attribute_created_by_store():
+    class N:
+        def go(self, x):
+            self.created = x + 1.0
+            return self.created
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    n = N()
+    out = convert_to_static(N.go)(n, paddle.to_tensor(np.float32(1.0)))
+    assert float(out) == 2.0 and float(n.created) == 2.0
+
+
+def test_attr_nested_function_alias_keeps_python():
+    # param captured by an inner function: localization must NOT apply
+    class P:
+        def __init__(self):
+            self.v = 7
+
+        def go(self):
+            def peek():
+                return self.v
+
+            self.v = 9
+            return peek()  # must see the live store
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    p = P()
+    # python semantics here would return 9 only if the store is real at
+    # call time; localization would have returned 7 — conversion skips it
+    assert int(convert_to_static(P.go)(p)) == 9
+
+
+def test_attr_store_buffer_updates_under_to_static():
+    # under the jit'd to_static path, a store to a REGISTERED buffer lands
+    # in-place and the functionalized buffer read-back applies it; the
+    # model output and the buffer state both advance
+    class Counting(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer(
+                "seen", paddle.to_tensor(np.float32(0.0)))
+
+        def forward(self, x):
+            if paddle.sum(x) > 0:
+                self.seen = self.seen + 1.0
+            return x * 1.0 + self.seen
+
+    layer = Counting()
+    m = to_static(layer)
+    out = m(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(layer.seen) == 1.0
+    np.testing.assert_allclose(out.numpy(), [3.0])
+    out = m(paddle.to_tensor(np.array([-2.0], np.float32)))
+    assert float(layer.seen) == 1.0
+    np.testing.assert_allclose(out.numpy(), [-1.0])
+
+
+def test_attr_store_visible_to_sibling_method_calls():
+    # aliasing guard: a method call on `self` must see real attribute
+    # state, so localization bails and python semantics win
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    class S:
+        def __init__(self):
+            self.n = 0
+
+        def setter(self):
+            self.n = 99
+
+        def go(self):
+            self.n = 5
+            self.setter()
+            return self.n
+
+    s = S()
+    out = convert_to_static(S.go)(s)
+    assert int(out) == 99 and s.n == 99
+
+
+def test_attr_store_root_escaping_as_argument_bails():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def poke(obj):
+        obj.v = 7
+
+    class T:
+        def __init__(self):
+            self.v = 0
+
+        def go(self):
+            self.v = 1
+            poke(self)  # self escapes: localization must bail
+            return self.v
+
+    t = T()
+    assert int(convert_to_static(T.go)(t)) == 7 and t.v == 7
+
+
+def test_attr_callee_write_survives_exception():
+    # flush-before + UNDEF gap: a callee that mutates then raises must
+    # keep its write (the finally must not re-flush stale state)
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    class T:
+        def __init__(self):
+            self.n = 0
+
+        def boom(self):
+            self.n = 99
+            raise RuntimeError("x")
+
+        def go(self):
+            self.n = 5
+            self.boom()
+
+    t = T()
+    with pytest.raises(RuntimeError):
+        convert_to_static(T.go)(t)
+    assert t.n == 99
+
+
+def test_attr_same_statement_alias_and_read_bails():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    class S:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n = self.n + 1
+
+        def go(self):
+            self.n = 5
+            return self.bump() or self.n
+
+    s = S()
+    assert int(convert_to_static(S.go)(s)) == 6 and s.n == 6
+
+
+def test_attr_no_store_path_performs_zero_setattrs():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    writes = []
+
+    class W:
+        def __init__(self):
+            object.__setattr__(self, "x", 1)
+
+        def __setattr__(self, k, v):
+            writes.append(k)
+            object.__setattr__(self, k, v)
+
+        def go(self, flag):
+            if flag:
+                self.x = 2
+            return self.x
+
+    w = W()
+    assert convert_to_static(W.go)(w, False) == 1 and writes == []
+    assert convert_to_static(W.go)(w, True) == 2 and writes == ["x"]
+
+
+def test_attr_store_with_sublayer_calls():
+    # the common Layer pattern: sublayer calls + buffer store in one
+    # forward — flush/reload around the call keeps both
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+            self.register_buffer("seen", paddle.to_tensor(np.float32(0.0)))
+
+        def forward(self, x):
+            h = self.fc(x)
+            if paddle.mean(h) > -1e9:  # traced, effectively always
+                self.seen = self.seen + 1.0
+            return h
+
+    net = Net()
+    out = convert_to_static(net.forward)(
+        paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert out.shape == [2, 4]
+    assert float(net.seen) == 1.0
