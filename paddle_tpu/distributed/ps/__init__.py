@@ -83,6 +83,14 @@ def _load_lib():
         _lib.ps_table_ctr_stats.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ]
+        _lib.ps_table_enable_ssd.restype = ctypes.c_int
+        _lib.ps_table_enable_ssd.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        _lib.ps_table_ram_size.restype = ctypes.c_int64
+        _lib.ps_table_ram_size.argtypes = [ctypes.c_void_p]
+        _lib.ps_table_disk_size.restype = ctypes.c_int64
+        _lib.ps_table_disk_size.argtypes = [ctypes.c_void_p]
     return _lib
 
 
@@ -114,7 +122,9 @@ class MemorySparseTable:
 
     def __init__(self, emb_dim: int, shard_num: int = 16, optimizer: str = "adagrad",
                  learning_rate: float = 0.05, init_range: float = 0.01,
-                 seed: int = 0, ctr: Optional["CtrAccessorConfig"] = None):
+                 seed: int = 0, ctr: Optional["CtrAccessorConfig"] = None,
+                 ssd_path: Optional[str] = None,
+                 ram_budget: Optional[int] = None):
         if optimizer not in _OPT_IDS:
             raise ValueError(f"optimizer must be one of {sorted(_OPT_IDS)}")
         self.emb_dim = emb_dim
@@ -129,6 +139,20 @@ class MemorySparseTable:
             self._lib.ps_table_set_ctr(
                 self._h, *[ctypes.c_float(v) for v in ctr.as_floats()]
             )
+        # SSD overflow (reference: ps/table/ssd_sparse_table.h): entries
+        # past ram_budget spill to a slot file at ssd_path; pull/push
+        # promote on demand — tables larger than host RAM (the 10B-feature
+        # ERNIE north star) keep the same API
+        self.ssd_path = ssd_path
+        if ssd_path is not None:
+            if ram_budget is None:
+                raise ValueError("ssd_path requires ram_budget (max RAM "
+                                 "entries)")
+            rc = self._lib.ps_table_enable_ssd(
+                self._h, str(ssd_path).encode(), ctypes.c_int64(ram_budget)
+            )
+            if rc != 0:
+                raise OSError(f"cannot create SSD slot file at {ssd_path}")
 
     def __del__(self):
         try:
@@ -195,6 +219,14 @@ class MemorySparseTable:
 
     def __len__(self):
         return int(self._lib.ps_table_size(self._h))
+
+    def ram_size(self) -> int:
+        """Entries resident in RAM (== len() without SSD overflow)."""
+        return int(self._lib.ps_table_ram_size(self._h))
+
+    def disk_size(self) -> int:
+        """Entries spilled to the SSD slot file."""
+        return int(self._lib.ps_table_disk_size(self._h))
 
     def save(self, path: str):
         if self._lib.ps_table_save(self._h, path.encode()) != 0:

@@ -77,4 +77,20 @@ int ps_table_ctr_stats(void* h, int64_t key, float* out4) {
   return static_cast<SparseTable*>(h)->ctr_stats(key, out4) ? 0 : -1;
 }
 
+// -- SSD overflow (reference: ps/table/ssd_sparse_table.h) ------------------
+// Entries past ram_budget spill to a fixed-record slot file; all other
+// ps_table_* calls work unchanged (pull/push promote from disk). Call after
+// ps_table_set_ctr — the record layout freezes here.
+int ps_table_enable_ssd(void* h, const char* path, int64_t ram_budget) {
+  return static_cast<SparseTable*>(h)->enable_ssd(path, ram_budget) ? 0 : -1;
+}
+
+int64_t ps_table_ram_size(void* h) {
+  return static_cast<SparseTable*>(h)->ram_size();
+}
+
+int64_t ps_table_disk_size(void* h) {
+  return static_cast<SparseTable*>(h)->disk_size();
+}
+
 }  // extern "C"

@@ -10,6 +10,7 @@
 // C ABI (ctypes): ps_client_create("ip:port,ip:port,...") + verbs below.
 // Every call returns 0 on success, -1 on a transport/servers error.
 
+#include <algorithm>
 #include <atomic>
 #include <cstdlib>
 #include <memory>
@@ -147,6 +148,157 @@ inline void dense_chunk(int64_t len, int n_servers, int i, int64_t* start,
   *end = len * (i + 1) / n_servers;
 }
 
+// -- pipelined sparse transfer (reference: the async Communicator's
+// batched, overlapped push/pull — ps/service/communicator/communicator.h).
+// One server's batch splits into kChunkKeys-key chunks; a sender thread
+// streams the chunk requests while the calling thread consumes the
+// responses in order, so serialization, kernel copies, and the server's
+// table work overlap instead of running strictly request-by-request. Row
+// payloads ride scatter-gather iovecs straight from/to the caller's
+// buffers (no gather/scatter copy). Also avoids the pipelining deadlock:
+// requests and responses move on independent threads, so a full socket
+// buffer in one direction can't wedge the other.
+constexpr int64_t kChunkKeys = 8192;
+constexpr int kIovBatch = 512;  // rows per sendmsg/recvmsg (< IOV_MAX)
+
+// receive `m` rows into out[idx[j]*emb_dim], batched readv
+inline bool recv_rows(int fd, float* out, const int64_t* idx, int64_t m,
+                      int emb_dim) {
+  const size_t row = sizeof(float) * static_cast<size_t>(emb_dim);
+  std::vector<struct iovec> iov(kIovBatch);
+  int64_t j = 0;
+  while (j < m) {
+    int cnt = static_cast<int>(std::min<int64_t>(m - j, kIovBatch));
+    for (int k = 0; k < cnt; ++k) {
+      iov[k].iov_base = out + idx[j + k] * emb_dim;
+      iov[k].iov_len = row;
+    }
+    if (!readv_full(fd, iov.data(), cnt)) return false;
+    j += cnt;
+  }
+  return true;
+}
+
+struct PullPlan {
+  const int64_t* keys;
+  const std::vector<int64_t>* idx;  // original positions for this server
+  uint32_t table_id;
+  int emb_dim;
+  bool create;
+};
+
+// one pull attempt over an (already ensured) connection; caller holds mu
+inline bool pull_attempt(Conn& c, const PullPlan& p, float* out) {
+  const int64_t total = static_cast<int64_t>(p.idx->size());
+  const int64_t nchunks = (total + kChunkKeys - 1) / kChunkKeys;
+  std::atomic<bool> send_ok{true};
+  std::thread sender([&] {
+    std::vector<int64_t> sk;
+    for (int64_t ci = 0; ci < nchunks; ++ci) {
+      const int64_t b = ci * kChunkKeys;
+      const int64_t e = std::min(total, b + kChunkKeys);
+      sk.resize(static_cast<size_t>(e - b));
+      for (int64_t j = b; j < e; ++j) sk[j - b] = p.keys[(*p.idx)[j]];
+      Header h{kMagic, CMD_PULL_SPARSE, p.table_id,
+               p.create ? kFlagCreate : 0u, e - b,
+               static_cast<int64_t>(sk.size() * sizeof(int64_t))};
+      if (!write_full(c.fd, &h, sizeof(h)) ||
+          !write_full(c.fd, sk.data(), sk.size() * sizeof(int64_t))) {
+        send_ok.store(false);
+        return;
+      }
+    }
+  });
+  bool ok = true;
+  for (int64_t ci = 0; ci < nchunks && ok; ++ci) {
+    const int64_t b = ci * kChunkKeys;
+    const int64_t e = std::min(total, b + kChunkKeys);
+    Header rh{};
+    ok = read_full(c.fd, &rh, sizeof(rh)) && rh.magic == kMagic &&
+         rh.flags == kStatusOk &&
+         rh.nbytes == (e - b) * static_cast<int64_t>(sizeof(float)) *
+                          p.emb_dim &&
+         recv_rows(c.fd, out, p.idx->data() + b, e - b, p.emb_dim);
+  }
+  // receiver aborted mid-stream (bad header / desync): the server keeps
+  // streaming replies and eventually blocks, which would wedge the sender
+  // in write_full forever — kill the socket so sender.join() returns
+  if (!ok) ::shutdown(c.fd, SHUT_RDWR);
+  sender.join();
+  return ok && send_ok.load();
+}
+
+// pipelined pull for one server, with the idempotent-retry contract
+inline bool pull_server(Client* c, int s, const PullPlan& p, float* out) {
+  Conn& conn = *c->conns[s];
+  std::lock_guard<std::mutex> lk(conn.mu);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (!conn.ensure()) return false;
+    if (pull_attempt(conn, p, out)) return true;
+    conn.drop();  // stale connection (server restart) — retry once fresh
+  }
+  return false;
+}
+
+// pipelined push for one server: chunk frames are written as ONE
+// scatter-gather sendmsg (header + keys + rows straight from the caller's
+// grads); a reader thread drains the per-chunk ack headers. PUSH is not
+// idempotent, so a transport failure is final (single attempt).
+inline bool push_server(Client* c, int s, uint32_t table_id,
+                        const int64_t* keys, const std::vector<int64_t>& idx,
+                        int emb_dim, const float* grads, bool raw) {
+  Conn& conn = *c->conns[s];
+  std::lock_guard<std::mutex> lk(conn.mu);
+  if (!conn.ensure()) return false;
+  const int64_t total = static_cast<int64_t>(idx.size());
+  const int64_t nchunks = (total + kChunkKeys - 1) / kChunkKeys;
+  const size_t row = sizeof(float) * static_cast<size_t>(emb_dim);
+  std::atomic<bool> acks_ok{true};
+  std::thread reader([&] {
+    for (int64_t ci = 0; ci < nchunks; ++ci) {
+      Header rh{};
+      if (!read_full(conn.fd, &rh, sizeof(rh)) || rh.magic != kMagic ||
+          rh.flags != kStatusOk || rh.nbytes != 0) {
+        acks_ok.store(false);
+        return;
+      }
+    }
+  });
+  bool ok = true;
+  std::vector<int64_t> sk;
+  std::vector<struct iovec> iov;
+  for (int64_t ci = 0; ci < nchunks && ok; ++ci) {
+    const int64_t b = ci * kChunkKeys;
+    const int64_t e = std::min(total, b + kChunkKeys);
+    const int64_t m = e - b;
+    sk.resize(static_cast<size_t>(m));
+    for (int64_t j = b; j < e; ++j) sk[j - b] = keys[idx[j]];
+    Header h{kMagic, CMD_PUSH_SPARSE, table_id, raw ? kFlagRaw : 0u, m,
+             static_cast<int64_t>(m * sizeof(int64_t) + m * row)};
+    iov.resize(2);
+    iov[0] = {&h, sizeof(h)};
+    iov[1] = {sk.data(), static_cast<size_t>(m) * sizeof(int64_t)};
+    ok = writev_full(conn.fd, iov.data(), 2);
+    int64_t j = b;
+    while (ok && j < e) {
+      int cnt = static_cast<int>(std::min<int64_t>(e - j, kIovBatch));
+      iov.resize(static_cast<size_t>(cnt));
+      for (int k = 0; k < cnt; ++k) {
+        iov[k].iov_base =
+            const_cast<float*>(grads + idx[j + k] * emb_dim);
+        iov[k].iov_len = row;
+      }
+      ok = writev_full(conn.fd, iov.data(), cnt);
+      j += cnt;
+    }
+  }
+  if (!ok) ::shutdown(conn.fd, SHUT_RDWR);  // unstick the ack reader
+  reader.join();
+  ok = ok && acks_ok.load();
+  if (!ok) conn.drop();
+  return ok;
+}
+
 }  // namespace
 }  // namespace ps
 
@@ -234,22 +386,8 @@ int ps_client_pull_sparse(void* h, uint32_t table_id, const int64_t* keys,
   for (int s = 0; s < S; ++s)
     if (!pos[s].empty()) involved.push_back(s);
   bool ok = c->fan_out(involved, [&](int s) {
-    const auto& ps_idx = pos[s];
-    std::vector<int64_t> sk(ps_idx.size());
-    for (size_t j = 0; j < ps_idx.size(); ++j) sk[j] = keys[ps_idx[j]];
-    ps::Header hd{0, ps::CMD_PULL_SPARSE, table_id,
-                  create ? ps::kFlagCreate : 0u,
-                  static_cast<int64_t>(sk.size()),
-                  static_cast<int64_t>(sk.size() * sizeof(int64_t))};
-    std::vector<char> resp;
-    if (!c->request(s, hd, sk.data(), &resp) ||
-        resp.size() != sk.size() * sizeof(float) * emb_dim)
-      return false;
-    const float* rows = reinterpret_cast<const float*>(resp.data());
-    for (size_t j = 0; j < ps_idx.size(); ++j)
-      std::memcpy(out + ps_idx[j] * emb_dim, rows + j * emb_dim,
-                  sizeof(float) * emb_dim);
-    return true;
+    ps::PullPlan p{keys, &pos[s], table_id, emb_dim, create != 0};
+    return ps::pull_server(c, s, p, out);
   });
   return ok ? 0 : -1;
 }
@@ -266,22 +404,8 @@ int ps_client_push_sparse(void* h, uint32_t table_id, const int64_t* keys,
   for (int s = 0; s < S; ++s)
     if (!pos[s].empty()) involved.push_back(s);
   bool ok = c->fan_out(involved, [&](int s) {
-    const auto& ps_idx = pos[s];
-    const size_t m = ps_idx.size();
-    std::vector<char> payload(m * sizeof(int64_t) +
-                              m * sizeof(float) * emb_dim);
-    int64_t* sk = reinterpret_cast<int64_t*>(payload.data());
-    float* sg =
-        reinterpret_cast<float*>(payload.data() + m * sizeof(int64_t));
-    for (size_t j = 0; j < m; ++j) {
-      sk[j] = keys[ps_idx[j]];
-      std::memcpy(sg + j * emb_dim, grads + ps_idx[j] * emb_dim,
-                  sizeof(float) * emb_dim);
-    }
-    ps::Header hd{0, ps::CMD_PUSH_SPARSE, table_id,
-                  raw ? ps::kFlagRaw : 0u, static_cast<int64_t>(m),
-                  static_cast<int64_t>(payload.size())};
-    return c->request(s, hd, payload.data(), nullptr);
+    return ps::push_server(c, s, table_id, keys, pos[s], emb_dim, grads,
+                           raw != 0);
   });
   return ok ? 0 : -1;
 }

@@ -12,6 +12,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <cstdint>
@@ -93,6 +94,72 @@ inline bool write_full(int fd, const void* buf, size_t len) {
   return true;
 }
 
+// scatter-gather socket IO: rows move straight between the caller's
+// strided buffers and the kernel, skipping the gather/scatter memcpy a
+// contiguous payload would need (sendmsg/recvmsg keep MSG_NOSIGNAL /
+// partial-transfer handling uniform with write_full/read_full)
+// MB-scale embedding rows stream through these sockets: default ~208KB
+// buffers force a scheduler round trip per fraction of a chunk, which on
+// a small host dominates the wire cost. 4MB buffers let a whole pipeline
+// chunk sit in flight.
+inline void set_bulk_buffers(int fd) {
+  int sz = 4 * 1024 * 1024;
+  if (const char* env = std::getenv("PS_SOCKBUF")) sz = std::atoi(env);
+  if (sz <= 0) return;  // PS_SOCKBUF=0: kernel defaults
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
+
+inline bool writev_full(int fd, struct iovec* iov, int cnt) {
+  while (cnt > 0) {
+    struct msghdr mh {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = static_cast<size_t>(cnt);
+    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    while (w > 0 && cnt > 0) {
+      if (static_cast<size_t>(w) >= iov->iov_len) {
+        w -= static_cast<ssize_t>(iov->iov_len);
+        ++iov;
+        --cnt;
+      } else {
+        iov->iov_base = static_cast<char*>(iov->iov_base) + w;
+        iov->iov_len -= static_cast<size_t>(w);
+        w = 0;
+      }
+    }
+  }
+  return true;
+}
+
+inline bool readv_full(int fd, struct iovec* iov, int cnt) {
+  while (cnt > 0) {
+    struct msghdr mh {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = static_cast<size_t>(cnt);
+    ssize_t r = ::recvmsg(fd, &mh, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    while (r > 0 && cnt > 0) {
+      if (static_cast<size_t>(r) >= iov->iov_len) {
+        r -= static_cast<ssize_t>(iov->iov_len);
+        ++iov;
+        --cnt;
+      } else {
+        iov->iov_base = static_cast<char*>(iov->iov_base) + r;
+        iov->iov_len -= static_cast<size_t>(r);
+        r = 0;
+      }
+    }
+  }
+  return true;
+}
+
 inline int connect_to(const std::string& host, int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
@@ -109,6 +176,7 @@ inline int connect_to(const std::string& host, int port) {
   }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  set_bulk_buffers(fd);
   return fd;
 }
 

@@ -214,11 +214,15 @@ struct Server {
           reply(fd, h, kStatusErr, nullptr, 0);
           return true;
         }
-        std::vector<float> out(static_cast<size_t>(n) * t->emb_dim);
+        // per-thread reusable buffer: chunked pipelined pulls hit this
+        // per chunk — a fresh vector would memset MBs on every request
+        static thread_local std::vector<float> out;
+        const size_t need = static_cast<size_t>(n) * t->emb_dim;
+        if (out.size() < need) out.resize(need);
         t->pull(reinterpret_cast<const int64_t*>(payload.data()), n,
                 out.data(), (h.flags & kFlagCreate) != 0);
         reply(fd, h, kStatusOk, out.data(),
-              static_cast<int64_t>(out.size() * sizeof(float)), n);
+              static_cast<int64_t>(need * sizeof(float)), n);
         return true;
       }
       case CMD_PUSH_SPARSE: {
@@ -453,6 +457,7 @@ struct Server {
       }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      set_bulk_buffers(fd);
       std::lock_guard<std::mutex> lk(conns_mu);
       conn_fds.push_back(fd);
       conns.emplace_back([this, fd] { handle_conn(fd); });

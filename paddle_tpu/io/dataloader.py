@@ -273,10 +273,36 @@ class DataLoader:
         in_order=True,
         worker_collate_fn=None,
         return_numpy=False,
+        bucket_spec=None,
     ):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
+        # shape-bucketing policy (io.bucketing.BucketSpec): ragged sample
+        # fields are padded to bucket boundaries during collate so the
+        # number of distinct batch shapes a compiled consumer sees stays
+        # bounded (each distinct shape = one XLA compilation)
+        self.bucket_spec = bucket_spec
+        if bucket_spec is not None:
+            if (getattr(bucket_spec, "pad_batch_to", None) is not None
+                    and int(num_workers) > 0 and not use_thread_workers):
+                # process workers pad on a forked COPY of the spec: the
+                # parent's real_batch_size() would silently report None
+                # and padded repeat-rows would count as real samples
+                raise ValueError(
+                    "BucketSpec.pad_batch_to requires num_workers=0 or "
+                    "use_thread_workers=True (the real-batch-size map "
+                    "cannot cross a process fork)"
+                )
+            base = self.collate_fn
+
+            def bucketed_collate(samples, _base=base, _spec=bucket_spec):
+                return _spec.collate(samples, _base)
+
+            self.collate_fn = bucketed_collate
+            self._bucket_base_collate = base
+        else:
+            self._bucket_base_collate = None
         self.num_workers = int(num_workers)
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
@@ -349,6 +375,17 @@ class DataLoader:
         the parent on worker-fetched samples)."""
         if self.worker_collate_fn is not None:
             return self.worker_collate_fn
+        if self.bucket_spec is not None:
+            if self._bucket_base_collate is default_collate_fn:
+                # numpy-pure bucket collate runs in the worker; the
+                # parent re-observes shapes when wrapping Tensors
+                spec = self.bucket_spec
+
+                def worker_bucketed(samples, _spec=spec):
+                    return _spec.collate(samples, _np_collate)
+
+                return worker_bucketed
+            return None
         return _np_collate if self.collate_fn is default_collate_fn else None
 
     def _start_pool(self):
@@ -448,8 +485,15 @@ class DataLoader:
         if status == "err":
             raise RuntimeError(f"DataLoader worker raised:\n{payload}")
         if status == "samples":
-            return self.collate_fn(_tree_from_ipc(payload, as_tensor=False))
-        return _tree_from_ipc(payload, as_tensor=not self.return_numpy)
+            batch = self.collate_fn(_tree_from_ipc(payload, as_tensor=False))
+        else:
+            batch = _tree_from_ipc(payload, as_tensor=not self.return_numpy)
+            if self.bucket_spec is not None:
+                # worker-side padding ran on a forked COPY of the spec —
+                # re-observe emitted shapes here so seen_shapes/the
+                # recompile-budget warning track the parent's reality
+                self.bucket_spec._record_shapes(batch)
+        return batch
 
     def _iter_multiprocess(self):
         from collections import deque

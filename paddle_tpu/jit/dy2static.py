@@ -116,6 +116,18 @@ class _Runtime:
         return getattr(obj, name, UNDEF)
 
     @staticmethod
+    def attr_check(value, obj, name):
+        """Guard on every localized attribute READ: a still-UNDEF local
+        means no path stored it and the attribute never existed — plain
+        python raises AttributeError at that read, so we do too instead
+        of leaking the sentinel into user code."""
+        if value is UNDEF:
+            raise AttributeError(
+                f"'{type(obj).__name__}' object has no attribute {name!r}"
+            )
+        return value
+
+    @staticmethod
     def attr_flush(obj, name, value, entry=UNDEF):
         """Write-back of a localized `param.attr` store.
 
@@ -1075,11 +1087,30 @@ def _localize_attr_stores(func_def) -> bool:
             v = node.value
             if (isinstance(v, ast.Name) and (v.id, node.attr) in pairs
                     and not isinstance(node.ctx, ast.Del)):
-                return ast.copy_location(
-                    ast.Name(id=_attr_local(v.id, node.attr),
-                             ctx=type(node.ctx)()),
-                    node,
-                )
+                local = ast.Name(id=_attr_local(v.id, node.attr),
+                                 ctx=type(node.ctx)())
+                if isinstance(node.ctx, ast.Load):
+                    # reads re-raise AttributeError when the local is
+                    # still UNDEF or was UNDEF-deleted by a region's
+                    # post-del cleanup (attribute never existed, no store
+                    # ran) — load_or_undef absorbs the deleted-name case
+                    return ast.copy_location(
+                        ast.Call(
+                            func=ast.Attribute(
+                                value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                                attr="attr_check", ctx=ast.Load(),
+                            ),
+                            args=[
+                                _load_or_undef_call(
+                                    _attr_local(v.id, node.attr)),
+                                ast.Name(id=v.id, ctx=ast.Load()),
+                                ast.Constant(node.attr),
+                            ],
+                            keywords=[],
+                        ),
+                        node,
+                    )
+                return ast.copy_location(local, node)
             return node
 
     ordered = sorted(pairs)

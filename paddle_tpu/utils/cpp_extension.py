@@ -16,7 +16,7 @@ from typing import List, Optional, Sequence
 
 __all__ = ["load", "get_build_directory"]
 
-_DEFAULT_CFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+_DEFAULT_CFLAGS = ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC"]
 
 
 def get_build_directory() -> str:
@@ -28,12 +28,31 @@ def get_build_directory() -> str:
     return d
 
 
+def _host_isa_tag() -> str:
+    """Fingerprint of this host's ISA features. -march=native bakes them
+    into the .so: a cached artifact moved to an older host (shared cache
+    dir, docker image) would SIGILL, so the cache key must change with
+    the CPU."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def _source_digest(sources: Sequence[str], cflags: Sequence[str]) -> str:
     h = hashlib.sha256()
     for s in sources:
         with open(s, "rb") as f:
             h.update(f.read())
     h.update(" ".join(cflags).encode())
+    if any("-march=native" in c for c in cflags):
+        h.update(_host_isa_tag().encode())
     return h.hexdigest()[:16]
 
 

@@ -1,0 +1,203 @@
+"""Shape-bucketing policy (VERDICT r4 missing #4 / SURVEY §7 hard part 3).
+
+Reference capability replaced: LoDTensor ragged batches
+(paddle/fluid/framework/lod_tensor.h) — here a padding policy bounds the
+number of distinct compiled shapes instead."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import BucketSpec, DataLoader, Dataset
+
+
+def tonp(x):
+    return x.numpy() if hasattr(x, "numpy") else np.asarray(x)
+
+
+class RaggedText(Dataset):
+    """NLP-style ragged dataset: token id sequences of length 5..120."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.seqs = [
+            rng.integers(1, 1000, rng.integers(5, 121)).astype(np.int64)
+            for _ in range(n)
+        ]
+
+    def __len__(self):
+        return len(self.seqs)
+
+    def __getitem__(self, i):
+        return self.seqs[i], np.int64(len(self.seqs[i]))
+
+
+def test_bucket_for_boundaries():
+    spec = BucketSpec([32, 64, 128])
+    assert spec.bucket_for(1) == 32
+    assert spec.bucket_for(32) == 32
+    assert spec.bucket_for(33) == 64
+    assert spec.bucket_for(128) == 128
+    # beyond the table: multiples of the top boundary, still bounded
+    assert spec.bucket_for(129) == 256
+    assert spec.bucket_for(300) == 384
+    with pytest.raises(ValueError):
+        BucketSpec([64, 32])
+
+
+def test_ragged_loader_bounds_compiled_shapes():
+    spec = BucketSpec([32, 64, 128], axis=-1, pad_value=0, fields=[0])
+    loader = DataLoader(RaggedText(), batch_size=8, bucket_spec=spec,
+                        drop_last=True, return_numpy=True)
+    lengths = set()
+    naive_lengths = set()
+    for ids, lens in loader:
+        ids, lens = tonp(ids), tonp(lens)
+        assert ids.shape[0] == 8
+        lengths.add(ids.shape[1])
+        naive_lengths.add(int(np.max(lens)))
+        # padding is zeros past each row's real length
+        for row, n in zip(ids, lens):
+            assert np.all(row[int(n):] == 0)
+            assert np.all(row[:int(n)] != 0)
+    # the policy's point: ≤3 padded widths where naive batch-max padding
+    # would produce ~one shape per batch
+    assert lengths <= {32, 64, 128}
+    assert len(lengths) <= 3
+    assert len(naive_lengths) > 2 * len(lengths)
+
+
+def test_compile_count_bounded_vs_naive():
+    import jax
+    import jax.numpy as jnp
+
+    traces = []
+
+    @jax.jit
+    def consume(ids):
+        traces.append(ids.shape)  # runs once per distinct shape (trace)
+        return jnp.sum(ids)
+
+    spec = BucketSpec([32, 64, 128], fields=[0])
+    loader = DataLoader(RaggedText(), batch_size=8, bucket_spec=spec,
+                        drop_last=True, return_numpy=True)
+    for ids, _ in loader:
+        consume(tonp(ids))
+    bucketed_traces = len(traces)
+
+    traces.clear()
+    naive = DataLoader(RaggedText(), batch_size=8, drop_last=True,
+                       return_numpy=True,
+                       collate_fn=lambda s: (
+                           np.stack([
+                               np.pad(a, (0, max(len(x) for x, _ in s) - len(a)))
+                               for a, _ in s
+                           ]),
+                           np.asarray([n for _, n in s]),
+                       ))
+    for ids, _ in naive:
+        consume(tonp(ids))
+    naive_traces = len(traces)
+    assert bucketed_traces <= 3
+    assert naive_traces >= 3 * bucketed_traces  # ~one compile per batch
+
+
+def test_recompile_budget_warns():
+    spec = BucketSpec([8], max_shapes=2)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for ln in (4, 12, 20, 28):  # buckets 8, 16, 24, 32
+            spec.apply(np.zeros((2, ln)))
+    msgs = [str(x.message) for x in w if "recompile budget" in str(x.message)]
+    assert len(msgs) == 2  # 3rd and 4th distinct shapes
+    assert len(spec.seen_shapes) == 4
+
+
+def test_pad_batch_to_fixes_last_batch():
+    spec = BucketSpec([16], pad_batch_to=8)
+    # 20 samples / batch 8 -> last batch has 4 rows; policy pads it to 8
+    class Fixed(Dataset):
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            return np.full((10,), i + 1, np.int64)
+
+    loader = DataLoader(Fixed(), batch_size=8, bucket_spec=spec,
+                        return_numpy=True)
+    batches = list(loader)
+    assert all(tuple(b.shape) == (8, 16) for b in batches)
+    last = batches[-1]
+    assert spec.real_batch_size(last) == 4
+    assert spec.real_batch_size(batches[0]) is None  # full batch untouched
+    # the padding repeats the final real row
+    lastnp = tonp(last)
+    np.testing.assert_array_equal(
+        lastnp[4:], np.broadcast_to(lastnp[3], (4, 16)))
+
+
+def test_bucketed_collate_multiprocess_workers():
+    spec = BucketSpec([32, 64, 128], fields=[0])
+    loader = DataLoader(RaggedText(), batch_size=8, num_workers=2,
+                        bucket_spec=spec, drop_last=True, return_numpy=True)
+    widths = set()
+    count = 0
+    for ids, lens in loader:
+        ids, lens = tonp(ids), tonp(lens)
+        widths.add(ids.shape[1])
+        count += 1
+        for row, n in zip(ids, lens):
+            assert np.all(row[int(n):] == 0)
+    assert count == 8 and widths <= {32, 64, 128}
+
+
+def test_apply_on_collated_dict():
+    spec = BucketSpec([8, 16])
+    out = spec.apply({"ids": np.ones((2, 5)), "mask": np.ones((2, 13))})
+    assert out["ids"].shape == (2, 8) and out["mask"].shape == (2, 16)
+
+
+def test_scalar_label_fields_pass_through_by_default():
+    # review r5: default fields=None must skip 0-d label fields
+    spec = BucketSpec([8, 16])
+
+    class WithLabels(Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return np.arange(3 + i % 5, dtype=np.int64), np.int64(i % 3)
+
+    loader = DataLoader(WithLabels(), batch_size=4, bucket_spec=spec)
+    for ids, labels in loader:
+        assert tuple(ids.shape)[1] == 8
+        assert tuple(labels.shape) == (4,)
+    # dict apply: scalars untouched
+    out = spec.apply({"ids": np.ones((2, 5)), "n": 7})
+    assert out["ids"].shape == (2, 8) and out["n"] == 7
+
+
+def test_pad_batch_to_rejected_with_process_workers():
+    spec = BucketSpec([8], pad_batch_to=4)
+
+    class D(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.arange(4, dtype=np.int64)
+
+    with pytest.raises(ValueError, match="pad_batch_to"):
+        DataLoader(D(), batch_size=4, num_workers=2, bucket_spec=spec)
+    DataLoader(D(), batch_size=4, num_workers=2, use_thread_workers=True,
+               bucket_spec=spec)  # threads share the spec: allowed
+
+
+def test_mp_workers_parent_observes_shapes():
+    spec = BucketSpec([32, 64, 128], fields=[0])
+    loader = DataLoader(RaggedText(n=32), batch_size=8, num_workers=2,
+                        bucket_spec=spec, drop_last=True, return_numpy=True)
+    for _ in loader:
+        pass
+    assert spec.seen_shapes  # parent-side tracking survives the fork

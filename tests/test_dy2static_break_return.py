@@ -848,3 +848,20 @@ def test_attr_store_with_sublayer_calls():
         paddle.to_tensor(np.ones((2, 4), np.float32)))
     assert out.shape == [2, 4]
     assert float(net.seen) == 1.0
+
+
+def test_attr_read_of_never_set_attribute_raises():
+    # review r5: a read of a localized attribute no path ever stored must
+    # raise AttributeError like python, not leak the UNDEF sentinel
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    class A:
+        def go(self, cond):
+            if cond:
+                self.x = 1
+            return self.x
+
+    a = A()
+    with pytest.raises(AttributeError, match="'A' object has no attribute"):
+        convert_to_static(A.go)(a, False)
+    assert convert_to_static(A.go)(A(), True) == 1

@@ -1,0 +1,136 @@
+"""SSD-overflow sparse table (VERDICT r4 missing #1).
+
+Reference analogue: paddle/fluid/distributed/ps/table/ssd_sparse_table.h —
+a RAM cache in front of a disk store so tables can exceed host RAM. Here:
+fixed-record slot file + per-shard key->slot index + LRU batch spill."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import CtrAccessorConfig, MemorySparseTable
+
+
+def _mk(tmp_path, budget=128, dim=8, ctr=None, opt="adagrad"):
+    return MemorySparseTable(
+        dim, shard_num=8, optimizer=opt, learning_rate=0.1,
+        init_range=0.01, seed=7, ctr=ctr,
+        ssd_path=str(tmp_path / "slots.bin"), ram_budget=budget,
+    )
+
+
+def test_budget_enforced_and_nothing_lost(tmp_path):
+    t = _mk(tmp_path, budget=128)
+    keys = np.arange(2000, dtype=np.int64)
+    t.pull(keys)  # creates 2000 entries through a 128-entry RAM budget
+    assert len(t) == 2000
+    assert t.ram_size() <= 2 * 128  # spill batches keep it near budget
+    assert t.disk_size() >= 2000 - 2 * 128
+    assert t.ram_size() + t.disk_size() == 2000
+
+
+def test_values_survive_spill_and_promote(tmp_path):
+    t = _mk(tmp_path, budget=64)
+    keys = np.arange(500, dtype=np.int64)
+    first = t.pull(keys).copy()
+    # most rows now live on disk; pulling again promotes them back
+    again = t.pull(keys)
+    np.testing.assert_array_equal(first, again)
+    assert len(t) == 500
+
+
+def test_parity_with_pure_ram_table(tmp_path):
+    # same ops on a spilling table and a pure-RAM twin -> identical state
+    ssd = _mk(tmp_path, budget=32)
+    ram = MemorySparseTable(8, shard_num=8, optimizer="adagrad",
+                            learning_rate=0.1, init_range=0.01, seed=7)
+    rng = np.random.default_rng(0)
+    for step in range(6):
+        keys = rng.integers(0, 400, 256).astype(np.int64)
+        ssd.pull(keys)
+        ram.pull(keys)
+        grads = rng.standard_normal((256, 8)).astype(np.float32)
+        ssd.push(keys, grads)
+        ram.push(keys, grads)
+    probe = np.arange(400, dtype=np.int64)
+    np.testing.assert_allclose(ssd.pull(probe), ram.pull(probe), rtol=1e-6)
+    assert ssd.ram_size() < 400 <= len(ssd)
+
+
+def test_save_load_roundtrip_with_spill(tmp_path):
+    t = _mk(tmp_path, budget=48)
+    keys = np.arange(300, dtype=np.int64)
+    t.pull(keys)
+    grads = np.ones((300, 8), np.float32)
+    t.push(keys, grads)
+    want = t.pull(keys).copy()
+    ckpt = str(tmp_path / "table.ckpt")
+    t.save(ckpt)
+
+    # restore into a table with a DIFFERENT budget (and one with none)
+    t2 = _mk(tmp_path / "other" if False else tmp_path, budget=1000)
+    t2.load(ckpt)
+    assert len(t2) == 300
+    np.testing.assert_allclose(t2.pull(keys), want, rtol=1e-6)
+
+    t3 = MemorySparseTable(8, shard_num=8, optimizer="adagrad",
+                           learning_rate=0.1, init_range=0.01, seed=7)
+    t3.load(ckpt)
+    np.testing.assert_allclose(t3.pull(keys), want, rtol=1e-6)
+
+
+def test_adam_state_spills_intact(tmp_path):
+    ssd = _mk(tmp_path, budget=16, opt="adam")
+    ram = MemorySparseTable(8, shard_num=8, optimizer="adam",
+                            learning_rate=0.1, init_range=0.01, seed=7)
+    keys = np.arange(100, dtype=np.int64)
+    g = np.full((100, 8), 0.5, np.float32)
+    for _ in range(4):  # adam moments + bias powers must survive spill
+        ssd.push(keys, g)
+        ram.push(keys, g)
+    np.testing.assert_allclose(ssd.pull(keys), ram.pull(keys), rtol=1e-6)
+
+
+def test_ctr_stats_and_shrink_reach_disk(tmp_path):
+    ctr = CtrAccessorConfig(decay_rate=0.5, delete_threshold=0.4,
+                            delete_after_unseen_days=2)
+    t = _mk(tmp_path, budget=16, ctr=ctr)
+    keys = np.arange(200, dtype=np.int64)
+    shows = np.full(200, 4.0, np.float32)
+    clicks = np.full(200, 2.0, np.float32)
+    t.push_ctr(keys, shows, clicks, np.zeros((200, 8), np.float32))
+    assert t.disk_size() > 0
+    # stats reach a disk-resident key (and promote it)
+    spilled_key = int(keys[0])
+    stats = t.ctr_stats(spilled_key)
+    assert stats is not None and stats[0] == 4.0 and stats[1] == 2.0
+    # shrink decays disk entries too; after enough days everything evicts
+    before = len(t)
+    t.shrink()
+    assert len(t) == before  # score 0.25*2+1*2=2.5 >= 0.4 after one decay
+    for _ in range(3):
+        t.shrink()
+    assert len(t) == 0  # unseen_days > 2 evicts RAM and disk alike
+
+
+def test_missing_dir_raises(tmp_path):
+    with pytest.raises(OSError):
+        MemorySparseTable(8, ssd_path=str(tmp_path / "no" / "dir" / "f.bin"),
+                          ram_budget=10)
+
+
+def test_ssd_requires_budget(tmp_path):
+    with pytest.raises(ValueError, match="ram_budget"):
+        MemorySparseTable(8, ssd_path=str(tmp_path / "f.bin"))
+
+
+def test_slot_file_reuse_bounded(tmp_path):
+    # promote/spill churn must reuse freed slots, not grow the file forever
+    t = _mk(tmp_path, budget=32)
+    keys = np.arange(200, dtype=np.int64)
+    for _ in range(10):
+        t.pull(keys)  # promotes + respills the same 200 entries
+    assert len(t) == 200
+    fsize = os.path.getsize(str(tmp_path / "slots.bin"))
+    rec = 8 + 4 * 8 + 4 * 8  # key + emb + adagrad accumulator
+    assert fsize <= rec * 300  # ~200 live slots + slack, not 2000
