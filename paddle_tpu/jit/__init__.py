@@ -339,7 +339,7 @@ class CompiledTrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, mesh=None,
-                 in_shardings=None):
+                 in_shardings=None, grad_input_idx=()):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -348,6 +348,11 @@ class CompiledTrainStep:
         self._params = [p for p in model.parameters() if not p.stop_gradient]
         self._buffers = [b for _, b in model.named_buffers()]
         self._hyper = optimizer._hyper()
+        # batch positions to ALSO differentiate: their grads come back to
+        # the caller instead of an optimizer (the PS sparse path — pulled
+        # embedding rows are step inputs, their grads push to the host
+        # table; reference: distributed_push_sparse after the backward)
+        self._grad_input_idx = tuple(int(i) for i in grad_input_idx)
 
     def _init_opt_state(self):
         states = []
@@ -379,9 +384,14 @@ class CompiledTrainStep:
 
         asp_masks = [_asp._mask_for(p) for p in params]
 
+        gidx = self._grad_input_idx
+
         def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
-            def loss_of(p_vals):
-                ins = [Tensor(v, stop_gradient=True) for v in batch_vals]
+            def loss_of(p_vals, diff_vals):
+                full = list(batch_vals)
+                for i, v in zip(gidx, diff_vals):
+                    full[i] = v
+                ins = [Tensor(v, stop_gradient=True) for v in full]
                 with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
                         no_grad(), _random.rng_scope(key):
                     out = model(*ins[:-1]) if len(ins) > 1 else model(ins[0])
@@ -391,9 +401,9 @@ class CompiledTrainStep:
                 lv = loss._value if isinstance(loss, Tensor) else loss
                 return lv, new_b
 
-            (loss, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                tuple(p_vals)
-            )
+            (loss, new_b), (grads, in_grads) = jax.value_and_grad(
+                loss_of, argnums=(0, 1), has_aux=True
+            )(tuple(p_vals), tuple(batch_vals[i] for i in gidx))
             if grad_clip is not None:
                 # the clip objects are pure jnp math on Tensor wrappers —
                 # tracer-safe, so the eager clip semantics apply unchanged
@@ -415,7 +425,7 @@ class CompiledTrainStep:
                     np_ = np_ * mask.astype(np_.dtype)
                 new_p.append(np_)
                 new_s.append(ns_)
-            return loss, tuple(new_p), tuple(new_s), new_b
+            return loss, in_grads, tuple(new_p), tuple(new_s), new_b
 
         # donate params and optimizer state: XLA reuses their HBM buffers
         return jax.jit(step_fn, donate_argnums=(0, 1))
@@ -430,7 +440,7 @@ class CompiledTrainStep:
         b_vals = tuple(b._value for b in self._buffers)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.next_key()
-        loss, new_p, new_s, new_b = self._step(
+        loss, in_grads, new_p, new_s, new_b = self._step(
             p_vals, tuple(self._opt_state), b_vals, key, lr, *batch_vals
         )
         for p, v in zip(self._params, new_p):
@@ -441,11 +451,16 @@ class CompiledTrainStep:
         for p, st in zip(self._params, self._opt_state):
             self.optimizer._accumulators[id(p)] = st
         self.optimizer._step_count += 1
-        return Tensor(loss, stop_gradient=True)
+        loss_t = Tensor(loss, stop_gradient=True)
+        if self._grad_input_idx:
+            return loss_t, [Tensor(g, stop_gradient=True) for g in in_grads]
+        return loss_t
 
 
-def compile_train_step(model, loss_fn, optimizer, mesh=None, in_shardings=None):
-    return CompiledTrainStep(model, loss_fn, optimizer, mesh, in_shardings)
+def compile_train_step(model, loss_fn, optimizer, mesh=None, in_shardings=None,
+                       grad_input_idx=()):
+    return CompiledTrainStep(model, loss_fn, optimizer, mesh, in_shardings,
+                             grad_input_idx)
 
 
 # ---------------------------------------------------------------------------

@@ -142,7 +142,17 @@ class ShardedTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=0,
                  batch_axes=("dp", "sharding"), forward_ctx=None,
-                 accumulate_steps=1, loss_scale=1.0):
+                 accumulate_steps=1, loss_scale=1.0, grad_input_idx=()):
+        # batch positions to ALSO differentiate — their grads return to the
+        # caller (the PS sparse path: pulled rows in, row grads out, pushed
+        # to the host table; reference: distributed_push_sparse)
+        self.grad_input_idx = tuple(int(i) for i in grad_input_idx)
+        if self.grad_input_idx and int(accumulate_steps) > 1:
+            raise ValueError(
+                "grad_input_idx is not supported with compiled gradient "
+                "merge (the per-microbatch input grads would need their "
+                "own accumulation contract)"
+            )
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -227,9 +237,12 @@ class ShardedTrainStep:
         # optimizer update, where it is a local slice.
         axes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)) \
             if self.mesh else {}
-        # stage 3 keeps sharded params, so its backward grads are naturally
-        # zero-sharded — only stages 1/2 hit the propagation trap
-        hybrid_zero = (self.zero_stage in (1, 2) and axes.get("dp", 1) > 1
+        # stage 3's sharded PARAMS hit the same trap from the other side:
+        # the zero spec propagates backwards through the weight-grad dot
+        # onto forward activations (r5: the ernie-ctr dryrun showed the
+        # remat on a gelu output under dp2×sharding4 stage3), so all three
+        # stages pin when both axes are real
+        hybrid_zero = (self.zero_stage in (1, 2, 3) and axes.get("dp", 1) > 1
                        and axes.get("sharding", 1) > 1)
         if hybrid_zero:
             grad_pin = [
@@ -237,8 +250,13 @@ class ShardedTrainStep:
                 for p in params
             ]
 
+        gidx = self.grad_input_idx
+
         def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
-            def loss_of(p_vals, b_vals, key, batch_vals):
+            def loss_of(p_vals, b_vals, key, batch_vals, diff_vals=()):
+                batch_vals = list(batch_vals)
+                for i, v in zip(gidx, diff_vals):
+                    batch_vals[i] = v
                 ins = [Tensor(v, stop_gradient=True) for v in batch_vals]
                 with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
                         no_grad(), _random.rng_scope(key), fwd_ctx():
@@ -283,7 +301,14 @@ class ShardedTrainStep:
                     for g, p in zip(g_acc, p_vals)
                 )
                 loss = jnp.mean(losses)
+                in_grads = ()  # gidx is rejected with gradient merge
+            elif gidx:
+                (loss, new_b), (grads, in_grads) = jax.value_and_grad(
+                    loss_of, argnums=(0, 4), has_aux=True
+                )(tuple(p_vals), tuple(b_vals), key, tuple(batch_vals),
+                  tuple(batch_vals[i] for i in gidx))
             else:
+                in_grads = ()
                 (loss, new_b), grads = jax.value_and_grad(
                     loss_of, has_aux=True
                 )(tuple(p_vals), tuple(b_vals), key, tuple(batch_vals))
@@ -297,6 +322,12 @@ class ShardedTrainStep:
                 grads = tuple(
                     (g.astype(jnp.float32) / loss_scale).astype(g.dtype)
                     for g in grads
+                )
+                # input grads ship to the caller (PS push): they must be
+                # unscaled exactly like the param grads
+                in_grads = tuple(
+                    (g.astype(jnp.float32) / loss_scale).astype(g.dtype)
+                    for g in in_grads
                 )
             if grad_clip is not None:
                 pairs = grad_clip(
@@ -313,12 +344,12 @@ class ShardedTrainStep:
                 np_, ns_ = rule(opt, pv, gv, lr, st, **h)
                 new_p.append(np_)
                 new_s.append(ns_)
-            return loss, tuple(new_p), tuple(new_s), new_b
+            return loss, tuple(in_grads), tuple(new_p), tuple(new_s), new_b
 
         p_sh, st_sh, b_sh, batch_sh = self._shardings()
         repl = NamedSharding(self.mesh, P())
         in_sh = (p_sh, st_sh, b_sh, repl, repl) + (batch_sh,) * n_batch_args
-        out_sh = (repl, p_sh, st_sh, b_sh)
+        out_sh = (repl, (batch_sh,) * len(gidx), p_sh, st_sh, b_sh)
         return jax.jit(
             step_fn, in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=(0, 1),
@@ -355,7 +386,7 @@ class ShardedTrainStep:
         b_vals = tuple(b._value for b in self._buffers)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _next_key()
-        loss, new_p, new_s, new_b = self._step(
+        loss, in_grads, new_p, new_s, new_b = self._step(
             p_vals, tuple(self._opt_state), b_vals, key, lr, *batch_vals
         )
         for p, v in zip(self._params, new_p):
@@ -366,7 +397,10 @@ class ShardedTrainStep:
         for p, st in zip(self._params, self._opt_state):
             self.optimizer._accumulators[id(p)] = st
         self.optimizer._step_count += 1
-        return Tensor(loss, stop_gradient=True)
+        loss_t = Tensor(loss, stop_gradient=True)
+        if self.grad_input_idx:
+            return loss_t, [Tensor(g, stop_gradient=True) for g in in_grads]
+        return loss_t
 
 
 def _next_key():
@@ -377,7 +411,7 @@ def _next_key():
 
 def sharded_train_step(model, loss_fn, optimizer, mesh=None, zero_stage=0,
                        batch_axes=("dp", "sharding"), forward_ctx=None,
-                       accumulate_steps=1, loss_scale=1.0):
+                       accumulate_steps=1, loss_scale=1.0, grad_input_idx=()):
     return ShardedTrainStep(model, loss_fn, optimizer, mesh, zero_stage,
                             batch_axes, forward_ctx, accumulate_steps,
-                            loss_scale)
+                            loss_scale, grad_input_idx)
