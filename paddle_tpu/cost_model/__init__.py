@@ -9,11 +9,22 @@ cost analysis (FLOPs / bytes accessed) and wall-time a few runs.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 import jax
 
-__all__ = ["CostModel"]
+# the ANALYTIC side is the auto-parallel planner's roofline — one
+# implementation, re-exported here (r4 review: the facade must not stub a
+# second cost model beside the real one)
+from ..distributed.auto_parallel.planner import (  # noqa: F401
+    Candidate,
+    ClusterSpec,
+    CostModel as AnalyticCostModel,
+    ModelDesc,
+)
+
+__all__ = ["CostModel", "AnalyticCostModel", "ClusterSpec", "ModelDesc",
+           "Candidate"]
 
 
 class CostModel:
@@ -57,3 +68,16 @@ class CostModel:
         no fixed per-op table (fusion changes everything), so measured costs
         are the only honest source here."""
         return {}
+
+    def analytic(self, cluster: Optional[ClusterSpec] = None
+                 ) -> AnalyticCostModel:
+        """The roofline estimator the auto-parallel Planner plans with —
+        `estimate(ModelDesc, Candidate)` → (cost_ms, breakdown, mem)."""
+        return AnalyticCostModel(cluster)
+
+    def calibrate(self, analytic_ms: float, fn: Callable, *args) -> float:
+        """One-probe calibration: measured/estimated scale for mapping the
+        roofline onto THIS backend (the same probe the auto-plan tuner
+        logs its candidate estimates with)."""
+        measured = self.profile_measure(fn, *args)["time_ms"]
+        return measured / analytic_ms if analytic_ms > 0 else 1.0

@@ -289,49 +289,45 @@ class Engine:
                 "analytic plan"
             )
             return analytic
-        if any(isinstance(s, (list, tuple)) and len(s) > 1
-               for s in (self.inputs_spec, self.labels_spec)):
+        if isinstance(self.labels_spec, (list, tuple)) \
+                and len(self.labels_spec) > 1:
             warnings.warn(
-                "Engine(tune=True) supports single-tensor inputs/labels "
-                "specs; keeping the analytic plan"
+                "Engine(tune=True) needs a single-tensor labels spec (the "
+                "compiled step's loss contract takes one label tensor); "
+                "keeping the analytic plan"
             )
             return analytic
         if len(plans) < 2:
             return analytic
 
-        def synth(spec):
-            first = spec[0] if isinstance(spec, (list, tuple)) else spec
+        def synth_one(spec):
             shape = [batch if (d in (None, -1) or i == 0) else int(d)
-                     for i, d in enumerate(first.shape)]
-            dtype = str(getattr(first, "dtype", "float32"))
+                     for i, d in enumerate(spec.shape)]
+            dtype = str(getattr(spec, "dtype", "float32"))
             if "int" in dtype:
                 return Tensor(jnp.zeros(shape, jnp.int32),
                               stop_gradient=True)
             return Tensor(jnp.zeros(shape, jnp.float32),
                           stop_gradient=True)
 
-        x, y = synth(self.inputs_spec), synth(self.labels_spec)
-        # snapshot to HOST memory: the trial steps donate the device
-        # buffers, so device-array references would be invalidated.
-        # Buffers included — BatchNorm running stats etc. also move during
-        # trial steps.
-        snapshot = [
-            (p, np.asarray(jax.device_get(p._value)))
-            for p in self.model.parameters()
-        ] + [
-            (b, np.asarray(jax.device_get(b._value)))
-            for _, b in self.model.named_buffers()
-        ]
-        opt_snapshot = {
-            pid: {k: np.asarray(jax.device_get(v)) for k, v in st.items()}
-            for pid, st in getattr(self._optimizer, "_accumulators",
-                                   {}).items()
-        }
-        opt_steps = getattr(self._optimizer, "_step_count", 0)
+        def synth(spec):
+            # multi-input models (r4 weak #6): synthesize every tensor
+            if isinstance(spec, (list, tuple)):
+                return tuple(synth_one(s) for s in spec)
+            return (synth_one(spec),)
+
+        xs, (y,) = synth(self.inputs_spec), synth(self.labels_spec)
+        # shared donation-safety harness (tuner.TrialStateGuard): trial
+        # steps donate the device buffers — params/buffers/opt state
+        # snapshot to host and restore per candidate + once in finally
+        from .tuner import TrialStateGuard
+
+        guard = TrialStateGuard(self.model, self._optimizer)
 
         def model_fn(cand):
             from .planner import mesh_degrees_for
 
+            guard.restore()
             init_mesh(**mesh_degrees_for(cand))
             shard_params(self.model, zero_stage=cand.zero_stage)
             step = sharded_train_step(
@@ -339,7 +335,7 @@ class Engine:
                 zero_stage=cand.zero_stage,
                 batch_axes=("dp", "sharding"),
             )
-            return step, (x, y)
+            return step, tuple(xs) + (y,)
 
         best = None
         try:
@@ -351,14 +347,7 @@ class Engine:
                 f"profile tuning failed ({e}); keeping the analytic plan"
             )
         finally:
-            for p, v in snapshot:
-                p._value = jnp.asarray(v)
-            if hasattr(self._optimizer, "_accumulators"):
-                self._optimizer._accumulators = {
-                    pid: {k: jnp.asarray(v) for k, v in st.items()}
-                    for pid, st in opt_snapshot.items()
-                }
-                self._optimizer._step_count = opt_steps
+            guard.restore()
         for p in plans:
             if p.candidate is best:
                 return p

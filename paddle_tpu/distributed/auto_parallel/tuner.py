@@ -91,6 +91,69 @@ def map_processes(candidate: Candidate, devices=None):
     return arr.reshape(c.pp, c.dp, c.sep, c.mp)
 
 
+class TrialStateGuard:
+    """Host-memory snapshot of model params/buffers + optimizer
+    accumulators around profile trials (shared by Engine(tune=True) and
+    the fleet auto path — the donation-safety logic must exist ONCE).
+
+    Trial steps DONATE the device buffers and advance optimizer state, so
+    device-array references die with the first trial; the snapshot lives
+    in host numpy and `restore()` re-uploads it — call it before each
+    candidate build and once more in a finally."""
+
+    def __init__(self, model, optimizer):
+        import jax as _jax
+        import numpy as _np
+
+        self._model = model
+        self._opt = optimizer
+        self._tensors = [
+            (t, _np.asarray(_jax.device_get(t._value)))
+            for t in list(model.parameters())
+            + [b for _, b in model.named_buffers()]
+        ]
+        self._acc = {
+            pid: {k: _np.asarray(_jax.device_get(v)) for k, v in st.items()}
+            for pid, st in getattr(optimizer, "_accumulators", {}).items()
+        }
+        self._steps = getattr(optimizer, "_step_count", 0)
+
+    def restore(self):
+        import jax.numpy as _jnp
+
+        for t, v in self._tensors:
+            t._value = _jnp.asarray(v)
+        if hasattr(self._opt, "_accumulators"):
+            self._opt._accumulators = {
+                pid: {k: _jnp.asarray(v) for k, v in st.items()}
+                for pid, st in self._acc.items()
+            }
+            self._opt._step_count = self._steps
+
+
+def calibration_scale(records, plans):
+    """One-probe calibration shared by every measure-then-pick site:
+    measured/estimated on the first candidate that both has an analytic
+    cost and got measured. Returns (scale, log_line) or (None, None)."""
+    measured = {r["candidate"]: r["ms"] for r in records if "ms" in r}
+    probe = next(
+        (p for p in plans if str(p.candidate) in measured
+         and p.cost_ms > 0),
+        None,
+    )
+    if probe is None:
+        return None, None
+    scale = measured[str(probe.candidate)] / probe.cost_ms
+    line = (
+        f"[auto-parallel tuner] calibration x{scale:.1f}: "
+        + " ".join(f"{p.candidate}~{p.cost_ms * scale:.1f}ms"
+                   for p in plans)
+    )
+    for p in plans:
+        p.calibrated_ms = p.cost_ms * scale
+    return scale, line
+
+
 class ProfileTuner:
     """Measure candidate parallelization configs on the real devices and
     keep the fastest (reference: tuner/optimization_tuner.py's
@@ -98,34 +161,52 @@ class ProfileTuner:
     candidate in-process)."""
 
     def __init__(self, model_fn, candidates: Sequence[Candidate],
-                 warmup: int = 1, iters: int = 3):
+                 warmup: int = 1, iters: int = 3, interleave: bool = False):
         """model_fn(candidate) -> (step_callable, example_batch_tuple);
         the callable must be ready to run (mesh installed, params placed).
-        """
+
+        interleave=True: build every candidate first, then time them in
+        round-robin rounds — ambient load drifting across the trial span
+        hits all candidates equally instead of whichever ran during the
+        bad minute. Requires each candidate to own its params (a SHARED
+        model reshared per candidate would be re-placed on every
+        cross-candidate call, biasing the timings — keep the sequential
+        default there)."""
         self.model_fn = model_fn
         self.candidates = list(candidates)
         self.warmup = warmup
         self.iters = iters
+        self.interleave = interleave
         self.records: List[Dict] = []
+        self.best_step = None
 
     def tune(self, verbose: bool = False) -> Candidate:
-        best = None
+        self.best_step = None  # the winner's ALREADY-COMPILED step object
+        if self.interleave:
+            return self._tune_interleaved(verbose)
+        best = None  # (dt, cand, step) — losers are dropped immediately so
+        # only one trial's executable + placed state is ever held alongside
+        # the one being measured (a kept loser could OOM the next build)
         for cand in self.candidates:
             try:
                 step, batch = self.model_fn(cand)
                 for _ in range(max(self.warmup, 1)):
                     out = step(*batch)
                 float(out)  # sync
-                t0 = time.perf_counter()
+                # min-of-iters: ambient load only ever slows an iteration,
+                # so the minimum is the honest cost (same estimator as
+                # bench.py's _best_window)
+                dt = float("inf")
                 for _ in range(self.iters):
+                    t0 = time.perf_counter()
                     out = step(*batch)
                     float(out)  # per-step sync: tunnel-safe timing
-                dt = (time.perf_counter() - t0) / self.iters
+                    dt = min(dt, time.perf_counter() - t0)
                 self.records.append({"candidate": str(cand), "ms": dt * 1e3})
                 if verbose:
                     print(f"[tuner] {cand}: {dt * 1e3:.2f} ms/step")
                 if best is None or dt < best[0]:
-                    best = (dt, cand)
+                    best = (dt, cand, step)
             except Exception as e:  # infeasible candidate: record, move on
                 self.records.append({"candidate": str(cand),
                                      "error": repr(e)})
@@ -135,4 +216,48 @@ class ProfileTuner:
             raise RuntimeError(
                 f"profile tuner: every candidate failed: {self.records}"
             )
+        self.best_step = best[2]
         return best[1]
+
+    def _tune_interleaved(self, verbose: bool) -> Candidate:
+        built = []  # [cand, step, batch, min_dt] — failed entries removed
+        for cand in self.candidates:
+            try:
+                step, batch = self.model_fn(cand)
+                for _ in range(max(self.warmup, 1)):
+                    out = step(*batch)
+                float(out)  # sync
+                built.append([cand, step, batch, float("inf")])
+            except Exception as e:
+                self.records.append({"candidate": str(cand),
+                                     "error": repr(e)})
+                if verbose:
+                    print(f"[tuner] {cand}: failed ({e})")
+        for _ in range(self.iters):
+            for entry in list(built):
+                cand, step, batch, _dt = entry
+                try:
+                    t0 = time.perf_counter()
+                    out = step(*batch)
+                    float(out)
+                    entry[3] = min(entry[3], time.perf_counter() - t0)
+                except Exception as e:
+                    # steady-state failure (late OOM, async XLA error):
+                    # drop this candidate, keep the round-robin going
+                    built.remove(entry)
+                    self.records.append({"candidate": str(cand),
+                                         "error": repr(e)})
+                    if verbose:
+                        print(f"[tuner] {cand}: failed ({e})")
+        built = [e for e in built if e[3] < float("inf")]
+        for cand, _s, _b, dt in built:
+            self.records.append({"candidate": str(cand), "ms": dt * 1e3})
+            if verbose:
+                print(f"[tuner] {cand}: {dt * 1e3:.2f} ms/step")
+        if not built:
+            raise RuntimeError(
+                f"profile tuner: every candidate failed: {self.records}"
+            )
+        best = min(built, key=lambda e: e[3])
+        self.best_step = best[1]
+        return best[0]

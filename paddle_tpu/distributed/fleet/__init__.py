@@ -303,6 +303,9 @@ class _AutoPlannedStep:
         self.accumulate_steps = accumulate_steps
         self.plan = None
         self._inner = None
+        self.tuner_records = []
+        self.calibration_scale = None
+        self._tuned_step = None
 
     def _build(self, batch):
         from ..auto_parallel.planner import mesh_degrees_for, plan_for_model
@@ -316,29 +319,97 @@ class _AutoPlannedStep:
         # gradient accumulation composes with pp only as pipeline
         # microbatching (same rule as the explicit path below)
         allow_pp = None if self.accumulate_steps == 1 else False
-        self.plan = plan_for_model(self.model, seq_len=seq, global_batch=gb,
-                                   allow_pp=allow_pp)
+        cfg = self.strategy.auto_configs or {}
+        topk = int(cfg.get("topk", 3)) if cfg.get("tune", True) else 1
+        plans = plan_for_model(self.model, seq_len=seq, global_batch=gb,
+                               allow_pp=allow_pp, topk=topk)
+        if topk == 1:
+            plans = [plans]
+        if len(plans) > 1:
+            self.plan = self._measure_and_pick(plans, batch, cfg)
+        else:
+            self.plan = plans[0]
         c = self.plan.candidate
         init_mesh(**mesh_degrees_for(c))
         shard_params(self.model, zero_stage=c.zero_stage)
+        # the tuner's winning trial already compiled this exact program —
+        # reuse it (state was reset) instead of paying the compile twice
+        self._inner = self._tuned_step or self._make_step(c)
+
+    def _make_step(self, c):
+        from ...parallel.sharding import sharded_train_step
+
         if c.pp > 1:
             from ...parallel.pipeline import pipelined_train_step
 
             _check_pp_loss_scale(self.strategy)
             target = self.model._layers if hasattr(self.model, "_layers") \
                 else self.model
-            self._inner = pipelined_train_step(
+            return pipelined_train_step(
                 target, self.loss_fn, self.optimizer,
                 num_micro=c.micro_batches, zero_stage=c.zero_stage,
                 forward_ctx=self.forward_ctx,
             )
-        else:
-            self._inner = sharded_train_step(
-                self.model, self.loss_fn, self.optimizer,
-                zero_stage=c.zero_stage, forward_ctx=self.forward_ctx,
-                accumulate_steps=self.accumulate_steps,
-                loss_scale=_static_loss_scale(self.strategy),
+        return sharded_train_step(
+            self.model, self.loss_fn, self.optimizer,
+            zero_stage=c.zero_stage, forward_ctx=self.forward_ctx,
+            accumulate_steps=self.accumulate_steps,
+            loss_scale=_static_loss_scale(self.strategy),
+        )
+
+    def _measure_and_pick(self, plans, batch, cfg):
+        """Profile the planner's shortlist on the real devices and keep
+        the measured winner (reference: tuner/optimization_tuner.py's
+        measure-then-pick loop). Also runs the one-probe CALIBRATION: the
+        analytic roofline is scaled by measured/estimated on the first
+        candidate, so the logged estimates are meaningful on any backend
+        (the raw roofline assumes the ClusterSpec's TPU numbers)."""
+        import warnings
+
+        from ..auto_parallel.planner import mesh_degrees_for
+        from ..auto_parallel.tuner import ProfileTuner, TrialStateGuard
+        from ...parallel.sharding import shard_params
+
+        # trial steps donate param/opt buffers — snapshot to HOST memory
+        # and restore between trials so every candidate starts identical
+        guard = TrialStateGuard(self.model, self.optimizer)
+
+        def model_fn(cand):
+            guard.restore()
+            init_mesh(**mesh_degrees_for(cand))
+            shard_params(self.model, zero_stage=cand.zero_stage)
+            return self._make_step(cand), batch
+
+        from ..auto_parallel.tuner import calibration_scale
+
+        tuner = ProfileTuner(model_fn, [p.candidate for p in plans],
+                             iters=int(cfg.get("tune_iters", 2)))
+        best_c = None
+        try:
+            best_c = tuner.tune(verbose=True)
+        except RuntimeError as e:
+            warnings.warn(
+                f"auto-plan profile tuning failed ({e}); keeping the "
+                "analytic plan"
             )
+        finally:
+            guard.restore()
+        self.tuner_records = tuner.records
+        self.calibration_scale, line = calibration_scale(
+            tuner.records, plans)
+        if line:
+            print(line)
+        # reuse the winner's already-compiled step: its optimizer state is
+        # trial-mutated, so drop it — the next call re-inits from the
+        # RESTORED accumulators without recompiling
+        if tuner.best_step is not None and hasattr(tuner.best_step,
+                                                   "_opt_state"):
+            tuner.best_step._opt_state = None
+            self._tuned_step = tuner.best_step
+        for p in plans:
+            if p.candidate is best_c:
+                return p
+        return plans[0]
 
     def __call__(self, *batch):
         if self._inner is None:

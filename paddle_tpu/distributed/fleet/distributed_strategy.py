@@ -58,6 +58,13 @@ class DistributedStrategy:
         }
         self.heter_ccl_mode = False
         self.auto = False
+        # auto=True planning knobs: tune=True measures the planner's topk
+        # candidates on the devices and keeps the fastest (reference:
+        # tuner/optimization_tuner.py's measure-then-pick loop); the
+        # analytic estimates are calibrated against the first measurement
+        self.auto_configs: Dict[str, Any] = {
+            "tune": True, "topk": 3, "tune_iters": 2,
+        }
         self.a_sync = False
         self.a_sync_configs: Dict[str, Any] = {"k_steps": -1}
         self.nccl_comm_num = 1

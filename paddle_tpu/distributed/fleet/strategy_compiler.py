@@ -63,6 +63,7 @@ FIELD_STATUS = {
     "hybrid_configs": "mesh",
     "heter_ccl_mode": "unimplemented",
     "auto": "train-step",   # auto_parallel planner (distributed/auto_parallel)
+    "auto_configs": "train-step",  # planner tune/topk knobs
     "a_sync": "ps",
     "a_sync_configs": "ps",
     "nccl_comm_num": "absorbed",

@@ -365,15 +365,19 @@ class ShardedTrainStep:
                         f"global batch {n0} is not divisible by gradient-"
                         f"merge accumulate_steps={self.accumulate_steps}"
                     )
-        if self._step is None:
+        if self._opt_state is None:
+            # (re)initialize + physically place optimizer state per its
+            # (ZeRO) spec — jit donation requires argument shardings to
+            # match declarations. Separate from the compile so a tuner can
+            # reset state on an already-compiled winner (trial steps
+            # mutate it) without paying the XLA compile twice.
             self._opt_state = self._init_state()
-            # physically place optimizer state per its (ZeRO) spec — jit
-            # donation requires argument shardings to match declarations
             _, st_sh, _, _ = self._shardings()
             self._opt_state = [
                 {k: jax.device_put(v, sh[k]) for k, v in st.items()}
                 for st, sh in zip(self._opt_state, st_sh)
             ]
+        if self._step is None:
             self._step = self._build(len(batch))
         _, _, _, batch_sh = self._shardings()
         batch_vals = [

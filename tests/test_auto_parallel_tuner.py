@@ -211,3 +211,154 @@ def test_engine_tune_restores_buffers_and_falls_back():
         )
     assert eng2.plan is not None
     assert any("analytic plan" in str(r.message) for r in rec)
+
+
+# -- round 5: measure-then-pick in the fleet auto path ------------------------
+def test_fleet_auto_tunes_by_default_and_calibrates():
+    """strategy.auto now PROFILES the planner's top-3 and keeps the
+    measured winner (VERDICT r4 task 4); the one-probe calibration makes
+    the analytic estimates meaningful on this backend."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.auto = True
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(32, 64), paddle.nn.ReLU(),
+        paddle.nn.Linear(64, 8),
+    )
+    opt = paddle.optimizer.SGD(0.01, parameters=model.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    step = fleet.distributed_train_step(model, lambda o, y: ce(o, y), opt)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((16, 32)).astype(np.float32))
+    y = paddle.randint(0, 8, [16])
+    l0 = step(x, y)
+    assert step.tuner_records, "tuner must run by default"
+    measured = [r for r in step.tuner_records if "ms" in r]
+    assert len(measured) >= 2  # several candidates actually profiled
+    assert step.calibration_scale is not None and step.calibration_scale > 0
+    assert hasattr(step.plan, "calibrated_ms")
+    # the chosen candidate is the measured minimum
+    best_ms = min(r["ms"] for r in measured)
+    chosen = next(r for r in measured
+                  if r["candidate"] == str(step.plan.candidate))
+    assert chosen["ms"] == best_ms
+    # training proceeds after trials (state was restored between them)
+    l1 = step(x, y)
+    assert float(l1) < float(l0) + 1.0
+
+
+def test_fleet_auto_tune_opt_out():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.auto = True
+    strategy.auto_configs = {"tune": False}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = paddle.nn.Linear(16, 4)
+    opt = paddle.optimizer.SGD(0.01, parameters=model.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    step = fleet.distributed_train_step(model, lambda o, y: ce(o, y), opt)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((8, 16)).astype(np.float32))
+    step(x, paddle.randint(0, 4, [8]))
+    assert step.tuner_records == []  # analytic-only when opted out
+
+
+def test_engine_tune_multi_input_specs():
+    """r4 weak #6: Engine(tune=True) must handle multi-tensor inputs."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.static import InputSpec
+
+    class TwoIn(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = paddle.nn.Linear(8, 16)
+            self.b = paddle.nn.Linear(4, 16)
+            self.out = paddle.nn.Linear(16, 3)
+
+        def forward(self, xa, xb):
+            return self.out(self.a(xa) + self.b(xb))
+
+    paddle.seed(0)
+    model = TwoIn()
+    ce = paddle.nn.CrossEntropyLoss()
+    eng = Engine(
+        model,
+        inputs_spec=[InputSpec([None, 8], "float32", "xa"),
+                     InputSpec([None, 4], "float32", "xb")],
+        labels_spec=InputSpec([None], "int64", "y"),
+        auto=True, tune=True,
+    )
+    opt = paddle.optimizer.SGD(0.01, parameters=model.parameters())
+    eng.prepare(optimizer=opt, loss=lambda o, y: ce(o, y))
+    assert eng.plan is not None  # tuning ran (or fell back) with 2 inputs
+
+
+def test_cost_model_facade_shares_planner_roofline():
+    from paddle_tpu import cost_model
+    from paddle_tpu.distributed.auto_parallel import planner
+
+    assert cost_model.AnalyticCostModel is planner.CostModel
+    cm = cost_model.CostModel()
+    analytic = cm.analytic(planner.ClusterSpec(n_devices=8))
+    desc = planner.ModelDesc(params=int(1e6), hidden=64, layers=2,
+                             seq_len=32, global_batch=16, vocab=100)
+    cand = planner.Candidate(dp=8, mp=1, pp=1, sep=1, zero_stage=0)
+    cost, breakdown, mem = analytic.estimate(desc, cand)
+    assert cost is not None and cost > 0
+
+
+def test_profile_tuner_interleaved_rounds():
+    """interleave=True times candidates round-robin so load drift across
+    the trial span cannot crown the wrong winner."""
+    import time as _t
+
+    from paddle_tpu.distributed.auto_parallel.tuner import ProfileTuner
+
+    calls = []
+
+    def model_fn(cand):
+        def step(x):
+            calls.append(cand)
+            _t.sleep(0.001 * cand)  # cand = its own cost in ms
+            return x
+
+        return step, (1.0,)
+
+    tuner = ProfileTuner(model_fn, [3, 1, 2], iters=2, interleave=True)
+    best = tuner.tune()
+    assert best == 1
+    assert tuner.best_step is not None
+    # round-robin: after warmups, rounds visit every candidate per round
+    timed = calls[3:]  # skip 3 warmup calls
+    assert timed[:3] == [3, 1, 2] and timed[3:6] == [3, 1, 2]
+    ms = {r["candidate"]: r["ms"] for r in tuner.records}
+    assert ms["1"] < ms["3"]
+
+
+def test_calibration_scale_helper():
+    from paddle_tpu.distributed.auto_parallel.planner import Candidate, Plan
+    from paddle_tpu.distributed.auto_parallel.tuner import calibration_scale
+
+    plans = [Plan(Candidate(dp=8), cost_ms=0.05, breakdown={}, mem_bytes=0),
+             Plan(Candidate(dp=4, zero_stage=2), cost_ms=0.06,
+                  breakdown={}, mem_bytes=0)]
+    records = [{"candidate": str(plans[0].candidate), "ms": 20.0}]
+    scale, line = calibration_scale(records, plans)
+    assert abs(scale - 400.0) < 1e-6
+    assert plans[1].calibrated_ms == 0.06 * 400.0
+    assert "calibration" in line
+    assert calibration_scale([], plans) == (None, None)
