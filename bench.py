@@ -179,8 +179,10 @@ def bench_ps_wire(iters=10, batch=65536, dim=64):
 def bench_gpt_longseq(steps=6, bsz=2, seq=4096):
     """Long-context GPT: seq 4096 through the Pallas flash-attention path —
     the capability the reference lacks (SURVEY §5). Recompute off: 345M at
-    seq 4k fits HBM, and rematerialization costs ~25% (21.2k vs 28.2k
-    tok/s measured); BENCH_RECOMPUTE=1 turns it on for longer contexts."""
+    seq 4k fits HBM, and rematerialization costs ~25%; batch 2 beats 1/4
+    per token and the bq=1024 flash default recovers +4% over the old 512
+    (PROFILE_LONGSEQ.md); BENCH_RECOMPUTE=1 turns recompute on for longer
+    contexts."""
     import jax
     import jax.numpy as jnp
 

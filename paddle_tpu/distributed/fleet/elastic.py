@@ -7,14 +7,15 @@ trainers and re-exec. Env contract kept: PADDLE_ELASTIC_JOB_ID,
 PADDLE_ELASTIC_NP, PADDLE_ELASTIC_TIMEOUT,
 PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL.
 
-TPU-native design: membership lives in a shared registry DIRECTORY (one
-heartbeat file per node) instead of etcd — the same lease semantics
-(mtime = TTL refresh) without an external service, which is also how
-single-host CI exercises it. A JAX collective job cannot re-admit a single
-process into a running coordination service, so fault recovery is
-whole-pod: on any worker death the manager stops the pod, rebuilds it (new
-endpoints if membership changed), and redeploys — the reference does the
-same for collective mode.
+TPU-native design: membership lives in a TCP lease/KV service (`master=`
+— the PS server's KV verbs over the ps_net.h framing, r5) with the same
+register/TTL/watch semantics as the reference's etcd leases; a shared
+registry DIRECTORY (one heartbeat file per node, mtime = TTL refresh)
+remains as the no-network fallback single-host CI exercises. A JAX
+collective job cannot re-admit a single process into a running
+coordination service, so fault recovery is whole-pod: on any worker death
+the manager stops the pod, rebuilds it (new endpoints if membership
+changed), and redeploys — the reference does the same for collective mode.
 """
 from __future__ import annotations
 
@@ -22,7 +23,16 @@ import os
 import time
 from typing import Callable, Optional
 
-__all__ = ["ElasticManager", "ElasticStatus"]
+__all__ = ["ElasticManager", "ElasticStatus", "start_master"]
+
+
+def start_master(port: int = 0):
+    """Start the TCP lease/KV master (one per job — the etcd replacement).
+    Returns the server; its endpoint is 127.0.0.1:server.port locally, or
+    <host-ip>:port across hosts."""
+    from ..ps import PsServer
+
+    return PsServer(port=port, server_id=0, n_servers=1, n_trainers=0)
 
 
 class ElasticStatus:
@@ -51,6 +61,7 @@ class ElasticManager:
         registry_dir: Optional[str] = None,
         heartbeat_ttl: float = 10.0,
         fault_tolerance_level: Optional[int] = None,
+        master: Optional[str] = None,
     ):
         self.pod_builder = pod_builder
         self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID", "default")
@@ -65,36 +76,77 @@ class ElasticManager:
             else int(os.getenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
         )
         self.registry_dir = registry_dir
+        # networked membership: "host:port" of the TCP lease/KV master
+        # (start_master) — true cross-host registry, no shared FS needed
+        self.master = master or os.getenv("PADDLE_ELASTIC_MASTER") or None
+        self._kv = None
         self.restarts = 0
         self.pod = None
         self._node_id = os.getenv("PADDLE_CURRENT_ENDPOINT", f"node-{os.getpid()}")
+
+    def _kv_client(self):
+        if self._kv is None:
+            from ..ps import PsClient
+
+            self._kv = PsClient([self.master])
+        return self._kv
+
+    def _lease_key(self):
+        return f"elastic/{self.job_id}/{self._node_id}"
 
     # --- membership registry (etcd replacement) -------------------------
     def _beat_path(self):
         return os.path.join(self.registry_dir, f"{self.job_id}.{self._node_id}.beat")
 
     def register(self):
-        if self.registry_dir:
+        if self.master:
+            try:
+                self._kv_client().kv_lease(
+                    self._lease_key(), str(os.getpid()), self.heartbeat_ttl
+                )
+            except ConnectionError:
+                # transient master hiccup: the fault-tolerance manager
+                # must not die of one — the next heartbeat retries over a
+                # fresh connection (the client reconnects on demand)
+                pass
+        elif self.registry_dir:
             os.makedirs(self.registry_dir, exist_ok=True)
             with open(self._beat_path(), "w") as f:
                 f.write(str(os.getpid()))
 
     def heartbeat(self):
-        if self.registry_dir:
+        if self.master:
+            self.register()  # re-lease = TTL refresh
+        elif self.registry_dir:
             try:
                 os.utime(self._beat_path())
             except FileNotFoundError:
                 self.register()
 
     def deregister(self):
-        if self.registry_dir:
+        if self.master:
+            try:
+                self._kv_client().kv_del(self._lease_key())
+            except ConnectionError:
+                pass
+        elif self.registry_dir:
             try:
                 os.remove(self._beat_path())
             except FileNotFoundError:
                 pass
 
     def alive_nodes(self):
-        """Nodes whose heartbeat file is fresher than the TTL."""
+        """Nodes whose lease/heartbeat is fresher than the TTL."""
+        if self.master:
+            prefix = f"elastic/{self.job_id}/"
+            try:
+                alive = self._kv_client().kv_alive(prefix)
+            except ConnectionError:
+                # transient master outage: keep the last-known membership
+                # (a missed poll must not masquerade as a rescale)
+                return getattr(self, "_last_members", [])
+            self._last_members = sorted(k[len(prefix):] for k in alive)
+            return self._last_members
         if not self.registry_dir or not os.path.isdir(self.registry_dir):
             return []
         now = time.time()
@@ -144,9 +196,10 @@ class ElasticManager:
                 return 0
             failed = [code for code in codes if code not in (None, 0)]
             now_members = self.alive_nodes()
-            rescale = self.registry_dir and now_members != membership and (
-                self.np_min <= max(len(now_members), 1) <= self.np_max
-            )
+            rescale = (self.registry_dir or self.master) \
+                and now_members != membership and (
+                    self.np_min <= max(len(now_members), 1) <= self.np_max
+                )
             if failed or rescale:
                 if self.level == 0 and failed:
                     self.pod.stop()

@@ -110,7 +110,11 @@ def _parse_args(argv=None):
         description="launch a (multi-host) paddle_tpu training job",
     )
     p.add_argument("--master", default=None,
-                   help="coordination address ip:port (JAX coordination service)")
+                   help="coordination address: ip:port (JAX coordination "
+                        "service), kv://ip:port (TCP lease/KV master — "
+                        "pods DISCOVER each other's endpoints through it, "
+                        "reference launch/controllers/master.py), or "
+                        "'auto' (this node starts the KV master)")
     p.add_argument("--nnodes", type=int, default=int(os.getenv("PADDLE_NNODES", "1")))
     p.add_argument("--rank", type=int, default=int(os.getenv("PADDLE_RANK", "-1")),
                    help="node rank; -1 = from env/auto")
@@ -132,6 +136,47 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _sync_endpoints_via_master(kv_ep: str, args, node_rank: int,
+                               nproc: int, timeout: float = 60.0):
+    """Endpoint discovery through the TCP KV master (reference:
+    launch/controllers/master.py sync_peers over etcd/http): every node
+    publishes its real endpoints under launch/<job>/g<gen>/<rank> and
+    waits until ALL nnodes ranks have — no pre-agreed port scheme.
+
+    The per-build GENERATION keeps an elastic relaunch from adopting the
+    previous build's (now dead) ports: whole-pod fault recovery restarts
+    every node, so the build counters advance in lockstep. Keys are
+    LEASED so a long-lived master doesn't accumulate dead jobs."""
+    from ..compat import find_free_ports
+    from ..ps import PsClient
+
+    kv = PsClient([kv_ep])
+    host = os.getenv("POD_IP", "127.0.0.1")
+    ports = find_free_ports(nproc)
+    if not ports:
+        raise RuntimeError("launch master sync: no free ports")
+    my_eps = [f"{host}:{p}" for p in sorted(ports)]
+    gen = getattr(args, "_kv_gen", 0)
+    key_prefix = f"launch/{args.job_id}/g{gen}/"
+    kv.kv_lease(f"{key_prefix}{node_rank}", ",".join(my_eps),
+                ttl_s=max(timeout * 2, 120.0))
+    t0 = time.time()
+    while True:
+        seen = kv.kv_alive(key_prefix)
+        if all(f"{key_prefix}{r}" in seen for r in range(args.nnodes)):
+            break
+        if time.time() - t0 > timeout:
+            raise TimeoutError(
+                f"launch master sync: {len(seen)}/{args.nnodes} nodes "
+                f"registered within {timeout}s: {sorted(seen)}"
+            )
+        time.sleep(0.2)
+    endpoints = []
+    for r in range(args.nnodes):
+        endpoints.extend(seen[f"{key_prefix}{r}"].split(","))
+    return endpoints
+
+
 def _build_pod_collective(args) -> Pod:
     """reference: controllers/collective.py:32 build_pod."""
     pod = Pod()
@@ -139,13 +184,19 @@ def _build_pod_collective(args) -> Pod:
     node_rank = args.rank if args.rank >= 0 else 0
     nproc = args.nproc_per_node
     world = nnodes * nproc
-    master = args.master or "127.0.0.1:49170"
-    base_port = 49171
-    endpoints = []
-    for node in range(nnodes):
-        host = "127.0.0.1" if nnodes == 1 else f"node{node}"
-        for i in range(nproc):
-            endpoints.append(f"{host}:{base_port + i}")
+    kv_ep = getattr(args, "_kv_master", None)
+    if kv_ep:
+        endpoints = _sync_endpoints_via_master(kv_ep, args, node_rank, nproc)
+        # process-0's endpoint doubles as the JAX coordination address
+        master = endpoints[0]
+    else:
+        master = args.master or "127.0.0.1:49170"
+        base_port = 49171
+        endpoints = []
+        for node in range(nnodes):
+            host = "127.0.0.1" if nnodes == 1 else f"node{node}"
+            for i in range(nproc):
+                endpoints.append(f"{host}:{base_port + i}")
 
     for local in range(nproc):
         rank = node_rank * nproc + local
@@ -205,7 +256,24 @@ def _build_pod_ps(args) -> Pod:
 def launch(argv=None) -> int:
     args = _parse_args(argv)
 
+    # --master auto | kv://host:port: the TCP lease/KV master serves
+    # endpoint discovery (and elastic membership when --max_restart > 0)
+    kv_server = None
+    args._kv_master = None
+    if args.master == "auto":
+        from ..fleet.elastic import start_master
+
+        kv_server = start_master(0)
+        args._kv_master = f"127.0.0.1:{kv_server.port}"
+        print(f"launch: KV master at {args._kv_master}")
+    elif args.master and args.master.startswith("kv://"):
+        args._kv_master = args.master[len("kv://"):]
+
     def build():
+        # per-build generation: elastic relaunches re-discover endpoints
+        # under a fresh KV prefix (whole-pod recovery restarts every node,
+        # so the counters advance in lockstep across hosts)
+        args._kv_gen = getattr(args, "_kv_gen", -1) + 1
         return (
             _build_pod_collective(args)
             if args.run_mode == "collective"
@@ -220,6 +288,7 @@ def launch(argv=None) -> int:
             job_id=args.job_id,
             max_restarts=args.max_restart,
             fault_tolerance_level=args.elastic_level,
+            master=args._kv_master,
         )
         mgr.launch()
 

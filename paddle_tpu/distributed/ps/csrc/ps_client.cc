@@ -65,6 +65,11 @@ struct Client {
       case CMD_CTR_STATS:
       case CMD_SAVE:
       case CMD_LOAD:
+      case CMD_KV_PUT:    // overwrite semantics
+      case CMD_KV_GET:
+      case CMD_KV_DEL:
+      case CMD_KV_LEASE:  // a re-lease is a refresh
+      case CMD_KV_ALIVE:
         return true;
       default:
         return false;
@@ -589,6 +594,66 @@ int ps_client_ctr_stats(void* h, uint32_t table_id, int64_t key,
     return -1;
   std::memcpy(out4, resp.data(), 4 * sizeof(float));
   return 0;
+}
+
+// -- KV / lease verbs (the etcd replacement: elastic membership + launch
+// master endpoint discovery). All route to server 0 — the KV master.
+static int kv_keyed_put(void* h, uint32_t cmd, int64_t n, const char* key,
+                        const char* val, int64_t val_len) {
+  auto* c = static_cast<ps::Client*>(h);
+  int32_t klen = static_cast<int32_t>(std::strlen(key));
+  std::vector<char> payload(4 + klen + val_len);
+  std::memcpy(payload.data(), &klen, 4);
+  std::memcpy(payload.data() + 4, key, klen);
+  if (val_len > 0) std::memcpy(payload.data() + 4 + klen, val, val_len);
+  ps::Header hd{0, cmd, 0, 0, n, static_cast<int64_t>(payload.size())};
+  return c->request(0, hd, payload.data(), nullptr) ? 0 : -1;
+}
+
+int ps_client_kv_put(void* h, const char* key, const char* val,
+                     int64_t val_len) {
+  return kv_keyed_put(h, ps::CMD_KV_PUT, 0, key, val, val_len);
+}
+
+int ps_client_kv_lease(void* h, const char* key, const char* val,
+                       int64_t val_len, int64_t ttl_ms) {
+  return kv_keyed_put(h, ps::CMD_KV_LEASE, ttl_ms, key, val, val_len);
+}
+
+// returns value length (copied into out, up to cap), -1 absent/expired,
+// -2 transport error, -3 value larger than cap
+int64_t ps_client_kv_get(void* h, const char* key, char* out, int64_t cap) {
+  auto* c = static_cast<ps::Client*>(h);
+  ps::Header hd{0, ps::CMD_KV_GET, 0, 0, 0,
+                static_cast<int64_t>(std::strlen(key))};
+  std::vector<char> resp;
+  int64_t n = 0;
+  if (!c->request(0, hd, key, &resp, &n)) return -2;
+  if (n < 0) return -1;
+  if (static_cast<int64_t>(resp.size()) > cap) return -3;
+  std::memcpy(out, resp.data(), resp.size());
+  return static_cast<int64_t>(resp.size());
+}
+
+int ps_client_kv_del(void* h, const char* key) {
+  auto* c = static_cast<ps::Client*>(h);
+  ps::Header hd{0, ps::CMD_KV_DEL, 0, 0, 0,
+                static_cast<int64_t>(std::strlen(key))};
+  return c->request(0, hd, key, nullptr) ? 0 : -1;
+}
+
+// unexpired keys with prefix: key\0value\0... copied into out (up to
+// cap); returns byte length, -2 transport error, -3 overflow
+int64_t ps_client_kv_alive(void* h, const char* prefix, char* out,
+                           int64_t cap) {
+  auto* c = static_cast<ps::Client*>(h);
+  ps::Header hd{0, ps::CMD_KV_ALIVE, 0, 0, 0,
+                static_cast<int64_t>(std::strlen(prefix))};
+  std::vector<char> resp;
+  if (!c->request(0, hd, prefix, &resp)) return -2;
+  if (static_cast<int64_t>(resp.size()) > cap) return -3;
+  if (!resp.empty()) std::memcpy(out, resp.data(), resp.size());
+  return static_cast<int64_t>(resp.size());
 }
 
 int ps_client_stop_servers(void* h) {

@@ -47,6 +47,14 @@ enum Cmd : uint32_t {
   CMD_CTR_STATS = 18,  // show/click/unseen/score for one key (tests)
   CMD_PUSH_PULL_DENSE = 19,  // fused: apply grads, reply updated values
                              // (one round trip instead of push+pull)
+  // KV / lease service (reference: the etcd the elastic manager and the
+  // launch master keep membership + endpoint discovery in —
+  // fleet/elastic/manager.py:130, launch/controllers/master.py)
+  CMD_KV_PUT = 20,    // payload: i32 klen, key, value
+  CMD_KV_GET = 21,    // payload: key; resp: value (n = -1 when absent)
+  CMD_KV_DEL = 22,    // payload: key
+  CMD_KV_LEASE = 23,  // n = ttl_ms; payload: i32 klen, key, value
+  CMD_KV_ALIVE = 24,  // payload: prefix; resp: key\0value\0... unexpired
 };
 
 // flags bits

@@ -16,6 +16,7 @@
 // ps_server_stop / ps_server_destroy.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <map>
 #include <memory>
@@ -104,6 +105,22 @@ struct Server {
   // wait() support
   std::mutex stop_mu;
   std::condition_variable stop_cv;
+
+  // KV / lease store (the etcd replacement for elastic membership and
+  // launch-master endpoint discovery). deadline_ms < 0 = plain put (no
+  // expiry); leases expire by steady-clock comparison at read time.
+  std::mutex kv_mu;
+  struct KvEntry {
+    std::string value;
+    double deadline_ms = -1.0;
+  };
+  std::map<std::string, KvEntry> kv;
+
+  static double now_ms() {
+    return std::chrono::duration<double, std::milli>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
 
   SparseTable* get_sparse(uint32_t id) {
     std::lock_guard<std::mutex> lk(tables_mu);
@@ -423,6 +440,79 @@ struct Server {
           if (h.table_id == 0 || kv.first == h.table_id)
             kv.second->lr = lr;
         reply(fd, h, kStatusOk, nullptr, 0);
+        return true;
+      }
+      case CMD_KV_PUT:
+      case CMD_KV_LEASE: {
+        // payload: i32 klen, key[klen], value[rest]
+        if (payload.size() < 4) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        int32_t klen;
+        std::memcpy(&klen, payload.data(), 4);
+        if (klen < 0 || payload.size() < 4 + static_cast<size_t>(klen)) {
+          reply(fd, h, kStatusErr, nullptr, 0);
+          return true;
+        }
+        std::string key(payload.data() + 4, static_cast<size_t>(klen));
+        std::string val(payload.data() + 4 + klen,
+                        payload.size() - 4 - klen);
+        std::lock_guard<std::mutex> lk(kv_mu);
+        KvEntry& e = kv[key];
+        e.value = std::move(val);
+        e.deadline_ms = h.cmd == CMD_KV_LEASE
+                            ? now_ms() + static_cast<double>(h.n)
+                            : -1.0;
+        reply(fd, h, kStatusOk, nullptr, 0);
+        return true;
+      }
+      case CMD_KV_GET: {
+        std::string key(payload.data(), payload.size());
+        std::lock_guard<std::mutex> lk(kv_mu);
+        auto it = kv.find(key);
+        if (it == kv.end() || (it->second.deadline_ms >= 0 &&
+                               now_ms() > it->second.deadline_ms)) {
+          reply(fd, h, kStatusOk, nullptr, 0, /*n=*/-1);  // absent/expired
+        } else {
+          reply(fd, h, kStatusOk, it->second.value.data(),
+                static_cast<int64_t>(it->second.value.size()), 1);
+        }
+        return true;
+      }
+      case CMD_KV_DEL: {
+        std::string key(payload.data(), payload.size());
+        std::lock_guard<std::mutex> lk(kv_mu);
+        kv.erase(key);
+        reply(fd, h, kStatusOk, nullptr, 0);
+        return true;
+      }
+      case CMD_KV_ALIVE: {
+        // every unexpired key with the prefix: key\0value\0 pairs
+        std::string prefix(payload.data(), payload.size());
+        std::string out;
+        int64_t count = 0;
+        {
+          std::lock_guard<std::mutex> lk(kv_mu);
+          double now = now_ms();
+          for (auto it = kv.begin(); it != kv.end();) {
+            if (it->second.deadline_ms >= 0 &&
+                now > it->second.deadline_ms) {
+              it = kv.erase(it);  // lazy expiry compaction
+              continue;
+            }
+            if (it->first.compare(0, prefix.size(), prefix) == 0) {
+              out += it->first;
+              out.push_back('\0');
+              out += it->second.value;
+              out.push_back('\0');
+              ++count;
+            }
+            ++it;
+          }
+        }
+        reply(fd, h, kStatusOk, out.data(),
+              static_cast<int64_t>(out.size()), count);
         return true;
       }
       case CMD_STOP: {

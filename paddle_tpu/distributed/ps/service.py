@@ -143,6 +143,28 @@ def _load_client_lib():
         lib.ps_client_ctr_stats.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_void_p,
         ]
+        lib.ps_client_kv_put.restype = ctypes.c_int
+        lib.ps_client_kv_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.ps_client_kv_lease.restype = ctypes.c_int
+        lib.ps_client_kv_lease.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.ps_client_kv_get.restype = ctypes.c_int64
+        lib.ps_client_kv_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.ps_client_kv_del.restype = ctypes.c_int
+        lib.ps_client_kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ps_client_kv_alive.restype = ctypes.c_int64
+        lib.ps_client_kv_alive.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
         _client_lib = lib
     return _client_lib
 
@@ -201,6 +223,47 @@ class PsClient:
     def ping(self):
         if self._lib.ps_client_ping(self._h) != 0:
             raise ConnectionError(f"ping failed for {self.endpoints}")
+
+    # -- KV / lease (the etcd replacement: elastic membership + launch
+    # master endpoint discovery; all keys live on server 0) -------------------
+    def kv_put(self, key: str, value: str):
+        v = value.encode()
+        if self._lib.ps_client_kv_put(self._h, key.encode(), v,
+                                      len(v)) != 0:
+            raise ConnectionError(f"kv_put({key}) failed")
+
+    def kv_lease(self, key: str, value: str, ttl_s: float):
+        """Register key with a TTL; re-lease to refresh (etcd lease)."""
+        v = value.encode()
+        if self._lib.ps_client_kv_lease(
+                self._h, key.encode(), v, len(v),
+                int(ttl_s * 1000)) != 0:
+            raise ConnectionError(f"kv_lease({key}) failed")
+
+    def kv_get(self, key: str, cap: int = 1 << 16):
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.ps_client_kv_get(self._h, key.encode(), buf, cap)
+        if n == -1:
+            return None  # absent or lease expired
+        if n < 0:
+            raise ConnectionError(f"kv_get({key}) failed ({n})")
+        return buf.raw[:n].decode()
+
+    def kv_del(self, key: str):
+        if self._lib.ps_client_kv_del(self._h, key.encode()) != 0:
+            raise ConnectionError(f"kv_del({key}) failed")
+
+    def kv_alive(self, prefix: str, cap: int = 1 << 20):
+        """{key: value} for every unexpired key under prefix."""
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.ps_client_kv_alive(self._h, prefix.encode(), buf, cap)
+        if n < 0:
+            raise ConnectionError(f"kv_alive({prefix}) failed ({n})")
+        parts = buf.raw[:n].split(b"\0")
+        out = {}
+        for i in range(0, len(parts) - 1, 2):
+            out[parts[i].decode()] = parts[i + 1].decode()
+        return out
 
     def stop_servers(self):
         self._lib.ps_client_stop_servers(self._h)

@@ -30,10 +30,18 @@ NEG_INF = np.float32(-1e30)
 
 
 def _default_block_q(seq_len: int) -> int:
-    """Measured on v5e: full-row q blocks win at moderate seq; 512 keeps
-    Mosaic compile fast at long seq. Shared by flash_attention and
+    """Measured on v5e (PROFILE_LONGSEQ.md block sweep): bq=1024 beats 512
+    by ~3.4% at seq 4096 (27.9k vs 27.0k tok/s on the 345M unrolled step,
+    and compiles FASTER — 42s vs 54s); 512 only wins past 4k where Mosaic
+    compile time for the wider grid grows. Seqs in (2048, 4096] that
+    1024 does not divide (2560, 3584...) keep 512 — the wider default
+    must never SHRINK the eligible set. Shared by flash_attention and
     supports() so eligibility always mirrors the kernel."""
-    return 1024 if seq_len <= 2048 else 512
+    if seq_len <= 2048:
+        return 1024
+    if seq_len <= 4096 and seq_len % 1024 == 0:
+        return 1024
+    return 512
 _0 = np.int32(0)  # index-map literal; Python ints trace to i64 under x64
 
 

@@ -27,7 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
-VOCAB, HID, LAYERS, HEADS, SEQ = 50304, 1024, 24, 16, 1024
+VOCAB, HID, LAYERS, HEADS = 50304, 1024, 24, 16
+SEQ = int(os.environ.get("BENCH_SEQ", 1024))
 HD = HID // HEADS
 FFN = 4 * HID
 BSZ = int(os.environ.get("BENCH_BATCH", 8))
@@ -70,12 +71,16 @@ def layer_norm(x, g, b):
     return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
 
 
-def make_forward(attn_kind="flash", bq=None, remat=False):
+def make_forward(attn_kind="flash", bq=None, bk=None, remat=False):
     scale = 1.0 / math.sqrt(HD)
 
     def attention(q, k, v):
         if attn_kind == "flash":
-            kw = {"block_q": bq} if bq else {}
+            kw = {}
+            if bq:
+                kw["block_q"] = bq
+            if bk:
+                kw["block_k"] = bk
             return flash_attention(q, k, v, scale=scale, causal=True, **kw)
         # xla_attn: dense softmax attention, XLA-fused
         qf = q.astype(jnp.float32) * scale
@@ -158,13 +163,26 @@ def make_step(forward):
 
 
 def run(variant):
-    kind = "xla_attn" if variant == "xla_attn" else "flash"
-    bq = 512 if variant == "flash_bq512" else None
-    remat = {"remat": "full", "remat_dots": "dots"}.get(variant, None)
-    forward, forward_unrolled = make_forward(kind, bq=bq, remat=remat)
-    step = make_step(
-        forward_unrolled if variant == "unrolled" else forward
-    )
+    # an "unrolled_" prefix selects the python-loop forward (XLA schedules
+    # its own memory; the scan form needs remat to fit long seq)
+    unroll = variant == "unrolled" or variant.startswith("unrolled_")
+    core = variant[len("unrolled_"):] if variant.startswith("unrolled_") \
+        else variant
+    kind = "xla_attn" if core == "xla_attn" else "flash"
+    # block sweeps: flash_bq<N>, flash_bk<N>, flash_bq<N>k<M>
+    bq = bk = None
+    import re as _re
+
+    mm = _re.match(r"flash_bq(\d+)(?:k(\d+))?$", core)
+    if mm:
+        bq = int(mm.group(1))
+        bk = int(mm.group(2)) if mm.group(2) else None
+    mm = _re.match(r"flash_bk(\d+)$", core)
+    if mm:
+        bk = int(mm.group(1))
+    remat = {"remat": "full", "remat_dots": "dots"}.get(core, None)
+    forward, forward_unrolled = make_forward(kind, bq=bq, bk=bk, remat=remat)
+    step = make_step(forward_unrolled if unroll else forward)
 
     key = jax.random.PRNGKey(0)
     p = init_params(key)
@@ -184,11 +202,17 @@ def run(variant):
     loss, p, m, v, t = step(p, m, v, t, x, y)
     float(loss)
 
-    t1 = time.time()
-    for _ in range(STEPS):
-        loss, p, m, v, t = step(p, m, v, t, x, y)
-    last = float(loss)
-    dt = time.time() - t1
+    # min-of-REPS windows: the relay's ambient congestion only slows a
+    # window down (PROFILE_EAGER.md)
+    reps = int(os.environ.get("BENCH_REPS", 2))
+    dt = float("inf")
+    last = first
+    for _ in range(max(1, reps)):
+        t1 = time.time()
+        for _ in range(STEPS):
+            loss, p, m, v, t = step(p, m, v, t, x, y)
+        last = float(loss)
+        dt = min(dt, time.time() - t1)
     tps = BSZ * SEQ * STEPS / dt
     print(f"{variant}: {tps:,.0f} tok/s | {dt / STEPS * 1e3:.1f} ms/step | "
           f"first loss {first:.3f} -> {last:.3f} | compile {compile_s:.0f}s")
