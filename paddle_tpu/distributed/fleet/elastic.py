@@ -98,17 +98,35 @@ class ElasticManager:
     def _beat_path(self):
         return os.path.join(self.registry_dir, f"{self.job_id}.{self._node_id}.beat")
 
+    def _master_error(self, what: str):
+        """Transient master hiccups are survivable; PERSISTENT failure
+        (wrong address) must be visible — warn after 3 consecutive
+        failures and at most once a minute after that."""
+        self._kv_fails = getattr(self, "_kv_fails", 0) + 1
+        now = time.time()
+        last = getattr(self, "_kv_warned_at", 0.0)
+        if self._kv_fails >= 3 and now - last > 60.0:
+            import warnings
+
+            warnings.warn(
+                f"elastic: {self._kv_fails} consecutive {what} failures "
+                f"against KV master {self.master} — membership/rescale is "
+                "inert until it becomes reachable"
+            )
+            self._kv_warned_at = now
+
     def register(self):
         if self.master:
             try:
                 self._kv_client().kv_lease(
                     self._lease_key(), str(os.getpid()), self.heartbeat_ttl
                 )
+                self._kv_fails = 0
             except ConnectionError:
                 # transient master hiccup: the fault-tolerance manager
                 # must not die of one — the next heartbeat retries over a
                 # fresh connection (the client reconnects on demand)
-                pass
+                self._master_error("lease")
         elif self.registry_dir:
             os.makedirs(self.registry_dir, exist_ok=True)
             with open(self._beat_path(), "w") as f:
@@ -136,15 +154,20 @@ class ElasticManager:
                 pass
 
     def alive_nodes(self):
-        """Nodes whose lease/heartbeat is fresher than the TTL."""
+        """Nodes whose lease/heartbeat is fresher than the TTL. Master
+        mode returns None when the master is unreachable AND no poll ever
+        succeeded — 'no signal yet' must be distinguishable from empty
+        membership, or a slow-starting master reads as a rescale."""
         if self.master:
             prefix = f"elastic/{self.job_id}/"
             try:
                 alive = self._kv_client().kv_alive(prefix)
             except ConnectionError:
-                # transient master outage: keep the last-known membership
-                # (a missed poll must not masquerade as a rescale)
-                return getattr(self, "_last_members", [])
+                self._master_error("membership poll")
+                # transient outage: last-known membership (None = never
+                # successfully polled)
+                return getattr(self, "_last_members", None)
+            self._kv_fails = 0
             self._last_members = sorted(k[len(prefix):] for k in alive)
             return self._last_members
         if not self.registry_dir or not os.path.isdir(self.registry_dir):
@@ -196,7 +219,14 @@ class ElasticManager:
                 return 0
             failed = [code for code in codes if code not in (None, 0)]
             now_members = self.alive_nodes()
+            if now_members is None:
+                # master unreachable and never successfully polled: no
+                # membership signal — treat as unchanged, never a rescale
+                now_members = membership
+            elif membership is None:
+                membership = now_members  # first successful poll baselines
             rescale = (self.registry_dir or self.master) \
+                and now_members is not None \
                 and now_members != membership and (
                     self.np_min <= max(len(now_members), 1) <= self.np_max
                 )
@@ -210,6 +240,16 @@ class ElasticManager:
                 self.restarts += 1
                 membership = now_members
                 self.pod.stop()
-                self.pod = self.pod_builder()
-                self.pod.deploy()
+                try:
+                    self.pod = self.pod_builder()
+                    self.pod.deploy()
+                except Exception as e:
+                    # a failed rebuild (e.g. endpoint-discovery timeout
+                    # while peers are still coming back) consumes this
+                    # restart and retries on the next loop turn — it must
+                    # not kill the fault-tolerance manager itself
+                    import warnings
+
+                    warnings.warn(f"elastic: pod rebuild failed ({e}); "
+                                  f"retry {self.restarts}/{self.max_restarts}")
             time.sleep(self.watch_interval)

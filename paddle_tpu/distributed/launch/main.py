@@ -136,17 +136,22 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+# a node's discovery key stays alive only while ITS sync loop refreshes
+# it: a dead or relaunched build's stale endpoints age out within this
+# window instead of being adopted by a rebuilding peer
+_SYNC_TTL_S = 15.0
+
+
 def _sync_endpoints_via_master(kv_ep: str, args, node_rank: int,
                                nproc: int, timeout: float = 60.0):
     """Endpoint discovery through the TCP KV master (reference:
     launch/controllers/master.py sync_peers over etcd/http): every node
-    publishes its real endpoints under launch/<job>/g<gen>/<rank> and
-    waits until ALL nnodes ranks have — no pre-agreed port scheme.
-
-    The per-build GENERATION keeps an elastic relaunch from adopting the
-    previous build's (now dead) ports: whole-pod fault recovery restarts
-    every node, so the build counters advance in lockstep. Keys are
-    LEASED so a long-lived master doesn't accumulate dead jobs."""
+    publishes its real endpoints under launch/<job>/<rank> with a SHORT
+    lease it keeps refreshing while waiting, and completes when all
+    nnodes ranks are simultaneously alive — no pre-agreed port scheme,
+    and no cross-host build counters to drift (a crashed build stops
+    refreshing, so its stale ports expire within _SYNC_TTL_S; a
+    rebuilding node simply waits for its peers' fresh keys)."""
     from ..compat import find_free_ports
     from ..ps import PsClient
 
@@ -156,12 +161,11 @@ def _sync_endpoints_via_master(kv_ep: str, args, node_rank: int,
     if not ports:
         raise RuntimeError("launch master sync: no free ports")
     my_eps = [f"{host}:{p}" for p in sorted(ports)]
-    gen = getattr(args, "_kv_gen", 0)
-    key_prefix = f"launch/{args.job_id}/g{gen}/"
-    kv.kv_lease(f"{key_prefix}{node_rank}", ",".join(my_eps),
-                ttl_s=max(timeout * 2, 120.0))
+    key_prefix = f"launch/{args.job_id}/"
+    my_key = f"{key_prefix}{node_rank}"
     t0 = time.time()
     while True:
+        kv.kv_lease(my_key, ",".join(my_eps), ttl_s=_SYNC_TTL_S)
         seen = kv.kv_alive(key_prefix)
         if all(f"{key_prefix}{r}" in seen for r in range(args.nnodes)):
             break
@@ -270,10 +274,6 @@ def launch(argv=None) -> int:
         args._kv_master = args.master[len("kv://"):]
 
     def build():
-        # per-build generation: elastic relaunches re-discover endpoints
-        # under a fresh KV prefix (whole-pod recovery restarts every node,
-        # so the counters advance in lockstep across hosts)
-        args._kv_gen = getattr(args, "_kv_gen", -1) + 1
         return (
             _build_pod_collective(args)
             if args.run_mode == "collective"

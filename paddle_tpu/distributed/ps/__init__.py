@@ -33,6 +33,7 @@ __all__ = [
     "MemorySparseTable", "SparseEmbedding", "TheOnePSRuntime",
     "PsServer", "PsClient", "DistributedSparseTable",
     "GeoDistributedSparseTable", "DenseTableHandle", "Communicator",
+    "SparsePipeline",
 ]
 
 _lib = None
@@ -534,6 +535,7 @@ from .service import (  # noqa: E402,F401
     GeoDistributedSparseTable,
     PsClient,
     PsServer,
+    SparsePipeline,
 )
 from . import the_one_ps  # noqa: E402,F401
 from . import utils  # noqa: E402,F401

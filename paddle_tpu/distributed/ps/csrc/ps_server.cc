@@ -458,32 +458,46 @@ struct Server {
         std::string key(payload.data() + 4, static_cast<size_t>(klen));
         std::string val(payload.data() + 4 + klen,
                         payload.size() - 4 - klen);
-        std::lock_guard<std::mutex> lk(kv_mu);
-        KvEntry& e = kv[key];
-        e.value = std::move(val);
-        e.deadline_ms = h.cmd == CMD_KV_LEASE
-                            ? now_ms() + static_cast<double>(h.n)
-                            : -1.0;
+        {
+          // never hold kv_mu across the reply socket write: a stalled
+          // client would block every other node's heartbeat past its TTL
+          std::lock_guard<std::mutex> lk(kv_mu);
+          KvEntry& e = kv[key];
+          e.value = std::move(val);
+          e.deadline_ms = h.cmd == CMD_KV_LEASE
+                              ? now_ms() + static_cast<double>(h.n)
+                              : -1.0;
+        }
         reply(fd, h, kStatusOk, nullptr, 0);
         return true;
       }
       case CMD_KV_GET: {
         std::string key(payload.data(), payload.size());
-        std::lock_guard<std::mutex> lk(kv_mu);
-        auto it = kv.find(key);
-        if (it == kv.end() || (it->second.deadline_ms >= 0 &&
-                               now_ms() > it->second.deadline_ms)) {
+        std::string val;
+        bool found = false;
+        {
+          std::lock_guard<std::mutex> lk(kv_mu);
+          auto it = kv.find(key);
+          if (it != kv.end() && !(it->second.deadline_ms >= 0 &&
+                                  now_ms() > it->second.deadline_ms)) {
+            val = it->second.value;  // copy; reply happens unlocked
+            found = true;
+          }
+        }
+        if (!found) {
           reply(fd, h, kStatusOk, nullptr, 0, /*n=*/-1);  // absent/expired
         } else {
-          reply(fd, h, kStatusOk, it->second.value.data(),
-                static_cast<int64_t>(it->second.value.size()), 1);
+          reply(fd, h, kStatusOk, val.data(),
+                static_cast<int64_t>(val.size()), 1);
         }
         return true;
       }
       case CMD_KV_DEL: {
         std::string key(payload.data(), payload.size());
-        std::lock_guard<std::mutex> lk(kv_mu);
-        kv.erase(key);
+        {
+          std::lock_guard<std::mutex> lk(kv_mu);
+          kv.erase(key);
+        }
         reply(fd, h, kStatusOk, nullptr, 0);
         return true;
       }

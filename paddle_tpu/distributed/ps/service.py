@@ -29,6 +29,7 @@ __all__ = [
     "GeoDistributedSparseTable",
     "DenseTableHandle",
     "Communicator",
+    "SparsePipeline",
 ]
 
 _CSRC = os.path.join(os.path.dirname(__file__), "csrc")
@@ -678,3 +679,44 @@ class Communicator:
             self._q.put(None)
             self._thread.join()
             self._thread = None
+
+
+class SparsePipeline:
+    """Overlap host PS traffic with device compute — the training-loop
+    half of the reference's async Communicator (communicator.h: pulls for
+    the NEXT minibatch and queued pushes run while the accelerator
+    executes the current step; the PSGPU trainer pipelines the same way,
+    framework/trainer.h:253).
+
+    Semantics: async-PS — a prefetched pull may miss pushes still in
+    flight (staleness ≤ `queue` steps), exactly the reference's async
+    mode. `flush()` drains pushes (the barrier point, e.g. before eval
+    or checkpoint).
+
+    Works over any table with pull(keys)/push(keys, grads) — the
+    in-process MemorySparseTable (SSD-backed or not) or the wire-backed
+    DistributedSparseTable."""
+
+    def __init__(self, table, max_queue: int = 8):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.table = table
+        # one worker per direction: pulls must not queue behind pushes
+        self._pull_pool = ThreadPoolExecutor(1)
+        self._push = Communicator(table, mode="async", max_queue=max_queue)
+
+    def prefetch(self, keys: np.ndarray):
+        """Start pulling rows for a FUTURE step; returns a future whose
+        .result() is the [n, dim] row block."""
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        return self._pull_pool.submit(self.table.pull, keys)
+
+    def push_async(self, keys: np.ndarray, grads: np.ndarray):
+        self._push.push(keys, grads)
+
+    def flush(self):
+        self._push.flush()
+
+    def stop(self):
+        self._push.stop()
+        self._pull_pool.shutdown(wait=True)
