@@ -210,7 +210,7 @@ def bench_gpt_longseq(steps=6, bsz=2, seq=4096):
     float(step(x, y))
     float(step(x, y))
     dt = _timed(lambda: step(x, y), steps)
-    return {"metric": "gpt2_345m_seq4096_tokens_per_sec_per_chip",
+    return {"metric": f"gpt2_345m_seq{seq}_tokens_per_sec_per_chip",
             "value": round(bsz * seq * steps / dt, 1), "unit": "tokens/s/chip"}
 
 

@@ -350,3 +350,39 @@ def test_pipelined_chunked_pull_push_parity():
                                    rtol=1e-6)
     finally:
         c.stop_servers()
+
+
+def test_kv_service_concurrent_clients():
+    """r5 KV/lease verbs under concurrency: parallel clients lease/put/
+    read without deadlock (regression for the reply-under-lock hazard).
+    Daemon threads + bounded joins: a recurrence must FAIL fast, not hang
+    the suite."""
+    import threading
+
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    srv = PsServer(port=0, server_id=0, n_servers=1, n_trainers=0)
+    errs = []
+    try:
+        def worker(wid):
+            try:
+                c = PsClient([f"127.0.0.1:{srv.port}"])
+                for i in range(50):
+                    c.kv_lease(f"stress/{wid}", f"v{i}", ttl_s=5.0)
+                    c.kv_put(f"plain/{wid}/{i % 5}", "x" * 100)
+                    alive = c.kv_alive("stress/")
+                    assert f"stress/{wid}" in alive
+                    assert c.kv_get(f"plain/{wid}/{i % 5}") == "x" * 100
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(w,), daemon=True)
+              for w in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in ts), "kv workers deadlocked"
+        assert not errs, errs
+    finally:
+        srv.stop()
