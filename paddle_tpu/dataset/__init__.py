@@ -16,14 +16,18 @@ from . import (  # noqa: F401
     cifar,
     common,
     conll05,
+    flowers,
+    image,
     imdb,
     imikolov,
     mnist,
     movielens,
     uci_housing,
+    voc2012,
     wmt14,
     wmt16,
 )
 
 __all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens",
-           "uci_housing", "common", "wmt14", "wmt16", "conll05"]
+           "uci_housing", "common", "wmt14", "wmt16", "conll05",
+           "flowers", "voc2012", "image"]

@@ -1,0 +1,78 @@
+"""reference: python/paddle/dataset/flowers.py — Oxford 102-flowers
+readers: train/test/valid yield (CHW float image, label) after the
+mapper (resize_short 256 → crop 224 ± flip). Synthetic-backed
+(zero-egress) with the exact mapper pipeline and sample contract; the
+`cycle` and `use_xmap` knobs behave like the reference's.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import image as _image
+from .. import reader as _reader_mod
+
+__all__ = ["train", "test", "valid"]
+
+NUM_CLASSES = 102
+
+
+def default_mapper(is_train, sample):
+    """bytes-free variant of the reference's mapper: the synthetic reader
+    already yields decoded HWC uint8, so only the geometric transform
+    runs (resize_short 256 → 224 crop ± flip → CHW float)."""
+    img, label = sample
+    img = _image.simple_transform(
+        img, 256, 224, is_train, mean=[103.94, 116.78, 123.68]
+    )
+    return img.flatten(), label  # simple_transform already yields float32
+
+
+train_mapper = functools.partial(default_mapper, True)
+test_mapper = functools.partial(default_mapper, False)
+
+
+def _synthetic_images(count, seed):
+    rng = np.random.default_rng(seed)
+    for i in range(count):
+        h = int(rng.integers(260, 320))
+        w = int(rng.integers(260, 320))
+        img = rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+        label = int(rng.integers(1, NUM_CLASSES + 1))  # labels are 1-based
+        yield img, label
+
+
+def reader_creator(dataset_name, mapper, buffered_size=1024,
+                   use_xmap=True, cycle=False, count=64):
+    seed = {"trnid": 10, "tstid": 11, "valid": 12}[dataset_name]
+
+    def reader():
+        while True:
+            for sample in _synthetic_images(count, seed):
+                yield sample
+            if not cycle:
+                break
+
+    if use_xmap:
+        return _reader_mod.xmap_readers(mapper, reader, 4, buffered_size)
+    return _reader_mod.map_readers(mapper, reader)
+
+
+def train(mapper=train_mapper, buffered_size=1024, use_xmap=True,
+          cycle=False):
+    """Each sample: (flattened CHW float32 image, 1-based label)."""
+    return reader_creator("trnid", mapper, buffered_size, use_xmap, cycle)
+
+
+def test(mapper=test_mapper, buffered_size=1024, use_xmap=True,
+         cycle=False):
+    return reader_creator("tstid", mapper, buffered_size, use_xmap, cycle)
+
+
+def valid(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    return reader_creator("valid", mapper, buffered_size, use_xmap)
+
+
+def fetch():
+    return None

@@ -1,0 +1,98 @@
+"""reference: python/paddle/dataset/image.py — numpy/cv2 image utilities
+(resize_short, crops, flip, simple_transform, CHW conversion) feeding the
+legacy readers. Pure-numpy here (no cv2 dependency): load_image decodes
+through paddle's own decode path when given bytes of a real format, and
+the geometric transforms are exact numpy equivalents.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform",
+]
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode encoded image bytes → HWC uint8 (same PIL decode path as
+    vision.ops.decode_jpeg, without the Tensor round trip)."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(bytes_))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color=is_color)
+
+
+def _resize(im, h, w):
+    """Nearest-neighbor resize (numpy-only stand-in for cv2.resize)."""
+    sh, sw = im.shape[:2]
+    ys = (np.arange(h) * sh / h).astype(np.int64).clip(0, sh - 1)
+    xs = (np.arange(w) * sw / w).astype(np.int64).clip(0, sw - 1)
+    return im[ys][:, xs]
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals `size` (reference image.py:202)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _resize(im, size, int(round(w * size / h)))
+    return _resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, h - size + 1)
+    w0 = np.random.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1, :] if (is_color and im.ndim == 3) else im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short → crop(±flip when training) → CHW float32 (−mean)
+    (reference image.py:332)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

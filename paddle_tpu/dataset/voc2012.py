@@ -1,0 +1,60 @@
+"""reference: python/paddle/dataset/voc2012.py — VOC2012 segmentation
+readers: train/test/val yield (HWC uint8 image, HW uint8 label map) with
+the 0-20 class palette plus 255 = void. Synthetic-backed (zero-egress)
+with the exact pair contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+NUM_CLASSES = 21  # 20 object classes + background
+VOID_LABEL = 255
+
+
+def _pairs(count, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(count):
+        h = int(rng.integers(120, 220))
+        w = int(rng.integers(120, 220))
+        img = rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+        # blocky label map: a few rectangles of random classes over
+        # background, a thin void border like the real annotations
+        label = np.zeros((h, w), np.uint8)
+        for _k in range(int(rng.integers(1, 4))):
+            cls = int(rng.integers(1, NUM_CLASSES))
+            y0, x0 = int(rng.integers(0, h // 2)), int(rng.integers(0, w // 2))
+            y1 = int(rng.integers(y0 + 1, h))
+            x1 = int(rng.integers(x0 + 1, w))
+            label[y0:y1, x0:x1] = cls
+            if y1 - y0 > 2 and x1 - x0 > 2:
+                label[y0, x0:x1] = VOID_LABEL
+        yield img, label
+
+
+def reader_creator(sub_name, count=48):
+    seed = {"trainval": 20, "train": 21, "val": 22}[sub_name]
+
+    def reader():
+        for img, label in _pairs(count, seed):
+            yield img, label
+
+    return reader
+
+
+def train(count: int = 48):
+    """Each sample: (HWC uint8 image, HW uint8 segmentation label)."""
+    return reader_creator("trainval", count)
+
+
+def test(count: int = 48):
+    return reader_creator("train", count)
+
+
+def val(count: int = 48):
+    return reader_creator("val", count)
+
+
+def fetch():
+    return None

@@ -327,3 +327,77 @@ def test_multiprocess_reader_early_exit_is_fast():
     assert next(it) is not None
     it.close()
     assert time.time() - t0 < 4.0
+
+
+# -- round-5 tail: flowers / voc2012 / image utilities ------------------------
+def test_image_transform_pipeline():
+    from paddle_tpu.dataset import image as dimg
+
+    im = np.arange(300 * 400 * 3, dtype=np.uint8).reshape(300, 400, 3)
+    r = dimg.resize_short(im, 256)
+    assert min(r.shape[:2]) == 256 and r.shape[0] == 256
+    c = dimg.center_crop(r, 224)
+    assert c.shape[:2] == (224, 224)
+    f = dimg.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    out = dimg.simple_transform(im, 256, 224, is_train=False,
+                                mean=[103.94, 116.78, 123.68])
+    assert out.shape == (3, 224, 224) and out.dtype == np.float32
+    tr = dimg.simple_transform(im, 256, 224, is_train=True)
+    assert tr.shape == (3, 224, 224)
+
+
+def test_image_load_bytes_roundtrip(tmp_path):
+    import io
+
+    from PIL import Image
+
+    from paddle_tpu.dataset import image as dimg
+
+    arr = np.zeros((32, 48, 3), np.uint8)
+    arr[:, :, 0] = 200
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    out = dimg.load_image_bytes(buf.getvalue())
+    np.testing.assert_array_equal(out, arr)
+    gray = dimg.load_image_bytes(buf.getvalue(), is_color=False)
+    assert gray.ndim == 2
+    p = tmp_path / "x.png"
+    p.write_bytes(buf.getvalue())
+    np.testing.assert_array_equal(dimg.load_image(str(p)), arr)
+
+
+def test_flowers_reader_contract():
+    from paddle_tpu.dataset import flowers
+
+    n = 0
+    for img, label in flowers.test(use_xmap=False)():
+        assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+        assert 1 <= label <= flowers.NUM_CLASSES
+        n += 1
+        if n >= 4:
+            break
+    assert n == 4
+    # xmap path produces the same contract
+    s = next(iter(flowers.train()()))
+    assert s[0].shape == (3 * 224 * 224,)
+
+
+def test_voc2012_reader_contract():
+    from paddle_tpu.dataset import voc2012
+
+    samples = list(voc2012.val(count=6)())
+    assert len(samples) == 6
+    for img, label in samples:
+        assert img.ndim == 3 and img.dtype == np.uint8
+        assert label.shape == img.shape[:2] and label.dtype == np.uint8
+        classes = set(np.unique(label)) - {voc2012.VOID_LABEL}
+        assert classes <= set(range(voc2012.NUM_CLASSES))
+    # deterministic: identical content on re-read
+    again = list(voc2012.val(count=6)())
+    np.testing.assert_array_equal(again[0][0], samples[0][0])
+    np.testing.assert_array_equal(again[0][1], samples[0][1])
+    # split-distinct: val and train draw from different seeds
+    tr = next(iter(voc2012.train(count=6)()))
+    assert tr[0].shape != samples[0][0].shape or not np.array_equal(
+        tr[0], samples[0][0])
