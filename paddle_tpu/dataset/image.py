@@ -1,8 +1,9 @@
 """reference: python/paddle/dataset/image.py — numpy/cv2 image utilities
 (resize_short, crops, flip, simple_transform, CHW conversion) feeding the
-legacy readers. Pure-numpy here (no cv2 dependency): load_image decodes
-through paddle's own decode path when given bytes of a real format, and
-the geometric transforms are exact numpy equivalents.
+legacy readers. No cv2 dependency: decode goes through PIL (the same path
+as vision.ops.decode_jpeg), resize_short through vision's bilinear
+jax.image resize (matching cv2's default interpolation), and the crop/
+flip/normalize transforms are exact numpy equivalents.
 """
 from __future__ import annotations
 
@@ -32,20 +33,13 @@ def load_image(file, is_color=True):
         return load_image_bytes(f.read(), is_color=is_color)
 
 
-def _resize(im, h, w):
-    """Nearest-neighbor resize (numpy-only stand-in for cv2.resize)."""
-    sh, sw = im.shape[:2]
-    ys = (np.arange(h) * sh / h).astype(np.int64).clip(0, sh - 1)
-    xs = (np.arange(w) * sw / w).astype(np.int64).clip(0, sw - 1)
-    return im[ys][:, xs]
-
-
 def resize_short(im, size):
-    """Scale so the SHORT side equals `size` (reference image.py:202)."""
-    h, w = im.shape[:2]
-    if h < w:
-        return _resize(im, size, int(round(w * size / h)))
-    return _resize(im, int(round(h * size / w)), size)
+    """Scale so the SHORT side equals `size` (reference image.py:202 uses
+    cv2's default bilinear) — delegates to vision's bilinear resize
+    (jax.image), one implementation for both surfaces."""
+    from ..vision.transforms_functional import resize as _v_resize
+
+    return np.asarray(_v_resize(im, int(size), interpolation="bilinear"))
 
 
 def to_chw(im, order=(2, 0, 1)):

@@ -30,7 +30,7 @@ from ...core.tensor import Tensor
 from ...nn.layer_base import Layer
 
 __all__ = [
-    "MemorySparseTable", "SparseEmbedding", "TheOnePSRuntime",
+    "MemorySparseTable", "GraphTable", "SparseEmbedding", "TheOnePSRuntime",
     "PsServer", "PsClient", "DistributedSparseTable",
     "GeoDistributedSparseTable", "DenseTableHandle", "Communicator",
     "SparsePipeline",
@@ -48,7 +48,8 @@ def _load_lib():
         src = os.path.join(csrc, "memory_sparse_table.cc")
         _lib = cpp_extension.load(
             "ps_table", [src],
-            depends=[os.path.join(csrc, "ps_sparse_table.h")],
+            depends=[os.path.join(csrc, "ps_sparse_table.h"),
+                     os.path.join(csrc, "graph_table.h")],
         )
         _lib.ps_table_create.restype = ctypes.c_void_p
         _lib.ps_table_create.argtypes = [
@@ -92,6 +93,40 @@ def _load_lib():
         _lib.ps_table_ram_size.argtypes = [ctypes.c_void_p]
         _lib.ps_table_disk_size.restype = ctypes.c_int64
         _lib.ps_table_disk_size.argtypes = [ctypes.c_void_p]
+        _lib.ps_graph_create.restype = ctypes.c_void_p
+        _lib.ps_graph_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ]
+        _lib.ps_graph_destroy.argtypes = [ctypes.c_void_p]
+        _lib.ps_graph_add_edges.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _lib.ps_graph_set_node_feat.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        _lib.ps_graph_get_node_feat.restype = ctypes.c_int64
+        _lib.ps_graph_get_node_feat.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        _lib.ps_graph_degree.restype = ctypes.c_int64
+        _lib.ps_graph_degree.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _lib.ps_graph_sample_neighbors.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib.ps_graph_random_sample_nodes.restype = ctypes.c_int64
+        _lib.ps_graph_random_sample_nodes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+        _lib.ps_graph_node_count.restype = ctypes.c_int64
+        _lib.ps_graph_node_count.argtypes = [ctypes.c_void_p]
+        _lib.ps_graph_edge_count.restype = ctypes.c_int64
+        _lib.ps_graph_edge_count.argtypes = [ctypes.c_void_p]
     return _lib
 
 
@@ -236,6 +271,99 @@ class MemorySparseTable:
     def load(self, path: str):
         if self._lib.ps_table_load(self._h, path.encode()) != 0:
             raise IOError(f"loading sparse table from {path} failed")
+
+
+class GraphTable:
+    """Sharded host graph store with neighbor sampling — the storage side
+    of the GNN pipeline (reference: ps/table/common_graph_table.h +
+    the graph service the PSGPU trainer samples from). The compute side
+    is paddle.incubate.graph_sample_neighbors / graph_send_recv /
+    graph_reindex over the sampled subgraph."""
+
+    def __init__(self, shard_num: int = 16, feat_dim: int = 0,
+                 seed: int = 0):
+        self.feat_dim = int(feat_dim)
+        self._lib = _load_lib()
+        self._h = self._lib.ps_graph_create(
+            int(shard_num), self.feat_dim, ctypes.c_uint64(seed)
+        )
+        self._calls = 0
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ps_graph_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def add_edges(self, src, dst, weights=None):
+        src = np.ascontiguousarray(src, np.int64).reshape(-1)
+        dst = np.ascontiguousarray(dst, np.int64).reshape(-1)
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        wp = 0
+        if weights is not None:
+            weights = np.ascontiguousarray(weights, np.float32).reshape(-1)
+            if weights.size != src.size:
+                raise ValueError("weights length mismatch")
+            wp = weights.ctypes.data
+        self._lib.ps_graph_add_edges(
+            self._h, src.ctypes.data, dst.ctypes.data, wp, src.size
+        )
+
+    def set_node_feat(self, ids, feats):
+        if self.feat_dim <= 0:
+            raise ValueError("GraphTable built with feat_dim=0")
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        feats = np.ascontiguousarray(feats, np.float32).reshape(
+            ids.size, self.feat_dim
+        )
+        self._lib.ps_graph_set_node_feat(
+            self._h, ids.ctypes.data, ids.size, feats.ctypes.data
+        )
+
+    def get_node_feat(self, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((ids.size, self.feat_dim), np.float32)
+        self._lib.ps_graph_get_node_feat(
+            self._h, ids.ctypes.data, ids.size, out.ctypes.data
+        )
+        return out
+
+    def degree(self, node: int) -> int:
+        return int(self._lib.ps_graph_degree(self._h, int(node)))
+
+    def sample_neighbors(self, ids, k: int, weighted: bool = False):
+        """(neighbors [n, k] padded with -1, counts [n]). Uniform mode
+        samples WITHOUT replacement (k >= degree returns the whole
+        neighborhood); weighted mode draws by edge weight with
+        replacement — the reference's two sampling modes."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        nbrs = np.empty((ids.size, int(k)), np.int64)
+        cnt = np.empty(ids.size, np.int32)
+        self._calls += 1
+        self._lib.ps_graph_sample_neighbors(
+            self._h, ids.ctypes.data, ids.size, int(k),
+            1 if weighted else 0, ctypes.c_uint64(self._calls),
+            nbrs.ctypes.data, cnt.ctypes.data,
+        )
+        return nbrs, cnt
+
+    def random_sample_nodes(self, count: int) -> np.ndarray:
+        out = np.empty(int(count), np.int64)
+        self._calls += 1
+        m = self._lib.ps_graph_random_sample_nodes(
+            self._h, int(count), ctypes.c_uint64(self._calls),
+            out.ctypes.data,
+        )
+        return out[:m]
+
+    def node_count(self) -> int:
+        return int(self._lib.ps_graph_node_count(self._h))
+
+    def edge_count(self) -> int:
+        return int(self._lib.ps_graph_edge_count(self._h))
 
 
 class SparseEmbedding(Layer):

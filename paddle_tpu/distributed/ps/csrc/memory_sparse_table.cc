@@ -8,8 +8,10 @@
 //
 // Build: g++ -O3 -std=c++17 -shared -fPIC memory_sparse_table.cc -o libps_table.so -lpthread
 
+#include "graph_table.h"
 #include "ps_sparse_table.h"
 
+using ps::GraphTable;
 using ps::SparseTable;
 
 extern "C" {
@@ -91,6 +93,54 @@ int64_t ps_table_ram_size(void* h) {
 
 int64_t ps_table_disk_size(void* h) {
   return static_cast<SparseTable*>(h)->disk_size();
+}
+
+// -- graph table (reference: ps/table/common_graph_table.h) -----------------
+void* ps_graph_create(int shard_num, int feat_dim, uint64_t seed) {
+  return new GraphTable(shard_num, feat_dim, seed);
+}
+
+void ps_graph_destroy(void* h) { delete static_cast<GraphTable*>(h); }
+
+void ps_graph_add_edges(void* h, const int64_t* src, const int64_t* dst,
+                        const float* w, int64_t n) {
+  static_cast<GraphTable*>(h)->add_edges(src, dst, w, n);
+}
+
+void ps_graph_set_node_feat(void* h, const int64_t* ids, int64_t n,
+                            const float* feats) {
+  static_cast<GraphTable*>(h)->set_node_feat(ids, n, feats);
+}
+
+int64_t ps_graph_get_node_feat(void* h, const int64_t* ids, int64_t n,
+                               float* out) {
+  return static_cast<GraphTable*>(h)->get_node_feat(ids, n, out);
+}
+
+int64_t ps_graph_degree(void* h, int64_t id) {
+  return static_cast<GraphTable*>(h)->degree(id);
+}
+
+void ps_graph_sample_neighbors(void* h, const int64_t* ids, int64_t n,
+                               int k, int weighted, uint64_t call_seed,
+                               int64_t* out_nbrs, int32_t* out_cnt) {
+  static_cast<GraphTable*>(h)->sample_neighbors(ids, n, k, weighted != 0,
+                                                call_seed, out_nbrs,
+                                                out_cnt);
+}
+
+int64_t ps_graph_random_sample_nodes(void* h, int64_t count,
+                                     uint64_t call_seed, int64_t* out) {
+  return static_cast<GraphTable*>(h)->random_sample_nodes(count, call_seed,
+                                                          out);
+}
+
+int64_t ps_graph_node_count(void* h) {
+  return static_cast<GraphTable*>(h)->node_count();
+}
+
+int64_t ps_graph_edge_count(void* h) {
+  return static_cast<GraphTable*>(h)->edge_count();
 }
 
 }  // extern "C"
