@@ -14,16 +14,21 @@
 #include <mutex>
 #include <random>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace ps {
 
 struct GraphNodeEntry {
-  // neighbors with cumulative weights: weighted sampling is one binary
-  // search per draw (the reference builds alias tables; cumulative sums
-  // are simpler and equally O(log d))
+  // neighbors with LAZY cumulative weights: cumw stays empty for
+  // unweighted graphs (the common GNN case — 8 bytes/edge, and weighted
+  // sampling degenerates to uniform-with-replacement); the first
+  // weighted edge materializes 1.0-prefix sums for what came before.
+  // Weighted sampling is one binary search per draw (the reference
+  // builds alias tables; cumulative sums are simpler and equally
+  // O(log d)).
   std::vector<int64_t> nbrs;
-  std::vector<float> cumw;  // inclusive prefix sums of edge weights
+  std::vector<float> cumw;  // inclusive prefix sums; empty = all-1.0
   std::vector<float> feat;  // optional per-node feature vector
 };
 
@@ -59,17 +64,27 @@ struct GraphTable {
     return it->second;
   }
 
-  // append directed edges src->dst with weights (nullptr = all 1.0)
+  // append directed edges src->dst with weights (nullptr = all 1.0,
+  // stored weight-free)
   void add_edges(const int64_t* src, const int64_t* dst, const float* w,
                  int64_t n) {
     for (int64_t i = 0; i < n; ++i) {
       GraphShardT& sh = shards[shard_of(src[i])];
       std::lock_guard<std::mutex> lk(sh.mu);
       GraphNodeEntry& e = ensure(sh, src[i]);
-      float wi = w ? w[i] : 1.0f;
-      float base = e.cumw.empty() ? 0.f : e.cumw.back();
+      if (w != nullptr && e.cumw.empty() && !e.nbrs.empty()) {
+        // first weighted edge after unweighted ones: materialize the
+        // implicit all-1.0 prefix for the existing neighbors
+        e.cumw.resize(e.nbrs.size());
+        for (size_t j = 0; j < e.nbrs.size(); ++j)
+          e.cumw[j] = static_cast<float>(j + 1);
+      }
       e.nbrs.push_back(dst[i]);
-      e.cumw.push_back(base + (wi > 0.f ? wi : 0.f));
+      if (w != nullptr || !e.cumw.empty()) {
+        float wi = w ? w[i] : 1.0f;
+        float base = e.cumw.empty() ? 0.f : e.cumw.back();
+        e.cumw.push_back(base + (wi > 0.f ? wi : 0.f));
+      }
     }
   }
 
@@ -139,6 +154,14 @@ struct GraphTable {
         continue;
       }
       if (weighted) {
+        if (e.cumw.empty()) {
+          // unweighted node: weighted semantics = uniform WITH
+          // replacement, no prefix array needed
+          std::uniform_int_distribution<int> pick(0, d - 1);
+          for (int j = 0; j < k; ++j) row[j] = e.nbrs[pick(gen)];
+          out_cnt[i] = k;
+          continue;
+        }
         const float total = e.cumw.back();
         if (total <= 0.f) {
           // every edge weight was <= 0: nothing is samplable (a clamped
@@ -153,6 +176,20 @@ struct GraphTable {
           int idx = static_cast<int>(pos - e.cumw.begin());
           if (idx >= d) idx = d - 1;
           row[j] = e.nbrs[idx];
+        }
+        out_cnt[i] = k;
+      } else if (k * 4 < d) {
+        // hub nodes, k << d: Floyd's distinct-sample — O(k) memory and
+        // draws, no O(degree) scratch per call
+        std::unordered_set<int> sel;
+        sel.reserve(static_cast<size_t>(k) * 2);
+        int j2 = 0;
+        for (int j = d - k; j < d; ++j) {
+          std::uniform_int_distribution<int> pick(0, j);
+          int t = pick(gen);
+          int chosen = sel.count(t) ? j : t;
+          sel.insert(chosen);
+          row[j2++] = e.nbrs[chosen];
         }
         out_cnt[i] = k;
       } else {
@@ -186,7 +223,7 @@ struct GraphTable {
     if (total == 0) return 0;
     std::mt19937_64 gen(seed ^ call_seed);
     const int64_t m = std::min(count, total);
-    std::unordered_map<int64_t, bool> taken;  // global index -> drawn
+    std::unordered_set<int64_t> taken;  // drawn global indices
     int64_t written = 0;
     // rejection on duplicates: cheap while m << total, and bounded by
     // the classic coupon argument otherwise (m == total degenerates to
@@ -197,8 +234,7 @@ struct GraphTable {
     while (written < m && attempts < max_attempts) {
       ++attempts;
       int64_t g = pick(gen);
-      if (taken.count(g)) continue;
-      taken[g] = true;
+      if (!taken.insert(g).second) continue;
       size_t s = static_cast<size_t>(
           std::upper_bound(prefix.begin(), prefix.end(), g) -
           prefix.begin());
