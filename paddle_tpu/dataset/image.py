@@ -36,19 +36,12 @@ def load_image(file, is_color=True):
 def resize_short(im, size):
     """Scale so the SHORT side equals `size` (reference image.py:202 uses
     cv2's default bilinear) — delegates to vision's bilinear resize
-    (jax.image), one implementation for both surfaces. Preserves the
-    input dtype like cv2.resize (integer dtypes round)."""
+    (jax.image), one implementation for both surfaces; dtype preservation
+    lives there too."""
     from ..vision.transforms_functional import resize as _v_resize
 
-    im = np.asarray(im)
-    out = np.asarray(_v_resize(im, int(size), interpolation="bilinear"))
-    if out.dtype != im.dtype:
-        if np.issubdtype(im.dtype, np.integer):
-            info = np.iinfo(im.dtype)
-            out = np.clip(np.rint(out), info.min, info.max).astype(im.dtype)
-        else:
-            out = out.astype(im.dtype)
-    return out
+    return np.asarray(_v_resize(np.asarray(im), int(size),
+                                interpolation="bilinear"))
 
 
 def to_chw(im, order=(2, 0, 1)):

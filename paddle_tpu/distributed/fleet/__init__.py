@@ -194,11 +194,16 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     return optimizer
 
 
-def distributed_train_step(model, loss_fn, optimizer):
+def distributed_train_step(model, loss_fn, optimizer, grad_input_idx=()):
     """Build the compiled hybrid-parallel train step for the current
     strategy/mesh — the single API that replaces the reference's
     fleet.distributed_model(...).train_batch / minimize pipeline.
-    With pp_degree > 1 this is the pipelined (GPipe-over-ppermute) step."""
+    With pp_degree > 1 this is the pipelined (GPipe-over-ppermute) step.
+
+    grad_input_idx: batch positions to ALSO differentiate — their grads
+    return to the caller (the PS sparse path: pulled rows in, row grads
+    out, pushed to the host table). Not supported with pipeline
+    parallelism or strategy.auto."""
     from ...parallel.sharding import sharded_train_step
     from ...parallel.topology import axis_size
 
@@ -256,9 +261,21 @@ def distributed_train_step(model, loss_fn, optimizer):
             model, (strategy.recompute_configs or {}).get("checkpoints") or []
         )
     if strategy.auto:
+        if grad_input_idx:
+            raise ValueError(
+                "grad_input_idx is not supported with strategy.auto (the "
+                "planner may choose a pipeline config, which has no "
+                "input-grad contract); build with sharded_train_step "
+                "directly"
+            )
         return _AutoPlannedStep(model, loss_fn, optimizer, strategy,
                                 forward_ctx, accumulate_steps)
     pp = axis_size("pp")
+    if pp > 1 and grad_input_idx:
+        raise ValueError(
+            "grad_input_idx is not supported with pp_degree > 1 (the "
+            "pipelined step has no input-grad contract)"
+        )
     if pp > 1:
         from ...parallel.pipeline import pipelined_train_step
 
@@ -281,6 +298,7 @@ def distributed_train_step(model, loss_fn, optimizer):
         model, loss_fn, optimizer, zero_stage=strategy.sharding_stage,
         forward_ctx=forward_ctx, accumulate_steps=accumulate_steps,
         loss_scale=_static_loss_scale(strategy),
+        grad_input_idx=grad_input_idx,
     )
 
 

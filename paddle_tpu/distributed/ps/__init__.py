@@ -127,6 +127,10 @@ def _load_lib():
         _lib.ps_graph_node_count.argtypes = [ctypes.c_void_p]
         _lib.ps_graph_edge_count.restype = ctypes.c_int64
         _lib.ps_graph_edge_count.argtypes = [ctypes.c_void_p]
+        _lib.ps_graph_save.restype = ctypes.c_int
+        _lib.ps_graph_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib.ps_graph_load.restype = ctypes.c_int
+        _lib.ps_graph_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     return _lib
 
 
@@ -364,6 +368,16 @@ class GraphTable:
 
     def edge_count(self) -> int:
         return int(self._lib.ps_graph_edge_count(self._h))
+
+    def save(self, path: str):
+        if self._lib.ps_graph_save(self._h, str(path).encode()) != 0:
+            raise IOError(f"saving graph table to {path} failed")
+
+    def load(self, path: str):
+        """Restore replaces the whole graph (same contract as the sparse
+        tables); feat_dim must match the checkpoint's."""
+        if self._lib.ps_graph_load(self._h, str(path).encode()) != 0:
+            raise IOError(f"loading graph table from {path} failed")
 
 
 class SparseEmbedding(Layer):

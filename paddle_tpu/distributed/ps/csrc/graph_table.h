@@ -10,6 +10,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <random>
@@ -258,6 +259,113 @@ struct GraphTable {
       }
     }
     return written;
+  }
+
+  // checkpoint format: magic, feat_dim, node count, then per node:
+  // id, n_nbrs, nbrs[], has_cumw, [cumw[]], n_feat, [feat[]]
+  // (reference: common_graph_table's load/save over edge/feature files)
+  bool save(const char* path) {
+    // write-to-temp + rename: a failed/interrupted save must never
+    // destroy the previous good checkpoint
+    std::string tmp = std::string(path) + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    const uint32_t magic = 0x47545631;  // "GTV1"
+    int64_t n = node_count();
+    bool ok = std::fwrite(&magic, 4, 1, f) == 1 &&
+              std::fwrite(&feat_dim, 4, 1, f) == 1 &&
+              std::fwrite(&n, 8, 1, f) == 1;
+    for (auto& sh : shards) {
+      if (!ok) break;
+      std::lock_guard<std::mutex> lk(sh.mu);
+      for (int64_t id : sh.ids) {
+        const GraphNodeEntry& e = sh.map.at(id);
+        int64_t nn = static_cast<int64_t>(e.nbrs.size());
+        uint8_t has_w = e.cumw.empty() ? 0 : 1;
+        int32_t nf = static_cast<int32_t>(e.feat.size());
+        ok = ok && std::fwrite(&id, 8, 1, f) == 1 &&
+             std::fwrite(&nn, 8, 1, f) == 1 &&
+             (nn == 0 || std::fwrite(e.nbrs.data(), 8, nn, f) ==
+                             static_cast<size_t>(nn)) &&
+             std::fwrite(&has_w, 1, 1, f) == 1 &&
+             (!has_w || std::fwrite(e.cumw.data(), 4, nn, f) ==
+                            static_cast<size_t>(nn)) &&
+             std::fwrite(&nf, 4, 1, f) == 1 &&
+             (nf == 0 || std::fwrite(e.feat.data(), 4, nf, f) ==
+                             static_cast<size_t>(nf));
+        if (!ok) break;
+      }
+    }
+    ok = (std::fclose(f) == 0) && ok;
+    if (ok) ok = std::rename(tmp.c_str(), path) == 0;
+    if (!ok) std::remove(tmp.c_str());
+    return ok;
+  }
+
+  bool load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    // file size bounds every on-disk count: a corrupt header must fail
+    // with `false`, never with a bad_alloc escaping the C ABI
+    std::fseek(f, 0, SEEK_END);
+    const long fsize = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    uint32_t magic = 0;
+    int fdim = 0;
+    int64_t n = 0;
+    if (fsize < 16 || std::fread(&magic, 4, 1, f) != 1 ||
+        magic != 0x47545631 || std::fread(&fdim, 4, 1, f) != 1 ||
+        fdim != feat_dim || std::fread(&n, 8, 1, f) != 1 || n < 0 ||
+        n > fsize) {
+      std::fclose(f);
+      return false;
+    }
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      sh.map.clear();
+      sh.ids.clear();
+    }
+    bool ok = true;
+    for (int64_t i = 0; i < n && ok; ++i) {
+      int64_t id = 0, nn = 0;
+      uint8_t has_w = 0;
+      int32_t nf = 0;
+      GraphNodeEntry e;
+      ok = std::fread(&id, 8, 1, f) == 1 && std::fread(&nn, 8, 1, f) == 1 &&
+           nn >= 0 && nn <= fsize / 8;
+      if (ok && nn > 0) {
+        e.nbrs.resize(static_cast<size_t>(nn));
+        ok = std::fread(e.nbrs.data(), 8, nn, f) ==
+             static_cast<size_t>(nn);
+      }
+      ok = ok && std::fread(&has_w, 1, 1, f) == 1;
+      if (ok && has_w) {
+        e.cumw.resize(static_cast<size_t>(nn));
+        ok = std::fread(e.cumw.data(), 4, nn, f) ==
+             static_cast<size_t>(nn);
+      }
+      ok = ok && std::fread(&nf, 4, 1, f) == 1 && nf >= 0 &&
+           nf <= fsize / 4;
+      if (ok && nf > 0) {
+        e.feat.resize(static_cast<size_t>(nf));
+        ok = std::fread(e.feat.data(), 4, nf, f) ==
+             static_cast<size_t>(nf);
+      }
+      if (ok) {
+        GraphShardT& sh = shards[shard_of(id)];
+        std::lock_guard<std::mutex> lk(sh.mu);
+        sh.map[id] = std::move(e);
+        sh.ids.push_back(id);
+      }
+    }
+    std::fclose(f);
+    if (!ok)  // truncated checkpoint: fail loudly with an empty table
+      for (auto& sh : shards) {
+        std::lock_guard<std::mutex> lk(sh.mu);
+        sh.map.clear();
+        sh.ids.clear();
+      }
+    return ok;
   }
 
   int64_t node_count() {

@@ -143,4 +143,12 @@ int64_t ps_graph_edge_count(void* h) {
   return static_cast<GraphTable*>(h)->edge_count();
 }
 
+int ps_graph_save(void* h, const char* path) {
+  return static_cast<GraphTable*>(h)->save(path) ? 0 : -1;
+}
+
+int ps_graph_load(void* h, const char* path) {
+  return static_cast<GraphTable*>(h)->load(path) ? 0 : -1;
+}
+
 }  // extern "C"

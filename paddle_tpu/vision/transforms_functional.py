@@ -113,8 +113,14 @@ def resize(img, size, interpolation="bilinear"):
     out = np.asarray(
         jax.image.resize(arr.astype(np.float32), (oh, ow, arr.shape[2]), order)
     )
-    if arr.dtype == np.uint8:
-        out = np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    # preserve the input dtype like cv2/PIL resize: integer images (uint8
+    # pixels, int label/ID maps) round and clip into range instead of
+    # silently becoming float32
+    if np.issubdtype(arr.dtype, np.integer):
+        info = np.iinfo(arr.dtype)
+        out = np.clip(np.rint(out), info.min, info.max).astype(arr.dtype)
+    elif out.dtype != arr.dtype:
+        out = out.astype(arr.dtype)
     if squeeze:
         out = out[:, :, 0]
     return _restore(out, kind)

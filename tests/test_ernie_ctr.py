@@ -174,3 +174,33 @@ def test_sparse_pipeline_prefetch_and_flush():
         assert not np.allclose(after, rows)  # push applied before flush ret
     finally:
         pipe.stop()
+
+
+def test_fleet_wrapper_grad_input_idx():
+    """fleet.distributed_train_step exposes the PS input-grad contract."""
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 3}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    m = paddle.nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(0.0, parameters=m.parameters())
+    step = fleet.distributed_train_step(
+        m, lambda o, y: paddle.mean((o.squeeze(-1) - y) ** 2), opt,
+        grad_input_idx=(0,),
+    )
+    rng = np.random.default_rng(0)
+    rows = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal(16).astype(np.float32))
+    loss, (g,) = step(rows, y)
+    assert tuple(g.shape) == (16, 4) and np.isfinite(g.numpy()).all()
+
+    # auto rejects it loudly
+    s2 = fleet.DistributedStrategy()
+    s2.auto = True
+    fleet.init(is_collective=True, strategy=s2)
+    with pytest.raises(ValueError, match="strategy.auto"):
+        fleet.distributed_train_step(m, None, opt, grad_input_idx=(0,))

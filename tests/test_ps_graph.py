@@ -171,3 +171,65 @@ def test_weighted_edges_after_unweighted_materialize():
     # weight 100 vs 1+1: node 3 dominates but 1/2 are still possible
     assert draws.count(3) / len(draws) > 0.9
     assert set(draws) <= {1, 2, 3}
+
+
+def test_graph_save_load_roundtrip(tmp_path):
+    t = GraphTable(shard_num=8, feat_dim=4, seed=2)
+    t.add_edges([0, 0, 1], [1, 2, 2], weights=[2.0, 1.0, 5.0])
+    t.add_edges([3], [0])  # unweighted node coexists
+    t.set_node_feat([0, 2], np.arange(8, dtype=np.float32).reshape(2, 4))
+    ckpt = str(tmp_path / "graph.bin")
+    t.save(ckpt)
+
+    t2 = GraphTable(shard_num=4, feat_dim=4, seed=9)  # different sharding
+    t2.load(ckpt)
+    assert t2.node_count() == t.node_count()
+    assert t2.edge_count() == t.edge_count()
+    assert t2.degree(0) == 2 and t2.degree(3) == 1
+    np.testing.assert_array_equal(t2.get_node_feat([0, 2]),
+                                  t.get_node_feat([0, 2]))
+    # weighted distribution survives (node 1 -> only nbr 2)
+    nbrs, cnt = t2.sample_neighbors([1], k=4, weighted=True)
+    assert cnt[0] == 4 and set(nbrs[0].tolist()) == {2}
+    # feat_dim mismatch fails loudly
+    with pytest.raises(IOError):
+        GraphTable(feat_dim=8).load(ckpt)
+    # load replaces prior contents
+    t3 = GraphTable(shard_num=2, feat_dim=4)
+    t3.add_edges([99], [98])
+    t3.load(ckpt)
+    assert t3.degree(99) == 0 and t3.node_count() == t.node_count()
+
+
+def test_graph_load_rejects_corrupt_checkpoint(tmp_path):
+    # review r5: corrupt counts must fail with IOError, never a C++ abort
+    import struct
+
+    t = GraphTable(shard_num=4, feat_dim=0)
+    bad = tmp_path / "bad.bin"
+    # valid header, then a node whose neighbor count is absurd
+    bad.write_bytes(struct.pack("<IiQ", 0x47545631, 0, 1)
+                    + struct.pack("<qq", 7, 1 << 60))
+    with pytest.raises(IOError):
+        t.load(str(bad))
+    assert t.node_count() == 0  # failed load leaves an empty table
+    # truncated mid-record also fails loudly
+    t2 = GraphTable(shard_num=4, feat_dim=0)
+    t2.add_edges([1], [2])
+    ok = tmp_path / "ok.bin"
+    t2.save(str(ok))
+    (tmp_path / "trunc.bin").write_bytes(ok.read_bytes()[:-4])
+    with pytest.raises(IOError):
+        t2.load(str(tmp_path / "trunc.bin"))
+
+
+def test_graph_save_failure_keeps_previous_checkpoint(tmp_path):
+    # write-to-temp + rename: a failed save must not clobber the old file
+    t = GraphTable(shard_num=4)
+    t.add_edges([1], [2])
+    ckpt = tmp_path / "g.bin"
+    t.save(str(ckpt))
+    before = ckpt.read_bytes()
+    with pytest.raises(IOError):
+        t.save(str(tmp_path / "no" / "such" / "dir" / "g.bin"))
+    assert ckpt.read_bytes() == before
