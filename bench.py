@@ -314,6 +314,33 @@ def bench_mnist_eager(steps=30, bsz=64):
     # op): use more windows so at least one lands in a quiet period
     dt = _timed(eager_step, steps,
                 reps=int(os.environ.get("BENCH_REPS", 4)))
+
+    # programs-per-step accounting (PROFILE_EAGER.md arithmetic): count one
+    # steady-state step per mode via the dispatch counters, and time a lazy
+    # window for comparison. '#'-prefixed on stderr — the one-JSON-line
+    # stdout contract stays intact.
+    import paddle_tpu.profiler as prof
+
+    prof.reset_dispatch_counters()
+    float(eager_step())
+    per_op_programs = prof.dispatch_counters()["programs"]
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    try:
+        for _ in range(3):  # warm the segment/tape/optimizer compile caches
+            loss = eager_step()
+        float(loss)
+        prof.reset_dispatch_counters()
+        float(eager_step())
+        lazy_programs = prof.dispatch_counters()["programs"]
+        lazy_dt = _timed(eager_step, steps,
+                         reps=int(os.environ.get("BENCH_REPS", 4)))
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    print(f"# mnist eager programs/step: per-op={per_op_programs} "
+          f"lazy={lazy_programs} (FLAGS_eager_lazy_dispatch); "
+          f"lazy {round(steps / lazy_dt, 1)} steps/s",
+          file=sys.stderr)
+
     return {"metric": "mnist_lenet_eager_steps_per_sec",
             "value": round(steps / dt, 1), "unit": "steps/s"}
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -36,10 +37,64 @@ __all__ = [
     "set_grad_enabled",
     "GradNode",
     "run_backward",
+    "dispatch_counters",
+    "reset_dispatch_counters",
 ]
 
 _tls = threading.local()
 _amp = None  # lazily bound paddle_tpu.amp module (circular at import time)
+
+
+def _amp_module():
+    global _amp
+    if _amp is None:
+        from .. import amp as _amp_mod
+
+        _amp = _amp_mod
+    return _amp
+
+
+# ---------------------------------------------------------------------------
+# Dispatch counters: device-program launches by category, lazy-segment flush
+# accounting, and compile-cache hit/miss/eviction counts. Readable via
+# paddle_tpu.profiler.dispatch_counters(). Program counts are one per
+# dispatched call (op / segment flush / backward sweep / fused optimizer
+# update) — the unit PROFILE_EAGER.md's relay-turnaround arithmetic uses.
+# ---------------------------------------------------------------------------
+_counters: Dict[str, Any] = {}
+
+
+def reset_dispatch_counters():
+    _counters.clear()
+    _counters.update(
+        programs=0,
+        op_programs=0,
+        segment_programs=0,
+        backward_programs=0,
+        optimizer_programs=0,
+        segments_flushed=0,
+        lazy_ops_deferred=0,
+        segment_cache_hits=0,
+        segment_cache_misses=0,
+        segment_cache_evictions=0,
+        jit_cache_evictions=0,
+        vjp_cache_evictions=0,
+        flush_reasons={},
+    )
+
+
+reset_dispatch_counters()
+
+
+def _count_program(kind: str = "op"):
+    _counters["programs"] += 1
+    _counters[kind + "_programs"] += 1
+
+
+def dispatch_counters() -> Dict[str, Any]:
+    out = dict(_counters)
+    out["flush_reasons"] = dict(_counters["flush_reasons"])
+    return out
 
 
 def _grad_state():
@@ -94,9 +149,31 @@ def enable_grad(func=None):
 
 
 # ---------------------------------------------------------------------------
-# Per-op compile cache (the PHI KernelFactory analogue: kernel_factory.h:230)
+# Per-op compile cache (the PHI KernelFactory analogue: kernel_factory.h:230).
+# LRU-bounded by FLAGS_eager_jit_cache_size: long-running eager sessions with
+# many op/static-kwarg combos must not grow compile caches (and their live
+# jax.jit wrappers) without bound. Oldest entries evict first, counted.
 # ---------------------------------------------------------------------------
-_jit_cache: Dict[Tuple, Callable] = {}
+_jit_cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
+
+
+def _lru_get(store: OrderedDict, key):
+    hit = store.get(key)
+    if hit is not None:
+        store.move_to_end(key)
+    return hit
+
+
+def _lru_put(store: OrderedDict, key, value, evict_counter: Optional[str] = None,
+             cap: Optional[int] = None):
+    store[key] = value
+    if cap is None:
+        cap = int(flags.flag("eager_jit_cache_size"))
+    if cap > 0:
+        while len(store) > cap:
+            store.popitem(last=False)
+            if evict_counter is not None:
+                _counters[evict_counter] += 1
 
 
 def _cache_token(fn: Callable):
@@ -139,12 +216,12 @@ def _jitted(fn: Callable, kw_items: Tuple, token=None) -> Optional[Callable]:
         return None
     key = (token, kw_items)
     try:
-        cached = _jit_cache.get(key)
+        cached = _lru_get(_jit_cache, key)
     except TypeError:  # unhashable static kwarg — run unjitted
         return None
     if cached is None:
         cached = jax.jit(functools.partial(fn, **dict(kw_items)))
-        _jit_cache[key] = cached
+        _lru_put(_jit_cache, key, cached, "jit_cache_evictions")
     return cached
 
 
@@ -162,7 +239,7 @@ def _hashable(v):
 # shapes) and later calls replay one compiled program. The closure's
 # application is likewise jitted (_apply_vjp), cached by residual structure.
 # ---------------------------------------------------------------------------
-_vjp_cache: Dict[Tuple, Callable] = {}
+_vjp_cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
 
 
 def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token,
@@ -178,7 +255,9 @@ def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token,
         except AttributeError:
             pass  # token without __dict__ — fall back to the global store
     try:
-        cached = store.get(key)
+        cached = (
+            _lru_get(store, key) if store is _vjp_cache else store.get(key)
+        )
     except TypeError:
         return None
     if cached is None:
@@ -195,7 +274,10 @@ def _jitted_vjp(fn: Callable, kw_items: Tuple, diff_idx: Tuple, token,
             return jax.vjp(partial_fn, *[all_vals[i] for i in diff_idx])
 
         cached = jax.jit(run)
-        store[key] = cached
+        if store is _vjp_cache:
+            _lru_put(store, key, cached, "vjp_cache_evictions")
+        else:
+            store[key] = cached
     return cached
 
 
@@ -303,12 +385,31 @@ def apply(
     else:
         kw_items = ()
 
+    # deferred-execution mode: append the op to the pending per-thread
+    # segment instead of launching a program (see core/lazy.py). Ops the
+    # segment can't host fall through to the per-op path below (the lazy
+    # layer flushes first, preserving program order).
+    if flags.flag("eager_lazy_dispatch"):
+        out = _lazy.lazy_apply(
+            fn,
+            args,
+            kw_items,
+            op_name=op_name,
+            differentiable=differentiable,
+            jit=jit,
+            cache_token=cache_token,
+        )
+        if out is not _lazy._FALLBACK:
+            return out
+
     # one pass over args: unwrap values AND find differentiable positions
     vals = []
     diff_idx: List[int] = []
     for i, a in enumerate(args):
         if isinstance(a, Tensor):
             v = a._value
+            if type(v) is _lazy.LazyRef:
+                v = v.materialize()
             vals.append(v)
             if not a.stop_gradient and getattr(v, "dtype", None) in _FLOAT_DTYPES:
                 diff_idx.append(i)
@@ -316,12 +417,7 @@ def apply(
             vals.append(a)
 
     # AMP O1 input casting (reference: tracer.cc:222-240 AMP auto-cast)
-    global _amp
-    if _amp is None:
-        from .. import amp as _amp_mod
-
-        _amp = _amp_mod
-    if _amp.amp_active():
+    if _amp_module().amp_active():
         vals = _amp.maybe_cast_inputs(
             op_name or getattr(fn, "__name__", "op"), vals
         )
@@ -340,6 +436,7 @@ def apply(
             out_vals = jfn(*vals)
         else:
             out_vals = fn(*vals, **dict(kw_items))
+        _count_program("op")
         return _wrap_outputs(out_vals, stop_gradient=True, node=None)
 
     # run the recorded primal through a CACHED forward+vjp program when the
@@ -379,6 +476,7 @@ def apply(
     else:
         out_vals, vjp_fn = jax.vjp(partial_fn, *[vals[i] for i in diff_idx])
         is_jit_vjp = False
+    _count_program("op")
 
     # AMP O1 casts inputs (e.g. fp32 weight → bf16) before the op; the
     # reference records the cast op so its backward restores fp32 grads
@@ -583,6 +681,7 @@ def _try_compiled_tape_backward(root, seed_val) -> bool:
         _tape_bwd_cache[key] = fn
     vjp_fns = [n.vjp_fn for n in order_nodes]
     leaf_vals = fn(vjp_fns, seed_val)
+    _count_program("backward")
     for t, g in zip(leaf_tensors, leaf_vals):
         if g is None:
             continue
@@ -623,6 +722,10 @@ def run_backward(
     double-grad ops (e.g. matmul_double_grad) without writing any of them.
     """
     from .tensor import Tensor
+
+    # backward is a materialization point: the pending forward segment (and
+    # any lazy grad_tensors) must be concrete before the sweep reads values
+    _lazy.flush_if_pending("backward")
 
     roots: List[Tensor] = list(tensors)
     if grad_tensors is None:
@@ -802,6 +905,7 @@ def run_backward(
                 in_grads = _apply_vjp(node.vjp_fn, packed)
             else:
                 in_grads = node.vjp_fn(packed)
+            _count_program("backward")
             if create_graph:
                 # no primal fn (PyLayer / AMP-recast): grads are correct but
                 # constant w.r.t. further differentiation
@@ -852,3 +956,8 @@ def run_backward(
     if want_inputs is not None:
         return leaf_grads
     return None
+
+
+# imported last: lazy.py only references dispatch internals from inside its
+# functions, so the cycle resolves here without a partial-module hazard
+from . import lazy as _lazy  # noqa: E402

@@ -84,6 +84,27 @@ define_flag(
     "compile the whole eager backward sweep into one cached XLA program",
 )
 define_flag(
+    "eager_lazy_dispatch", False,
+    "defer eager ops onto a pending per-thread segment and flush whole "
+    "segments as ONE jitted program at materialization points (host reads, "
+    "backward, device.synchronize); cached by segment signature",
+)
+define_flag(
+    "eager_jit_cache_size", 4096,
+    "LRU cap on the per-op jit / vjp compile caches and the lazy-dispatch "
+    "output-aval metadata cache (0 = unbounded); oldest entries evict "
+    "first, compile-cache evictions are counted",
+)
+define_flag(
+    "eager_segment_cache_size", 256,
+    "LRU cap on the lazy-dispatch segment compile cache (0 = unbounded)",
+)
+define_flag(
+    "eager_segment_max_ops", 256,
+    "flush a pending lazy-dispatch segment once it reaches this many ops "
+    "(bounds trace length and compile time of one fused segment)",
+)
+define_flag(
     "use_standalone_executor", True, "use the compiled whole-program executor path"
 )
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")

@@ -1,0 +1,538 @@
+"""Deferred (lazy) eager dispatch: batch per-op launches into fused segments.
+
+The per-op eager path (dispatch.apply) launches one XLA program per op, so
+an eager LeNet train step costs ~13 device-program round-trips — and
+PROFILE_EAGER.md shows the program *count*, not host Python, is the ceiling
+on eager throughput through the relay. This module is the classic
+LazyTensor-style fix proven by torch-xla (XLATensor + pending IR graph,
+torch_xla/csrc/tensor.cpp) and by the reference's own to_static tracing:
+
+  - with FLAGS_eager_lazy_dispatch on, `apply()` does not execute: the op is
+    appended to a per-thread pending *segment* and the caller gets a Tensor
+    backed by a `LazyRef` (shape/dtype known via jax.eval_shape, value
+    pending);
+  - materialization points — host reads (numpy/item/float/bool), backward,
+    explicit paddle_tpu.device.synchronize(), uncacheable/jit=False ops, a
+    mid-segment AMP region — flush the whole pending segment as ONE jitted
+    program;
+  - the compiled segment is cached by *segment signature* (sequence of op
+    cache-tokens + static kwargs + input bindings + external input avals),
+    so a steady-state eager train step replays a cached fused executable:
+    1 forward segment + 1 compiled-tape backward + 1 fused optimizer update.
+
+Autograd composes unchanged: recorded ops get their GradNode at defer time
+(so later ops snapshot correct Edges), and the segment program computes each
+recorded op's jax.vjp *inside the fused trace* — at flush the pytree vjp
+closures come back as concrete residuals and are slotted into the pending
+GradNodes, which then behave exactly like per-op-path nodes (including the
+compiled-tape backward and create_graph re-derivation).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags
+
+__all__ = ["LazyRef", "flush_if_pending", "materialize", "pending_op_count"]
+
+# sentinel returned by lazy_apply when the op must take the per-op path
+_FALLBACK = object()
+
+_tls = threading.local()
+
+# binding kinds inside a segment: op input comes from an external array, a
+# previous op's output, or an embedded python-scalar literal
+_EXT, _RES, _LIT = 0, 1, 2
+
+
+def _np_dtype(dt):
+    """np.dtype when possible; jax extended dtypes (PRNG keys, float8 wrap
+    types) pass through as-is — they are hashable and aval-comparable."""
+    try:
+        return np.dtype(dt)
+    except TypeError:
+        return dt
+
+
+class LazyRef:
+    """Pending value of one output of one deferred op.
+
+    Carries the inferred aval so shape/dtype-dependent control flow does NOT
+    flush; any other attribute access (or numpy/jax conversion) materializes
+    by flushing the owning segment. After the flush `_concrete` holds the
+    real array and all access delegates to it.
+    """
+
+    __slots__ = (
+        "_segment",
+        "_op_index",
+        "_out_index",
+        "_shape",
+        "_dtype",
+        "_concrete",
+        "__weakref__",
+    )
+
+    def __init__(self, segment, op_index, out_index, shape, dtype):
+        self._segment = segment
+        self._op_index = op_index
+        self._out_index = out_index
+        self._shape = tuple(shape)
+        self._dtype = _np_dtype(dtype)
+        self._concrete = None
+
+    # -- aval surface (no flush) -------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    # -- materialization ----------------------------------------------------
+    def materialize(self):
+        if self._concrete is None:
+            _flush(self._segment, "sync")
+            if self._concrete is None:
+                # the owning segment's flush failed earlier (compile or
+                # runtime error): surface the root cause on every read
+                # instead of silently yielding None
+                raise RuntimeError(
+                    "lazy-dispatch segment flush failed; this tensor's value "
+                    "is unavailable"
+                ) from self._segment.error
+        return self._concrete
+
+    def __getattr__(self, name):
+        # anything beyond the aval surface needs the real array
+        return getattr(self.materialize(), name)
+
+    def __jax_array__(self):
+        return self.materialize()
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(jax.device_get(self.materialize()))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __repr__(self):
+        state = "pending" if self._concrete is None else "materialized"
+        return f"<LazyRef {state} shape={self._shape} dtype={self._dtype}>"
+
+
+def _delegating(name):
+    def method(self, *args, **kwargs):
+        return getattr(self.materialize(), name)(*args, **kwargs)
+
+    method.__name__ = name
+    return method
+
+
+# operators bypass instance __getattr__ — install explicit delegates so a
+# LazyRef that leaks into raw jnp/python arithmetic still behaves like its
+# (materialized) array instead of raising
+for _name in (
+    "__add__", "__radd__", "__sub__", "__rsub__", "__mul__", "__rmul__",
+    "__truediv__", "__rtruediv__", "__floordiv__", "__rfloordiv__",
+    "__mod__", "__rmod__", "__pow__", "__rpow__", "__matmul__",
+    "__rmatmul__", "__neg__", "__pos__", "__abs__", "__getitem__",
+    "__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
+    "__float__", "__int__", "__bool__", "__len__", "__iter__",
+):
+    setattr(LazyRef, _name, _delegating(_name))
+LazyRef.__hash__ = object.__hash__  # __eq__ delegate must not kill identity hash
+
+
+def materialize(v):
+    """Concrete value of `v` (flushes the pending segment for LazyRefs)."""
+    return v.materialize() if type(v) is LazyRef else v
+
+
+class _SegOp:
+    """One deferred op inside a pending segment."""
+
+    __slots__ = ("fn", "kw", "bindings", "diff_idx", "record", "node", "outs")
+
+    def __init__(self, fn, kw, bindings, diff_idx, record, node):
+        self.fn = fn
+        self.kw = kw
+        self.bindings = bindings
+        self.diff_idx = diff_idx
+        self.record = record
+        self.node = node
+        self.outs = []  # [(LazyRef, Tensor)] — filled by lazy_apply
+
+
+class _Segment:
+    """Per-thread pending op trace, flushed as one jitted program."""
+
+    __slots__ = (
+        "ops", "ext_vals", "ext_ids", "ext_specs", "sig_parts", "flushed",
+        "error",
+    )
+
+    def __init__(self):
+        self.ops: List[_SegOp] = []
+        self.ext_vals: List[Any] = []
+        self.ext_ids: Dict[int, int] = {}
+        self.ext_specs: List[Tuple] = []
+        self.sig_parts: List[Tuple] = []
+        self.flushed = False
+        self.error: Optional[BaseException] = None
+
+
+def _current_segment() -> _Segment:
+    seg = getattr(_tls, "segment", None)
+    if seg is None or seg.flushed:
+        seg = _Segment()
+        _tls.segment = seg
+    return seg
+
+
+def pending_op_count() -> int:
+    seg = getattr(_tls, "segment", None)
+    return 0 if seg is None or seg.flushed else len(seg.ops)
+
+
+def flush_if_pending(reason: str = "explicit_sync"):
+    """Flush this thread's pending segment (no-op when nothing is pending)."""
+    seg = getattr(_tls, "segment", None)
+    if seg is not None and not seg.flushed and seg.ops:
+        _flush(seg, reason)
+
+
+# ---------------------------------------------------------------------------
+# Output-aval inference, cached by (op token, statics, input specs): one
+# host-side jax.eval_shape per new op configuration, dict lookups after.
+# ---------------------------------------------------------------------------
+_aval_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+
+
+def _infer_out_specs(fn, kw, arg_specs):
+    args = []
+    for spec in arg_specs:
+        if spec[0] == "arr":
+            args.append(jax.ShapeDtypeStruct(spec[1], spec[2]))
+        else:
+            args.append(spec[1])
+    out = jax.eval_shape(functools.partial(fn, **kw), *args)
+    if isinstance(out, (tuple, list)):
+        flat, is_seq = list(out), True
+    else:
+        flat, is_seq = [out], False
+    return [(tuple(o.shape), _np_dtype(o.dtype)) for o in flat], is_seq
+
+
+# ---------------------------------------------------------------------------
+# Segment compile cache: signature -> jitted segment program (LRU-bounded)
+# ---------------------------------------------------------------------------
+_segment_cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
+
+
+def _build_segment_fn(plan):
+    """plan: [(fn, kw, bindings, diff_idx, record)] — deliberately stripped
+    of _SegOp/GradNode/Tensor refs so the cached closure pins no user data."""
+
+    def seg_fn(ext):
+        results = []
+        vjps = []
+        for fn, kw, bindings, diff_idx, record in plan:
+            vals = []
+            for kind, a, b in bindings:
+                if kind == _EXT:
+                    vals.append(ext[a])
+                elif kind == _RES:
+                    vals.append(results[a][b])
+                else:
+                    vals.append(a)
+            if record:
+
+                def partial(*dv, _fn=fn, _kw=kw, _vals=tuple(vals), _di=diff_idx):
+                    full = list(_vals)
+                    for i, v in zip(_di, dv):
+                        full[i] = v
+                    res = _fn(*full, **_kw)
+                    return tuple(res) if isinstance(res, list) else res
+
+                out, vjp = jax.vjp(partial, *[vals[i] for i in diff_idx])
+                vjps.append(vjp)
+            else:
+                out = fn(*vals, **kw)
+            results.append(list(out) if isinstance(out, (tuple, list)) else [out])
+        return results, vjps
+
+    return jax.jit(seg_fn)
+
+
+def _flush(seg: _Segment, reason: str):
+    from . import dispatch
+
+    if seg.flushed:
+        return
+    seg.flushed = True
+    if getattr(_tls, "segment", None) is seg:
+        _tls.segment = None
+    if not seg.ops:
+        return
+
+    sig = (tuple(seg.sig_parts), tuple(seg.ext_specs))
+    jfn = dispatch._lru_get(_segment_cache, sig)
+    fresh = jfn is None
+    if fresh:
+        dispatch._counters["segment_cache_misses"] += 1
+        plan = [
+            (op.fn, op.kw, op.bindings, op.diff_idx, op.record) for op in seg.ops
+        ]
+        jfn = _build_segment_fn(plan)
+    else:
+        dispatch._counters["segment_cache_hits"] += 1
+
+    try:
+        results, vjps = jfn(seg.ext_vals)
+    except BaseException as e:
+        # record the root cause: every later materialize() of this segment's
+        # refs re-raises it instead of silently yielding None. A program
+        # that never ran successfully is never cached.
+        seg.error = e
+        seg.ops = []
+        raise
+    if fresh:
+        dispatch._lru_put(
+            _segment_cache, sig, jfn,
+            evict_counter="segment_cache_evictions",
+            cap=int(flags.flag("eager_segment_cache_size")),
+        )
+    dispatch._count_program("segment")
+    dispatch._counters["segments_flushed"] += 1
+    reasons = dispatch._counters["flush_reasons"]
+    reasons[reason] = reasons.get(reason, 0) + 1
+
+    vi = 0
+    for op, outs in zip(seg.ops, results):
+        for (ref, t), val in zip(op.outs, outs):
+            ref._concrete = val
+            if t._value is ref:
+                t._value = val
+        if op.record:
+            node = op.node
+            node.vjp_fn = vjps[vi]
+            vi += 1
+            node.jit_vjp = True
+            # replace predicted avals with the real ones (weak-type exactness)
+            node.out_avals = [(tuple(v.shape), v.dtype) for v in outs]
+    seg.ops = []  # drop op/node/tensor refs — the segment is spent
+
+
+# ---------------------------------------------------------------------------
+# The deferral entry point, called from dispatch.apply when the flag is on
+# ---------------------------------------------------------------------------
+def lazy_apply(
+    fn: Callable,
+    args: Tuple,
+    kw_items: Tuple,
+    *,
+    op_name: Optional[str],
+    differentiable: bool,
+    jit: bool,
+    cache_token,
+):
+    """Defer `fn` onto the pending segment; `_FALLBACK` sends the caller to
+    the per-op path (after flushing, so program order is preserved)."""
+    from . import dispatch
+    from .tensor import Tensor
+
+    # bail-outs: ops the segment trace cannot host take the per-op path.
+    # jit=False ops have data-dependent output shapes; closure-captured fns
+    # have no stable cache token; explicit cache_token ops (to_static
+    # closures) manage their own compile caches; AMP casting and the debug
+    # flags read per-call state the segment signature doesn't cover.
+    if not jit:
+        flush_if_pending("fallback_nojit")
+        return _FALLBACK
+    if cache_token is not None:
+        flush_if_pending("fallback_token")
+        return _FALLBACK
+    token = dispatch._cache_token(fn)
+    if token is None:
+        flush_if_pending("fallback_uncacheable")
+        return _FALLBACK
+    if flags.flag("check_nan_inf") or flags.flag("benchmark"):
+        flush_if_pending("fallback_debug")
+        return _FALLBACK
+    amp = dispatch._amp_module()
+    if amp.amp_active():
+        flush_if_pending("fallback_amp")
+        return _FALLBACK
+    try:
+        hash(kw_items)
+    except TypeError:
+        flush_if_pending("fallback_unhashable")
+        return _FALLBACK
+
+    # unwrap + classify args; tracer-backed values mean we are inside
+    # someone's jit trace (to_static / recompute) — defer nothing there
+    vals: List[Any] = []
+    diff_idx: List[int] = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            v = a._value
+            if isinstance(v, jax.core.Tracer):
+                return _FALLBACK
+            vals.append(v)
+            if not a.stop_gradient and (
+                getattr(v, "dtype", None) in dispatch._FLOAT_DTYPES
+            ):
+                diff_idx.append(i)
+        else:
+            if isinstance(a, jax.core.Tracer):
+                return _FALLBACK
+            vals.append(a)
+
+    seg = _current_segment()
+
+    # pass 1 — classify without mutating the segment, so any fallback below
+    # leaves no stray external inputs in the signature
+    pre: List[Tuple] = []
+    arg_specs: List[Tuple] = []
+    for v in vals:
+        if type(v) is LazyRef:
+            if v._concrete is not None:
+                v = v._concrete
+            elif v._segment is not seg:
+                # pending ref from a stale/foreign segment: materialize it
+                _flush(v._segment, "cross_segment")
+                v = v._concrete
+            else:
+                pre.append((_RES, v._op_index, v._out_index))
+                arg_specs.append(("arr", v._shape, v._dtype))
+                continue
+        if isinstance(v, (jax.Array, np.ndarray)):
+            pre.append((_EXT, v, 0))
+            arg_specs.append(
+                ("arr", tuple(v.shape), _np_dtype(v.dtype),
+                 bool(getattr(v, "weak_type", False)))
+            )
+        else:
+            try:
+                hash(v)
+            except TypeError:
+                flush_if_pending("fallback_unhashable")
+                return _FALLBACK
+            pre.append((_LIT, v, 0))
+            arg_specs.append(("lit", v))
+
+    record = (
+        differentiable and bool(diff_idx) and dispatch._grad_state().grad_enabled
+    )
+
+    # output avals (cached eval_shape); failure → op is not traceable as-is
+    kw = dict(kw_items)
+    aval_key = (token, kw_items, tuple(arg_specs), record)
+    hit = dispatch._lru_get(_aval_cache, aval_key)
+    if hit is not None:
+        out_specs, is_seq = hit
+    else:
+        try:
+            out_specs, is_seq = _infer_out_specs(fn, kw, arg_specs)
+        except Exception:
+            flush_if_pending("fallback_infer")
+            return _FALLBACK
+        # capped alongside the per-op compile caches (host-only metadata, no
+        # jit wrappers, so no eviction counter)
+        dispatch._lru_put(_aval_cache, aval_key, (out_specs, is_seq))
+
+    # pass 2 — commit: intern external inputs, build final bindings
+    bindings = []
+    for kind, a, b in pre:
+        if kind == _EXT:
+            k = seg.ext_ids.get(id(a))
+            if k is None:
+                k = len(seg.ext_vals)
+                seg.ext_vals.append(a)
+                seg.ext_ids[id(a)] = k
+                seg.ext_specs.append(
+                    (tuple(a.shape), _np_dtype(a.dtype),
+                     bool(getattr(a, "weak_type", False)))
+                )
+            bindings.append((_EXT, k, 0))
+        else:
+            bindings.append((kind, a, b))
+    bindings = tuple(bindings)
+    diff_t = tuple(diff_idx)
+
+    node = None
+    if record:
+        node = dispatch.GradNode(
+            None,
+            [args[i] for i in diff_idx],
+            list(out_specs),
+            op_name or getattr(fn, "__name__", "op"),
+            out_is_seq=is_seq,
+        )
+
+        # pure primal for create_graph double-grad re-derivation; non-diff
+        # captures resolve at call time (post-flush they are concrete)
+        def primal_fn(*dv, _fn=fn, _kw=kw, _vals=tuple(vals), _di=diff_t):
+            full = [materialize(x) for x in _vals]
+            for i, v in zip(_di, dv):
+                full[i] = v
+            res = _fn(*full, **_kw)
+            return tuple(res) if isinstance(res, list) else res
+
+        node.primal_fn = primal_fn
+
+    op_index = len(seg.ops)
+    op = _SegOp(fn, kw, bindings, diff_t, record, node)
+    outs = []
+    for i, (shape, dtype) in enumerate(out_specs):
+        ref = LazyRef(seg, op_index, i, shape, dtype)
+        # per-op parity: only RECORDED float outputs are differentiable;
+        # non-recorded ops (no_grad, differentiable=False, int inputs) wrap
+        # with stop_gradient=True exactly like _wrap_outputs does
+        sg = True if not record else dtype not in dispatch._FLOAT_DTYPES
+        t = _new_tensor(ref, stop_gradient=sg)
+        if record and not t.stop_gradient:
+            t._grad_node = node
+            t._out_index = i
+        op.outs.append((ref, t))
+        outs.append(t)
+    seg.ops.append(op)
+    seg.sig_parts.append((token, kw_items, bindings, record, diff_t))
+    dispatch._counters["lazy_ops_deferred"] += 1
+
+    if len(seg.ops) >= int(flags.flag("eager_segment_max_ops")):
+        _flush(seg, "segment_limit")
+
+    return outs if is_seq else outs[0]
+
+
+def _new_tensor(value, stop_gradient):
+    from .tensor import Tensor
+
+    t = Tensor.__new__(Tensor)
+    t._value = value
+    t.stop_gradient = stop_gradient
+    t.grad = None
+    t._grad_node = None
+    t._out_index = 0
+    t._backward_hooks = []
+    t._inplace_version = 0
+    t.name = ""
+    t.persistable = False
+    t.is_parameter = False
+    return t

@@ -29,6 +29,7 @@ import numpy as np
 
 from . import dispatch
 from .dtype import DType, to_np_dtype, to_paddle_dtype, get_default_dtype
+from .lazy import LazyRef, materialize as _mat
 from .place import CPUPlace, Place, TPUPlace, _expected_place
 
 
@@ -117,7 +118,9 @@ class Tensor:
     @property
     def place(self) -> Place:
         v = self._value
-        if isinstance(v, jax.core.Tracer):
+        if isinstance(v, jax.core.Tracer) or type(v) is LazyRef:
+            # pending lazy values commit to the expected device at flush;
+            # answering from metadata keeps .place from forcing a flush
             return _expected_place()
         dev = next(iter(v.devices()), None) if hasattr(v, "devices") else None
         if dev is not None and dev.platform == "cpu":
@@ -145,7 +148,9 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self):
-        return np.asarray(jax.device_get(self._value))
+        # host read = materialization point: flush any pending lazy segment
+        # (item/tolist/__float__/__int__/__bool__/__array__ all funnel here)
+        return np.asarray(jax.device_get(_mat(self._value)))
 
     def item(self, *args):
         if args:
@@ -248,7 +253,7 @@ class Tensor:
         return self.set_value(other)
 
     def fill_(self, value):
-        self._value = jnp.full_like(self._value, value)
+        self._value = jnp.full_like(_mat(self._value), value)
         self._bump_version()
         return self
 
@@ -258,14 +263,14 @@ class Tensor:
     # -- device movement ----------------------------------------------------
     def cpu(self):
         t = self.detach()
-        t._value = jax.device_put(self._value, jax.devices("cpu")[0])
+        t._value = jax.device_put(_mat(self._value), jax.devices("cpu")[0])
         t.stop_gradient = self.stop_gradient
         return t
 
     def cuda(self, device_id=None, blocking=True):
         """Compat: move to the default accelerator (TPU here)."""
         t = self.detach()
-        t._value = jax.device_put(self._value, jax.devices()[device_id or 0])
+        t._value = jax.device_put(_mat(self._value), jax.devices()[device_id or 0])
         t.stop_gradient = self.stop_gradient
         return t
 
@@ -312,7 +317,7 @@ class Tensor:
                 place = _p.set_device(device)
                 _p._set_expected_place(prev)
             t = out.detach()
-            t._value = jax.device_put(out._value, place.jax_device)
+            t._value = jax.device_put(_mat(out._value), place.jax_device)
             t.stop_gradient = out.stop_gradient
             out = t
         return out
@@ -464,9 +469,11 @@ class Tensor:
         self._value = self._value.at[idx].set(v)
         self._bump_version()
 
-    # pytree-friendliness: jax can flatten Tensors transparently
+    # pytree-friendliness: jax can flatten Tensors transparently. Direct jnp
+    # consumption outside the dispatcher is a materialization point for lazy
+    # values (tracers pass through untouched).
     def __jax_array__(self):
-        return self._value
+        return _mat(self._value)
 
 
 def _unwrap_index(idx):

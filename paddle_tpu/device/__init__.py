@@ -28,8 +28,23 @@ __all__ = [
     "memory_allocated",
     "max_memory_allocated",
     "memory_reserved",
+    "synchronize",
     "cuda",
 ]
+
+
+def synchronize(device=None):
+    """Block until all queued device work is done.
+
+    Also a lazy-dispatch materialization point: any pending deferred-eager
+    segment (FLAGS_eager_lazy_dispatch) is flushed as one program first, so
+    after synchronize() every live Tensor holds a concrete, ready array.
+    """
+    from ..core import lazy
+
+    lazy.flush_if_pending("explicit_sync")
+    for arr in jax.live_arrays():
+        arr.block_until_ready()
 
 
 # "compiled with" probes (reference: python/paddle/device/__init__.py) —
@@ -268,8 +283,7 @@ class _CudaNamespace:
 
     @staticmethod
     def synchronize(device=None):
-        for d in jax.live_arrays():
-            d.block_until_ready()
+        synchronize(device)
 
 
 cuda = _CudaNamespace()

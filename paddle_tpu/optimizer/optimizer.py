@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dispatch import no_grad
+from ..core import lazy as _lazy
+from ..core.dispatch import _count_program, no_grad
 from ..core.tensor import Tensor
 from .lr import LRScheduler
 
@@ -96,6 +97,9 @@ class Optimizer:
         jitted XLA program (the merged_adam/multi_tensor path the reference
         gates behind use_multi_tensor), so eager training pays a single
         dispatch per step instead of one per parameter."""
+        # lazy-dispatch materialization point: grads (and lazily-created
+        # params) must be concrete before the fused jitted update reads them
+        _lazy.flush_if_pending("optimizer_step")
         params_grads = [
             (p, p.grad)
             for p in self._param_list()
@@ -110,7 +114,8 @@ class Optimizer:
     def _apply_fused(self, params_grads):
         params = [p for p, _ in params_grads]
         g_vals = [
-            (g._value if isinstance(g, Tensor) else g) for _, g in params_grads
+            (_lazy.materialize(g._value) if isinstance(g, Tensor) else g)
+            for _, g in params_grads
         ]
         states = []
         for p in params:
@@ -180,6 +185,7 @@ class Optimizer:
             [p._value for p in params], g_vals,
             jnp.asarray(self.get_lr(), dtype=jnp.float32), states,
         )
+        _count_program("optimizer")
         for p, npv, nst in zip(params, new_ps, new_sts):
             p._value = npv
             self._accumulators[id(p)] = nst

@@ -21,6 +21,14 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+# dispatch-level counters: device-program launches by category, lazy-segment
+# flush reasons, compile-cache hit/miss/eviction counts (core/dispatch.py).
+# The programs-per-step arithmetic in PROFILE_EAGER.md reads these.
+from ..core.dispatch import (  # noqa: F401
+    dispatch_counters,
+    reset_dispatch_counters,
+)
+
 __all__ = [
     "Profiler",
     "ProfilerState",
@@ -31,6 +39,8 @@ __all__ = [
     "load_profiler_result",
     "SummaryView",
     "SortedKeys",
+    "dispatch_counters",
+    "reset_dispatch_counters",
 ]
 
 

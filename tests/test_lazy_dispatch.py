@@ -1,0 +1,297 @@
+"""Lazy eager dispatch (FLAGS_eager_lazy_dispatch): semantics + program budget.
+
+Covers the deferred-execution mode of the eager dispatcher (core/lazy.py):
+numeric parity against the per-op path (forward + grads, fp32/bf16, no_grad),
+flush-at-materialization correctness (float()/numpy()/bool branch/explicit
+synchronize), the jit=False data-dependent-shape fallback, segment-cache
+reuse (a steady-state step compiles nothing new), LRU bounds on the compile
+caches, and the tier-1 programs-per-step regression guard (steady-state
+eager LeNet step ≤ 3 programs under lazy mode).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.profiler as prof
+from paddle_tpu.core import lazy
+
+
+@pytest.fixture
+def lazy_mode():
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    try:
+        yield
+    finally:
+        lazy.flush_if_pending("test_teardown")
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+
+
+def _mlp_forward(x, w1, b1, w2):
+    h = F.relu(paddle.matmul(x, w1) + b1)
+    return paddle.matmul(h, w2).sum()
+
+
+def _make_inputs(dtype="float32"):
+    rng = np.random.default_rng(7)
+    mk = lambda *s: rng.standard_normal(s).astype(np.float32)
+    ts = []
+    for arr in (mk(4, 8), mk(8, 16), mk(16), mk(16, 2)):
+        t = paddle.to_tensor(arr)
+        if dtype != "float32":
+            t = t.astype(dtype)
+        t.stop_gradient = False
+        ts.append(t)
+    return ts
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_numeric_parity_forward_and_grads(dtype):
+    # per-op reference
+    ins_ref = _make_inputs(dtype)
+    loss_ref = _mlp_forward(*ins_ref)
+    loss_ref.backward()
+
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    try:
+        ins_lazy = [paddle.to_tensor(t.numpy()) for t in ins_ref]
+        for t in ins_lazy:
+            t.stop_gradient = False
+        loss_lazy = _mlp_forward(*ins_lazy)
+        assert type(loss_lazy._value) is lazy.LazyRef  # actually deferred
+        loss_lazy.backward()
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+
+    np.testing.assert_allclose(
+        loss_lazy.numpy(), loss_ref.numpy(), rtol=1e-6, atol=1e-6
+    )
+    for a, b in zip(ins_lazy, ins_ref):
+        np.testing.assert_allclose(
+            a.grad.numpy().astype(np.float32),
+            b.grad.numpy().astype(np.float32),
+            rtol=1e-6,
+            atol=1e-6,
+        )
+
+
+def test_no_grad_parity(lazy_mode):
+    with paddle.no_grad():
+        x = paddle.to_tensor(np.ones((3, 3), np.float32))
+        x.stop_gradient = False
+        y = (x * 2.0 + 1.0).sum()
+        assert y._grad_node is None
+        # per-op parity: non-recorded outputs wrap with stop_gradient=True
+        assert y.stop_gradient is True
+    assert float(y) == pytest.approx(27.0)
+    # a later recorded op must not treat the no_grad result as a diff leaf
+    w = paddle.to_tensor(np.ones(4, np.float32))
+    w.stop_gradient = False
+    with paddle.no_grad():
+        feat = w * 3.0
+    (feat * w).sum().backward()
+    assert feat.grad is None
+    np.testing.assert_allclose(w.grad.numpy(), np.full(4, 3.0))
+
+
+def test_failed_flush_raises_on_every_read(lazy_mode):
+    """A segment whose flush fails must raise on each read of its tensors,
+    never silently hand back None (review finding: flushed-before-success)."""
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    y = x * 2.0
+    seg = y._value._segment
+    seg.ops[0].fn = lambda v: v.reshape(999, 999)  # breaks at trace time
+    with pytest.raises(Exception):
+        y.numpy()
+    with pytest.raises(RuntimeError, match="flush failed"):
+        y.numpy()
+
+
+def test_flush_at_float_numpy_and_bool(lazy_mode):
+    x = paddle.to_tensor(np.full((2, 2), 3.0, np.float32))
+    y = x * 2.0
+    assert type(y._value) is lazy.LazyRef
+    assert lazy.pending_op_count() == 1
+    # float() on a derived scalar flushes the whole pending segment
+    s = y.sum()
+    assert float(s) == pytest.approx(24.0)
+    assert lazy.pending_op_count() == 0
+    assert not isinstance(y._value, lazy.LazyRef)  # written back concrete
+
+    z = x + 1.0
+    np.testing.assert_allclose(z.numpy(), np.full((2, 2), 4.0))
+
+    # bool-dependent control flow materializes
+    c = (x.sum() * 0.0) + 1.0
+    assert type(c._value) is lazy.LazyRef
+    took_branch = bool(c > 0.5)
+    assert took_branch
+    assert lazy.pending_op_count() == 0
+
+
+def test_shape_access_does_not_flush(lazy_mode):
+    x = paddle.to_tensor(np.ones((3, 5), np.float32))
+    y = paddle.matmul(x, paddle.to_tensor(np.ones((5, 7), np.float32)))
+    assert y.shape == [3, 7]
+    assert y.ndim == 2
+    assert y.dtype == paddle.float32
+    assert lazy.pending_op_count() == 1  # shape/dtype answered from avals
+
+
+def test_explicit_synchronize_flushes(lazy_mode):
+    x = paddle.to_tensor(np.ones(4, np.float32)) * 5.0
+    assert lazy.pending_op_count() == 1
+    paddle.device.synchronize()
+    assert lazy.pending_op_count() == 0
+    np.testing.assert_allclose(x.numpy(), np.full(4, 5.0))
+
+
+def test_jit_false_op_forces_flush_and_fallback(lazy_mode):
+    prof.reset_dispatch_counters()
+    x = paddle.to_tensor(np.array([1.0, -2.0, 3.0, -4.0], np.float32))
+    y = x * 2.0
+    mask = paddle.to_tensor(np.array([True, False, True, False]))
+    sel = paddle.masked_select(y, mask)  # data-dependent shape, jit=False
+    np.testing.assert_allclose(sel.numpy(), [2.0, 6.0])
+    reasons = prof.dispatch_counters()["flush_reasons"]
+    assert reasons.get("fallback_nojit", 0) >= 1
+
+
+def test_segment_cache_reuse_second_step_compiles_nothing(lazy_mode):
+    rng = np.random.default_rng(3)
+    w = paddle.to_tensor(rng.standard_normal((6, 6)).astype(np.float32))
+    w.stop_gradient = False
+
+    def step():
+        x = paddle.to_tensor(np.ones((2, 6), np.float32))
+        loss = F.relu(paddle.matmul(x, w)).sum()
+        loss.backward()
+        g = w.grad.numpy()
+        w.clear_grad()
+        return g
+
+    g1 = step()  # compiles the segment
+    prof.reset_dispatch_counters()
+    g2 = step()  # must replay the cached fused executable
+    c = prof.dispatch_counters()
+    assert c["segment_cache_misses"] == 0
+    assert c["segment_cache_hits"] >= 1
+    np.testing.assert_allclose(g1, g2)
+
+
+def _hook_scenario():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * x).sum().backward()
+    (x * 4.0).sum().backward()
+    return x.grad.numpy(), seen
+
+
+def test_backward_hooks_and_grad_accumulation(lazy_mode):
+    grad_lazy, seen_lazy = _hook_scenario()
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    try:
+        grad_ref, seen_ref = _hook_scenario()
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    # hook cadence and values must match the per-op path exactly
+    assert len(seen_lazy) == len(seen_ref)
+    for a, b in zip(seen_lazy, seen_ref):
+        np.testing.assert_allclose(a, b)
+    np.testing.assert_allclose(grad_lazy, grad_ref)
+    np.testing.assert_allclose(grad_lazy, [4.0 + 4.0, 6.0 + 4.0])
+
+
+def test_double_grad_through_lazy_segments(lazy_mode):
+    x = paddle.to_tensor(np.array(3.0, np.float32))
+    x.stop_gradient = False
+    y = x * x * x
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    (ggx,) = paddle.grad(gx, [x])
+    assert float(gx) == pytest.approx(27.0)
+    assert float(ggx) == pytest.approx(18.0)
+
+
+def test_flag_off_restores_per_op_path():
+    assert not paddle.get_flags("FLAGS_eager_lazy_dispatch")[
+        "FLAGS_eager_lazy_dispatch"
+    ]
+    prof.reset_dispatch_counters()
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = x + 1.0
+    assert not isinstance(y._value, lazy.LazyRef)
+    c = prof.dispatch_counters()
+    assert c["op_programs"] >= 1
+    assert c["lazy_ops_deferred"] == 0
+
+
+def test_jit_cache_lru_eviction():
+    from paddle_tpu.core import dispatch
+
+    prev = paddle.get_flags("FLAGS_eager_jit_cache_size")[
+        "FLAGS_eager_jit_cache_size"
+    ]
+    paddle.set_flags({"FLAGS_eager_jit_cache_size": 4})
+    try:
+        prof.reset_dispatch_counters()
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        # distinct static-kwarg configs -> distinct cache entries
+        for k in range(8):
+            paddle.clip(x, min=-float(k + 1), max=float(k + 1))
+        assert len(dispatch._jit_cache) <= 4
+        assert prof.dispatch_counters()["jit_cache_evictions"] >= 1
+    finally:
+        paddle.set_flags({"FLAGS_eager_jit_cache_size": prev})
+
+
+def test_segment_max_ops_bounds_trace_length(lazy_mode):
+    prev = paddle.get_flags("FLAGS_eager_segment_max_ops")[
+        "FLAGS_eager_segment_max_ops"
+    ]
+    paddle.set_flags({"FLAGS_eager_segment_max_ops": 4})
+    try:
+        prof.reset_dispatch_counters()
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        for _ in range(9):
+            x = x + 1.0
+        c = prof.dispatch_counters()
+        assert c["flush_reasons"].get("segment_limit", 0) == 2
+        assert lazy.pending_op_count() == 1
+        np.testing.assert_allclose(x.numpy(), np.full(3, 10.0))
+    finally:
+        paddle.set_flags({"FLAGS_eager_segment_max_ops": prev})
+
+
+def test_lenet_program_budget_regression_guard(lazy_mode):
+    """Tier-1 guard: the steady-state eager LeNet train step must stay
+    within a 3-program budget under lazy mode (1 fused forward segment +
+    1 compiled-tape backward + 1 fused optimizer update). A dispatcher edit
+    that silently splits segments or un-fuses the sweep fails here."""
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (4,)))
+
+    def step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(2):  # warm-up: compile segment / tape / optimizer programs
+        loss = step()
+    float(loss)
+
+    prof.reset_dispatch_counters()
+    float(step())
+    c = prof.dispatch_counters()
+    assert c["programs"] <= 3, c
+    assert c["segment_cache_misses"] == 0, c

@@ -1,0 +1,85 @@
+"""Programs-per-step probe for the eager LeNet train step.
+
+Measures what PROFILE_EAGER.md's arithmetic predicts: the number of device
+programs one eager LeNet train step launches on the per-op path versus the
+lazy-dispatch path (FLAGS_eager_lazy_dispatch), using the dispatch counters
+exposed via paddle_tpu.profiler. Runs on any backend; pin CPU with:
+
+    JAX_PLATFORMS=cpu python tools/perf_eager_probe.py
+
+Env knobs: PROBE_BATCH (default 16), PROBE_STEPS timed steps (default 5).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.profiler as prof  # noqa: E402
+from paddle_tpu.vision.models import LeNet  # noqa: E402
+
+
+def build(bsz):
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((bsz, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (bsz,)))
+
+    def step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+def probe(lazy: bool, bsz: int, steps: int):
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy})
+    try:
+        step = build(bsz)
+        for _ in range(3):  # warm-up: fill the per-op / segment compile caches
+            loss = step()
+        float(loss)
+
+        prof.reset_dispatch_counters()
+        t0 = time.time()
+        for _ in range(steps):
+            loss = step()
+        float(loss)  # hard sync
+        dt = time.time() - t0
+        c = prof.dispatch_counters()
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    return c, dt
+
+
+def main():
+    bsz = int(os.environ.get("PROBE_BATCH", 16))
+    steps = int(os.environ.get("PROBE_STEPS", 5))
+    print(f"eager LeNet train step, batch {bsz}, {steps} steady-state steps\n")
+    for mode, lazy in (("per-op", False), ("lazy", True)):
+        c, dt = probe(lazy, bsz, steps)
+        per_step = c["programs"] / steps
+        print(f"[{mode}] programs/step = {per_step:.1f}  "
+              f"({steps / dt:.1f} steps/s)")
+        print(f"    op={c['op_programs']} segment={c['segment_programs']} "
+              f"backward={c['backward_programs']} "
+              f"optimizer={c['optimizer_programs']}")
+        if lazy:
+            print(f"    segments_flushed={c['segments_flushed']} "
+                  f"cache hits/misses={c['segment_cache_hits']}/"
+                  f"{c['segment_cache_misses']} "
+                  f"flush_reasons={c['flush_reasons']}")
+        print()
+
+
+if __name__ == "__main__":
+    main()
