@@ -22,6 +22,17 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.models import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
 
 
+def build_model():
+    """Model-builder entry point used by tools/graph_lint.py (and the CI
+    self-lint step): the single-chip model at a lint-friendly sequence
+    length (tracing only — no training step)."""
+    cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                    num_heads=8, max_seq_len=64,
+                    dropout=0.0, attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    return model, [paddle.static.InputSpec([1, 32], "int64")]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dp", type=int, default=1)

@@ -25,6 +25,13 @@ from paddle_tpu.vision import models as M
 from paddle_tpu.vision import transforms as T
 
 
+def build_model():
+    """Model-builder entry point used by tools/graph_lint.py (and the CI
+    self-lint step): returns (layer, input_specs) for the default config."""
+    net = M.mobilenet_v3_small(num_classes=8)
+    return net, [paddle.static.InputSpec([1, 3, 64, 64], "float32")]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="mobilenet_v3_small")

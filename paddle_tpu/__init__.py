@@ -83,6 +83,7 @@ from . import tensor_api as _tensor_api
 import importlib as _importlib
 
 for _pkg in (
+    "analysis",
     "nn",
     "regularizer",
     "sysconfig",

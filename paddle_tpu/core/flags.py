@@ -31,9 +31,23 @@ def define_flag(name: str, default: Any, doc: str = "", writable: bool = True):
     return value
 
 
+_TRUE_WORDS = frozenset(("1", "true", "yes", "on", "y", "t"))
+_FALSE_WORDS = frozenset(("0", "false", "no", "off", "n", "f", ""))
+
+
 def _parse(text: str, default):
     if isinstance(default, bool):
-        return text.lower() in ("1", "true", "yes", "on")
+        # strict both ways: "0"/"off"/"no" are False, "1"/"on"/"yes" are
+        # True, anything else is an error instead of silently False
+        word = text.strip().lower()
+        if word in _TRUE_WORDS:
+            return True
+        if word in _FALSE_WORDS:
+            return False
+        raise ValueError(
+            f"invalid boolean flag value {text!r}: use 1/0, true/false, "
+            "yes/no, or on/off"
+        )
     if isinstance(default, int):
         return int(text)
     if isinstance(default, float):
@@ -64,13 +78,42 @@ def set_flags(flags: Dict[str, Any]):
         key = _norm(n)
         if key not in _registry:
             raise ValueError(f"unknown flag {n!r}")
-        if not _registry[key]["writable"]:
-            raise ValueError(f"flag {n!r} is not writable at runtime")
-        _registry[key]["value"] = v
+        entry = _registry[key]
+        if not entry["writable"]:
+            raise ValueError(
+                f"flag FLAGS_{key} is read-only at runtime: it is consumed "
+                "once at startup — export FLAGS_" + key + "=... in the "
+                "environment before importing paddle_tpu instead"
+            )
+        if isinstance(v, str) and not isinstance(entry["default"], str):
+            # env-style string values parse with the same (strict) rules as
+            # FLAGS_* environment variables, so "0"/"off" mean False here too
+            v = _parse(v, entry["default"])
+        entry["value"] = v
 
 
 def flag(name: str):
     return _registry[_norm(name)]["value"]
+
+
+def describe_flags(match: str = None):
+    """Sorted [{name, value, default, doc, writable}] for every registered
+    flag, optionally filtered by a substring of the name (reference: the
+    --help text gflags generates; used by tools/graph_lint.py to print the
+    analysis-related flags in effect)."""
+    out = []
+    for name in sorted(_registry):
+        if match is not None and match not in name:
+            continue
+        e = _registry[name]
+        out.append({
+            "name": "FLAGS_" + name,
+            "value": e["value"],
+            "default": e["default"],
+            "doc": e["doc"],
+            "writable": e["writable"],
+        })
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +149,13 @@ define_flag(
 )
 define_flag(
     "use_standalone_executor", True, "use the compiled whole-program executor path"
+)
+define_flag(
+    "check_programs", 0,
+    "run the paddle_tpu.analysis verifier over every program at compile "
+    "time (Executor.run) and at lazy-segment flush: 0 = off, 1 = report "
+    "every diagnostic as a Python warning, 2 = additionally raise "
+    "ProgramVerificationError on error-severity findings",
 )
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
 define_flag(

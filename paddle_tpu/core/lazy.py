@@ -40,7 +40,13 @@ import numpy as np
 
 from . import flags
 
-__all__ = ["LazyRef", "flush_if_pending", "materialize", "pending_op_count"]
+__all__ = [
+    "LazyRef",
+    "flush_if_pending",
+    "materialize",
+    "pending_op_count",
+    "pending_segment_jaxpr",
+]
 
 # sentinel returned by lazy_apply when the op must take the per-op path
 _FALLBACK = object()
@@ -244,8 +250,10 @@ def _infer_out_specs(fn, kw, arg_specs):
 _segment_cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
 
 
-def _build_segment_fn(plan):
-    """plan: [(fn, kw, bindings, diff_idx, record)] — deliberately stripped
+def _segment_fn(plan):
+    """Raw (unjitted) segment program over the external-input list.
+
+    plan: [(fn, kw, bindings, diff_idx, record)] — deliberately stripped
     of _SegOp/GradNode/Tensor refs so the cached closure pins no user data."""
 
     def seg_fn(ext):
@@ -276,7 +284,40 @@ def _build_segment_fn(plan):
             results.append(list(out) if isinstance(out, (tuple, list)) else [out])
         return results, vjps
 
-    return jax.jit(seg_fn)
+    return seg_fn
+
+
+def _build_segment_fn(plan):
+    return jax.jit(_segment_fn(plan))
+
+
+def _seg_plan(seg: _Segment):
+    return [(op.fn, op.kw, op.bindings, op.diff_idx, op.record) for op in seg.ops]
+
+
+def _segment_jaxpr(plan, ext_specs):
+    """Closed jaxpr of the fused segment program (for the verifier).
+
+    Preserves the recorded weak_type flags: weak scalars promote
+    differently, and the verified jaxpr must match the jaxpr the segment
+    actually compiles (a weak f64 literal is benign; a strong one is the
+    upcast the dtype pass hunts)."""
+    specs = [
+        jax.ShapeDtypeStruct(
+            shape, dtype, weak_type=bool(rest[0]) if rest else False
+        )
+        for shape, dtype, *rest in ext_specs
+    ]
+    return jax.make_jaxpr(_segment_fn(plan))(specs)
+
+
+def pending_segment_jaxpr():
+    """Trace this thread's pending segment WITHOUT flushing it; None when
+    nothing is pending. Feeds paddle_tpu.analysis.check_pending_segment."""
+    seg = getattr(_tls, "segment", None)
+    if seg is None or seg.flushed or not seg.ops:
+        return None
+    return _segment_jaxpr(_seg_plan(seg), seg.ext_specs)
 
 
 def _flush(seg: _Segment, reason: str):
@@ -295,14 +336,26 @@ def _flush(seg: _Segment, reason: str):
     fresh = jfn is None
     if fresh:
         dispatch._counters["segment_cache_misses"] += 1
-        plan = [
-            (op.fn, op.kw, op.bindings, op.diff_idx, op.record) for op in seg.ops
-        ]
+        plan = _seg_plan(seg)
         jfn = _build_segment_fn(plan)
     else:
         dispatch._counters["segment_cache_hits"] += 1
 
     try:
+        if fresh and int(flags.flag("check_programs")):
+            # FLAGS_check_programs: verify the fused segment before its
+            # first compile (cached replays were already verified). A
+            # level-2 raise lands in the except path below, so reads of
+            # this segment's tensors re-raise the verification error.
+            from .. import analysis
+
+            analysis.enforce(
+                analysis.check(
+                    _segment_jaxpr(plan, seg.ext_specs),
+                    source="lazy-segment",
+                ),
+                where=f"lazy-segment flush ({reason})",
+            )
         results, vjps = jfn(seg.ext_vals)
     except BaseException as e:
         # record the root cause: every later materialize() of this segment's

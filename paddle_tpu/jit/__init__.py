@@ -252,6 +252,14 @@ class StaticFunction:
             return list(model_outs)
         return model_outs[0]
 
+    def check(self, input_spec=None, **kwargs):
+        """Run the paddle_tpu.analysis verifier over this compiled function
+        (traced with `input_spec`, falling back to the decorator's spec).
+        Returns the Diagnostic list — see paddle.static.analysis.check."""
+        from .. import analysis
+
+        return analysis.check(self, input_spec, **kwargs)
+
     # compatibility surface
     def concrete_program(self):
         raise NotImplementedError

@@ -41,6 +41,7 @@ __all__ = [
     "SortedKeys",
     "dispatch_counters",
     "reset_dispatch_counters",
+    "measure_programs",
 ]
 
 
@@ -303,6 +304,28 @@ benchmark_timer = _Timer()
 
 def benchmark():
     return benchmark_timer
+
+
+def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
+    """Dispatch-counter snapshot of ONE steady-state `step_fn` call.
+
+    Runs `warmup` calls first (compiles segments / tape / optimizer
+    programs), flushes any pending lazy segment, zeroes the counters, runs
+    one measured call, flushes again so trailing lazy ops are charged to
+    the step, and returns the counter dict. This is the measurement the
+    PROFILE_EAGER.md programs-per-step arithmetic — and the analysis
+    launch-budget pass — is defined over."""
+    from ..core import lazy
+
+    for _ in range(max(0, warmup)):
+        step_fn(*args, **kwargs)
+    lazy.flush_if_pending("measure_programs")
+    reset_dispatch_counters()
+    out = step_fn(*args, **kwargs)
+    lazy.flush_if_pending("measure_programs")
+    counters = dispatch_counters()
+    counters["_step_result"] = out
+    return counters
 
 
 def export_protobuf(dir_name: str, worker_name=None):

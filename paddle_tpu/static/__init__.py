@@ -138,10 +138,65 @@ class Program:
                             out.append(v)
         return out
 
+    def _iter_layers(self):
+        """Every layer/parameter object held by static.nn layer caches."""
+        for obj in getattr(self, "_static_layers", {}).values():
+            items = obj if isinstance(obj, (list, tuple)) else [obj]
+            for it in items:
+                if isinstance(it, dict):
+                    for v in it.values():
+                        yield v
+                else:
+                    yield it
+
     def clone(self, for_test=False):
+        """reference: framework.py Program.clone — the clone shares the
+        source's variables (persistables live in one scope), so here it
+        shares `_static_layers`/warm state: `all_parameters()` on the clone
+        returns the SOURCE's parameters, and training the source updates
+        the clone's weights. `for_test=True` marks the clone eval-mode:
+        its builder runs with every cached layer switched to eval
+        (dropout off, batch-norm running stats) and restored after."""
         p = Program()
         p.feed_vars = dict(self.feed_vars)
-        p.builder = self.builder
+        p.random_seed = self.random_seed
+        # materialize the layer cache NOW even if the source never ran:
+        # a clone taken before the first run must still share the dict the
+        # source will fill later, or their parameters silently diverge
+        if getattr(self, "_static_layers", None) is None:
+            self._static_layers = {}
+        p._static_layers = self._static_layers
+        p._warmed = getattr(self, "_warmed", False)
+        p._for_test = bool(for_test)
+        src = self.builder
+        if src is None:
+            return p
+        inner = getattr(src, "__wrapped__", src)
+
+        def cloned(feed):
+            # reset the CLONE's unnamed-layer call sequence (the source's
+            # builder wrapper resets only the source program's)
+            p._call_seq = {}
+            if not p._for_test:
+                return inner(feed)
+            layers = [
+                l for l in p._iter_layers()
+                if hasattr(l, "eval") and hasattr(l, "training")
+            ]
+            prev = [l.training for l in layers]
+            for l in layers:
+                l.eval()
+            try:
+                return inner(feed)
+            finally:
+                for l, was_training in zip(layers, prev):
+                    if was_training:
+                        l.train()
+                    else:
+                        l.eval()
+
+        cloned.__wrapped__ = inner
+        p.builder = cloned
         return p
 
     def __repr__(self):
@@ -215,18 +270,19 @@ class Program:
 
 def _flat_eqns(jaxpr):
     """Flatten call-like eqns (the per-op jit cache wraps every framework
-    op in pjit) so `ops` lists the REAL primitives, like the reference's
-    flat op list."""
+    op in pjit) AND control-flow primitives (`scan`/`while`/`cond` branch
+    jaxprs) so `ops` — and the paddle_tpu.analysis passes — list the REAL
+    primitives, like the reference's flat op list, instead of an opaque
+    control-flow node. The primitive -> sub-jaxpr dispatch is shared with
+    the analysis inliner so the two can never disagree on the op list."""
+    from ..analysis import _as_open, _sub_jaxprs
+
     out = []
     for eqn in jaxpr.eqns:
-        inner = None
-        for key in ("jaxpr", "call_jaxpr"):
-            v = eqn.params.get(key)
-            if v is not None:
-                inner = getattr(v, "jaxpr", v)
-                break
-        if inner is not None:
-            out.extend(_flat_eqns(inner))
+        _, subs = _sub_jaxprs(eqn)
+        if subs:
+            for sub in subs:
+                out.extend(_flat_eqns(_as_open(sub)[0]))
         else:
             out.append(eqn)
     return out
@@ -342,6 +398,25 @@ class Executor:
         if fn is None:
             builder = program.builder
 
+            # FLAGS_check_programs: verify the program once per feed
+            # signature, before it is compiled (reference: the IR pass
+            # verifiers that run ahead of executor program build)
+            from ..core import flags as _flags
+
+            if int(_flags.flag("check_programs")):
+                from .. import analysis
+
+                analysis.enforce(
+                    analysis.check(
+                        program,
+                        feed_specs={
+                            k: (v.shape, str(v.dtype))
+                            for k, v in zip(names, vals)
+                        },
+                    ),
+                    where="Executor.run",
+                )
+
             def pure(*feed_vals):
                 d = {k: Tensor(v, stop_gradient=True) for k, v in zip(names, feed_vals)}
                 # guard THIS program as default while tracing: static.nn
@@ -357,7 +432,19 @@ class Executor:
 
             fn = jax.jit(pure)
             program._compiled_cache[sig] = fn
+        # jit tracing (first call per feed signature) replays the builder
+        # with tracers; a layer buffer the builder mutates (BN running
+        # stats) would otherwise keep a leaked tracer that crashes any
+        # later eager read — e.g. running a clone(for_test=True) program.
+        # Compiled execution is pure (host-side buffer updates only happen
+        # on the eager warm run), so restoring the snapshot is exact.
+        buf_state = []
+        for layer in program._iter_layers():
+            if hasattr(layer, "named_buffers"):
+                buf_state.extend((b, b._value) for _, b in layer.named_buffers())
         out = fn(*vals)
+        for t, v in buf_state:
+            t._value = v
         outs = list(out) if isinstance(out, tuple) else [out]
         if return_numpy:
             outs = [np.asarray(jax.device_get(o)) for o in outs]
@@ -989,6 +1076,19 @@ def normalize_program(program, feeds, fetches, **kwargs):
 
 
 from . import sparsity  # noqa: E402,F401
+
+# paddle.static.analysis — graph verifier & lint passes over traced
+# programs (reference: the fluid/framework/ir pass suite). The package
+# lives at paddle_tpu.analysis; this alias is its public address, and the
+# sys.modules entry makes `import paddle_tpu.static.analysis` (and the
+# API.spec generator) resolve it like a real submodule.
+import sys as _sys  # noqa: E402
+
+from .. import analysis  # noqa: E402,F401
+
+_sys.modules[__name__ + ".analysis"] = analysis
+
+__all__ += ["analysis"]
 
 __all__ += [
     "BuildStrategy", "ExecutionStrategy", "ExponentialMovingAverage",

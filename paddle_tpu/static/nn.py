@@ -165,6 +165,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                in_place=False, name=None, moving_mean_name=None,
                moving_variance_name=None, do_model_average_for_mean_and_var=True,
                use_global_stats=False):
+    from . import default_main_program
+
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     key = (name or "batch_norm", int(c))
     layer = _layer_cache(
@@ -173,6 +175,9 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
             param_attr=param_attr, bias_attr=bias_attr,
             data_layout=data_layout),
     named=name is not None)
+    # a Program cloned with for_test=True marks itself eval-mode; the op's
+    # is_test then defaults on, like the reference clone's is_test rewrite
+    is_test = is_test or getattr(default_main_program(), "_for_test", False)
     layer.training = not is_test and not use_global_stats
     out = layer(input)
     return getattr(paddle.nn.functional, act)(out) if act else out

@@ -1,0 +1,108 @@
+"""CI self-lint: tools/graph_lint.py over the example model builders.
+
+The tier-1 gate from this PR's ISSUE: linting the shipped example models
+(`examples/train_vision.py`, `examples/train_gpt.py`) must produce NO
+error-severity diagnostics with FLAGS_check_programs=1 — a pass-suite or
+model regression that introduces one fails here. Runs the CLI in-process
+(same code path as `python tools/graph_lint.py ...`, minus the interpreter
+spawn).
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli():
+    path = os.path.join(REPO, "tools", "graph_lint.py")
+    spec = importlib.util.spec_from_file_location("graph_lint_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def check_programs_on():
+    paddle.set_flags({"FLAGS_check_programs": 1})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_check_programs": 0})
+
+
+@pytest.mark.parametrize("example", ["train_gpt.py", "train_vision.py"])
+def test_example_models_lint_error_clean(example, check_programs_on, capsys):
+    rc = _cli().main([os.path.join(REPO, "examples", example)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"error-severity diagnostics in {example}:\n{out}"
+    assert "error[" not in out
+    # the CLI footer reports the analysis flags in effect for CI logs
+    assert "FLAGS_check_programs=1" in out
+
+
+def test_lint_fails_on_injected_error(tmp_path, capsys):
+    bad = tmp_path / "bad_model.py"
+    bad.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model():\n"
+        "    fn = lambda x: paddle.log(x).sum()\n"
+        "    return fn, [paddle.static.InputSpec([4], 'float32')]\n"
+    )
+    cli = _cli()
+    rc = cli.main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unguarded log" in out
+
+    # --fail-on warning catches warning-severity findings too
+    warn = tmp_path / "warn_model.py"
+    warn.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model():\n"
+        "    fn = lambda x: x * 1.0\n"
+        "    return fn, [paddle.static.InputSpec([4], 'float32')]\n"
+    )
+    assert cli.main([str(warn)]) == 0
+    assert cli.main([str(warn), "--fail-on", "warning"]) == 1
+
+
+def test_lint_json_output_is_structured(tmp_path, capsys):
+    mod = tmp_path / "json_model.py"
+    mod.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model():\n"
+        "    fn = lambda x: paddle.log(x).sum()\n"
+        "    return fn, [paddle.static.InputSpec([4], 'float32')]\n"
+    )
+    rc = _cli().main([str(mod), "--json"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert rc == 1
+    recs = [json.loads(l) for l in lines]
+    assert any(
+        r["severity"] == "error" and r["pass"] == "numeric_hazards"
+        for r in recs
+    )
+    assert all({"severity", "pass", "op", "message", "hint"} <= set(r)
+               for r in recs)
+
+
+def test_lint_input_spec_override_and_pass_subset(tmp_path, capsys):
+    mod = tmp_path / "spec_model.py"
+    mod.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model():\n"
+        "    return lambda x: paddle.log(x).sum()\n"  # no specs returned
+    )
+    cli = _cli()
+    rc = cli.main([str(mod), "--input-spec", "2,3:float32",
+                   "--passes", "dead_code"])
+    assert rc == 0  # hazard pass not selected
+    rc = cli.main([str(mod), "--input-spec", "2,3:float32"])
+    assert rc == 1

@@ -1,0 +1,133 @@
+"""Lint a model file's traced graph with the paddle_tpu.analysis pass suite.
+
+Reference analogue: the IR pass/verifier gates the reference runs in CI over
+ProgramDesc graphs (fluid/framework/ir). Here the subject is the traced
+jaxpr of a model builder:
+
+    python tools/graph_lint.py examples/train_vision.py
+    python tools/graph_lint.py examples/train_gpt.py --builder build_model
+    python tools/graph_lint.py my_model.py --passes dtype_check,dead_code
+    python tools/graph_lint.py examples/train_vision.py --json
+
+The model file must expose a builder callable (default name: ``build_model``)
+returning one of:
+
+  - ``(layer_or_fn, input_specs)``  — traced via analysis.check(fn, specs),
+  - a ``static.Program``            — checked directly (feed vars known),
+  - a ``layer_or_fn``               — requires ``--input-spec``.
+
+``--input-spec`` accepts ``1,3,64,64:float32 8,16:int64`` style overrides.
+Exit status: 1 when any diagnostic at or above ``--fail-on`` (default:
+error) is found, else 0 — the CI self-lint step keys on this.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_module(path: str):
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(f"_graph_lint_{name}", path)
+    if spec is None or spec.loader is None:
+        raise SystemExit(f"graph_lint: cannot import {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _parse_spec(text: str):
+    shape_s, _, dtype = text.partition(":")
+    shape = [None if d in ("None", "-1") else int(d)
+             for d in shape_s.split(",") if d]
+    return tuple(shape), (dtype or "float32")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graph_lint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("model_file", help="python file exposing the builder")
+    ap.add_argument("--builder", default="build_model",
+                    help="builder callable name (default: build_model)")
+    ap.add_argument("--input-spec", nargs="*", default=None, metavar="SHAPE:DTYPE",
+                    help="input specs like 1,3,64,64:float32 (overrides the "
+                         "builder's own specs)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset (default: all)")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["info", "warning", "error"],
+                    help="exit nonzero at/above this severity (default: error)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as JSON lines")
+    args = ap.parse_args(argv)
+
+    # runnable as `python tools/graph_lint.py` from a checkout
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+
+    # force CPU before jax initializes: linting must run without the
+    # accelerator (same bootstrap as the examples / tests)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu import analysis
+    from paddle_tpu.core.flags import describe_flags
+
+    mod = _load_module(args.model_file)
+    builder = getattr(mod, args.builder, None)
+    if builder is None:
+        raise SystemExit(
+            f"graph_lint: {args.model_file} has no {args.builder}() — "
+            "expose a builder returning (model, input_specs) or a Program"
+        )
+    built = builder()
+    if isinstance(built, tuple) and len(built) == 2:
+        target, specs = built
+    else:
+        target, specs = built, None
+    if args.input_spec:
+        specs = [_parse_spec(s) for s in args.input_spec]
+
+    passes = args.passes.split(",") if args.passes else None
+    diags = analysis.check(target, specs, passes=passes)
+
+    if args.json:
+        for d in diags:
+            print(json.dumps({
+                "severity": str(d.severity), "pass": d.pass_name, "op": d.op,
+                "message": d.message, "hint": d.hint, "source": d.source,
+                "shapes": [list(map(int, s)) for s in d.shapes if s is not None],
+                "dtypes": list(d.dtypes),
+            }))
+    else:
+        if not diags:
+            print(f"graph_lint: {args.model_file}: clean "
+                  f"({len(analysis.pass_names())} passes)")
+        for d in diags:
+            print(f"  {d}")
+        # analysis-related flags in effect, so CI logs show the exact mode
+        active = describe_flags("check") + describe_flags("eager_lazy")
+        flags_str = ", ".join(f"{f['name']}={f['value']}" for f in active)
+        counts = {}
+        for d in diags:
+            counts[str(d.severity)] = counts.get(str(d.severity), 0) + 1
+        summary = ", ".join(f"{v} {k}" for k, v in sorted(counts.items())) or "0 findings"
+        print(f"graph_lint: {summary}  [{flags_str}]")
+
+    threshold = {"info": analysis.Severity.INFO,
+                 "warning": analysis.Severity.WARNING,
+                 "error": analysis.Severity.ERROR}[args.fail_on]
+    return 1 if any(d.severity >= threshold for d in diags) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

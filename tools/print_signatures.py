@@ -24,6 +24,7 @@ SUBMODULES = [
     "io",
     "jit",
     "static",
+    "static.analysis",
     "linalg",
     "metric",
     "distributed",
