@@ -624,13 +624,15 @@ def check_pending_segment(passes=None) -> List[Diagnostic]:
     return run_passes(ctx, passes)
 
 
-def check_launch_budget(step_fn=None, *args, budget=3, counters=None,
+def check_launch_budget(step_fn=None, *args, budget=None, counters=None,
                         warmup=2, **kwargs) -> List[Diagnostic]:
     """Audit steady-state device-program launches per step against a budget.
 
     Reuses the dispatch counters (PR 1): runs ``step_fn`` ``warmup`` times,
     then measures one step. Alternatively pass a ``counters`` dict captured
-    around a step. The default budget of 3 is the lazy-dispatch steady state
+    around a step. ``budget=None`` picks the budget from the counters: 1
+    when whole-step capture replayed the step as one donated program
+    (``FLAGS_eager_step_capture``), else 3 — the lazy-dispatch steady state
     (fused forward + compiled-tape backward + fused optimizer —
     PROFILE_EAGER.md)."""
     if counters is None:

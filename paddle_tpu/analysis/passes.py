@@ -511,24 +511,55 @@ def launch_budget(ctx: Context) -> List[Diagnostic]:
     if not ctx.counters:
         return []  # only meaningful when a counter snapshot is provided
     c = ctx.counters
-    budget = ctx.budget if ctx.budget is not None else 3
+    # whole-step capture (FLAGS_eager_step_capture) tightens the budget: a
+    # captured steady-state step is ONE donated XLA program, not three
+    captured = int(c.get("capture_replays", 0)) > 0
+    if ctx.budget is not None:
+        budget = ctx.budget
+    else:
+        budget = 1 if captured else 3
     diags = []
     programs = int(c.get("programs", 0))
     if programs > budget:
         parts = ", ".join(
             f"{k.removesuffix('_programs')}={c[k]}"
             for k in ("op_programs", "segment_programs", "backward_programs",
-                      "optimizer_programs")
+                      "optimizer_programs", "captured_programs")
             if c.get(k)
+        )
+        what = (
+            "one captured whole-step program"
+            if budget == 1
+            else "fused forward + compiled-tape backward + fused optimizer"
         )
         diags.append(Diagnostic(
             Severity.WARNING, "launch_budget", "step",
             f"step launched {programs} device programs "
-            f"(budget {budget}: fused forward + compiled-tape backward + "
-            f"fused optimizer); breakdown: {parts}",
+            f"(budget {budget}: {what}); breakdown: {parts}",
             hint="enable FLAGS_eager_lazy_dispatch, keep data-dependent "
                  "(jit=False) ops out of the hot loop, and check "
                  "flush_reasons in paddle.profiler.dispatch_counters()",
+        ))
+    if captured and programs <= budget:
+        diags.append(Diagnostic(
+            Severity.INFO, "launch_budget", "step",
+            "whole-step capture active: the step replayed as 1 XLA program "
+            "with parameters and optimizer state donated in place "
+            f"(capture_replays={c.get('capture_replays')})",
+        ))
+    fallbacks = int(c.get("capture_fallbacks", 0))
+    if fallbacks > 0:
+        reasons = c.get("capture_fallback_reasons") or {}
+        parts = ", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+        diags.append(Diagnostic(
+            Severity.WARNING, "launch_budget", "step",
+            f"step fell back out of whole-step capture {fallbacks} time(s)"
+            + (f" ({parts})" if parts else ""),
+            hint="a steady-state step keeps capture only when its signature "
+                 "is stable: avoid per-step shape/scalar changes, tensor "
+                 "hooks, retain_graph/create_graph, grad clipping, and "
+                 "reads of .grad or pending tensors between backward() and "
+                 "optimizer.step()",
         ))
     if int(c.get("segment_cache_misses", 0)) > 0:
         diags.append(Diagnostic(

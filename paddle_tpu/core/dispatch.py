@@ -79,7 +79,13 @@ def reset_dispatch_counters():
         segment_cache_evictions=0,
         jit_cache_evictions=0,
         vjp_cache_evictions=0,
+        captured_programs=0,
+        capture_builds=0,
+        capture_replays=0,
+        capture_fallbacks=0,
+        capture_evictions=0,
         flush_reasons={},
+        capture_fallback_reasons={},
     )
 
 
@@ -89,11 +95,16 @@ reset_dispatch_counters()
 def _count_program(kind: str = "op"):
     _counters["programs"] += 1
     _counters[kind + "_programs"] += 1
+    if kind == "op":
+        # per-op program launches make a step ineligible for whole-step
+        # capture; the observer (when active) marks the step dirty
+        _lazy._observe_op_program()
 
 
 def dispatch_counters() -> Dict[str, Any]:
     out = dict(_counters)
     out["flush_reasons"] = dict(_counters["flush_reasons"])
+    out["capture_fallback_reasons"] = dict(_counters["capture_fallback_reasons"])
     return out
 
 
@@ -591,14 +602,20 @@ def _make_tape_backward(avals, seqflags, edges, n_leaves, root_key):
     return jax.jit(fn)
 
 
-def _try_compiled_tape_backward(root, seed_val) -> bool:
-    """Run root.backward() as one compiled program. Returns False when the
-    tape has features the compiled path doesn't cover (caller falls back)."""
-    from .tensor import Tensor
+def _tape_structure(root, node_check=None):
+    """Canonical structure of root's tape: (key, order_nodes, leaf_tensors),
+    or None when the tape has features the caller can't cover.
 
+    `node_check(node) -> bool` filters every discovered node (the compiled
+    tape requires a live jitted vjp closure; the whole-step capture
+    controller requires the opposite: unflushed nodes owned by the pending
+    segment). Tapes with backward hooks or disconnected multi-root pieces
+    are rejected for both callers. The key is deterministic across steps
+    with identical topology/avals — it doubles as the capture controller's
+    tape fingerprint."""
     root_node = root._grad_node
     if root_node is None:
-        return False
+        return None
 
     # discover graph + consumer counts (mirrors run_backward pass 1)
     nodes: List[GradNode] = []
@@ -609,13 +626,13 @@ def _try_compiled_tape_backward(root, seed_val) -> bool:
         node = stack.pop()
         if id(node) in index:
             continue
-        if not node.jit_vjp or node.vjp_fn is None:
-            return False
+        if node_check is not None and not node_check(node):
+            return None
         index[id(node)] = len(nodes)
         nodes.append(node)
         for edge in node.inputs:
             if edge.tensor._backward_hooks:
-                return False
+                return None
             prod = edge.node
             if prod is not None:
                 pending[id(prod)] = pending.get(id(prod), 0) + 1
@@ -640,7 +657,7 @@ def _try_compiled_tape_backward(root, seed_val) -> bool:
                 if counts[id(prod)] == 0:
                     ready.append(prod)
     if len(order_nodes) != len(nodes):
-        return False  # disconnected pieces (multi-root tape) — fall back
+        return None  # disconnected pieces (multi-root tape) — fall back
 
     node_pos = {id(n): i for i, n in enumerate(order_nodes)}
     leaf_slots: Dict[int, int] = {}
@@ -668,10 +685,23 @@ def _try_compiled_tape_backward(root, seed_val) -> bool:
                     erec.append((-1, 0, slot))
         edges_rec.append(tuple(erec))
 
-    avals_rec = tuple(avals_rec)
-    seq_rec = tuple(seq_rec)
-    edges_rec = tuple(edges_rec)
-    key = (avals_rec, seq_rec, edges_rec, len(leaf_tensors), root._out_index)
+    key = (tuple(avals_rec), tuple(seq_rec), tuple(edges_rec),
+           len(leaf_tensors), root._out_index)
+    return key, order_nodes, leaf_tensors
+
+
+def _try_compiled_tape_backward(root, seed_val) -> bool:
+    """Run root.backward() as one compiled program. Returns False when the
+    tape has features the compiled path doesn't cover (caller falls back)."""
+    from .tensor import Tensor
+
+    struct = _tape_structure(
+        root, node_check=lambda n: n.jit_vjp and n.vjp_fn is not None
+    )
+    if struct is None:
+        return False
+    key, order_nodes, leaf_tensors = struct
+    avals_rec, seq_rec, edges_rec = key[0], key[1], key[2]
     fn = _tape_bwd_cache.get(key)
     if fn is None:
         fn = _make_tape_backward(
@@ -682,6 +712,9 @@ def _try_compiled_tape_backward(root, seed_val) -> bool:
     vjp_fns = [n.vjp_fn for n in order_nodes]
     leaf_vals = fn(vjp_fns, seed_val)
     _count_program("backward")
+    # step-capture observation: a compiled-tape backward is one of the two
+    # events (fused segment flush + this) a capturable step consists of
+    _lazy._observe_event(("bwd", key))
     for t, g in zip(leaf_tensors, leaf_vals):
         if g is None:
             continue
@@ -723,13 +756,32 @@ def run_backward(
     """
     from .tensor import Tensor
 
+    roots: List[Tensor] = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    # whole-step capture (FLAGS_eager_step_capture): when the controller is
+    # armed and this backward matches the captured step's forward-segment +
+    # tape signature, the backward is DEFERRED — the pending segment stays
+    # unflushed and the whole step (forward + backward + optimizer update)
+    # resolves at optimizer.step() as ONE donated XLA program. Any read of a
+    # grad / pending tensor before then aborts back to the 3-program path.
+    if (
+        not retain_graph
+        and not create_graph
+        and inputs is None
+        and accumulate_into_grad
+        and len(roots) == 1
+        and grad_tensors[0] is None
+        and flags.flag("eager_tape_jit")
+        and _lazy.step_capture_backward(roots[0])
+    ):
+        return None
+
     # backward is a materialization point: the pending forward segment (and
     # any lazy grad_tensors) must be concrete before the sweep reads values
     _lazy.flush_if_pending("backward")
 
-    roots: List[Tensor] = list(tensors)
-    if grad_tensors is None:
-        grad_tensors = [None] * len(roots)
     if create_graph:
         retain_graph = True
 

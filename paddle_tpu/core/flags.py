@@ -148,6 +148,37 @@ define_flag(
     "(bounds trace length and compile time of one fused segment)",
 )
 define_flag(
+    "eager_step_capture", True,
+    "whole-step capture-and-replay under FLAGS_eager_lazy_dispatch: once a "
+    "steady-state train step (fused forward segment + compiled-tape backward "
+    "+ fused optimizer) repeats with an identical signature for "
+    "FLAGS_eager_capture_warmup steps, re-trace the whole step as ONE XLA "
+    "program with parameters and optimizer state donated in place; any "
+    "signature mismatch / hook / retain_graph falls back to the 3-segment "
+    "path with identical numerics",
+)
+define_flag(
+    "eager_capture_donate", True,
+    "donate parameter and optimizer-state buffers to the captured "
+    "whole-step executable (in-place HBM reuse, the compile_train_step "
+    "discipline). On backends with real donation (TPU/GPU) this "
+    "invalidates stale aliases of the PREVIOUS buffers — e.g. a Tensor "
+    "from p.detach() or an optimizer state_dict() held across a later "
+    "captured step; set to 0 to keep whole-step capture (still 1 program "
+    "per step) without buffer donation",
+)
+define_flag(
+    "eager_capture_warmup", 2,
+    "number of consecutive identical steady-state steps observed before the "
+    "whole-step capture controller captures and replays the step as one "
+    "donated program",
+)
+define_flag(
+    "eager_capture_cache_size", 8,
+    "LRU cap on captured whole-step executables (0 = unbounded); evictions "
+    "are counted in paddle.profiler.dispatch_counters()",
+)
+define_flag(
     "use_standalone_executor", True, "use the compiled whole-program executor path"
 )
 define_flag(

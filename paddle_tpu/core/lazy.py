@@ -46,6 +46,7 @@ __all__ = [
     "materialize",
     "pending_op_count",
     "pending_segment_jaxpr",
+    "step_capture_state",
 ]
 
 # sentinel returned by lazy_apply when the op must take the per-op path
@@ -216,7 +217,14 @@ def pending_op_count() -> int:
 
 
 def flush_if_pending(reason: str = "explicit_sync"):
-    """Flush this thread's pending segment (no-op when nothing is pending)."""
+    """Flush this thread's pending segment (no-op when nothing is pending).
+
+    Also a resolution point for a deferred captured-step backward
+    (FLAGS_eager_step_capture): anything that forces materialization before
+    optimizer.step() replays the capture aborts it back to the normal
+    3-program path first — numerics never change, only the program count."""
+    if getattr(_tls, "capture_deferred", None) is not None:
+        _abort_capture(reason)
     seg = getattr(_tls, "segment", None)
     if seg is not None and not seg.flushed and seg.ops:
         _flush(seg, reason)
@@ -325,6 +333,13 @@ def _flush(seg: _Segment, reason: str):
 
     if seg.flushed:
         return
+    rec = getattr(_tls, "capture_deferred", None)
+    if rec is not None and (seg is rec.segment or seg is rec.stub_seg):
+        # a read reached a deferred captured step (the unflushed forward
+        # segment or one of the placeholder grads) before optimizer.step()
+        # replayed it: resolve by the normal flush + tape-backward path
+        _abort_capture(reason)
+        return
     seg.flushed = True
     if getattr(_tls, "segment", None) is seg:
         _tls.segment = None
@@ -374,6 +389,7 @@ def _flush(seg: _Segment, reason: str):
     dispatch._counters["segments_flushed"] += 1
     reasons = dispatch._counters["flush_reasons"]
     reasons[reason] = reasons.get(reason, 0) + 1
+    _observe_event(("seg", sig))
 
     vi = 0
     for op, outs in zip(seg.ops, results):
@@ -589,3 +605,579 @@ def _new_tensor(value, stop_gradient):
     t.persistable = False
     t.is_parameter = False
     return t
+
+
+# ---------------------------------------------------------------------------
+# Whole-step capture-and-replay (FLAGS_eager_step_capture).
+#
+# The LazyTensor / CUDA-Graphs idiom on top of lazy dispatch: the controller
+# observes the per-step event sequence — one fused forward segment flush, one
+# compiled-tape backward, one fused optimizer update — and once the same
+# (segment signature, tape fingerprint, optimizer fingerprint) triple has
+# recurred for FLAGS_eager_capture_warmup consecutive steps it re-traces the
+# WHOLE step (forward + backward + optimizer update) as one jaxpr, compiled
+# with donate_argnums over parameters and optimizer state so updates reuse
+# their HBM buffers in place. The mechanics:
+#
+#   - run_backward, seeing an armed controller and a matching pending
+#     segment + tape, DEFERS the backward: the segment stays unflushed, each
+#     tape leaf gets a placeholder grad (a LazyRef on a stub segment), and
+#     execution continues;
+#   - optimizer.step() is the step boundary: with a deferred backward
+#     pending it replays (or first compiles) the captured executable — ONE
+#     device program for the whole step — and writes back op outputs, leaf
+#     grads, new params, and new optimizer state;
+#   - ANY materialization in between (host read of a pending tensor or a
+#     placeholder grad, device.synchronize, a second backward, a signature
+#     mismatch at either end) aborts transparently: the segment flushes, the
+#     real tape backward runs, and the step completes on the 3-program path.
+#     Fallback is a counted perf event, never a numerics change — the
+#     captured program reproduces the tape's gradient contract structurally
+#     (stop_gradient on every non-differentiable input position), so its
+#     results match the per-op path exactly.
+# ---------------------------------------------------------------------------
+_capture_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+
+# events a capturable step consists of, in order; kept tiny — anything else
+# (per-op fallbacks, extra flushes, per-node backward sweeps) marks the step
+# dirty / pattern-mismatched and the controller simply keeps observing
+_MAX_OBSERVED_EVENTS = 8
+
+
+class _Observer:
+    """Per-thread step-signature observer / arming state."""
+
+    __slots__ = ("events", "dirty", "prev", "stable", "armed")
+
+    def __init__(self):
+        self.events: List[Tuple] = []
+        self.dirty = False
+        self.prev: Optional[Tuple] = None
+        self.stable = 0
+        self.armed: Optional[Tuple] = None  # (seg_sig, tape_key, opt_fp)
+
+
+class _DeferredStep:
+    """One backward deferred between loss.backward() and optimizer.step()."""
+
+    __slots__ = (
+        "segment", "stub_seg", "root", "seg_sig", "tape_key",
+        "leaves", "leaf_slots", "leaf_grads", "expected_opt_fp",
+    )
+
+
+class _CaptureEntry:
+    """One compiled whole-step executable plus its slot bookkeeping.
+
+    Everything here is structural (slot indices, plan closures, optimizer
+    hyper floats) — no tensors or arrays are pinned, so a cached entry
+    outlives any particular model instance with the same step signature."""
+
+    __slots__ = ("exe", "param_idx", "extra_idx", "param_slots",
+                 "extra_slots", "rest_slots", "warmed")
+
+
+class _CaptureIneligible(Exception):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _capture_on() -> bool:
+    return bool(flags.flag("eager_lazy_dispatch")) and bool(
+        flags.flag("eager_step_capture")
+    )
+
+
+def _observer() -> _Observer:
+    obs = getattr(_tls, "observer", None)
+    if obs is None:
+        obs = _Observer()
+        _tls.observer = obs
+    return obs
+
+
+def _observe_event(ev: Tuple):
+    if not _capture_on():
+        return
+    obs = _observer()
+    if len(obs.events) < _MAX_OBSERVED_EVENTS:
+        obs.events.append(ev)
+    else:
+        obs.dirty = True
+
+
+def _observe_op_program():
+    # called from dispatch._count_program on every per-op launch; a step
+    # containing per-op programs is not capturable as one executable
+    obs = getattr(_tls, "observer", None)
+    if obs is not None:
+        obs.dirty = True
+
+
+def _capture_fallback(reason: str):
+    from . import dispatch
+
+    dispatch._counters["capture_fallbacks"] += 1
+    rs = dispatch._counters["capture_fallback_reasons"]
+    rs[reason] = rs.get(reason, 0) + 1
+
+
+def _opt_fingerprint(opt) -> Tuple:
+    """Hashable identity of the optimizer part of a step signature: rule
+    type + global AND per-param hypers + weight decay + clip-absence + the
+    ids of the params that will be updated. Per-param overrides (e.g.
+    AdamW's apply_decay_param_fun exclusions) are baked into the compiled
+    executable, so they MUST key it — same convention as _apply_fused's
+    _jit_update_cache key. lr VALUE is excluded (schedulers may vary it per
+    step; it is a traced input of the captured program).
+
+    Deliberately NOT memoized: per-param overrides can only be validated by
+    recomputing them (a memo keyed on anything cheaper replays stale
+    hypers), and the per-step cost equals what _apply_fused already pays to
+    rebuild per_hypers — work a captured step skips entirely."""
+    upd = [
+        p for p in opt._param_list()
+        if not p.stop_gradient and p.grad is not None
+    ]
+    return (
+        type(opt),
+        tuple(sorted(opt._hyper().items())),
+        tuple(tuple(sorted(opt._per_param_hyper(p).items())) for p in upd),
+        opt._weight_decay,
+        getattr(opt, "_grad_clip", None) is None,
+        tuple(id(p) for p in upd),
+    )
+
+
+def _step_boundary(opt):
+    """Fold this step's observed events into the stability counter; arm the
+    controller after FLAGS_eager_capture_warmup consecutive identical
+    steady-state steps."""
+    obs = _observer()
+    events, dirty = obs.events, obs.dirty
+    obs.events, obs.dirty = [], False
+    opt_fp = None
+    if (
+        not dirty
+        and len(events) == 2
+        and events[0][0] == "seg"
+        and events[1][0] == "bwd"
+        # grad clipping reads (and rewrites) grads between backward and the
+        # update — that read would abort every deferred step, so never arm
+        and getattr(opt, "_grad_clip", None) is None
+    ):
+        try:
+            opt_fp = _opt_fingerprint(opt)
+        except Exception:
+            opt_fp = None
+    if opt_fp is None:
+        obs.prev, obs.stable, obs.armed = None, 0, None
+        return
+    sig = (events[0][1], events[1][1], opt_fp)
+    if sig == obs.prev:
+        obs.stable += 1
+    else:
+        obs.prev, obs.stable = sig, 1
+    obs.armed = (
+        sig if obs.stable >= int(flags.flag("eager_capture_warmup")) else None
+    )
+
+
+def step_capture_backward(root) -> bool:
+    """run_backward's capture hook: defer this backward when the controller
+    is armed and the pending segment + tape match the armed signature.
+    Returns True when deferred (the caller returns without sweeping)."""
+    if not _capture_on():
+        return False
+    obs = getattr(_tls, "observer", None)
+    if obs is None or obs.armed is None:
+        return False
+    if getattr(_tls, "capture_deferred", None) is not None:
+        return False  # a second backward this step — flush path aborts it
+    from . import dispatch
+
+    seg = getattr(_tls, "segment", None)
+    if seg is None or seg.flushed or not seg.ops:
+        return False
+    rv = root._value
+    if type(rv) is not LazyRef or rv._segment is not seg or rv._concrete is not None:
+        return False
+    if rv.size != 1:
+        return False
+    seg_sig = (tuple(seg.sig_parts), tuple(seg.ext_specs))
+    armed_seg, armed_tape, armed_opt = obs.armed
+    if seg_sig != armed_seg:
+        _capture_fallback("signature_mismatch")
+        obs.armed = None
+        return False
+    seg_nodes = {id(op.node) for op in seg.ops if op.record}
+    struct = dispatch._tape_structure(
+        root, node_check=lambda n: n.vjp_fn is None and id(n) in seg_nodes
+    )
+    if struct is None:
+        _capture_fallback("tape_ineligible")
+        obs.armed = None
+        return False
+    tape_key, order_nodes, leaves = struct
+    if tape_key != armed_tape:
+        _capture_fallback("tape_mismatch")
+        obs.armed = None
+        return False
+    if len(order_nodes) != len(seg_nodes):
+        # the segment recorded differentiable ops that are NOT ancestors of
+        # the loss (auxiliary outputs): a normal flush would give them vjp
+        # closures for a later backward of their own, which the captured
+        # replay cannot — keep such steps on the 3-program path
+        _capture_fallback("non_tape_recorded_ops")
+        obs.armed = None
+        return False
+    # every tape leaf must be a distinct concrete external input of the
+    # segment with no pre-existing grad (accumulation steps never capture)
+    slots: List[int] = []
+    ineligible = None
+    for t in leaves:
+        v = t._value
+        slot = None if type(v) is LazyRef else seg.ext_ids.get(id(v))
+        if slot is None or t.grad is not None:
+            ineligible = "leaf_ineligible"
+            break
+        slots.append(slot)
+    if ineligible is None and len(set(slots)) != len(slots):
+        ineligible = "aliased_leaves"
+    if ineligible is not None:
+        _capture_fallback(ineligible)
+        obs.armed = None
+        return False
+
+    # defer: detach the pending segment (later ops open a fresh one) and
+    # hand every leaf a placeholder grad whose read resolves — or aborts —
+    # the captured step
+    _tls.segment = None
+    stub_seg = _Segment()
+    rec = _DeferredStep()
+    rec.segment = seg
+    rec.stub_seg = stub_seg
+    rec.root = root
+    rec.seg_sig = seg_sig
+    rec.tape_key = tape_key
+    rec.leaves = leaves
+    rec.leaf_slots = slots
+    rec.leaf_grads = []
+    rec.expected_opt_fp = armed_opt
+    for i, t in enumerate(leaves):
+        v = t._value
+        ref = LazyRef(stub_seg, i, 0, tuple(v.shape), v.dtype)
+        gt = _new_tensor(ref, stop_gradient=True)
+        t.grad = gt
+        rec.leaf_grads.append((t, gt, ref))
+    _tls.capture_deferred = rec
+    return True
+
+
+def _abort_capture(reason: str):
+    """Resolve a deferred captured-step backward on the normal 3-program
+    path: flush the segment (which populates the tape's vjp closures), run
+    the real backward, and fill the placeholder grads. Numerics match the
+    never-captured path exactly; the event is counted as a capture
+    fallback and the controller re-observes from scratch."""
+    from . import dispatch
+
+    rec = getattr(_tls, "capture_deferred", None)
+    if rec is None:
+        return
+    _tls.capture_deferred = None
+    rec.stub_seg.flushed = True
+    _capture_fallback(reason)
+    obs = getattr(_tls, "observer", None)
+    if obs is not None:
+        obs.armed, obs.prev, obs.stable = None, None, 0
+        obs.events, obs.dirty = [], False
+    # leaves had no grad when the backward was deferred, so the real sweep
+    # must compute from scratch — exactly what the eager ordering did: the
+    # backward wrote a fresh grad FIRST, any later user write/clear of
+    # t.grad then replaced it. Reproduce that: run the sweep over grad=None
+    # leaves, give the placeholder tensor the computed value (whoever saved
+    # p.grad at backward() time sees the real gradient), and put back the
+    # user's replacement if there was one.
+    saved = [(t, gt, ref, t.grad) for t, gt, ref in rec.leaf_grads]
+    for t, _gt, _ref, _cur in saved:
+        t.grad = None
+    if not rec.segment.flushed:
+        _flush(rec.segment, "capture_abort")
+    root = rec.root
+    seed = jnp.ones_like(materialize(root._value))
+    if not dispatch._try_compiled_tape_backward(root, seed):
+        dispatch.run_backward([root])
+    for t, gt, ref, cur in saved:
+        real = t.grad
+        val = (
+            real._value if real is not None
+            else jnp.zeros(ref._shape, ref._dtype)
+        )
+        ref._concrete = val
+        gt._value = val
+        # keep the object identity handed out at backward() time, unless
+        # the user replaced/cleared t.grad after the deferral
+        t.grad = gt if cur is gt else cur
+
+
+def _plan_capture_forward(plan):
+    """Pure replay of a segment plan for whole-step capture.
+
+    The tape's gradient contract is reproduced structurally: gradient flows
+    ONLY through recorded ops' differentiable input positions (exactly the
+    positions the per-op path takes jax.vjp over); every other array input
+    is wrapped in lax.stop_gradient, so jax.vjp over this whole replay
+    equals the composition of the per-op vjps the tape would have applied."""
+
+    def fwd(ext):
+        results = []
+        for fn, kw, bindings, diff_idx, record in plan:
+            vals = []
+            for j, (kind, a, b) in enumerate(bindings):
+                if kind == _EXT:
+                    v = ext[a]
+                elif kind == _RES:
+                    v = results[a][b]
+                else:
+                    vals.append(a)  # python literal — no gradient path
+                    continue
+                if not record or j not in diff_idx:
+                    v = jax.lax.stop_gradient(v)
+                vals.append(v)
+            out = fn(*vals, **kw)
+            results.append(list(out) if isinstance(out, (tuple, list)) else [out])
+        return results
+
+    return fwd
+
+
+def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
+    """Trace + jit the whole step — forward plan, loss vjp, optimizer
+    update — as ONE program with params and optimizer state donated."""
+    seg = rec.segment
+    leaves = rec.leaves
+    if getattr(opt, "_grad_clip", None) is not None:
+        raise _CaptureIneligible("grad_clip")
+    leaf_pos = {id(t): i for i, t in enumerate(leaves)}
+    params = [
+        p for p in opt._param_list()
+        if not p.stop_gradient and p.grad is not None
+    ]
+    for p in params:
+        if id(p) not in leaf_pos:
+            # a param carries a grad the deferred tape did not produce
+            # (stale grad from an earlier step): updating it from inside
+            # the capture would diverge from the eager path
+            raise _CaptureIneligible("stale_or_external_grad")
+    param_idx = [leaf_pos[id(p)] for p in params]
+    pset = set(param_idx)
+    extra_idx = [i for i in range(len(leaves)) if i not in pset]
+    param_slots = [rec.leaf_slots[i] for i in param_idx]
+    extra_slots = [rec.leaf_slots[i] for i in extra_idx]
+    n_ext = len(seg.ext_vals)
+    leaf_slot_set = set(param_slots) | set(extra_slots)
+    rest_slots = [s for s in range(n_ext) if s not in leaf_slot_set]
+
+    fwd = _plan_capture_forward(_seg_plan(seg))
+    rv = rec.root._value
+    root_op, root_out = rv._op_index, rv._out_index
+    seed_shape, seed_dtype = rv._shape, rv._dtype
+
+    # the ONE shared definition of the traced optimizer math — identical to
+    # what Optimizer._apply_fused jits, so captured and 3-program steps
+    # cannot drift apart (it pins no optimizer instance)
+    from ..optimizer.optimizer import make_fused_update
+
+    apply_update = make_fused_update(opt, params)
+
+    def step_fn(p_vals, sts, lr, extra_vals, rest_vals):
+        ext = [None] * n_ext
+        for s, v in zip(rest_slots, rest_vals):
+            ext[s] = v
+
+        def loss_of(dp, dx):
+            e = list(ext)
+            for s, v in zip(param_slots, dp):
+                e[s] = v
+            for s, v in zip(extra_slots, dx):
+                e[s] = v
+            results = fwd(e)
+            return results[root_op][root_out], results
+
+        loss_val, vjp, results = jax.vjp(
+            loss_of, tuple(p_vals), tuple(extra_vals), has_aux=True
+        )
+        del loss_val  # the loss is results[root_op][root_out]
+        gp, gx = vjp(jnp.ones(seed_shape, seed_dtype))
+        new_p, new_s = apply_update(p_vals, gp, lr, sts)
+        return results, gp, gx, tuple(new_p), tuple(new_s)
+
+    entry = _CaptureEntry()
+    # donate params + optimizer state: XLA reuses their HBM buffers for the
+    # updated values (the compile_train_step discipline, earned by plain
+    # eager code). Batch data / extra leaves are NOT donated — they are
+    # caller-owned and reused across steps. FLAGS_eager_capture_donate=0
+    # opts out (keeps the 1-program step, drops in-place reuse) for code
+    # that holds aliases of param/state buffers across steps.
+    donate = (0, 1) if flags.flag("eager_capture_donate") else ()
+    entry.exe = jax.jit(step_fn, donate_argnums=donate)
+    entry.param_idx = param_idx
+    entry.extra_idx = extra_idx
+    entry.param_slots = param_slots
+    entry.extra_slots = extra_slots
+    entry.rest_slots = rest_slots
+    entry.warmed = False
+    return entry
+
+
+def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
+    from . import dispatch
+
+    seg = rec.segment
+    leaves = rec.leaves
+    params = [leaves[i] for i in entry.param_idx]
+    ext = seg.ext_vals
+    for p, s in zip(params, entry.param_slots):
+        if p._value is not ext[s]:
+            raise _CaptureIneligible("param_rebound")
+    for t, gt, _ref in rec.leaf_grads:
+        if t.grad is not gt:
+            # the user wrote/cleared a .grad between backward() and step():
+            # the eager path would feed THAT value to the update — abort so
+            # the normal path does exactly that
+            raise _CaptureIneligible("grad_replaced")
+    states = []
+    for p in params:
+        st = opt._accumulators.get(id(p))
+        if st is None:
+            st = opt._create_state(p)
+        states.append(st)
+    lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
+    args = (
+        tuple(ext[s] for s in entry.param_slots),
+        tuple(states),
+        lr,
+        tuple(ext[s] for s in entry.extra_slots),
+        tuple(ext[s] for s in entry.rest_slots),
+    )
+    if entry.warmed:
+        results, gp, gx, new_p, new_s = entry.exe(*args)
+    else:
+        import warnings
+
+        with warnings.catch_warnings():
+            # first call compiles; backends without real buffer donation
+            # (CPU) warn that donated buffers were unused — benign here
+            warnings.filterwarnings("ignore", message=".*onated buffer.*")
+            results, gp, gx, new_p, new_s = entry.exe(*args)
+        entry.warmed = True
+
+    _tls.capture_deferred = None
+    rec.stub_seg.flushed = True
+    dispatch._count_program("captured")
+    dispatch._counters["capture_replays"] += 1
+
+    # the captured program subsumes the segment flush: write every op
+    # output back exactly like _flush does (minus the vjp closures, which
+    # the capture consumed — a second backward raises, same as always)
+    seg.flushed = True
+    for op, outs in zip(seg.ops, results):
+        for (ref, t), val in zip(op.outs, outs):
+            ref._concrete = val
+            if t._value is ref:
+                t._value = val
+        if op.record:
+            op.node.out_avals = [(tuple(v.shape), v.dtype) for v in outs]
+    seg.ops = []
+    # donated param buffers are dead: drop the segment's references
+    seg.ext_vals = []
+    seg.ext_ids = {}
+
+    for i, g in zip(list(entry.param_idx) + list(entry.extra_idx),
+                    list(gp) + list(gx)):
+        t, gt, ref = rec.leaf_grads[i]
+        ref._concrete = g
+        gt._value = g
+    for p, v, ns in zip(params, new_p, new_s):
+        p._value = v
+        opt._accumulators[id(p)] = ns
+    obs = getattr(_tls, "observer", None)
+    if obs is not None:
+        obs.events, obs.dirty = [], False  # stays armed for the next step
+    return True
+
+
+def step_capture_step(optimizer) -> bool:
+    """Optimizer.step() entry hook — the capture controller's step boundary.
+
+    With no deferred backward pending this is the ordinary lazy-dispatch
+    materialization point (flush, reason 'optimizer_step') plus signature
+    observation. With a deferred backward pending, the whole step replays
+    (or first compiles) as ONE donated XLA program and True is returned so
+    Optimizer.step() skips the per-part path; any mismatch aborts to the
+    normal path and returns False."""
+    rec = getattr(_tls, "capture_deferred", None)
+    if rec is None:
+        flush_if_pending("optimizer_step")
+        if _capture_on():
+            _step_boundary(optimizer)
+        return False
+
+    def fallback(reason: str) -> bool:
+        _abort_capture(reason)
+        flush_if_pending("optimizer_step")
+        return False
+
+    if not _capture_on():
+        # the flag was turned off between backward() and step(): honor it —
+        # the deferred step resolves on the normal path, nothing is donated
+        return fallback("capture_disabled")
+    from . import dispatch
+
+    try:
+        opt_fp = _opt_fingerprint(optimizer)
+    except Exception:
+        opt_fp = None
+    if opt_fp is None or opt_fp != rec.expected_opt_fp:
+        return fallback("optimizer_mismatch")
+    key = (rec.seg_sig, rec.tape_key, opt_fp,
+           bool(flags.flag("eager_capture_donate")))
+    try:
+        entry = dispatch._lru_get(_capture_cache, key)
+    except TypeError:
+        # unhashable step key (exotic custom-optimizer hypers) — the step
+        # is not cacheable as a capture; run it on the normal path
+        return fallback("unhashable_key")
+    try:
+        if entry is None:
+            entry = _build_captured_step(rec, optimizer)
+            dispatch._counters["capture_builds"] += 1
+            dispatch._lru_put(
+                _capture_cache, key, entry,
+                evict_counter="capture_evictions",
+                cap=int(flags.flag("eager_capture_cache_size")),
+            )
+        return _run_captured(rec, optimizer, entry)
+    except _CaptureIneligible as e:
+        return fallback(e.reason)
+    except Exception:
+        # any trace/compile/runtime error from the captured executable must
+        # honor the fallback contract — the step completes on the normal
+        # 3-program path instead of crashing optimizer.step() (and the
+        # deferred placeholder grads must not outlive the failure)
+        return fallback("capture_error")
+
+
+def step_capture_state() -> Dict[str, Any]:
+    """Snapshot of this thread's whole-step capture controller (for
+    bench.py's capture-state line and paddle.profiler.measure_programs)."""
+    obs = getattr(_tls, "observer", None)
+    return {
+        "enabled": _capture_on(),
+        "armed": bool(obs is not None and obs.armed is not None),
+        "stable_steps": 0 if obs is None else obs.stable,
+        "deferred": getattr(_tls, "capture_deferred", None) is not None,
+        "cached_steps": len(_capture_cache),
+    }

@@ -26,6 +26,35 @@ from .lr import LRScheduler
 _jit_update_cache: Dict = {}
 
 
+def make_fused_update(opt, params):
+    """Pure multi-tensor update applier `(p_vals, g_vals, lr, states) ->
+    (new_ps, new_states)` over `opt`'s rule for `params`.
+
+    The ONE definition of the traced optimizer math shared by the eager
+    fused step (`_apply_fused`) and the whole-step capture trace
+    (core/lazy.py `_build_captured_step`): same rule, same static global +
+    per-param hyper merge, same grad-dtype cast. The rule is bound to a
+    bare shim carrying just `_weight_decay` — NOT the live optimizer — so
+    callers can cache the (jitted) closure without pinning the instance
+    and its accumulators."""
+    rule = type(opt)._update
+    hypers = [dict(opt._hyper(), **opt._per_param_hyper(p)) for p in params]
+    ctx = object.__new__(type(opt))
+    ctx._weight_decay = opt._weight_decay
+
+    def apply_update(p_vals, g_vals, lr, states):
+        new_ps, new_sts = [], []
+        for pv, gv, st, hy in zip(p_vals, g_vals, states, hypers):
+            if gv.dtype != pv.dtype:
+                gv = gv.astype(pv.dtype)
+            np_, nst = rule(ctx, pv, gv, lr, st, **hy)
+            new_ps.append(np_)
+            new_sts.append(nst)
+        return new_ps, new_sts
+
+    return apply_update
+
+
 class Optimizer:
     _update_has_state = True
 
@@ -97,9 +126,15 @@ class Optimizer:
         jitted XLA program (the merged_adam/multi_tensor path the reference
         gates behind use_multi_tensor), so eager training pays a single
         dispatch per step instead of one per parameter."""
-        # lazy-dispatch materialization point: grads (and lazily-created
-        # params) must be concrete before the fused jitted update reads them
-        _lazy.flush_if_pending("optimizer_step")
+        # whole-step capture boundary (FLAGS_eager_step_capture): a deferred
+        # backward resolves here as ONE donated XLA program covering forward
+        # + backward + this update. Otherwise this is the ordinary lazy-
+        # dispatch materialization point — grads (and lazily-created params)
+        # are flushed concrete before the fused jitted update reads them —
+        # plus step-signature observation for the capture controller.
+        if _lazy.step_capture_step(self):
+            self._step_count += 1
+            return
         params_grads = [
             (p, p.grad)
             for p in self._param_list()
@@ -159,27 +194,10 @@ class Optimizer:
             self._fused_key_memo = (sig, key)
         fn = _jit_update_cache.get(key)
         if fn is None:
-            rule = type(self)._update
-            hypers = [dict(self._hyper(), **self._per_param_hyper(p)) for p in params]
-            # the traced rule reads nothing off the instance except
-            # _weight_decay (via _apply_weight_decay_l2) — bind a bare shim
-            # carrying just that scalar, NOT `self`: this cache is global and
-            # capturing the instance would pin its accumulators (potentially
-            # hundreds of MB of moments) for the process lifetime
-            ctx = object.__new__(type(self))
-            ctx._weight_decay = self._weight_decay
-
-            def fused(p_vals, g_vals, lr, sts, _ctx=ctx, _hypers=hypers):
-                new_ps, new_sts = [], []
-                for pv, gv, st, hy in zip(p_vals, g_vals, sts, _hypers):
-                    if gv.dtype != pv.dtype:
-                        gv = gv.astype(pv.dtype)
-                    np_, nst = rule(_ctx, pv, gv, lr, st, **hy)
-                    new_ps.append(np_)
-                    new_sts.append(nst)
-                return new_ps, new_sts
-
-            fn = jax.jit(fused)
+            # make_fused_update binds a bare weight-decay shim, NOT `self`:
+            # this cache is global and capturing the instance would pin its
+            # accumulators (potentially hundreds of MB of moments) forever
+            fn = jax.jit(make_fused_update(self, params))
             _jit_update_cache[key] = fn
         new_ps, new_sts = fn(
             [p._value for p in params], g_vals,

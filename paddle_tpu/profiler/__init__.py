@@ -310,11 +310,13 @@ def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
     """Dispatch-counter snapshot of ONE steady-state `step_fn` call.
 
     Runs `warmup` calls first (compiles segments / tape / optimizer
-    programs), flushes any pending lazy segment, zeroes the counters, runs
-    one measured call, flushes again so trailing lazy ops are charged to
-    the step, and returns the counter dict. This is the measurement the
-    PROFILE_EAGER.md programs-per-step arithmetic — and the analysis
-    launch-budget pass — is defined over."""
+    programs; with FLAGS_eager_step_capture on, also the steps that arm the
+    whole-step capture controller), flushes any pending lazy segment, zeroes
+    the counters, runs one measured call, flushes again so trailing lazy ops
+    are charged to the step, and returns the counter dict — including the
+    capture hit/fallback/eviction counters and a `_capture_state` snapshot.
+    This is the measurement the PROFILE_EAGER.md programs-per-step
+    arithmetic — and the analysis launch-budget pass — is defined over."""
     from ..core import lazy
 
     for _ in range(max(0, warmup)):
@@ -325,6 +327,7 @@ def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
     lazy.flush_if_pending("measure_programs")
     counters = dispatch_counters()
     counters["_step_result"] = out
+    counters["_capture_state"] = lazy.step_capture_state()
     return counters
 
 

@@ -1,0 +1,620 @@
+"""Whole-step capture-and-replay (FLAGS_eager_step_capture): budget + parity.
+
+Covers the step-capture controller of the lazy dispatcher (core/lazy.py):
+the steady-state eager LeNet train step going 3 -> 1 device programs with
+params + optimizer state donated, bitwise numeric parity against the per-op
+eager path (params, optimizer state, losses, grads), and every fallback
+path — hooks, retain_graph, shape changes, grad/loss reads between
+backward() and optimizer.step() — staying a counted perf event with
+identical numerics. Also the launch_budget analysis pass learning the
+1-program captured-step budget, and the capture counters surfacing through
+paddle.profiler.dispatch_counters() / measure_programs(). All CPU, no TPU
+required — the win is proven by the program counters.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+from paddle_tpu.core import lazy
+
+
+@pytest.fixture
+def capture_mode():
+    # fresh controller state per test: a stale armed signature from another
+    # test's model must not leak into this one's counters
+    lazy._tls.observer = None
+    lazy._capture_cache.clear()
+    prof.reset_dispatch_counters()
+    paddle.set_flags({
+        "FLAGS_eager_lazy_dispatch": True,
+        "FLAGS_eager_step_capture": True,
+    })
+    try:
+        yield
+    finally:
+        lazy.flush_if_pending("test_teardown")
+        paddle.set_flags({
+            "FLAGS_eager_lazy_dispatch": False,
+            "FLAGS_eager_step_capture": True,
+        })
+        lazy._tls.observer = None
+
+
+def _mlp_trainer(seed=0, lr=1e-2, bsz=4):
+    paddle.seed(seed)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+    )
+    opt = paddle.optimizer.Adam(learning_rate=lr, parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((bsz, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (bsz,)))
+
+    def step(xt=None, yt=None):
+        loss = loss_fn(model(xt if xt is not None else x),
+                       yt if yt is not None else y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return model, opt, step, (x, y)
+
+
+def _snapshot(model, opt):
+    params = [np.asarray(p.numpy()) for p in model.parameters()]
+    states = []
+    for p in model.parameters():
+        st = opt._accumulators.get(id(p)) or {}
+        states.append({k: np.asarray(v) for k, v in st.items()})
+    return params, states
+
+
+def _lenet_trainer():
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (4,)))
+
+    def step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# acceptance: steady-state LeNet step is ONE program captured, 3 uncaptured
+# ---------------------------------------------------------------------------
+def test_lenet_captured_step_is_one_program(capture_mode):
+    step = _lenet_trainer()
+    # warmup=2 arms the controller after two identical steps; the measured
+    # third step compiles + replays the captured whole-step program
+    c = prof.measure_programs(step, warmup=2)
+    assert c["programs"] == 1, c
+    assert c["captured_programs"] == 1, c
+    assert c["capture_replays"] == 1, c
+    assert c["segment_programs"] == 0, c
+    assert c["backward_programs"] == 0, c
+    assert c["optimizer_programs"] == 0, c
+    assert c["_capture_state"]["armed"] is True
+    # a later measured step replays the cached executable: no new build
+    c2 = prof.measure_programs(step, warmup=1)
+    assert c2["programs"] == 1 and c2["capture_builds"] == 0, c2
+
+
+def test_lenet_capture_off_is_three_programs(capture_mode):
+    paddle.set_flags({"FLAGS_eager_step_capture": False})
+    step = _lenet_trainer()
+    c = prof.measure_programs(step, warmup=2)
+    assert c["programs"] == 3, c
+    assert c["captured_programs"] == 0, c
+    assert c["segment_programs"] == 1, c
+    assert c["backward_programs"] == 1, c
+    assert c["optimizer_programs"] == 1, c
+
+
+# ---------------------------------------------------------------------------
+# donation-aliasing correctness: captured numerics bitwise-match per-op
+# ---------------------------------------------------------------------------
+def _run_reference(n_steps):
+    """The same trainer on the plain per-op eager path."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    try:
+        model, opt, step, _ = _mlp_trainer()
+        losses = [float(step()) for _ in range(n_steps)]
+        return losses, _snapshot(model, opt)
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+
+
+def test_captured_numerics_bitwise_match_per_op(capture_mode):
+    n = 5  # warmup (2) + captured steps (3)
+    losses_ref, (p_ref, s_ref) = _run_reference(n)
+
+    model, opt, step, _ = _mlp_trainer()
+    losses = [float(step()) for _ in range(n)]
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 3, c  # steps 3..5 ran captured
+    assert losses == losses_ref
+    p_cap, s_cap = _snapshot(model, opt)
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_cap, s_ref):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_fresh_batches_replay_and_match(capture_mode):
+    """Fresh batch tensors every step (the realistic loader pattern) keep
+    the signature stable — replays continue, numerics stay bitwise."""
+
+    def run(lazy_on, n=6):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        paddle.seed(0)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+        )
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, weight_decay=0.01,
+                                     parameters=model.parameters())
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        rng = np.random.default_rng(11)
+        losses = []
+        for _ in range(n):
+            x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+            y = paddle.to_tensor(rng.integers(0, 4, (4,)))
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        params = [np.asarray(p.numpy()) for p in model.parameters()]
+        return losses, params
+
+    l_ref, p_ref = run(False)
+    prof.reset_dispatch_counters()
+    l_cap, p_cap = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 4, c
+    assert c["capture_fallbacks"] == 0, c
+    assert l_ref == l_cap
+    for a, b in zip(p_ref, p_cap):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_captured_step_still_exposes_grads(capture_mode):
+    """p.grad after a captured optimizer.step() (before clear_grad) must
+    hold the same grad the per-op path would have stored."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    model_r, opt_r, _, (x, y) = _mlp_trainer()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    ref_grads = None
+    for i in range(4):
+        loss = loss_fn(model_r(x), y)
+        loss.backward()
+        opt_r.step()
+        if i == 3:
+            ref_grads = [np.asarray(p.grad.numpy()) for p in model_r.parameters()]
+        opt_r.clear_grad()
+
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    model, opt, _, (x2, y2) = _mlp_trainer()
+    got = None
+    for i in range(4):
+        loss = loss_fn(model(x2), y2)
+        loss.backward()
+        opt.step()
+        if i == 3:
+            got = [np.asarray(p.grad.numpy()) for p in model.parameters()]
+        opt.clear_grad()
+    assert prof.dispatch_counters()["capture_replays"] >= 1
+    for a, b in zip(got, ref_grads):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_second_backward_after_captured_step_raises(capture_mode):
+    _model, _opt, step, _ = _mlp_trainer()
+    for _ in range(4):
+        loss = step()
+    assert prof.dispatch_counters()["capture_replays"] >= 1
+    with pytest.raises(RuntimeError, match="second time"):
+        loss.backward()
+
+
+# ---------------------------------------------------------------------------
+# fallback paths: perf events, never numerics changes
+# ---------------------------------------------------------------------------
+def test_hooks_prevent_capture_with_identical_results(capture_mode):
+    losses_ref, (p_ref, _) = _run_reference(4)
+
+    model, opt, step, _ = _mlp_trainer()
+    seen = []
+    list(model.parameters())[0].register_hook(lambda g: seen.append(g.numpy()))
+    losses = [float(step()) for _ in range(4)]
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] == 0, c  # hooked tape never captures
+    assert losses == losses_ref
+    assert len(seen) == 4
+    p_cap, _ = _snapshot(model, opt)
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_retain_graph_step_takes_normal_path(capture_mode):
+    model, opt, _, (x, y) = _mlp_trainer()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    for _ in range(3):  # arm + capture
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert prof.dispatch_counters()["capture_replays"] >= 1
+    # retain_graph backward is never deferred; two sweeps double the grad
+    loss = loss_fn(model(x), y)
+    loss.backward(retain_graph=True)
+    g1 = np.asarray(list(model.parameters())[0].grad.numpy())
+    loss.backward()
+    g2 = np.asarray(list(model.parameters())[0].grad.numpy())
+    np.testing.assert_allclose(g2, 2.0 * g1, rtol=1e-6, atol=1e-7)
+    opt.step()
+    opt.clear_grad()
+
+
+def test_shape_change_falls_back_and_recaptures(capture_mode):
+    model, opt, step, _ = _mlp_trainer()
+    rng = np.random.default_rng(3)
+    x6 = paddle.to_tensor(rng.standard_normal((6, 8)).astype(np.float32))
+    y6 = paddle.to_tensor(rng.integers(0, 4, (6,)))
+    for _ in range(3):
+        step()
+    assert prof.dispatch_counters()["capture_replays"] >= 1
+    # a different batch shape mismatches the armed signature: counted
+    # fallback, step completes on the 3-program path
+    prof.reset_dispatch_counters()
+    float(step(x6, y6))
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] == 0, c
+    assert c["capture_fallbacks"] >= 1, c
+    assert c["capture_fallback_reasons"].get("signature_mismatch", 0) >= 1, c
+    assert c["programs"] == 3, c
+    # the original shape re-arms after the warmup and replays the CACHED
+    # executable — no new capture build
+    prof.reset_dispatch_counters()
+    for _ in range(3):
+        step()
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 1, c
+    assert c["capture_builds"] == 0, c
+
+
+def test_grad_read_between_backward_and_step_aborts(capture_mode):
+    losses_ref, (p_ref, _) = _run_reference(4)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    model_r, opt_r, _, (xr, yr) = _mlp_trainer()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    for _ in range(3):
+        l = loss_fn(model_r(xr), yr)
+        l.backward()
+        opt_r.step()
+        opt_r.clear_grad()
+    l = loss_fn(model_r(xr), yr)
+    l.backward()
+    ref_grad = np.asarray(list(model_r.parameters())[0].grad.numpy())
+    opt_r.step()
+    opt_r.clear_grad()
+    _, (p_ref2, _) = (None, _snapshot(model_r, opt_r))
+
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    model, opt, step, (x, y) = _mlp_trainer()
+    for _ in range(3):
+        step()
+    assert prof.dispatch_counters()["capture_replays"] >= 1
+    loss = loss_fn(model(x), y)
+    loss.backward()  # deferred (armed)
+    # reading a grad before optimizer.step() aborts the capture: the real
+    # flush + tape backward run, values identical to the per-op path
+    prof.reset_dispatch_counters()
+    got = np.asarray(list(model.parameters())[0].grad.numpy())
+    np.testing.assert_array_equal(got, ref_grad)
+    c = prof.dispatch_counters()
+    assert c["capture_fallbacks"] >= 1, c
+    opt.step()
+    opt.clear_grad()
+    p_cap, _ = _snapshot(model, opt)
+    for a, b in zip(p_cap, p_ref2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_loss_read_between_backward_and_step_aborts(capture_mode):
+    model, opt, _, (x, y) = _mlp_trainer()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    vals = []
+    for _ in range(3):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        vals.append(float(loss))
+    loss = loss_fn(model(x), y)
+    loss.backward()  # deferred
+    prof.reset_dispatch_counters()
+    v = float(loss)  # host read aborts the deferred step
+    assert np.isfinite(v)
+    c = prof.dispatch_counters()
+    assert c["capture_fallbacks"] >= 1, c
+    opt.step()  # completes normally on concrete grads
+    opt.clear_grad()
+
+
+def test_flag_off_between_backward_and_step_is_honored(capture_mode):
+    """Turning FLAGS_eager_step_capture off after a deferred backward must
+    resolve that step on the normal path, not replay the capture."""
+    model, opt, step, (x, y) = _mlp_trainer()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    for _ in range(3):
+        step()
+    assert prof.dispatch_counters()["capture_replays"] >= 1
+    loss = loss_fn(model(x), y)
+    loss.backward()  # deferred (armed)
+    paddle.set_flags({"FLAGS_eager_step_capture": False})
+    prof.reset_dispatch_counters()
+    opt.step()
+    opt.clear_grad()
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] == 0, c
+    assert c["capture_fallback_reasons"].get("capture_disabled", 0) == 1, c
+    assert np.isfinite(float(loss))
+    paddle.set_flags({"FLAGS_eager_step_capture": True})
+
+
+def test_capture_without_donation_still_one_program(capture_mode):
+    """FLAGS_eager_capture_donate=0 keeps the 1-program captured step (for
+    code holding aliases of param/state buffers) — numerics unchanged."""
+    paddle.set_flags({"FLAGS_eager_capture_donate": False})
+    try:
+        losses_ref, (p_ref, _) = _run_reference(5)
+        model, opt, step, _ = _mlp_trainer()
+        losses = [float(step()) for _ in range(4)]
+        c = prof.measure_programs(step, warmup=0)
+        assert c["programs"] == 1 and c["captured_programs"] == 1, c
+        losses.append(float(c["_step_result"]))
+        assert losses == losses_ref
+        p_cap, _ = _snapshot(model, opt)
+        for a, b in zip(p_cap, p_ref):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        paddle.set_flags({"FLAGS_eager_capture_donate": True})
+
+
+def test_capture_build_error_falls_back_not_crashes(capture_mode, monkeypatch):
+    """An unexpected error while building/running the captured executable
+    must resolve the step on the normal path, not crash optimizer.step()."""
+    model, opt, step, _ = _mlp_trainer()
+    for _ in range(2):  # arm without capturing yet (warmup=2)
+        step()
+    monkeypatch.setattr(
+        lazy, "_build_captured_step",
+        lambda rec, opt: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    losses_after = [float(step()) for _ in range(2)]
+    assert all(np.isfinite(v) for v in losses_after)
+    c = prof.dispatch_counters()
+    assert c["capture_fallback_reasons"].get("capture_error", 0) >= 1, c
+    assert c["capture_replays"] == 0, c
+
+
+def test_capture_cache_lru_eviction(capture_mode):
+    prev = paddle.get_flags("FLAGS_eager_capture_cache_size")[
+        "FLAGS_eager_capture_cache_size"
+    ]
+    paddle.set_flags({"FLAGS_eager_capture_cache_size": 1})
+    try:
+        _m1, _o1, step1, _ = _mlp_trainer(seed=0)
+        _m2, _o2, step2, _ = _mlp_trainer(seed=1, bsz=6)
+        for _ in range(3):
+            step1()
+        for _ in range(3):
+            step2()
+        c = prof.dispatch_counters()
+        assert c["capture_builds"] == 2, c
+        assert c["capture_evictions"] >= 1, c
+        assert len(lazy._capture_cache) <= 1
+    finally:
+        paddle.set_flags({"FLAGS_eager_capture_cache_size": prev})
+
+
+def test_per_param_hyper_change_misses_capture_cache(capture_mode):
+    """A recreated optimizer with different per-param hyper overrides (same
+    type, same globals, same params) must NOT hit the old captured
+    executable — the overrides are baked into the compiled update. Run the
+    whole swap scenario on both paths and compare bitwise."""
+
+    def run(lazy_on):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        paddle.seed(0)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 4)
+        )
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        rng = np.random.default_rng(5)
+        x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 4, (4,)))
+
+        def train(opt, n):
+            for _ in range(n):
+                loss = loss_fn(model(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+
+        opt_a = paddle.optimizer.AdamW(learning_rate=1e-2, weight_decay=0.5,
+                                       parameters=model.parameters())
+        train(opt_a, 3)
+        # same type/globals/params, but every param now excluded from decay
+        opt_b = paddle.optimizer.AdamW(
+            learning_rate=1e-2, weight_decay=0.5,
+            parameters=model.parameters(),
+            apply_decay_param_fun=lambda name: False,
+        )
+        opt_b._accumulators = opt_a._accumulators
+        train(opt_b, 3)
+        return [np.asarray(p.numpy()) for p in model.parameters()]
+
+    params_ref = run(False)
+    prof.reset_dispatch_counters()
+    params_cap = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 1, c  # capture did engage for opt_a
+    for a, b in zip(params_cap, params_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_aux_output_backward_prevents_capture(capture_mode):
+    """A differentiable output recorded in the same segment but NOT on the
+    loss tape must keep the step on the 3-program path — a later backward
+    through it needs the flush-time vjp closures."""
+    model, opt, _, (x, y) = _mlp_trainer()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    w = paddle.to_tensor(np.ones(4, np.float32))
+    w.stop_gradient = False
+    auxes = []
+    for _ in range(4):
+        aux = (w * 3.0).sum()  # recorded, not an ancestor of loss
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        auxes.append(aux)
+        w.clear_grad()
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] == 0, c
+    # first backward through the aux subgraph still works
+    auxes[-1].backward()
+    np.testing.assert_allclose(w.grad.numpy(), np.full(4, 3.0))
+
+
+def test_grad_write_between_backward_and_step_aborts(capture_mode):
+    """p.grad = <custom> between backward() and step(): the update must use
+    the user's grad (eager ordering), and a grad saved at backward() time
+    must hold the real backward value."""
+
+    def run(lazy_on):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        model, opt, _, (x, y) = _mlp_trainer()
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        for _ in range(3):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        p0 = list(model.parameters())[0]
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        saved = p0.grad  # handed out at backward() time
+        p0.grad = paddle.to_tensor(np.zeros(p0.shape, np.float32))
+        opt.step()
+        opt.clear_grad()
+        return (np.asarray(saved.numpy()),
+                [np.asarray(p.numpy()) for p in model.parameters()])
+
+    saved_ref, params_ref = run(False)
+    prof.reset_dispatch_counters()
+    saved_cap, params_cap = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    c = prof.dispatch_counters()
+    assert c["capture_fallback_reasons"].get("grad_replaced", 0) >= 1, c
+    np.testing.assert_array_equal(saved_cap, saved_ref)
+    for a, b in zip(params_cap, params_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_grad_clear_between_backward_and_step_aborts(capture_mode):
+    """clear_grad() between backward() and step(): no update happens (eager
+    ordering), and the grad tensor saved at backward() time still holds the
+    real backward value."""
+
+    def run(lazy_on):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        model, opt, _, (x, y) = _mlp_trainer()
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        for _ in range(3):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        saved = [p.grad for p in model.parameters()]
+        opt.clear_grad()
+        opt.step()  # all grads cleared: must be a no-op update
+        return ([np.asarray(g.numpy()) for g in saved],
+                [np.asarray(p.numpy()) for p in model.parameters()])
+
+    saved_ref, params_ref = run(False)
+    saved_cap, params_cap = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    for a, b in zip(saved_cap, saved_ref):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(params_cap, params_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# launch_budget pass learns the captured-step budget + fallback diagnostics
+# ---------------------------------------------------------------------------
+def test_launch_budget_learns_captured_budget(capture_mode):
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import Severity
+
+    step = _lenet_trainer()
+    diags = analysis.check_launch_budget(step, warmup=2)
+    assert not [d for d in diags if d.severity >= Severity.WARNING], diags
+    # donation status is reported for the captured steady state
+    infos = [d for d in diags if d.pass_name == "launch_budget"]
+    assert any("donated" in d.message for d in infos), diags
+
+
+def test_launch_budget_flags_repeated_fallbacks():
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import Severity
+
+    diags = analysis.check_launch_budget(counters={
+        "programs": 3,
+        "segment_programs": 1,
+        "backward_programs": 1,
+        "optimizer_programs": 1,
+        "capture_fallbacks": 2,
+        "capture_fallback_reasons": {"signature_mismatch": 2},
+    })
+    hits = [
+        d for d in diags
+        if d.pass_name == "launch_budget" and d.severity == Severity.WARNING
+        and "fell back out of whole-step capture" in d.message
+    ]
+    assert hits and "signature_mismatch" in hits[0].message, diags
+
+
+def test_dispatch_counters_expose_capture_keys():
+    c = prof.dispatch_counters()
+    for k in ("captured_programs", "capture_builds", "capture_replays",
+              "capture_fallbacks", "capture_evictions",
+              "capture_fallback_reasons"):
+        assert k in c, c

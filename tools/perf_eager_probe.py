@@ -1,9 +1,11 @@
 """Programs-per-step probe for the eager LeNet train step.
 
 Measures what PROFILE_EAGER.md's arithmetic predicts: the number of device
-programs one eager LeNet train step launches on the per-op path versus the
-lazy-dispatch path (FLAGS_eager_lazy_dispatch), using the dispatch counters
-exposed via paddle_tpu.profiler. Runs on any backend; pin CPU with:
+programs one eager LeNet train step launches on the per-op path, the
+lazy-dispatch path (FLAGS_eager_lazy_dispatch), and the whole-step
+capture-and-replay path (FLAGS_eager_step_capture — one donated program per
+step), using the dispatch counters exposed via paddle_tpu.profiler. Runs on
+any backend; pin CPU with:
 
     JAX_PLATFORMS=cpu python tools/perf_eager_probe.py
 
@@ -41,11 +43,14 @@ def build(bsz):
     return step
 
 
-def probe(lazy: bool, bsz: int, steps: int):
-    paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy})
+def probe(lazy: bool, capture: bool, bsz: int, steps: int):
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy,
+                      "FLAGS_eager_step_capture": capture})
     try:
         step = build(bsz)
-        for _ in range(3):  # warm-up: fill the per-op / segment compile caches
+        # warm-up: fill the per-op / segment compile caches; with capture on
+        # this also arms the controller and compiles the captured step
+        for _ in range(4):
             loss = step()
         float(loss)
 
@@ -57,7 +62,8 @@ def probe(lazy: bool, bsz: int, steps: int):
         dt = time.time() - t0
         c = prof.dispatch_counters()
     finally:
-        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False,
+                          "FLAGS_eager_step_capture": True})
     return c, dt
 
 
@@ -65,19 +71,29 @@ def main():
     bsz = int(os.environ.get("PROBE_BATCH", 16))
     steps = int(os.environ.get("PROBE_STEPS", 5))
     print(f"eager LeNet train step, batch {bsz}, {steps} steady-state steps\n")
-    for mode, lazy in (("per-op", False), ("lazy", True)):
-        c, dt = probe(lazy, bsz, steps)
+    for mode, lazy, capture in (
+        ("per-op", False, False),
+        ("lazy", True, False),
+        ("captured", True, True),
+    ):
+        c, dt = probe(lazy, capture, bsz, steps)
         per_step = c["programs"] / steps
         print(f"[{mode}] programs/step = {per_step:.1f}  "
               f"({steps / dt:.1f} steps/s)")
         print(f"    op={c['op_programs']} segment={c['segment_programs']} "
               f"backward={c['backward_programs']} "
-              f"optimizer={c['optimizer_programs']}")
+              f"optimizer={c['optimizer_programs']} "
+              f"captured={c['captured_programs']}")
         if lazy:
             print(f"    segments_flushed={c['segments_flushed']} "
                   f"cache hits/misses={c['segment_cache_hits']}/"
                   f"{c['segment_cache_misses']} "
                   f"flush_reasons={c['flush_reasons']}")
+        if capture:
+            print(f"    capture replays={c['capture_replays']} "
+                  f"builds={c['capture_builds']} "
+                  f"fallbacks={c['capture_fallbacks']} "
+                  f"fallback_reasons={c['capture_fallback_reasons']}")
         print()
 
 
