@@ -353,6 +353,33 @@ def bench_mnist_eager(steps=30, bsz=64):
                           "FLAGS_eager_step_capture": True})
     from paddle_tpu.core.lazy import step_capture_state
 
+    # estimated peak HBM per regime (analysis.memory liveness planner over
+    # the captured whole-step program): the captured regime gets donation
+    # credit; per-op and lazy run the same op set with no donation, so the
+    # no-donation plan is their shared estimate (MEMORY_PLAN.md) — this is
+    # the memory trajectory BENCH_* files track
+    est_mem = None
+    try:
+        from paddle_tpu.analysis import memory as _mem
+
+        plans = _mem.captured_step_plans()
+        if plans is not None:
+            cap_plan, nodon_plan = plans
+            mb = lambda n: round(n / 2**20, 2)  # noqa: E731
+            est_mem = {
+                "per_op": mb(nodon_plan.peak_bytes),
+                "lazy": mb(nodon_plan.peak_bytes),
+                "captured": mb(cap_plan.peak_bytes),
+                "donation_credit": mb(cap_plan.donation_credit_bytes),
+            }
+            print(f"# mnist est peak HBM (MB): per-op/lazy={est_mem['lazy']} "
+                  f"captured={est_mem['captured']} "
+                  f"(donation credit {est_mem['donation_credit']})",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"# mnist memory estimate FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     cap_state = step_capture_state()
     print(f"# mnist eager programs/step: per-op={per_op_programs} "
           f"lazy={lazy_programs} captured={cap_programs} "
@@ -368,8 +395,11 @@ def bench_mnist_eager(steps=30, bsz=64):
           f"evictions={cap_counters['capture_evictions']}",
           file=sys.stderr)
 
-    return {"metric": "mnist_lenet_eager_steps_per_sec",
-            "value": round(steps / dt, 1), "unit": "steps/s"}
+    rec = {"metric": "mnist_lenet_eager_steps_per_sec",
+           "value": round(steps / dt, 1), "unit": "steps/s"}
+    if est_mem is not None:
+        rec["est_peak_hbm_mb"] = est_mem
+    return rec
 
 
 def _backend_or_skip():
@@ -476,6 +506,16 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps / baseline, 3),
     }
+    # estimated peak HBM of the donated whole-step program (static liveness
+    # plan, analysis.memory) — the memory-trajectory entry for BENCH_* files
+    try:
+        plan = step.memory_plan()
+        result["est_peak_hbm_mb"] = round(plan.peak_bytes / 2**20, 1)
+        result["est_donation_credit_mb"] = round(
+            plan.donation_credit_bytes / 2**20, 1
+        )
+    except Exception as e:
+        print(f"# memory plan FAILED: {type(e).__name__}: {e}", file=sys.stderr)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)
     print(json.dumps(result), flush=True)

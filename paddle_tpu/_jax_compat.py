@@ -1,0 +1,85 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+One import site for the whole tree (library modules AND tests): jax
+promoted ``shard_map`` from ``jax.experimental.shard_map`` to the top-level
+namespace (and later removed the experimental module), so neither spelling
+imports across every version we run against. Import it from here instead:
+
+    from paddle_tpu._jax_compat import shard_map
+"""
+from __future__ import annotations
+
+__all__ = ["axis_size", "shard_map"]
+
+try:  # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map
+    if not callable(_shard_map):  # transitional releases export the module
+        _shard_map = _shard_map.shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+_params = frozenset(_inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f=None, *args, **kwargs):
+    """``jax.shard_map`` with version drift normalized: the replication
+    check is spelled ``check_vma`` (new) or ``check_rep`` (0.4.x), and the
+    manual-axes set is ``axis_names`` (new) or the complementary ``auto``
+    (0.4.x) — accept either spelling and pass whichever the installed
+    version understands. Positional ``(f, mesh, in_specs, out_specs)``
+    calls work as with the real API."""
+    if args:
+        if len(args) > 3:
+            raise TypeError(
+                f"shard_map() takes at most 4 positional arguments "
+                f"({1 + len(args)} given)"
+            )
+        for name, val in zip(("mesh", "in_specs", "out_specs"), args):
+            if name in kwargs:
+                raise TypeError(
+                    f"shard_map() got multiple values for argument {name!r}"
+                )
+            kwargs[name] = val
+    check = kwargs.pop("check_vma", kwargs.pop("check_rep", None))
+    if check is not None:
+        if "check_vma" in _params:
+            kwargs["check_vma"] = check
+        elif "check_rep" in _params:
+            kwargs["check_rep"] = check
+    if "axis_names" in kwargs and "axis_names" not in _params:
+        # newer jax: axis_names = the MANUAL axes; 0.4.x spells the same
+        # contract as `auto` = the complement set of the mesh's axes.
+        # Size-1 axes are folded into the manual set instead: replication
+        # over a 1-sized axis is a no-op, and 0.4.x cannot differentiate
+        # through shard_map when `auto` is non-empty.
+        manual = frozenset(kwargs.pop("axis_names"))
+        mesh = kwargs.get("mesh")
+        if "auto" in _params and mesh is not None:
+            kwargs["auto"] = frozenset(
+                a for a in mesh.axis_names
+                if a not in manual and mesh.shape[a] > 1
+            )
+        else:  # never silently widen the manual set
+            raise TypeError(
+                "this jax version supports neither the axis_names kwarg "
+                "nor an auto+mesh translation for it; pass mesh= and drop "
+                "axis_names, or upgrade jax"
+            )
+    if f is None:
+        import functools
+
+        return functools.partial(shard_map, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+try:  # jax >= 0.5
+    from jax.lax import axis_size
+except ImportError:
+    def axis_size(axis_name):
+        """Size of a named mesh axis inside a shard_map/pmap region. psum of
+        a Python literal folds to a concrete int on every jax version."""
+        import jax
+
+        return jax.lax.psum(1, axis_name)

@@ -80,6 +80,10 @@ class Diagnostic:
     shapes: Tuple = ()
     dtypes: Tuple = ()
     source: str = ""
+    # structured payload for tools (graph_lint --json, bench): the memory
+    # passes put their peak/credit arithmetic here so consumers need not
+    # parse the message text
+    data: Dict = dataclasses.field(default_factory=dict)
 
     def __str__(self):
         loc = f" [{self.source}]" if self.source else ""
@@ -338,15 +342,29 @@ def pass_names() -> List[str]:
 class Context:
     """Everything a pass sees for one checked program."""
 
-    def __init__(self, closed, roles, source, counters=None, budget=None):
+    def __init__(self, closed, roles, source, counters=None, budget=None,
+                 donated=(), alias_groups=None, alias_refs=None,
+                 memory_budget_mb=None):
+        # closed=None builds a jaxpr-less context (counter-only passes like
+        # launch_budget) — every field still gets its default, so passes
+        # never need getattr guards against a partially-built Context
         self.closed = closed
-        self.jaxpr, _ = _as_open(closed)
+        self.jaxpr = _as_open(closed)[0] if closed is not None else None
         # (kind, name) per jaxpr invar; kind in {"param","buffer","feed","arg"}
         self.roles: List[Tuple[str, str]] = list(roles)
         self.source = source
         self.counters = counters
         self.budget = budget
-        self.ops, self.producers, self.out_atoms = _inline_ops(closed)
+        # memory/donation info (analysis.memory): flat invar indices donated
+        # to the program, groups of indices bound to one runtime buffer, and
+        # {index: [description of live external alias]} from a runtime scan
+        self.donated: Tuple[int, ...] = tuple(donated or ())
+        self.alias_groups = list(alias_groups or [])
+        self.alias_refs: Dict[int, List] = dict(alias_refs or {})
+        self.memory_budget_mb = memory_budget_mb
+        self.ops, self.producers, self.out_atoms = (
+            _inline_ops(closed) if closed is not None else ([], {}, [])
+        )
 
     def invar_roles(self):
         invars = list(self.jaxpr.invars)
@@ -598,6 +616,10 @@ def check(
     counters: Optional[Dict[str, Any]] = None,
     budget: Optional[int] = None,
     source: Optional[str] = None,
+    donated: Sequence[int] = (),
+    alias_groups=None,
+    alias_refs=None,
+    memory_budget_mb: Optional[float] = None,
 ) -> List[Diagnostic]:
     """Run the analysis pass suite over a traced program.
 
@@ -606,9 +628,17 @@ def check(
     ``feed_specs``: input shapes/dtypes — ``InputSpec`` list, ``(shape,
     dtype)`` tuples, or a ``{name: spec}`` dict. Required unless the target
     is a Program (which knows its feed vars) or carries an input_spec.
+    ``donated``/``alias_groups``/``alias_refs`` feed the memory passes:
+    donated flat invar indices, indices sharing one runtime buffer, and
+    live-external-alias descriptions per index (see ``analysis.memory``).
+    ``memory_budget_mb`` overrides ``FLAGS_memory_budget_mb`` for this run.
     Returns diagnostics sorted most-severe first."""
     closed, roles, src = _context_of(program_or_fn, feed_specs)
-    ctx = Context(closed, roles, source or src, counters=counters, budget=budget)
+    ctx = Context(
+        closed, roles, source or src, counters=counters, budget=budget,
+        donated=donated, alias_groups=alias_groups, alias_refs=alias_refs,
+        memory_budget_mb=memory_budget_mb,
+    )
     return run_passes(ctx, passes)
 
 
@@ -641,14 +671,8 @@ def check_launch_budget(step_fn=None, *args, budget=None, counters=None,
         from ..profiler import measure_programs
 
         counters = measure_programs(step_fn, *args, warmup=warmup, **kwargs)
-    ctx = Context.__new__(Context)
-    ctx.closed = None
-    ctx.jaxpr = None
-    ctx.roles = []
-    ctx.source = "launch-budget"
-    ctx.counters = dict(counters)
-    ctx.budget = budget
-    ctx.ops, ctx.producers, ctx.out_atoms = [], {}, []
+    ctx = Context(None, [], "launch-budget", counters=dict(counters),
+                  budget=budget)
     return run_passes(ctx, ["launch_budget"])
 
 
@@ -676,3 +700,6 @@ def enforce(diags: List[Diagnostic], where: str, level: Optional[int] = None):
 
 
 from . import passes as _builtin_passes  # noqa: E402,F401  (registers the suite)
+from . import memory  # noqa: E402  (registers memory_budget / donation_safety)
+
+__all__ += ["memory"]

@@ -84,6 +84,7 @@ def reset_dispatch_counters():
         capture_replays=0,
         capture_fallbacks=0,
         capture_evictions=0,
+        donation_alias_flags=0,
         flush_reasons={},
         capture_fallback_reasons={},
     )

@@ -188,6 +188,15 @@ define_flag(
     "every diagnostic as a Python warning, 2 = additionally raise "
     "ProgramVerificationError on error-severity findings",
 )
+define_flag(
+    "memory_budget_mb", 0.0,
+    "estimated peak-HBM budget (MB) enforced by the paddle_tpu.analysis "
+    "memory_budget pass: when > 0, every checked program gets a static "
+    "liveness-based peak estimate and an error-severity diagnostic when it "
+    "exceeds the budget (0 = only the detected device HBM bounds apply); "
+    "combine with FLAGS_check_programs to warn (1) or raise (2) at "
+    "Executor.run compile time and lazy-segment flush",
+)
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
 define_flag(
     "use_flash_attention",

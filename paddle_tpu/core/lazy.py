@@ -42,6 +42,7 @@ from . import flags
 
 __all__ = [
     "LazyRef",
+    "captured_step_program",
     "flush_if_pending",
     "materialize",
     "pending_op_count",
@@ -674,7 +675,12 @@ class _CaptureEntry:
     outlives any particular model instance with the same step signature."""
 
     __slots__ = ("exe", "param_idx", "extra_idx", "param_slots",
-                 "extra_slots", "rest_slots", "warmed")
+                 "extra_slots", "rest_slots", "warmed",
+                 # static-analysis surface: the raw (unjitted) step fn, the
+                 # arg ShapeDtypeStructs of the first replay, and whether
+                 # params/state were donated — captured_step_program()
+                 # retraces these for the memory planner without compiling
+                 "step_fn", "arg_specs", "donated", "__weakref__")
 
 
 class _CaptureIneligible(Exception):
@@ -1023,6 +1029,9 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     # that holds aliases of param/state buffers across steps.
     donate = (0, 1) if flags.flag("eager_capture_donate") else ()
     entry.exe = jax.jit(step_fn, donate_argnums=donate)
+    entry.step_fn = step_fn
+    entry.arg_specs = None  # recorded at first replay
+    entry.donated = bool(donate)
     entry.param_idx = param_idx
     entry.extra_idx = extra_idx
     entry.param_slots = param_slots
@@ -1030,6 +1039,54 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     entry.rest_slots = rest_slots
     entry.warmed = False
     return entry
+
+
+def _capture_arg_roles(entry: _CaptureEntry):
+    """(invar roles, donated flat invar indices) of the captured step
+    program traced from entry.arg_specs — donate_argnums=(0, 1) donates the
+    leaves of the param and optimizer-state pytrees, which flatten first."""
+    leaves = jax.tree_util.tree_leaves
+    p_specs, s_specs, _lr, extra, rest = entry.arg_specs
+    n_p, n_s = len(leaves(p_specs)), len(leaves(s_specs))
+    roles = (
+        [("param", f"param{i}") for i in range(n_p)]
+        + [("buffer", f"opt_state{i}") for i in range(n_s)]
+        + [("arg", "lr")]
+        + [("feed", f"batch{i}") for i in range(len(leaves(extra)))]
+        + [("arg", f"ext{i}") for i in range(len(leaves(rest)))]
+    )
+    donated = tuple(range(n_p + n_s)) if entry.donated else ()
+    return roles, donated
+
+
+def captured_step_program():
+    """(closed jaxpr, donated invar indices, invar roles) of the most
+    recently replayed captured whole-step executable on this thread, or
+    None when no capture has replayed yet (or its cache entry has been
+    evicted and collected). Trace-only (no compile) — feeds the
+    paddle_tpu.analysis.memory planner, bench.py's memory trajectory, and
+    paddle.profiler.measure_programs."""
+    ref = getattr(_tls, "last_capture_entry", None)
+    entry = ref() if ref is not None else None
+    if entry is None or entry.arg_specs is None:
+        return None
+    closed = jax.make_jaxpr(entry.step_fn)(*entry.arg_specs)
+    roles, donated = _capture_arg_roles(entry)
+    return closed, donated, roles
+
+
+def _check_captured_donation(entry: _CaptureEntry, params, states):
+    # the static traced-program pass runs once per capture build (warmed is
+    # set only after a successful replay, so a raising verdict re-proves)
+    from ..analysis import memory as _memory
+
+    roles, donated = _capture_arg_roles(entry)
+    _memory.donation_gate(
+        params, states,
+        lambda: jax.make_jaxpr(entry.step_fn)(*entry.arg_specs),
+        roles, donated, "captured-step",
+        static_diags=[] if entry.warmed else None,
+    )
 
 
 def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
@@ -1062,6 +1119,18 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
         tuple(ext[s] for s in entry.extra_slots),
         tuple(ext[s] for s in entry.rest_slots),
     )
+    if entry.arg_specs is None:
+        entry.arg_specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), args
+        )
+    if entry.donated and int(flags.flag("check_programs")):
+        # donation-safety gate (analysis.memory): statically verify the
+        # captured program's donated positions and gc-scan the to-be-donated
+        # buffers for live external Tensor aliases (state_dict()/detach()
+        # held across steps) BEFORE XLA invalidates them. Raises
+        # ProgramVerificationError at FLAGS_check_programs>=2 — the caller
+        # resolves the deferred step on the safe 3-program path first.
+        _check_captured_donation(entry, params, states)
     if entry.warmed:
         results, gp, gx, new_p, new_s = entry.exe(*args)
     else:
@@ -1076,6 +1145,13 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
 
     _tls.capture_deferred = None
     rec.stub_seg.flushed = True
+    # captured_step_program() surface: a WEAK ref, so the introspection
+    # hook never outlives the capture cache (the step fn closes over the
+    # plan and optimizer math — pinning it would keep a dropped model's
+    # buffers reachable for the thread's lifetime)
+    import weakref
+
+    _tls.last_capture_entry = weakref.ref(entry)
     dispatch._count_program("captured")
     dispatch._counters["capture_replays"] += 1
 
@@ -1162,7 +1238,26 @@ def step_capture_step(optimizer) -> bool:
         return _run_captured(rec, optimizer, entry)
     except _CaptureIneligible as e:
         return fallback(e.reason)
-    except Exception:
+    except Exception as e:
+        from ..analysis import ProgramVerificationError
+
+        if isinstance(e, ProgramVerificationError):
+            # verification failed at FLAGS_check_programs>=2: resolve the
+            # deferred step on the safe 3-program path (numerics and
+            # placeholder grads stay correct), then surface the verdict —
+            # this is the static trip wire that fires BEFORE XLA's runtime
+            # use-after-donate error (or CPU's silent non-donation). Label
+            # the fallback by what actually failed, so the fallback-reason
+            # histogram doesn't blame donation for a budget overrun.
+            from ..analysis import Severity
+
+            donation = any(
+                d.pass_name == "donation_safety"
+                and d.severity >= Severity.ERROR
+                for d in e.diagnostics
+            )
+            fallback("donation_unsafe" if donation else "verification_failed")
+            raise
         # any trace/compile/runtime error from the captured executable must
         # honor the fallback contract — the step completes on the normal
         # 3-program path instead of crashing optimizer.step() (and the

@@ -352,6 +352,10 @@ class CompiledTrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._step = None
+        self._step_fn_raw = None  # unjitted step fn, kept for the planner
+        self._arg_specs = None  # ShapeDtypeStructs of the last call's args
+        self._batch_sig = None
+        self._static_donation_diags = None  # cached after a clean enforce
         self._opt_state = None
         self._params = [p for p in model.parameters() if not p.stop_gradient]
         self._buffers = [b for _, b in model.named_buffers()]
@@ -436,7 +440,64 @@ class CompiledTrainStep:
             return loss, in_grads, tuple(new_p), tuple(new_s), new_b
 
         # donate params and optimizer state: XLA reuses their HBM buffers
+        self._step_fn_raw = step_fn
         return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def _roles_and_donated(self):
+        """(invar roles, donated flat invar indices) for the traced step:
+        donate_argnums=(0, 1) donates the param and optimizer-state leaves,
+        which flatten first in the jaxpr's invar order."""
+        leaves = jax.tree_util.tree_leaves
+        p, st, b, _key, _lr, *batch = self._arg_specs
+        n_p, n_s, n_b = len(leaves(p)), len(leaves(st)), len(leaves(b))
+        n_batch = len(leaves(list(batch)))
+        roles = (
+            [("param", getattr(t, "name", "") or f"param{i}")
+             for i, t in enumerate(self._params)][:n_p]
+            + [("buffer", f"opt_state{i}") for i in range(n_s)]
+            + [("buffer", f"buffer{i}") for i in range(n_b)]
+            + [("arg", "rng_key"), ("arg", "lr")]
+            + [("feed", f"batch{i}") for i in range(n_batch)]
+        )
+        return roles, tuple(range(n_p + n_s))
+
+    def memory_plan(self, donated=None):
+        """Static liveness plan of the whole-step program (see
+        paddle_tpu.analysis.memory): traces the step function — no compile
+        — and returns a ``MemoryPlan`` with the donation-credited peak-HBM
+        estimate. Needs one executed step first (arg shapes come from the
+        last call). ``donated=()`` plans the same program without donation
+        credit, quantifying what ``donate_argnums`` saves."""
+        if self._arg_specs is None:
+            raise RuntimeError(
+                "memory_plan() needs one executed step first (the argument "
+                "shapes are taken from the last call)"
+            )
+        from .. import analysis
+        from ..analysis import memory as _memory
+
+        closed = jax.make_jaxpr(self._step_fn_raw)(*self._arg_specs)
+        roles, don = self._roles_and_donated()
+        ctx = analysis.Context(closed, roles, "compile_train_step",
+                               donated=don if donated is None else donated)
+        return _memory.plan_memory(ctx)
+
+    def _check_donation(self, states):
+        """FLAGS_check_programs hook: gc-scan the to-be-donated buffers for
+        live external Tensor aliases and double-bound (tied) buffers, plus
+        (once per program shape) the static jaxpr-level donation-safety and
+        memory-budget passes over the traced step. The static result is
+        cached only after a clean enforce, so a raising verdict re-proves
+        on retry instead of being disarmed."""
+        from ..analysis import memory as _memory
+
+        roles, don = self._roles_and_donated()
+        self._static_donation_diags = _memory.donation_gate(
+            self._params, states,
+            lambda: jax.make_jaxpr(self._step_fn_raw)(*self._arg_specs),
+            roles, don, "compile_train_step",
+            static_diags=self._static_donation_diags,
+        )
 
     @no_grad()
     def __call__(self, *batch) -> Tensor:
@@ -448,9 +509,24 @@ class CompiledTrainStep:
         b_vals = tuple(b._value for b in self._buffers)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.next_key()
-        loss, in_grads, new_p, new_s, new_b = self._step(
-            p_vals, tuple(self._opt_state), b_vals, key, lr, *batch_vals
-        )
+        args = (p_vals, tuple(self._opt_state), b_vals, key, lr, *batch_vals)
+        # only the batch can change shape between calls (params/state/key are
+        # fixed); refresh the traced-spec snapshot when it does so
+        # memory_plan() and the donation gate always see the LAST program
+        batch_sig = tuple((tuple(b.shape), str(b.dtype)) for b in batch_vals)
+        if self._arg_specs is None or batch_sig != self._batch_sig:
+            self._batch_sig = batch_sig
+            self._arg_specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), args
+            )
+            self._static_donation_diags = None  # re-verify the new program
+        from ..core import flags as _flags
+
+        if int(_flags.flag("check_programs")):
+            # donation-safety gate (analysis.memory): flag live aliases of
+            # the donated param/state buffers before XLA reuses them
+            self._check_donation(self._opt_state)
+        loss, in_grads, new_p, new_s, new_b = self._step(*args)
         for p, v in zip(self._params, new_p):
             p._value = v
         for b, v in zip(self._buffers, new_b):

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from jax import shard_map
+from .._jax_compat import axis_size as _axis_size, shard_map
 
 __all__ = ["ring_attention", "ulysses_attention"]
 
@@ -71,7 +71,7 @@ def _combine(m1, l1, a1, m2, l2, a2):
 
 
 def _ring_inner(q, k, v, *, axis, causal, scale):
-    p_size = jax.lax.axis_size(axis)
+    p_size = _axis_size(axis)
     my = jax.lax.axis_index(axis)
     sq = q.shape[1]
     b, _, h, d = q.shape

@@ -38,8 +38,9 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .._jax_compat import shard_map
 
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor

@@ -328,7 +328,44 @@ def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
     counters = dispatch_counters()
     counters["_step_result"] = out
     counters["_capture_state"] = lazy.step_capture_state()
+    counters["_memory"] = _memory_snapshot(counters)
     return counters
+
+
+def _memory_snapshot(counters):
+    """Measured live-buffer stats at the step boundary plus, when a
+    whole-step capture replayed the step, the static analysis.memory peak
+    estimate of the captured program — the estimated-vs-measured pair the
+    MEMORY_PLAN.md methodology is defined over. Absolute live bytes cover
+    the whole process; compare deltas or the planner's boundary estimate,
+    not raw totals."""
+    snap = {}
+    try:
+        live = jax.live_arrays()
+        snap["live_buffer_bytes"] = int(
+            sum(int(getattr(a, "nbytes", 0) or 0) for a in live)
+        )
+        snap["live_buffer_count"] = len(live)
+    except Exception:
+        snap["live_buffer_bytes"] = None
+        snap["live_buffer_count"] = None
+    if int(counters.get("capture_replays", 0) or 0) > 0:
+        try:
+            from ..analysis import memory as _mem
+
+            plans = _mem.captured_step_plans()
+            if plans is not None:
+                plan, _no_donation = plans
+                snap["estimated_captured_peak_bytes"] = int(plan.peak_bytes)
+                snap["estimated_captured_boundary_bytes"] = int(
+                    plan.boundary_bytes
+                )
+                snap["estimated_donation_credit_bytes"] = int(
+                    plan.donation_credit_bytes
+                )
+        except Exception:
+            pass  # measurement must never break the profiled step
+    return snap
 
 
 def export_protobuf(dir_name: str, worker_name=None):

@@ -1087,6 +1087,10 @@ import sys as _sys  # noqa: E402
 from .. import analysis  # noqa: E402,F401
 
 _sys.modules[__name__ + ".analysis"] = analysis
+# the memory planner submodule needs its own alias: without it an import
+# of paddle_tpu.static.analysis.memory would RE-EXECUTE memory.py under
+# the static package name (and its relative imports would break)
+_sys.modules[__name__ + ".analysis.memory"] = analysis.memory
 
 __all__ += ["analysis"]
 

@@ -9,10 +9,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import paddle_tpu as paddle
+from paddle_tpu._jax_compat import shard_map
 import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F

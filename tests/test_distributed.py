@@ -11,9 +11,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 import paddle_tpu as paddle
+from paddle_tpu._jax_compat import shard_map
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.distributed import fleet

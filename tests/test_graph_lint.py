@@ -47,6 +47,30 @@ def test_example_models_lint_error_clean(example, check_programs_on, capsys):
     assert "FLAGS_check_programs=1" in out
 
 
+@pytest.mark.parametrize("example", ["train_gpt.py", "train_vision.py"])
+def test_example_models_stay_under_memory_budget(example, check_programs_on,
+                                                 capsys):
+    """CI memory gate: both shipped example models must keep their
+    liveness-estimated peak HBM under a declared 64 MB budget (current
+    estimates: vision ~6 MB, gpt ~15 MB — the budget flags a 4x+ memory
+    regression while leaving room for model growth)."""
+    rc = _cli().main([os.path.join(REPO, "examples", example),
+                      "--memory-budget-mb", "64"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"memory budget exceeded in {example}:\n{out}"
+    assert "estimated peak HBM" in out  # the report diagnostic is emitted
+
+    # and the gate actually bites: an absurdly small budget fails the lint
+    rc = _cli().main([os.path.join(REPO, "examples", example),
+                      "--memory-budget-mb", "0.001", "--json"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert rc == 1
+    recs = [json.loads(l) for l in lines]
+    over = [r for r in recs if r["severity"] == "error"
+            and r["pass"] == "memory_budget"]
+    assert over and over[0]["data"]["peak_bytes"] > 0
+
+
 def test_lint_fails_on_injected_error(tmp_path, capsys):
     bad = tmp_path / "bad_model.py"
     bad.write_text(

@@ -59,6 +59,13 @@ def main(argv=None) -> int:
                          "builder's own specs)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated pass subset (default: all)")
+    ap.add_argument("--memory-budget-mb", type=float, default=None,
+                    metavar="MB",
+                    help="declare a peak-HBM budget: the memory_budget pass "
+                         "reports the liveness-based peak estimate (JSON "
+                         "runs carry the full breakdown in 'data') and "
+                         "emits an error-severity diagnostic when the "
+                         "estimate exceeds the budget")
     ap.add_argument("--fail-on", default="error",
                     choices=["info", "warning", "error"],
                     help="exit nonzero at/above this severity (default: error)")
@@ -98,7 +105,8 @@ def main(argv=None) -> int:
         specs = [_parse_spec(s) for s in args.input_spec]
 
     passes = args.passes.split(",") if args.passes else None
-    diags = analysis.check(target, specs, passes=passes)
+    diags = analysis.check(target, specs, passes=passes,
+                           memory_budget_mb=args.memory_budget_mb)
 
     if args.json:
         for d in diags:
@@ -107,6 +115,7 @@ def main(argv=None) -> int:
                 "message": d.message, "hint": d.hint, "source": d.source,
                 "shapes": [list(map(int, s)) for s in d.shapes if s is not None],
                 "dtypes": list(d.dtypes),
+                "data": d.data,
             }))
     else:
         if not diags:
@@ -115,7 +124,8 @@ def main(argv=None) -> int:
         for d in diags:
             print(f"  {d}")
         # analysis-related flags in effect, so CI logs show the exact mode
-        active = describe_flags("check") + describe_flags("eager_lazy")
+        active = (describe_flags("check") + describe_flags("eager_lazy")
+                  + describe_flags("memory_budget"))
         flags_str = ", ".join(f"{f['name']}={f['value']}" for f in active)
         counts = {}
         for d in diags:

@@ -25,6 +25,7 @@ SUBMODULES = [
     "jit",
     "static",
     "static.analysis",
+    "static.analysis.memory",
     "linalg",
     "metric",
     "distributed",
