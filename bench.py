@@ -402,6 +402,76 @@ def bench_mnist_eager(steps=30, bsz=64):
     return rec
 
 
+def _resilience_block(steps=8, bsz=16):
+    """Resilience micro-probe for the BENCH_* trajectory (ISSUE 5): retries/
+    fallbacks under an injected fault plan, per-step recovery overhead, and
+    proof the numeric-rescue sentinel is free — steps/s with and without it
+    on the lazy LeNet step (programs-per-step must not change)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as prof
+    import paddle_tpu.resilience as res
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((bsz, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (bsz,)))
+
+    def step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": False})
+    try:
+        for _ in range(3):  # warm the segment/tape/optimizer caches
+            loss = step()
+        float(loss)
+        clean_dt = _timed(step, steps)
+        # sentinel on: one extra fused scalar, zero extra programs
+        paddle.set_flags({"FLAGS_numeric_rescue": "skip"})
+        for _ in range(2):
+            loss = step()
+        float(loss)
+        rescue_dt = _timed(step, steps)
+        rescue_programs = prof.measure_programs(step)["programs"]
+        paddle.set_flags({"FLAGS_numeric_rescue": ""})
+        # faulted window: every site faults once per step, retry recovers
+        res.reset()
+        prof.reset_dispatch_counters()
+        paddle.set_flags({"FLAGS_fault_inject": "execute:p=1:x=1",
+                          "FLAGS_retry_backoff_ms": 0.5})
+        fault_dt = _timed(step, steps)
+        c = prof.dispatch_counters()
+    finally:
+        paddle.set_flags({"FLAGS_fault_inject": "",
+                          "FLAGS_numeric_rescue": "",
+                          "FLAGS_eager_lazy_dispatch": False,
+                          "FLAGS_eager_step_capture": True,
+                          "FLAGS_retry_backoff_ms": 5.0})
+        res.reset()
+    return {
+        "steps_per_s_clean": round(steps / clean_dt, 1),
+        "steps_per_s_rescue": round(steps / rescue_dt, 1),
+        "sentinel_overhead_pct": round((rescue_dt - clean_dt) / clean_dt * 100, 1),
+        "rescue_programs_per_step": rescue_programs,
+        "retries": c["retry_attempts"],
+        "injected_faults": c["injected_faults"],
+        "capture_fallbacks": c["capture_fallbacks"],
+        "segment_per_op_fallbacks": c["segment_per_op_fallbacks"],
+        "recovery_overhead_ms_per_step": round(
+            (fault_dt - clean_dt) / steps * 1000, 2),
+        "retry_backoff_ms": round(c["retry_backoff_ms"], 1),
+    }
+
+
 def _backend_or_skip():
     """Probe the accelerator backend before any model builds. When the
     TPU/axon backend cannot initialize (tunnel down, relay unavailable),
@@ -516,6 +586,14 @@ def main():
         )
     except Exception as e:
         print(f"# memory plan FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+    # resilience trajectory block (retries / fallbacks / recovery overhead /
+    # sentinel-is-free proof) — BENCH_RESILIENCE=0 skips it
+    if os.environ.get("BENCH_RESILIENCE", "1") == "1":
+        try:
+            result["resilience"] = _resilience_block()
+        except Exception as e:
+            print(f"# resilience block FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)
     print(json.dumps(result), flush=True)

@@ -101,6 +101,7 @@ for _pkg in (
     "distributed",
     "incubate",
     "profiler",
+    "resilience",
     "hapi",
     "text",
     "distribution",

@@ -180,12 +180,18 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        from ..resilience import rescue as _rescue
+
+        # under FLAGS_numeric_rescue the fused sentinel in optimizer.step
+        # detects non-finite grads in-program (and marks this scaler's
+        # found_inf) — skip the per-grad host isfinite scan here
+        sentinel = _rescue.active()
         found = False
         with no_grad():
             for p in optimizer._param_list():
                 if p.grad is not None:
                     g = p.grad._value / self._scale
-                    if not bool(jnp.all(jnp.isfinite(g))):
+                    if not sentinel and not bool(jnp.all(jnp.isfinite(g))):
                         found = True
                     p.grad._value = g
         self._found_inf = found
@@ -202,8 +208,15 @@ class GradScaler:
             return
         if not getattr(self, "_unscaled", False):
             self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
+        # numeric-rescue handshake: a rescued (skipped) step marks this
+        # scaler's found_inf so update() backs the scale off, exactly as if
+        # the host scan had caught it
+        optimizer._rescue_scaler = self
+        try:
+            if not self._found_inf:
+                optimizer.step()
+        finally:
+            optimizer._rescue_scaler = None
         self._unscaled = False
 
     def update(self):

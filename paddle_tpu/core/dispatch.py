@@ -43,6 +43,7 @@ __all__ = [
 
 _tls = threading.local()
 _amp = None  # lazily bound paddle_tpu.amp module (circular at import time)
+_res = None  # lazily bound paddle_tpu.resilience (same circularity)
 
 
 def _amp_module():
@@ -52,6 +53,21 @@ def _amp_module():
 
         _amp = _amp_mod
     return _amp
+
+
+def _resilience_module():
+    global _res
+    if _res is None:
+        from .. import resilience as _res_mod
+
+        _res = _res_mod
+    return _res
+
+
+def _rexec(site, thunk, **kw):
+    """Route one program launch through the resilience executor (fault
+    injection + retry/backoff + ladder accounting; paddle.resilience)."""
+    return _resilience_module().runtime.execute(site, thunk, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -85,8 +101,26 @@ def reset_dispatch_counters():
         capture_fallbacks=0,
         capture_evictions=0,
         donation_alias_flags=0,
+        # resilience runtime (paddle.resilience): fault / retry / ladder /
+        # rescue / preemption event accounting
+        fault_events=0,
+        injected_faults=0,
+        transient_faults=0,
+        fatal_faults=0,
+        retry_attempts=0,
+        retry_exhausted=0,
+        retry_backoff_ms=0.0,
+        ladder_demotions=0,
+        ladder_promotions=0,
+        numeric_rescues=0,
+        rescue_lr_backoffs=0,
+        segment_nan_checks=0,
+        segment_per_op_fallbacks=0,
+        preemptions=0,
+        emergency_saves=0,
         flush_reasons={},
         capture_fallback_reasons={},
+        fault_sites={},
     )
 
 
@@ -106,6 +140,7 @@ def dispatch_counters() -> Dict[str, Any]:
     out = dict(_counters)
     out["flush_reasons"] = dict(_counters["flush_reasons"])
     out["capture_fallback_reasons"] = dict(_counters["capture_fallback_reasons"])
+    out["fault_sites"] = dict(_counters["fault_sites"])
     return out
 
 
@@ -402,17 +437,22 @@ def apply(
     # segment can't host fall through to the per-op path below (the lazy
     # layer flushes first, preserving program order).
     if flags.flag("eager_lazy_dispatch"):
-        out = _lazy.lazy_apply(
-            fn,
-            args,
-            kw_items,
-            op_name=op_name,
-            differentiable=differentiable,
-            jit=jit,
-            cache_token=cache_token,
-        )
-        if out is not _lazy._FALLBACK:
-            return out
+        if _resilience_module().runtime.lazy_tier_ok():
+            out = _lazy.lazy_apply(
+                fn,
+                args,
+                kw_items,
+                op_name=op_name,
+                differentiable=differentiable,
+                jit=jit,
+                cache_token=cache_token,
+            )
+            if out is not _lazy._FALLBACK:
+                return out
+        else:
+            # degradation ladder demoted the lazy tier (repeated segment
+            # faults): run per-op until the cooldown re-promotes it
+            _lazy.flush_if_pending("ladder_demoted")
 
     # one pass over args: unwrap values AND find differentiable positions
     vals = []
@@ -445,9 +485,10 @@ def apply(
             else None
         )
         if jfn is not None:
-            out_vals = jfn(*vals)
+            out_vals = _rexec("op", lambda: jfn(*vals))
         else:
-            out_vals = fn(*vals, **dict(kw_items))
+            kw = dict(kw_items)
+            out_vals = _rexec("op", lambda: fn(*vals, **kw))
         _count_program("op")
         return _wrap_outputs(out_vals, stop_gradient=True, node=None)
 
@@ -483,10 +524,12 @@ def apply(
         return tuple(res) if isinstance(res, list) else res
 
     if jitted_vjp is not None:
-        out_vals, vjp_fn = jitted_vjp(*vals)
+        out_vals, vjp_fn = _rexec("op", lambda: jitted_vjp(*vals))
         is_jit_vjp = True
     else:
-        out_vals, vjp_fn = jax.vjp(partial_fn, *[vals[i] for i in diff_idx])
+        out_vals, vjp_fn = _rexec(
+            "op", lambda: jax.vjp(partial_fn, *[vals[i] for i in diff_idx])
+        )
         is_jit_vjp = False
     _count_program("op")
 
@@ -711,7 +754,7 @@ def _try_compiled_tape_backward(root, seed_val) -> bool:
         )
         _tape_bwd_cache[key] = fn
     vjp_fns = [n.vjp_fn for n in order_nodes]
-    leaf_vals = fn(vjp_fns, seed_val)
+    leaf_vals = _rexec("backward", lambda: fn(vjp_fns, seed_val))
     _count_program("backward")
     # step-capture observation: a compiled-tape backward is one of the two
     # events (fused segment flush + this) a capturable step consists of
@@ -955,7 +998,9 @@ def run_backward(
             if node.jit_vjp:
                 # jitted application of the pytree vjp closure — the
                 # transpose is compiled once per residual structure
-                in_grads = _apply_vjp(node.vjp_fn, packed)
+                in_grads = _rexec(
+                    "backward", lambda: _apply_vjp(node.vjp_fn, packed)
+                )
             else:
                 in_grads = node.vjp_fn(packed)
             _count_program("backward")

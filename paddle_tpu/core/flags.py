@@ -197,6 +197,68 @@ define_flag(
     "combine with FLAGS_check_programs to warn (1) or raise (2) at "
     "Executor.run compile time and lazy-segment flush",
 )
+# ---------------------------------------------------------------------------
+# Resilience runtime (paddle.resilience — see RESILIENCE.md)
+# ---------------------------------------------------------------------------
+define_flag(
+    "fault_inject", "",
+    "deterministic fault-injection spec for the resilience chaos harness, "
+    "e.g. 'execute:p=0.2,compile:step>=3,nan:grads' — comma-separated "
+    "clauses of kind (execute/compile/hang/nan/kill) with p=/step>=/x= "
+    "qualifiers and an optional site target; decisions are seeded per "
+    "(clause, site, step) from FLAGS_fault_seed so failures replay exactly "
+    "(empty = off)",
+)
+define_flag(
+    "fault_seed", 0,
+    "seed for the fault-injection harness's per-(clause, site, step) "
+    "decisions — same seed, same spec: same faults at the same steps",
+)
+define_flag(
+    "fault_hang_ms", 20.0,
+    "stall duration of an injected 'hang' fault before the simulated "
+    "watchdog raises (classified transient, so the retry path runs)",
+)
+define_flag(
+    "retry_max", 2,
+    "max retries of a transiently-failed program launch (per-op, segment "
+    "flush, backward, optimizer update, captured replay) or checkpoint "
+    "write before the error propagates; 0 disables retrying",
+)
+define_flag(
+    "retry_backoff_ms", 5.0,
+    "base delay of the capped exponential retry backoff (doubles per "
+    "attempt, multiplied by up to 25% jitter); accumulated delay is "
+    "counted in dispatch_counters()['retry_backoff_ms']",
+)
+define_flag(
+    "retry_backoff_max_ms", 1000.0,
+    "cap on a single retry backoff delay",
+)
+define_flag(
+    "ladder_demote_after", 2,
+    "faults observed at an execution tier (captured / lazy) before the "
+    "degradation ladder demotes it one rung (captured→lazy→per-op); "
+    "numerics are identical across rungs, only programs-per-step changes",
+)
+define_flag(
+    "ladder_cooldown_steps", 8,
+    "clean steps a demoted tier waits before the ladder re-promotes it "
+    "and the fast path is attempted again",
+)
+define_flag(
+    "numeric_rescue", "",
+    "step-level numeric rescue policy: '' (off), 'skip' (drop steps with "
+    "non-finite gradients; params/optimizer state untouched), 'lr_backoff' "
+    "(skip + multiply lr by FLAGS_numeric_rescue_lr_factor), or 'abort' "
+    "(raise FloatingPointError). Detection is a sentinel fused into the "
+    "optimizer-update / captured-step program — no extra program launches",
+)
+define_flag(
+    "numeric_rescue_lr_factor", 0.5,
+    "lr multiplier applied by the 'lr_backoff' numeric-rescue policy on "
+    "each rescued step",
+)
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
 define_flag(
     "use_flash_attention",

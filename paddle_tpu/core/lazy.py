@@ -259,15 +259,21 @@ def _infer_out_specs(fn, kw, arg_specs):
 _segment_cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
 
 
-def _segment_fn(plan):
+def _segment_fn(plan, check=False):
     """Raw (unjitted) segment program over the external-input list.
 
     plan: [(fn, kw, bindings, diff_idx, record)] — deliberately stripped
-    of _SegOp/GradNode/Tensor refs so the cached closure pins no user data."""
+    of _SegOp/GradNode/Tensor refs so the cached closure pins no user data.
+
+    With `check=True` (FLAGS_check_nan_inf under lazy dispatch) the program
+    additionally returns one bool per op — any(~isfinite) over that op's
+    float outputs — folded INTO the fused trace: the finite scan costs zero
+    extra program launches and is read once at flush."""
 
     def seg_fn(ext):
         results = []
         vjps = []
+        bad_flags = []
         for fn, kw, bindings, diff_idx, record in plan:
             vals = []
             for kind, a, b in bindings:
@@ -290,14 +296,34 @@ def _segment_fn(plan):
                 vjps.append(vjp)
             else:
                 out = fn(*vals, **kw)
-            results.append(list(out) if isinstance(out, (tuple, list)) else [out])
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            results.append(outs)
+            if check:
+                bad = jnp.asarray(False)
+                for o in outs:
+                    if jnp.issubdtype(jnp.result_type(o), jnp.inexact):
+                        bad = bad | jnp.any(~jnp.isfinite(o))
+                bad_flags.append(bad)
+        if check:
+            return results, vjps, jnp.stack(bad_flags)
         return results, vjps
 
     return seg_fn
 
 
-def _build_segment_fn(plan):
-    return jax.jit(_segment_fn(plan))
+def _build_segment_fn(plan, check=False):
+    return jax.jit(_segment_fn(plan, check))
+
+
+def _seg_signature(seg: _Segment) -> Tuple:
+    """Canonical compile-cache / capture signature of a segment. The
+    finite-check flag is part of it: a checking segment compiles a different
+    program (one extra bool-vector output) than a non-checking one."""
+    return (
+        tuple(seg.sig_parts),
+        tuple(seg.ext_specs),
+        bool(flags.flag("check_nan_inf")),
+    )
 
 
 def _seg_plan(seg: _Segment):
@@ -347,16 +373,21 @@ def _flush(seg: _Segment, reason: str):
     if not seg.ops:
         return
 
-    sig = (tuple(seg.sig_parts), tuple(seg.ext_specs))
+    check = bool(flags.flag("check_nan_inf"))
+    sig = _seg_signature(seg)
     jfn = dispatch._lru_get(_segment_cache, sig)
     fresh = jfn is None
+    # the op plan is only needed to build a fresh segment fn and by the
+    # per-op fault fallback below — cache-hit steady state skips the
+    # O(num_ops) build entirely
+    plan = _seg_plan(seg) if fresh else None
     if fresh:
         dispatch._counters["segment_cache_misses"] += 1
-        plan = _seg_plan(seg)
-        jfn = _build_segment_fn(plan)
+        jfn = _build_segment_fn(plan, check)
     else:
         dispatch._counters["segment_cache_hits"] += 1
 
+    fused = True
     try:
         if fresh and int(flags.flag("check_programs")):
             # FLAGS_check_programs: verify the fused segment before its
@@ -372,25 +403,58 @@ def _flush(seg: _Segment, reason: str):
                 ),
                 where=f"lazy-segment flush ({reason})",
             )
-        results, vjps = jfn(seg.ext_vals)
+        out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals), fresh=fresh)
     except BaseException as e:
-        # record the root cause: every later materialize() of this segment's
-        # refs re-raises it instead of silently yielding None. A program
-        # that never ran successfully is never cached.
-        seg.error = e
-        seg.ops = []
-        raise
-    if fresh:
-        dispatch._lru_put(
-            _segment_cache, sig, jfn,
-            evict_counter="segment_cache_evictions",
-            cap=int(flags.flag("eager_segment_cache_size")),
-        )
-    dispatch._count_program("segment")
+        # graceful degradation (paddle.resilience): when the FUSED launch
+        # keeps failing transiently (retries exhausted), re-execute the
+        # same plan per-op — identical ops and vjps, one rung down the
+        # ladder. Deterministic failures keep the fail-loud contract.
+        out = None
+        if isinstance(e, Exception) and dispatch._resilience_module().is_transient(e):
+            try:
+                if plan is None:
+                    plan = _seg_plan(seg)  # cache-hit flush skipped the build
+                out = _segment_fn(plan, check)(seg.ext_vals)
+            except Exception:
+                out = None
+        if out is None:
+            # record the root cause: every later materialize() of this
+            # segment's refs re-raises it instead of silently yielding None.
+            # A program that never ran successfully is never cached.
+            seg.error = e
+            seg.ops = []
+            raise
+        fused = False
+        dispatch._counters["segment_per_op_fallbacks"] += 1
+        for _ in plan:  # per-op programs, and the step is no longer capturable
+            dispatch._count_program("op")
+    if fused:
+        if fresh:
+            dispatch._lru_put(
+                _segment_cache, sig, jfn,
+                evict_counter="segment_cache_evictions",
+                cap=int(flags.flag("eager_segment_cache_size")),
+            )
+        dispatch._count_program("segment")
     dispatch._counters["segments_flushed"] += 1
     reasons = dispatch._counters["flush_reasons"]
     reasons[reason] = reasons.get(reason, 0) + 1
-    _observe_event(("seg", sig))
+    if fused:
+        _observe_event(("seg", sig))
+
+    if check:
+        results, vjps, bad_flags = out
+        dispatch._counters["segment_nan_checks"] += 1
+    else:
+        results, vjps = out
+        bad_flags = None
+    bad_op = None
+    if bad_flags is not None:
+        badvec = np.asarray(bad_flags)
+        if badvec.any():
+            bad_op = getattr(
+                seg.ops[int(np.argmax(badvec))].fn, "__name__", "op"
+            )
 
     vi = 0
     for op, outs in zip(seg.ops, results):
@@ -406,6 +470,14 @@ def _flush(seg: _Segment, reason: str):
             # replace predicted avals with the real ones (weak-type exactness)
             node.out_avals = [(tuple(v.shape), v.dtype) for v in outs]
     seg.ops = []  # drop op/node/tensor refs — the segment is spent
+    if bad_op is not None:
+        # the fused finite-check fired: same FloatingPointError contract as
+        # the per-op FLAGS_check_nan_inf scan, raised once at flush (values
+        # are already written back, so the bad tensors are inspectable)
+        raise FloatingPointError(
+            f"NaN/Inf detected in output of op '{bad_op}' "
+            "(lazy-segment flush, FLAGS_check_nan_inf)"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -441,7 +513,10 @@ def lazy_apply(
     if token is None:
         flush_if_pending("fallback_uncacheable")
         return _FALLBACK
-    if flags.flag("check_nan_inf") or flags.flag("benchmark"):
+    if flags.flag("benchmark"):
+        # FLAGS_check_nan_inf no longer forces the per-op path: the finite
+        # scan is folded into the fused segment and read once at flush
+        # (_segment_fn(check=True)), so programs-per-step is unchanged
         flush_if_pending("fallback_debug")
         return _FALLBACK
     amp = dispatch._amp_module()
@@ -675,7 +750,7 @@ class _CaptureEntry:
     outlives any particular model instance with the same step signature."""
 
     __slots__ = ("exe", "param_idx", "extra_idx", "param_slots",
-                 "extra_slots", "rest_slots", "warmed",
+                 "extra_slots", "rest_slots", "warmed", "rescue",
                  # static-analysis surface: the raw (unjitted) step fn, the
                  # arg ShapeDtypeStructs of the first replay, and whether
                  # params/state were donated — captured_step_program()
@@ -690,8 +765,12 @@ class _CaptureIneligible(Exception):
 
 
 def _capture_on() -> bool:
-    return bool(flags.flag("eager_lazy_dispatch")) and bool(
-        flags.flag("eager_step_capture")
+    # FLAGS_check_nan_inf needs the per-flush finite scan, which the
+    # captured 1-program replay bypasses — checking runs lazy at 3 programs
+    return (
+        bool(flags.flag("eager_lazy_dispatch"))
+        and bool(flags.flag("eager_step_capture"))
+        and not flags.flag("check_nan_inf")
     )
 
 
@@ -788,6 +867,13 @@ def _step_boundary(opt):
     obs.armed = (
         sig if obs.stable >= int(flags.flag("eager_capture_warmup")) else None
     )
+    if obs.armed is not None:
+        from . import dispatch
+
+        if not dispatch._resilience_module().runtime.captured_tier_ok(
+            hash(events[0][1])
+        ):
+            obs.armed = None  # ladder demoted this signature — don't arm
 
 
 def step_capture_backward(root) -> bool:
@@ -811,7 +897,11 @@ def step_capture_backward(root) -> bool:
         return False
     if rv.size != 1:
         return False
-    seg_sig = (tuple(seg.sig_parts), tuple(seg.ext_specs))
+    seg_sig = _seg_signature(seg)
+    if not dispatch._resilience_module().runtime.captured_tier_ok(hash(seg_sig)):
+        # degradation ladder demoted this step signature: stay on the
+        # 3-program path until the cooldown re-promotes it
+        return False
     armed_seg, armed_tape, armed_opt = obs.armed
     if seg_sig != armed_seg:
         _capture_fallback("signature_mismatch")
@@ -995,8 +1085,10 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     # what Optimizer._apply_fused jits, so captured and 3-program steps
     # cannot drift apart (it pins no optimizer instance)
     from ..optimizer.optimizer import make_fused_update
+    from ..resilience import rescue as _rescue
 
-    apply_update = make_fused_update(opt, params)
+    rescue_on = _rescue.active()
+    apply_update = make_fused_update(opt, params, sentinel=rescue_on)
 
     def step_fn(p_vals, sts, lr, extra_vals, rest_vals):
         ext = [None] * n_ext
@@ -1017,10 +1109,18 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
         )
         del loss_val  # the loss is results[root_op][root_out]
         gp, gx = vjp(jnp.ones(seed_shape, seed_dtype))
+        if rescue_on:
+            # numeric-rescue sentinel (paddle.resilience): one extra scalar
+            # output of the SAME program; the update is where-gated on it
+            # in-program, so a non-finite step leaves params/state untouched
+            # at zero extra launches
+            new_p, new_s, bad = apply_update(p_vals, gp, lr, sts)
+            return results, gp, gx, tuple(new_p), tuple(new_s), bad
         new_p, new_s = apply_update(p_vals, gp, lr, sts)
         return results, gp, gx, tuple(new_p), tuple(new_s)
 
     entry = _CaptureEntry()
+    entry.rescue = rescue_on
     # donate params + optimizer state: XLA reuses their HBM buffers for the
     # updated values (the compile_train_step discipline, earned by plain
     # eager code). Batch data / extra leaves are NOT donated — they are
@@ -1131,17 +1231,35 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
         # ProgramVerificationError at FLAGS_check_programs>=2 — the caller
         # resolves the deferred step on the safe 3-program path first.
         _check_captured_donation(entry, params, states)
+    lkey = hash(rec.seg_sig)
+    # with donation on, a REAL fault from inside exe may fire after XLA
+    # consumed the param/state buffers — replaying the same args would feed
+    # deleted buffers, so such faults skip in-place retry and resolve via
+    # the 3-program fallback (injected faults raise pre-launch and retry)
+    unsafe = entry.donated
     if entry.warmed:
-        results, gp, gx, new_p, new_s = entry.exe(*args)
+        out = dispatch._rexec(
+            "captured", lambda: entry.exe(*args), ladder_key=lkey,
+            retry_unsafe=unsafe,
+        )
     else:
         import warnings
 
-        with warnings.catch_warnings():
-            # first call compiles; backends without real buffer donation
-            # (CPU) warn that donated buffers were unused — benign here
-            warnings.filterwarnings("ignore", message=".*onated buffer.*")
-            results, gp, gx, new_p, new_s = entry.exe(*args)
+        def _first_run():
+            with warnings.catch_warnings():
+                # first call compiles; backends without real buffer donation
+                # (CPU) warn that donated buffers were unused — benign here
+                warnings.filterwarnings("ignore", message=".*onated buffer.*")
+                return entry.exe(*args)
+
+        out = dispatch._rexec("captured", _first_run, fresh=True,
+                              ladder_key=lkey, retry_unsafe=unsafe)
         entry.warmed = True
+    if entry.rescue:
+        results, gp, gx, new_p, new_s, bad = out
+    else:
+        results, gp, gx, new_p, new_s = out
+        bad = None
 
     _tls.capture_deferred = None
     rec.stub_seg.flushed = True
@@ -1182,6 +1300,12 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     obs = getattr(_tls, "observer", None)
     if obs is not None:
         obs.events, obs.dirty = [], False  # stays armed for the next step
+    if bad is not None:
+        from ..resilience import rescue as _rescue
+
+        # host-reads the fused sentinel and applies the configured policy
+        # (skip already happened in-program; lr_backoff/abort act here)
+        _rescue.handle_sentinel(opt, bad)
     return True
 
 
@@ -1210,6 +1334,17 @@ def step_capture_step(optimizer) -> bool:
         # the flag was turned off between backward() and step(): honor it —
         # the deferred step resolves on the normal path, nothing is donated
         return fallback("capture_disabled")
+    from ..resilience import faults as _faults
+
+    plan = _faults.active_plan()
+    if plan is not None and plan.would_fire(
+        "nan", "grads", _faults.current_step()
+    ):
+        # nan:grads poisons a MATERIALIZED gradient, which the captured
+        # 1-program replay never produces — resolve this step on the
+        # 3-program path so the injection (and its in-program rescue)
+        # actually fire instead of passing vacuously
+        return fallback("nan_injected")
     from . import dispatch
 
     try:
@@ -1218,8 +1353,11 @@ def step_capture_step(optimizer) -> bool:
         opt_fp = None
     if opt_fp is None or opt_fp != rec.expected_opt_fp:
         return fallback("optimizer_mismatch")
+    from ..resilience import rescue as _rescue
+
     key = (rec.seg_sig, rec.tape_key, opt_fp,
-           bool(flags.flag("eager_capture_donate")))
+           bool(flags.flag("eager_capture_donate")),
+           _rescue.active())  # the sentinel changes the traced program
     try:
         entry = dispatch._lru_get(_capture_cache, key)
     except TypeError:
@@ -1228,7 +1366,10 @@ def step_capture_step(optimizer) -> bool:
         return fallback("unhashable_key")
     try:
         if entry is None:
-            entry = _build_captured_step(rec, optimizer)
+            entry = dispatch._rexec(
+                "captured", lambda: _build_captured_step(rec, optimizer),
+                fresh=True, ladder_key=hash(rec.seg_sig),
+            )
             dispatch._counters["capture_builds"] += 1
             dispatch._lru_put(
                 _capture_cache, key, entry,
@@ -1238,6 +1379,11 @@ def step_capture_step(optimizer) -> bool:
         return _run_captured(rec, optimizer, entry)
     except _CaptureIneligible as e:
         return fallback(e.reason)
+    except FloatingPointError:
+        # numeric_rescue=abort fired AFTER the captured step resolved (the
+        # rescued update was already suppressed in-program) — propagate the
+        # verdict, don't re-run the step on the fallback path
+        raise
     except Exception as e:
         from ..analysis import ProgramVerificationError
 

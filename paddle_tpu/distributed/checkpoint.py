@@ -27,7 +27,26 @@ try:
 except Exception:  # pragma: no cover
     _HAS_ORBAX = False
 
-__all__ = ["save_state_dict", "load_state_dict", "AsyncCheckpointer", "train_epoch_range"]
+__all__ = [
+    "save_state_dict",
+    "load_state_dict",
+    "AsyncCheckpointer",
+    "TrainingState",
+    "restore_training_state",
+    "train_epoch_range",
+    "train_step_range",
+    "training_state",
+]
+
+_LATEST = "LATEST"
+
+
+def _ckpt_io(thunk):
+    """Checkpoint IO through the resilience executor: injected faults fire
+    here ('checkpoint' site) and transient IO errors retry with backoff."""
+    from ..resilience import runtime as _rrt
+
+    return _rrt.execute("checkpoint", thunk)
 
 
 def _to_arrays(state_dict: Dict[str, Any]):
@@ -37,16 +56,22 @@ def _to_arrays(state_dict: Dict[str, Any]):
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = False):
-    """Sharded save: each host writes only its local shards (orbax)."""
+    """Sharded save: each host writes only its local shards (orbax).
+
+    Crash-consistent on both backends: orbax commits via its own temp-dir +
+    rename protocol; the pickle fallback writes tmp + atomic rename
+    (framework.io_utils.save). Transient IO failures retry with backoff."""
+    if hasattr(state_dict, "refresh"):
+        state_dict.refresh()  # TrainingState: re-snapshot optimizer moments
     if not _HAS_ORBAX:
         from ..framework.io_utils import save as _save
 
-        _save(state_dict, path)
+        _ckpt_io(lambda: _save(state_dict, path))
         return None
     ckptr = ocp.StandardCheckpointer()
     arrays = _to_arrays(state_dict)
     path = os.path.abspath(path)
-    ckptr.save(path, arrays, force=True)
+    _ckpt_io(lambda: ckptr.save(path, arrays, force=True))
     if not async_save:
         ckptr.wait_until_finished()
     return ckptr
@@ -99,16 +124,64 @@ class AsyncCheckpointer:
             self._mgr = None
         self.max_to_keep = max_to_keep
 
-    def save(self, step: int, state_dict: Dict[str, Any]):
-        arrays = _to_arrays(state_dict)
-        if self._mgr is not None:
-            self._mgr.save(step, args=ocp.args.StandardSave(arrays))
-        else:
-            from ..framework.io_utils import save as _save
+    # -- crash-consistent commit protocol (fallback backend) ----------------
+    # 1. payload → hidden temp file; 2. atomic rename to the numeric name;
+    # 3. LATEST pointer updated last (atomic replace). A kill anywhere in
+    # the sequence leaves either the previous complete snapshot (pointer
+    # untouched) or the new complete one — never a corrupt "latest".
+    # Orbax runs its own equivalent temp-dir + rename commit.
+    def _write_latest(self, step: int):
+        tmp = os.path.join(self.directory, f".{_LATEST}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(str(step))
+        os.replace(tmp, os.path.join(self.directory, _LATEST))
 
-            _save(state_dict, os.path.join(self.directory, str(step)))
+    def _read_latest(self) -> Optional[int]:
+        try:
+            with open(os.path.join(self.directory, _LATEST)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _retain(self):
+        if self.max_to_keep and self.max_to_keep > 0:
+            steps = sorted(
+                int(d) for d in os.listdir(self.directory) if d.isdigit()
+            )
+            for old in steps[: -self.max_to_keep]:
+                try:
+                    os.remove(os.path.join(self.directory, str(old)))
+                except OSError:
+                    pass
+
+    def save(self, step: int, state_dict: Dict[str, Any]):
+        if hasattr(state_dict, "refresh"):
+            state_dict.refresh()  # TrainingState: re-snapshot moments
+        if self._mgr is not None:
+            arrays = _to_arrays(state_dict)
+            _ckpt_io(lambda: self._mgr.save(step, args=ocp.args.StandardSave(arrays)))
+            return
+        from ..framework.io_utils import save as _save
+        from ..resilience import faults as _faults
+
+        def _commit():
+            final = os.path.join(self.directory, str(step))
+            tmp = os.path.join(self.directory, f".snap.{step}.{os.getpid()}")
+            _save(state_dict, tmp)
+            # chaos harness kill point: snapshot bytes written but not yet
+            # committed — a kill here must leave the previous LATEST intact
+            _faults.maybe_kill("checkpoint")
+            os.replace(tmp, final)
+            self._retain()
+            self._write_latest(step)
+
+        _ckpt_io(_commit)
 
     def restore_latest(self, state_dict: Dict[str, Any]) -> Optional[int]:
+        if hasattr(state_dict, "refresh"):
+            # TrainingState: materialize missing optimizer accumulators so
+            # the restore template covers the saved moment entries
+            state_dict.refresh(create=True)
         if self._mgr is not None:
             step = self._mgr.latest_step()
             if step is None:
@@ -132,31 +205,155 @@ class AsyncCheckpointer:
             return None
         from ..framework.io_utils import load as _load
 
-        loaded = _load(os.path.join(self.directory, str(steps[-1])))
-        with no_grad():
-            for k, v in state_dict.items():
-                if k in loaded and isinstance(v, Tensor):
-                    v.set_value(loaded[k])
-        return steps[-1]
+        # prefer the LATEST pointer (committed only after a complete
+        # snapshot); fall back through newer→older snapshots, skipping any
+        # that fail to load — a kill mid-save never loses the run
+        pointed = self._read_latest()
+        candidates = sorted(steps, reverse=True)
+        if pointed in steps:
+            candidates = [pointed] + [s for s in candidates if s != pointed]
+        for step in candidates:
+            try:
+                loaded = _load(os.path.join(self.directory, str(step)))
+            except Exception:
+                continue  # partial/corrupt snapshot — try the previous one
+            with no_grad():
+                for k, v in state_dict.items():
+                    if k in loaded and isinstance(v, Tensor):
+                        v.set_value(loaded[k])
+            return step
+        return None
 
     def wait(self):
         if self._mgr is not None:
             self._mgr.wait_until_finished()
 
 
-def train_epoch_range(max_epoch_num: int, checkpointer: Optional[AsyncCheckpointer] = None,
-                      state_dict: Optional[Dict] = None, save_freq: int = 1):
-    """reference: auto_checkpoint.py:598 train_epoch_range — a generator
-    wrapping the epoch loop that restores the last epoch on (re)start and
-    snapshots at each epoch end; pairs with elastic relaunch for resume."""
+def _train_range(count: int, checkpointer, state_dict, save_freq: int,
+                 guard, optimizer):
+    """Shared restore → yield → boundary-check → periodic-save protocol
+    behind train_epoch_range / train_step_range (they differ only in the
+    granularity of `count` and the save_freq default)."""
     start = 0
     if checkpointer is not None and state_dict is not None:
         restored = checkpointer.restore_latest(state_dict)
         if restored is not None:
+            restore_training_state(state_dict, optimizer=optimizer)
             start = restored + 1
-    for epoch in range(start, max_epoch_num):
-        yield epoch
-        if checkpointer is not None and state_dict is not None and (epoch + 1) % save_freq == 0:
-            checkpointer.save(epoch, state_dict)
+    if guard is not None:
+        guard.bind(checkpointer, state_dict)
+        guard.install()
+    try:
+        for i in range(start, count):
+            yield i
+            if guard is not None:
+                guard.step_boundary(i)  # raises Preempted after a signal
+            if (checkpointer is not None and state_dict is not None
+                    and save_freq and (i + 1) % save_freq == 0):
+                checkpointer.save(i, state_dict)
+    finally:
+        if guard is not None:
+            guard.uninstall()
     if checkpointer is not None:
         checkpointer.wait()
+
+
+def train_epoch_range(max_epoch_num: int, checkpointer: Optional[AsyncCheckpointer] = None,
+                      state_dict: Optional[Dict] = None, save_freq: int = 1,
+                      guard=None, optimizer=None):
+    """reference: auto_checkpoint.py:598 train_epoch_range — a generator
+    wrapping the epoch loop that restores the last epoch on (re)start and
+    snapshots at each epoch end; pairs with elastic relaunch for resume.
+
+    Pass a `paddle.resilience.PreemptionGuard` as `guard` to make the loop
+    preemption-safe: a SIGTERM/SIGINT during an epoch finishes that epoch,
+    emergency-saves it, and raises `Preempted` — relaunching resumes at the
+    next epoch. When `state_dict` is a `training_state` view (or `optimizer`
+    is passed), the optimizer's accumulators are restored too — Adam resumes
+    with its real moments, not fresh zeros. For step-granular (≤1 step lost)
+    resume use train_step_range."""
+    return _train_range(max_epoch_num, checkpointer, state_dict, save_freq,
+                        guard, optimizer)
+
+
+def train_step_range(max_steps: int, checkpointer: Optional[AsyncCheckpointer] = None,
+                     state_dict: Optional[Dict] = None, save_freq: int = 0,
+                     guard=None, optimizer=None):
+    """Step-granular, preemption-safe resume loop (paddle.resilience).
+
+    Restores the latest snapshot on (re)start and yields the remaining step
+    indices. With a `PreemptionGuard`, a SIGTERM/SIGINT arriving during a
+    step lets that step FINISH, then emergency-saves it and raises
+    `Preempted` — a relaunch resumes at the next step, so at most the step
+    that was in flight when the process actually died is lost (CheckFreq's
+    bound, with frequency-based saves via `save_freq` as the crash
+    backstop). Pass `optimizer` to restore its accumulators from the
+    snapshot (see `training_state`)."""
+    return _train_range(max_steps, checkpointer, state_dict, save_freq,
+                        guard, optimizer)
+
+
+_OPT_PREFIX = "__opt__."
+
+
+class TrainingState(dict):
+    """Live flat checkpoint view over model params + optimizer accumulators.
+
+    Model entries are the LIVE parameter tensors (a restore writes into
+    them in place). Optimizer accumulators are REPLACED every step, so the
+    view re-snapshots them on `refresh()` — the save/restore paths call it
+    automatically (save: fresh moments are packed; restore: `create=True`
+    materializes missing accumulators so the snapshot has tensors to land
+    in). After a restore, `restore_training_state` pushes the restored
+    moment values back into the optimizer."""
+
+    def __init__(self, model, optimizer=None):
+        super().__init__()
+        self._model = model
+        self._optimizer = optimizer
+        self.refresh()
+
+    def refresh(self, create: bool = False):
+        self.clear()
+        self.update(self._model.state_dict())
+        opt = self._optimizer
+        if opt is not None:
+            # keyed by parameter INDEX, not name: auto-generated param names
+            # are process-global ("param_7"), so a relaunch's fresh model
+            # would never match name-keyed entries
+            for i, p in enumerate(opt._param_list()):
+                st = opt._accumulators.get(id(p))
+                if st is None and create:
+                    st = opt._create_state(p)
+                    opt._accumulators[id(p)] = st
+                for k, v in (st or {}).items():
+                    self[f"{_OPT_PREFIX}{i}.{k}"] = (
+                        v if isinstance(v, Tensor) else Tensor(v)
+                    )
+        return self
+
+
+def training_state(model, optimizer=None) -> TrainingState:
+    """Checkpointable state covering model params AND optimizer
+    accumulators, for AsyncCheckpointer / save_state_dict / the
+    train_step_range resume loop."""
+    return TrainingState(model, optimizer)
+
+
+def restore_training_state(state: Dict[str, Any], optimizer=None):
+    """Push the optimizer slice of a restored `training_state` back into
+    the optimizer's accumulators (model params restored in place)."""
+    if optimizer is None:
+        optimizer = getattr(state, "_optimizer", None)
+    if optimizer is None:
+        return
+    for i, p in enumerate(optimizer._param_list()):
+        prefix = f"{_OPT_PREFIX}{i}."
+        st = {
+            k[len(prefix):]: (v._value if isinstance(v, Tensor) else jax.numpy.asarray(np.asarray(v)))
+            for k, v in state.items() if k.startswith(prefix)
+        }
+        if st:
+            cur = optimizer._accumulators.get(id(p)) or optimizer._create_state(p)
+            cur.update(st)
+            optimizer._accumulators[id(p)] = cur

@@ -60,12 +60,26 @@ def _unpack(obj: Any, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
-    """paddle.save — state_dicts, Tensors, nested containers."""
+    """paddle.save — state_dicts, Tensors, nested containers.
+
+    Crash-consistent: the payload is written to a temp file in the target
+    directory and atomically renamed into place, so a kill mid-save can
+    never leave a truncated file at `path` (the reader sees either the old
+    complete file or the new complete file)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed mid-write — drop the partial file
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load(path, return_numpy=False, **configs):

@@ -26,7 +26,7 @@ from .lr import LRScheduler
 _jit_update_cache: Dict = {}
 
 
-def make_fused_update(opt, params):
+def make_fused_update(opt, params, sentinel=False):
     """Pure multi-tensor update applier `(p_vals, g_vals, lr, states) ->
     (new_ps, new_states)` over `opt`'s rule for `params`.
 
@@ -36,7 +36,13 @@ def make_fused_update(opt, params):
     per-param hyper merge, same grad-dtype cast. The rule is bound to a
     bare shim carrying just `_weight_decay` — NOT the live optimizer — so
     callers can cache the (jitted) closure without pinning the instance
-    and its accumulators."""
+    and its accumulators.
+
+    With `sentinel=True` (FLAGS_numeric_rescue, paddle.resilience) the
+    applier returns a third output — `any(~isfinite(g))` over every grad —
+    and where-gates the whole update on it: a non-finite step returns the
+    ORIGINAL params and state. The scan and the gate are folded into the
+    same traced program, so rescue adds zero program launches."""
     rule = type(opt)._update
     hypers = [dict(opt._hyper(), **opt._per_param_hyper(p)) for p in params]
     ctx = object.__new__(type(opt))
@@ -50,7 +56,19 @@ def make_fused_update(opt, params):
             np_, nst = rule(ctx, pv, gv, lr, st, **hy)
             new_ps.append(np_)
             new_sts.append(nst)
-        return new_ps, new_sts
+        if not sentinel:
+            return new_ps, new_sts
+        bad = jnp.asarray(False)
+        for gv in g_vals:
+            bad = bad | jnp.any(~jnp.isfinite(gv))
+        new_ps = [
+            jnp.where(bad, pv, nv) for pv, nv in zip(p_vals, new_ps)
+        ]
+        new_sts = [
+            jax.tree_util.tree_map(lambda o, n: jnp.where(bad, o, n), st, nst)
+            for st, nst in zip(states, new_sts)
+        ]
+        return new_ps, new_sts, bad
 
     return apply_update
 
@@ -132,26 +150,48 @@ class Optimizer:
         # dispatch materialization point — grads (and lazily-created params)
         # are flushed concrete before the fused jitted update reads them —
         # plus step-signature observation for the capture controller.
-        if _lazy.step_capture_step(self):
+        from ..resilience import runtime as _rrt
+
+        try:
+            if _lazy.step_capture_step(self):
+                self._step_count += 1
+                return
+            params_grads = [
+                (p, p.grad)
+                for p in self._param_list()
+                if not p.stop_gradient and p.grad is not None
+            ]
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
             self._step_count += 1
-            return
-        params_grads = [
-            (p, p.grad)
-            for p in self._param_list()
-            if not p.stop_gradient and p.grad is not None
-        ]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        self._step_count += 1
-        if params_grads:
-            self._apply_fused(params_grads)
+            if params_grads:
+                self._apply_fused(params_grads)
+        finally:
+            # resilience step boundary: advances the fault-injection step
+            # counter and the degradation ladder's cooldown clocks
+            _rrt.on_step_end()
 
     def _apply_fused(self, params_grads):
+        from ..core import dispatch as _dispatch
+        from ..resilience import faults as _faults
+        from ..resilience import rescue as _rescue
+        from ..resilience import runtime as _rrt
+
         params = [p for p, _ in params_grads]
         g_vals = [
             (_lazy.materialize(g._value) if isinstance(g, Tensor) else g)
             for _, g in params_grads
         ]
+        # chaos harness: a `nan:grads` clause poisons the first gradient
+        # this step (the numeric-rescue sentinel must catch it in-program)
+        plan = _faults.active_plan()
+        if plan is not None and g_vals and plan.nan_fires(
+            "grads", _faults.current_step()
+        ):
+            _dispatch._counters["injected_faults"] += 1
+            g_vals = list(g_vals)
+            g_vals[0] = jnp.full_like(g_vals[0], jnp.nan)
+        sentinel = _rescue.active()
         states = []
         for p in params:
             st = self._accumulators.get(id(p))
@@ -172,6 +212,7 @@ class Optimizer:
             tuple(sorted(self._hyper().items())),
             per_hypers,
             self._weight_decay,
+            sentinel,
             tuple(
                 (id(p), p._value.shape, p._value.dtype, g.dtype)
                 for p, g in zip(params, g_vals)
@@ -186,6 +227,7 @@ class Optimizer:
                 tuple(sorted(self._hyper().items())),
                 per_hypers,
                 self._weight_decay,
+                sentinel,
                 tuple(
                     (p._value.shape, str(p._value.dtype), str(g.dtype))
                     for p, g in zip(params, g_vals)
@@ -197,16 +239,24 @@ class Optimizer:
             # make_fused_update binds a bare weight-decay shim, NOT `self`:
             # this cache is global and capturing the instance would pin its
             # accumulators (potentially hundreds of MB of moments) forever
-            fn = jax.jit(make_fused_update(self, params))
+            fn = jax.jit(make_fused_update(self, params, sentinel=sentinel))
             _jit_update_cache[key] = fn
-        new_ps, new_sts = fn(
-            [p._value for p in params], g_vals,
-            jnp.asarray(self.get_lr(), dtype=jnp.float32), states,
-        )
+        p_vals = [p._value for p in params]
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        out = _rrt.execute("optimizer", lambda: fn(p_vals, g_vals, lr, states))
+        if sentinel:
+            new_ps, new_sts, bad = out
+        else:
+            new_ps, new_sts = out
+            bad = None
         _count_program("optimizer")
         for p, npv, nst in zip(params, new_ps, new_sts):
             p._value = npv
             self._accumulators[id(p)] = nst
+        if bad is not None:
+            # host-read of the fused sentinel (same program's output —
+            # no extra launch); applies skip / lr_backoff / abort
+            _rescue.handle_sentinel(self, bad)
 
     def _param_list(self) -> List[Tensor]:
         if self._parameters is None:

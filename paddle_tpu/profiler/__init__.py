@@ -329,6 +329,12 @@ def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
     counters["_step_result"] = out
     counters["_capture_state"] = lazy.step_capture_state()
     counters["_memory"] = _memory_snapshot(counters)
+    try:
+        from ..resilience import runtime as _resilience_rt
+
+        counters["_resilience"] = _resilience_rt.state()
+    except Exception:  # measurement must never break the profiled step
+        counters["_resilience"] = None
     return counters
 
 

@@ -1,0 +1,82 @@
+"""paddle.resilience — the fault-tolerant training runtime.
+
+Production accelerators fail in four ways the execution tiers themselves
+don't handle: transient device/compile errors, numeric blowups, preemption
+signals, and crashes mid-checkpoint. This package weaves recovery for all
+four through the existing execution choke points (per-op dispatch, lazy
+segment flush, captured-step replay, checkpoint IO) instead of bolting it
+onto user code:
+
+  faults      deterministic fault injection (FLAGS_fault_inject) — the chaos
+              harness tests and tools/chaos_probe.py drive
+  retry       transient-vs-fatal classification + capped exponential backoff
+  ladder      graceful degradation: repeated faults demote a tier
+              captured(1 program) → lazy(3) → per-op(13), cooldown re-promotes
+  rescue      fused non-finite sentinel + skip/lr-backoff/abort policies
+              (FLAGS_numeric_rescue), integrated with amp.GradScaler
+  preemption  SIGTERM/SIGINT guard → emergency checkpoint → resume ≤1 step
+  runtime     the execute() wrapper binding it all to the dispatcher
+
+Every retry, fault, demotion, rescue, and emergency save is counted in
+paddle.profiler.dispatch_counters(). See RESILIENCE.md for the fault model
+and the sentinel arithmetic.
+"""
+from __future__ import annotations
+
+from . import faults, ladder, preemption, rescue, retry, runtime  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultClause,
+    FaultPlan,
+    InjectedCompileError,
+    InjectedExecuteError,
+    InjectedFault,
+    InjectedHang,
+    current_step,
+    parse_fault_spec,
+)
+from .ladder import (  # noqa: F401
+    DegradationLadder,
+    LadderPolicy,
+    degradation_ladder,
+)
+from .preemption import Preempted, PreemptionGuard  # noqa: F401
+from .rescue import (  # noqa: F401
+    Abort,
+    LRBackoff,
+    RescuePolicy,
+    SkipStep,
+)
+from .retry import RetryPolicy, is_transient  # noqa: F401
+from .runtime import execute, on_step_end, state  # noqa: F401
+
+__all__ = [
+    "Abort",
+    "DegradationLadder",
+    "FaultClause",
+    "FaultPlan",
+    "InjectedCompileError",
+    "InjectedExecuteError",
+    "InjectedFault",
+    "InjectedHang",
+    "LRBackoff",
+    "LadderPolicy",
+    "Preempted",
+    "PreemptionGuard",
+    "RescuePolicy",
+    "RetryPolicy",
+    "SkipStep",
+    "current_step",
+    "degradation_ladder",
+    "execute",
+    "is_transient",
+    "on_step_end",
+    "parse_fault_spec",
+    "reset",
+    "state",
+]
+
+
+def reset():
+    """Reset injection plan, step counter, and ladder state (test/chaos
+    scenario isolation)."""
+    runtime.reset()

@@ -1,0 +1,135 @@
+"""Preemption-safe training: SIGTERM/SIGINT → finish the in-flight step,
+emergency-checkpoint, exit cleanly, resume losing at most one step.
+
+TPU fleets are preemptible by design: the scheduler sends SIGTERM and gives
+the process a grace window. The guard's signal handler only sets a flag (so
+the in-flight step always runs to completion — or, for a deferred captured
+step, resolves through the normal fallback path when the loop flushes); the
+training loop then observes the flag at the next step boundary, fires an
+emergency AsyncCheckpointer.save, and raises `Preempted` (a SystemExit, so
+generic `except Exception` recovery code can't swallow it). On relaunch,
+`train_step_range` restores the emergency snapshot and continues from the
+next step — the CheckFreq discipline: checkpointing frequency bounds lost
+work, and the preemption path bounds it to one step.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["Preempted", "PreemptionGuard"]
+
+
+class Preempted(SystemExit):
+    """Raised at the step boundary after a preemption signal; carries the
+    signal and the last completed step. SystemExit subclass: training loops
+    that catch Exception for fault recovery do not accidentally absorb it."""
+
+    def __init__(self, signum: int, step: Optional[int] = None):
+        super().__init__(128 + int(signum))
+        self.signum = int(signum)
+        self.step = step
+
+    def __str__(self):
+        name = signal.Signals(self.signum).name
+        return f"preempted by {name} (last completed step: {self.step})"
+
+
+class PreemptionGuard:
+    """Installable SIGTERM/SIGINT latch + emergency-checkpoint hook.
+
+    Usage::
+
+        guard = paddle.resilience.PreemptionGuard(checkpointer, state_dict)
+        with guard:
+            for step in range(n):
+                train_one_step()
+                guard.step_boundary(step)   # raises Preempted after a signal
+
+    or hand the guard to `paddle.distributed.checkpoint.train_step_range`,
+    which wires the boundary check (and the restore on relaunch) for you.
+    """
+
+    def __init__(self, checkpointer=None, state_dict: Optional[Dict[str, Any]] = None,
+                 signals=None, on_preempt: Optional[Callable[[int], None]] = None):
+        self.checkpointer = checkpointer
+        self.state_dict = state_dict
+        self.signals = tuple(signals or (signal.SIGTERM, signal.SIGINT))
+        self.on_preempt = on_preempt
+        self.preempted = False
+        self.signum: Optional[int] = None
+        self._prev = {}
+        self._installed = False
+
+    def bind(self, checkpointer, state_dict):
+        """Late-bind the emergency-save target (no-op for already-set
+        fields) — used by train_step_range/train_epoch_range."""
+        if self.checkpointer is None:
+            self.checkpointer = checkpointer
+        if self.state_dict is None:
+            self.state_dict = state_dict
+
+    # -- signal plumbing ----------------------------------------------------
+    def _handler(self, signum, frame):
+        self.preempted = True
+        self.signum = signum
+        from ..core import dispatch
+
+        dispatch._counters["preemptions"] += 1
+
+    def install(self):
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal is main-thread-only; stay passive
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev = {}
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- boundary protocol ---------------------------------------------------
+    def emergency_save(self, step: int):
+        """Flush in-flight lazy/captured work, then snapshot synchronously."""
+        from ..core import dispatch, lazy
+
+        # resolve any pending segment or deferred captured backward first:
+        # the step either finishes (flush) or rolls back onto the 3-program
+        # path (capture abort) — state is consistent before the snapshot
+        lazy.flush_if_pending("preemption")
+        if self.checkpointer is not None and self.state_dict is not None:
+            self.checkpointer.save(step, self.state_dict)
+            self.checkpointer.wait()
+            dispatch._counters["emergency_saves"] += 1
+
+    def step_boundary(self, step: int):
+        """Call after each completed step; raises Preempted (after the
+        emergency save) when a signal arrived during the step."""
+        if not self.preempted:
+            return
+        if self.on_preempt is not None:
+            self.on_preempt(step)
+        self.emergency_save(step)
+        raise Preempted(self.signum if self.signum is not None else signal.SIGTERM,
+                        step)

@@ -1,0 +1,135 @@
+"""Step-level numeric rescue over the fused non-finite sentinel.
+
+With FLAGS_numeric_rescue set, the fused optimizer update (and the captured
+whole-step program) computes ONE extra scalar output — `any(~isfinite(g))`
+over every gradient — and gates the parameter/state update on it in-program:
+a blown-up step leaves params and optimizer state untouched without any
+additional program launch (verified by measure_programs: programs-per-step
+stays 13/3/1 per tier). The host then reads the sentinel and applies the
+configured policy:
+
+    skip        drop the step (update already suppressed in-program)
+    lr_backoff  drop the step AND multiply the lr by
+                FLAGS_numeric_rescue_lr_factor (a loss-spike brake)
+    abort       raise FloatingPointError (fail fast, e.g. under a debugger)
+
+AMP integration: when a GradScaler drove the step, a rescued step also marks
+the scaler's found_inf so dynamic loss scaling backs off — and the scaler
+skips its own per-grad host isfinite scan (the sentinel subsumes it).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+from ..core import flags
+
+__all__ = [
+    "Abort",
+    "LRBackoff",
+    "RescuePolicy",
+    "SkipStep",
+    "active",
+    "handle_sentinel",
+    "mode",
+    "policy",
+]
+
+
+def mode() -> str:
+    return str(flags.flag("numeric_rescue"))
+
+
+def active() -> bool:
+    return mode() != ""
+
+
+class RescuePolicy:
+    """What to do — beyond the in-program update suppression — when the
+    sentinel reports non-finite gradients."""
+
+    name = ""
+
+    def apply(self, optimizer):
+        raise NotImplementedError
+
+
+class SkipStep(RescuePolicy):
+    name = "skip"
+
+    def apply(self, optimizer):
+        pass  # update already suppressed in-program
+
+
+class LRBackoff(RescuePolicy):
+    name = "lr_backoff"
+
+    def apply(self, optimizer):
+        from ..core import dispatch
+
+        factor = float(flags.flag("numeric_rescue_lr_factor"))
+        try:
+            optimizer.set_lr(optimizer.get_lr() * factor)
+            dispatch._counters["rescue_lr_backoffs"] += 1
+        except RuntimeError:
+            # scheduler-driven lr: the optimizer refuses set_lr — degrade to
+            # skip-step and say so once
+            warnings.warn(
+                "numeric_rescue=lr_backoff: optimizer lr is scheduler-driven; "
+                "rescued steps are skipped without backing off the lr",
+                stacklevel=3,
+            )
+
+
+class Abort(RescuePolicy):
+    name = "abort"
+
+    def apply(self, optimizer):
+        raise FloatingPointError(
+            "non-finite gradients at optimizer.step "
+            f"(step {_current_step()}): numeric_rescue=abort"
+        )
+
+
+def _current_step() -> int:
+    from . import faults
+
+    return faults.current_step()
+
+
+_POLICIES = {p.name: p for p in (SkipStep(), LRBackoff(), Abort())}
+
+
+def policy() -> Optional[RescuePolicy]:
+    m = mode()
+    if not m:
+        return None
+    pol = _POLICIES.get(m)
+    if pol is None:
+        raise ValueError(
+            f"unknown FLAGS_numeric_rescue policy {m!r}: expected one of "
+            f"{sorted(_POLICIES)}"
+        )
+    return pol
+
+
+def handle_sentinel(optimizer, bad) -> bool:
+    """Host-read the fused sentinel; on non-finite apply the policy.
+
+    Returns True when the step was rescued (params/state unchanged). Reading
+    `bad` blocks on the already-launched step program — it never launches a
+    new one."""
+    if not bool(bad):
+        return False
+    from ..core import dispatch
+
+    dispatch._counters["numeric_rescues"] += 1
+    scaler = getattr(optimizer, "_rescue_scaler", None)
+    if scaler is not None:
+        # dynamic loss scaling reacts to the rescued step exactly as it
+        # would to its own inf scan
+        scaler._found_inf = True
+    pol = policy()
+    if pol is not None:
+        pol.apply(optimizer)
+    return True

@@ -1,0 +1,236 @@
+"""Chaos probe: the LeNet example under a canned fault plan.
+
+The CI-facing proof of the ISSUE-5 acceptance criterion: injected transient
+execute/compile faults (p=0.2) and one mid-run SIGTERM must leave the final
+loss IDENTICAL to the fault-free run, with at most one step of progress
+lost, and every retry/demotion/rescue visible in
+paddle.profiler.dispatch_counters(). Exits nonzero on any unrecovered fault
+(wired like the CI self-lint: tests/test_resilience.py runs this CLI).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_probe.py [--steps 5] [--batch 8]
+                                                  [--tier all|per_op|lazy|captured]
+
+Scenarios:
+  recovery/<tier>   execute+compile faults at p=0.2 (and a guaranteed-fire
+                    x=1 plan) recover by retry to the bitwise final loss
+  nan-rescue        nan:grads + FLAGS_numeric_rescue=skip: poisoned step is
+                    dropped in-program, training continues finite
+  sigterm-resume    SIGTERM mid-run → emergency save at the step boundary →
+                    relaunch resumes with ≤1 step lost and the bitwise
+                    fault-free final loss
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+
+STEPS = 5
+BATCH = 8
+
+
+def _build(seed=0):
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(seed)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    return net, opt, loss_fn
+
+
+def _batches(steps, batch):
+    rng = np.random.default_rng(0)
+    return [
+        (rng.standard_normal((batch, 1, 28, 28)).astype(np.float32),
+         rng.integers(0, 10, (batch,)))
+        for _ in range(steps)
+    ]
+
+
+def _set_tier(tier):
+    paddle.set_flags({
+        "FLAGS_eager_lazy_dispatch": tier in ("lazy", "captured"),
+        "FLAGS_eager_step_capture": tier == "captured",
+    })
+
+
+def _one_step(net, opt, loss_fn, xy):
+    x, y = xy
+    loss = loss_fn(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss)
+
+
+def _run(batches, seed=0, net_opt=None):
+    net, opt, loss_fn = _build(seed) if net_opt is None else net_opt
+    return [_one_step(net, opt, loss_fn, xy) for xy in batches]
+
+
+def _fresh(fault_spec=""):
+    res.reset()
+    prof.reset_dispatch_counters()
+    paddle.set_flags({"FLAGS_fault_inject": fault_spec,
+                      "FLAGS_retry_backoff_ms": 0.5})
+
+
+def scenario_recovery(tier, batches, results):
+    _set_tier(tier)
+    _fresh()
+    clean = _run(batches)
+    # acceptance plan: transient execute/compile faults at p=0.2 …
+    _fresh("execute:p=0.2,compile:p=0.2")
+    faulted = _run(batches)
+    c1 = prof.dispatch_counters()
+    # … plus a guaranteed-fire plan so the retry path is always exercised
+    _fresh("execute:p=1:x=1,compile:p=1:x=1")
+    stormed = _run(batches)
+    c2 = prof.dispatch_counters()
+    _fresh()
+    ok = faulted == clean and stormed == clean and c2["retry_attempts"] > 0
+    results.append({
+        "scenario": f"recovery/{tier}",
+        "ok": ok,
+        "final_loss_clean": clean[-1],
+        "final_loss_p02": faulted[-1],
+        "final_loss_storm": stormed[-1],
+        "p02_injected": c1["injected_faults"],
+        "p02_retries": c1["retry_attempts"],
+        "storm_retries": c2["retry_attempts"],
+        "storm_backoff_ms": round(c2["retry_backoff_ms"], 2),
+        "capture_fallbacks": c2["capture_fallbacks"],
+        "per_op_fallbacks": c2["segment_per_op_fallbacks"],
+    })
+    return ok
+
+
+def scenario_nan_rescue(batches, results):
+    _set_tier("lazy")
+    _fresh("nan:grads:step=1")
+    paddle.set_flags({"FLAGS_numeric_rescue": "skip"})
+    losses = _run(batches)
+    c = prof.dispatch_counters()
+    paddle.set_flags({"FLAGS_numeric_rescue": ""})
+    _fresh()
+    ok = all(np.isfinite(v) for v in losses) and c["numeric_rescues"] >= 1
+    results.append({
+        "scenario": "nan-rescue",
+        "ok": ok,
+        "final_loss": losses[-1],
+        "numeric_rescues": c["numeric_rescues"],
+    })
+    return ok
+
+
+def scenario_sigterm(tier, batches, results):
+    from paddle_tpu.distributed.checkpoint import (
+        AsyncCheckpointer,
+        train_step_range,
+        training_state,
+    )
+    from paddle_tpu.resilience import Preempted, PreemptionGuard
+
+    _set_tier(tier)
+    _fresh()
+    clean = _run(batches)
+    kill_at = len(batches) // 2
+
+    with tempfile.TemporaryDirectory() as ckdir:
+        _fresh()
+        net, opt, loss_fn = _build()
+        ck = AsyncCheckpointer(ckdir, max_to_keep=2)
+        state = training_state(net, opt)
+        done = []
+        preempted = False
+        try:
+            for step in train_step_range(len(batches), ck, state,
+                                         guard=PreemptionGuard()):
+                _one_step(net, opt, loss_fn, batches[step])
+                done.append(step)
+                if step == kill_at:
+                    os.kill(os.getpid(), signal.SIGTERM)
+        except Preempted:
+            preempted = True
+        c = prof.dispatch_counters()
+
+        # relaunch: fresh process state (fresh model/optimizer), resume
+        net2, opt2, loss_fn2 = _build(seed=123)
+        ck2 = AsyncCheckpointer(ckdir, max_to_keep=2)
+        state2 = training_state(net2, opt2)
+        resumed, losses = [], []
+        for step in train_step_range(len(batches), ck2, state2,
+                                     guard=PreemptionGuard()):
+            losses.append(_one_step(net2, opt2, loss_fn2, batches[step]))
+            resumed.append(step)
+    steps_lost = (resumed[0] - (done[-1] + 1)) if resumed else 0
+    ok = (preempted and resumed and resumed[0] >= done[-1]  # ≤1 step lost
+          and steps_lost <= 1 and losses[-1] == clean[-1]
+          and c["emergency_saves"] == 1)
+    results.append({
+        "scenario": f"sigterm-resume/{tier}",
+        "ok": ok,
+        "preempted_after_step": done[-1] if done else None,
+        "resumed_at_step": resumed[0] if resumed else None,
+        "steps_lost": steps_lost,
+        "final_loss_clean": clean[-1],
+        "final_loss_resumed": losses[-1] if losses else None,
+        "emergency_saves": c["emergency_saves"],
+    })
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=STEPS)
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--tier", default="all",
+                    choices=["all", "per_op", "lazy", "captured"])
+    args = ap.parse_args(argv)
+
+    batches = _batches(args.steps, args.batch)
+    tiers = (["per_op", "lazy", "captured"] if args.tier == "all"
+             else [args.tier])
+    results = []
+    ok = True
+    try:
+        for tier in tiers:
+            ok &= scenario_recovery(tier, batches, results)
+        ok &= scenario_nan_rescue(batches, results)
+        ok &= scenario_sigterm(tiers[0], batches, results)
+    finally:
+        paddle.set_flags({
+            "FLAGS_fault_inject": "",
+            "FLAGS_numeric_rescue": "",
+            "FLAGS_eager_lazy_dispatch": False,
+            "FLAGS_eager_step_capture": True,
+            "FLAGS_retry_backoff_ms": 5.0,
+        })
+        res.reset()
+
+    for r in results:
+        print(json.dumps(r))
+    print("ALL SCENARIOS PASSED" if ok else "UNRECOVERED FAULTS", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

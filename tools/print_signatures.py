@@ -42,6 +42,7 @@ SUBMODULES = [
     "inference",
     "device",
     "profiler",
+    "resilience",
     "quantization",
     "incubate",
     "utils",
