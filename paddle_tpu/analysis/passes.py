@@ -512,12 +512,20 @@ def launch_budget(ctx: Context) -> List[Diagnostic]:
         return []  # only meaningful when a counter snapshot is provided
     c = ctx.counters
     # whole-step capture (FLAGS_eager_step_capture) tightens the budget: a
-    # captured steady-state step is ONE donated XLA program, not three
-    captured = int(c.get("capture_replays", 0)) > 0
+    # captured steady-state step is ONE donated XLA program, not three, and
+    # each accumulate-only microstep of a captured k-step gradient-
+    # accumulation cycle replays as one captured program (counted in
+    # capture_accum_replays). The auto budget is therefore one program per
+    # replay in the measured window — a k-cycle window legitimately
+    # launches k captured programs, and an accumulation loop under
+    # FLAGS_check_programs must not warn spuriously.
+    replays = int(c.get("capture_replays", 0))
+    accum_replays = int(c.get("capture_accum_replays", 0))
+    captured = replays > 0 or accum_replays > 0
     if ctx.budget is not None:
         budget = ctx.budget
     else:
-        budget = 1 if captured else 3
+        budget = (replays + accum_replays) if captured else 3
     diags = []
     programs = int(c.get("programs", 0))
     if programs > budget:
@@ -528,8 +536,8 @@ def launch_budget(ctx: Context) -> List[Diagnostic]:
             if c.get(k)
         )
         what = (
-            "one captured whole-step program"
-            if budget == 1
+            "one captured program per update step / accumulate microstep"
+            if captured
             else "fused forward + compiled-tape backward + fused optimizer"
         )
         diags.append(Diagnostic(
@@ -541,11 +549,20 @@ def launch_budget(ctx: Context) -> List[Diagnostic]:
                  "flush_reasons in paddle.profiler.dispatch_counters()",
         ))
     if captured and programs <= budget:
+        what_ran = (
+            "each microstep of the accumulation cycle replayed as one "
+            "captured XLA program (update step donated)"
+            if accum_replays
+            else "the step replayed as 1 XLA program with parameters and "
+                 "optimizer state donated in place"
+        )
         diags.append(Diagnostic(
             Severity.INFO, "launch_budget", "step",
-            "whole-step capture active: the step replayed as 1 XLA program "
-            "with parameters and optimizer state donated in place "
-            f"(capture_replays={c.get('capture_replays')})",
+            f"whole-step capture active: {what_ran} "
+            f"(capture_replays={replays}"
+            + (f", capture_accum_replays={accum_replays}" if accum_replays
+               else "")
+            + ")",
         ))
     fallbacks = int(c.get("capture_fallbacks", 0))
     if fallbacks > 0:
@@ -555,10 +572,15 @@ def launch_budget(ctx: Context) -> List[Diagnostic]:
             Severity.WARNING, "launch_budget", "step",
             f"step fell back out of whole-step capture {fallbacks} time(s)"
             + (f" ({parts})" if parts else ""),
+            # built-in grad clipping and k-step gradient accumulation are
+            # CAPTURABLE patterns now — they no longer belong on this
+            # permanent-bailout list (only custom clip subclasses do)
             hint="a steady-state step keeps capture only when its signature "
                  "is stable: avoid per-step shape/scalar changes, tensor "
-                 "hooks, retain_graph/create_graph, grad clipping, and "
-                 "reads of .grad or pending tensors between backward() and "
+                 "hooks, retain_graph/create_graph, custom grad-clip "
+                 "subclasses (the built-in ClipGradBy* configs capture "
+                 "fine), irregular accumulation cycles, and reads of .grad "
+                 "or pending tensors between backward() and "
                  "optimizer.step()",
         ))
     if int(c.get("segment_cache_misses", 0)) > 0:

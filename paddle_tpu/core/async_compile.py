@@ -1,0 +1,112 @@
+"""Background compile executor for the eager host pipeline.
+
+FLAGS_eager_async_compile moves fresh XLA compilation off the Python hot
+path. The policy, shared by lazy-segment flushes and whole-step capture
+builds (core/lazy.py):
+
+  - the FIRST occurrence of a new program signature runs WITHOUT the fused
+    executable (a segment executes its op plan eagerly — the "bridge"; a
+    captured step resolves on the 3-program path) and submits the compile
+    here;
+  - the NEXT occurrence joins the finished future and installs the result
+    in the ordinary compile cache, so steady state is byte-identical to the
+    synchronous path. Total main-thread blocking is strictly <= synchronous
+    compilation, and a loop that never repeats a signature never blocks.
+
+Exceptions raised on the compile thread are stored in the future and
+re-raise at the join point with their original traceback (concurrent
+.futures preserves ``__traceback__``). Resilience stays on the MAIN thread:
+fault injection, retries, and ladder accounting wrap the bridge/3-program
+execution exactly as they wrap a synchronous flush — the background thread
+only ever compiles pure programs, so it can neither perturb numerics nor
+swallow an injected fault.
+
+Worker time lands in ``dispatch_counters()['async_compile_ms']`` so the
+bench host-breakdown can show how much compile moved off the critical path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
+
+from . import flags
+
+__all__ = ["enabled", "submit", "drain", "pending_jobs"]
+
+_lock = threading.Lock()
+_executor: Optional[ThreadPoolExecutor] = None
+_pending = 0
+# submissions past this depth fall back to the synchronous path: an
+# unbounded queue would let a signature-churning loop pile up compiles of
+# programs it will never replay
+_MAX_PENDING = 8
+
+
+def enabled() -> bool:
+    return bool(flags.flag("eager_async_compile"))
+
+
+def _get_executor() -> ThreadPoolExecutor:
+    global _executor
+    if _executor is None:
+        _executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="paddle-async-compile"
+        )
+    return _executor
+
+
+def pending_jobs() -> int:
+    with _lock:
+        return _pending
+
+
+def submit(job: Callable[[], object]) -> Optional[Future]:
+    """Run `job` (a pure compile) on the background thread.
+
+    Returns the Future, or None when the queue is saturated — the caller
+    then compiles synchronously as if the flag were off."""
+    from . import dispatch
+
+    global _pending
+    with _lock:
+        if _pending >= _MAX_PENDING:
+            dispatch._counters["async_compile_skipped"] += 1
+            return None
+        _pending += 1
+        ex = _get_executor()
+
+    def run():
+        global _pending
+        t0 = time.perf_counter()
+        try:
+            return job()
+        finally:
+            dt = (time.perf_counter() - t0) * 1000.0
+            with _lock:
+                _pending -= 1
+                try:
+                    dispatch._counters["async_compile_ms"] += dt
+                except KeyError:
+                    # raced a reset_dispatch_counters() on the main thread
+                    # (clear() before the defaults repopulate): drop the
+                    # sample — raising from this finally would replace the
+                    # job's compiled executable in the Future
+                    pass
+
+    fut = ex.submit(run)
+    dispatch._counters["async_compiles"] += 1
+    return fut
+
+
+def drain(timeout: Optional[float] = None):
+    """Block until every submitted compile job has finished (the worker is
+    single-threaded and FIFO, so a barrier job runs after all queued work).
+    An explicit synchronization point — paddle.device.synchronize() and the
+    test suites use it; normal execution never needs to."""
+    with _lock:
+        ex, pending = _executor, _pending
+    if ex is None or pending == 0:
+        return
+    ex.submit(lambda: None).result(timeout)

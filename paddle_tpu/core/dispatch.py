@@ -101,6 +101,26 @@ def reset_dispatch_counters():
         capture_fallbacks=0,
         capture_evictions=0,
         donation_alias_flags=0,
+        # gradient-accumulation capture: accumulate-only microsteps replayed
+        # as one captured program (forward + backward + grad accumulate)
+        capture_accum_builds=0,
+        capture_accum_replays=0,
+        # async host pipeline (FLAGS_eager_async_compile): background compile
+        # submissions/joins, bridge flushes (fresh segments executed eagerly
+        # while their fused program compiles off-thread), and captured steps
+        # resolved on the 3-program path while their executable compiles
+        async_compiles=0,
+        async_compile_joins=0,
+        async_compile_skipped=0,
+        async_bridge_flushes=0,
+        capture_async_builds=0,
+        capture_build_pending_steps=0,
+        # host-side time breakdown (ms): aval/trace work, main-thread-blocking
+        # fresh compiles, cached replays, and background-thread compile time
+        trace_time_ms=0.0,
+        compile_time_ms=0.0,
+        replay_time_ms=0.0,
+        async_compile_ms=0.0,
         # resilience runtime (paddle.resilience): fault / retry / ladder /
         # rescue / preemption event accounting
         fault_events=0,

@@ -179,6 +179,34 @@ define_flag(
     "are counted in paddle.profiler.dispatch_counters()",
 )
 define_flag(
+    "eager_async_compile", True,
+    "move fresh XLA compiles off the Python hot path: the FIRST flush of a "
+    "new lazy-segment signature executes its op plan eagerly (bitwise the "
+    "same programs) while the fused segment program compiles on a "
+    "background thread, and the first armed whole-step capture resolves on "
+    "the 3-program path while its donated executable compiles off-thread; "
+    "the next occurrence of the same signature joins the finished compile "
+    "(compile-thread exceptions re-raise there with their original "
+    "traceback). Numerics are identical; only host blocking time moves — "
+    "see trace/compile/replay timers in paddle.profiler.dispatch_counters()",
+)
+define_flag(
+    "pallas_fused_update", False,
+    "route the fused optimizer update (optimizer.make_fused_update — the "
+    "one shared definition used by the eager fused step AND the captured "
+    "whole-step trace) through the hand-written Pallas TPU kernel for "
+    "Adam / SGD / Momentum: each parameter's whole elementwise update "
+    "chain plus its non-finite sentinel contribution runs as one kernel "
+    "pass (one read + one write per buffer) instead of an XLA elementwise "
+    "chain; programs-per-step stays 1 under capture. Off-TPU, and for "
+    "unsupported rules/dtypes, the lax composition is used unchanged",
+)
+define_flag(
+    "pallas_update_interpret", False,
+    "run the Pallas fused-update kernel in interpreter mode so the kernel "
+    "path is testable on CPU (slow; parity/debugging only)",
+)
+define_flag(
     "use_standalone_executor", True, "use the compiled whole-program executor path"
 )
 define_flag(

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -38,17 +39,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import async_compile as _async
 from . import flags
 
 __all__ = [
     "LazyRef",
     "captured_step_program",
+    "drain_async",
     "flush_if_pending",
     "materialize",
     "pending_op_count",
     "pending_segment_jaxpr",
     "step_capture_state",
 ]
+
+
+def _add_time(key: str, t0: float):
+    from . import dispatch
+
+    dispatch._counters[key] += (time.perf_counter() - t0) * 1000.0
+
+
+def drain_async():
+    """Join every background compile job (FLAGS_eager_async_compile). An
+    explicit sync point for benchmarks/tests; steady-state code never needs
+    it — pending compiles install themselves at the next flush/replay of
+    their signature."""
+    _async.drain()
 
 # sentinel returned by lazy_apply when the op must take the per-op path
 _FALLBACK = object()
@@ -254,9 +271,14 @@ def _infer_out_specs(fn, kw, arg_specs):
 
 
 # ---------------------------------------------------------------------------
-# Segment compile cache: signature -> jitted segment program (LRU-bounded)
+# Segment compile cache: signature -> jitted segment program (LRU-bounded).
+# With FLAGS_eager_async_compile, a fresh signature's fused program compiles
+# on the background thread first (_pending_seg_compiles holds the future)
+# and is installed here at the next flush of the same signature.
 # ---------------------------------------------------------------------------
 _segment_cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_pending_seg_compiles: Dict[Tuple, Any] = {}
+_pending_lock = threading.Lock()
 
 
 def _segment_fn(plan, check=False):
@@ -377,19 +399,23 @@ def _flush(seg: _Segment, reason: str):
     sig = _seg_signature(seg)
     jfn = dispatch._lru_get(_segment_cache, sig)
     fresh = jfn is None
-    # the op plan is only needed to build a fresh segment fn and by the
-    # per-op fault fallback below — cache-hit steady state skips the
-    # O(num_ops) build entirely
-    plan = _seg_plan(seg) if fresh else None
+    fut = None
     if fresh:
+        with _pending_lock:
+            fut = _pending_seg_compiles.get(sig)
+    # the op plan is only needed to build a fresh segment fn, by the async
+    # bridge, and by the per-op fault fallback below — cache-hit steady
+    # state skips the O(num_ops) build entirely
+    plan = _seg_plan(seg) if (fresh and fut is None) else None
+    if fresh and fut is None:
         dispatch._counters["segment_cache_misses"] += 1
-        jfn = _build_segment_fn(plan, check)
-    else:
+    elif not fresh:
         dispatch._counters["segment_cache_hits"] += 1
 
     fused = True
+    bridged = False
     try:
-        if fresh and int(flags.flag("check_programs")):
+        if plan is not None and int(flags.flag("check_programs")):
             # FLAGS_check_programs: verify the fused segment before its
             # first compile (cached replays were already verified). A
             # level-2 raise lands in the except path below, so reads of
@@ -403,8 +429,94 @@ def _flush(seg: _Segment, reason: str):
                 ),
                 where=f"lazy-segment flush ({reason})",
             )
-        out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals), fresh=fresh)
+        if not fresh:
+            t0 = time.perf_counter()
+            out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals))
+            _add_time("replay_time_ms", t0)
+        elif fut is not None:
+            # second flush of a signature whose fused program is compiling
+            # in the background: join it (a compile-thread exception
+            # re-raises HERE with its original traceback and lands in the
+            # except path below, exactly like a synchronous compile error)
+            t0 = time.perf_counter()
+            with _pending_lock:
+                # drop the pending entry up front: a compile-thread error
+                # surfaces HERE once, and the next flush of this signature
+                # starts a fresh compile instead of re-raising forever
+                _pending_seg_compiles.pop(sig, None)
+            jfn = fut.result()
+            # any wait on a still-unfinished background compile is
+            # main-thread-blocking compile time, not replay time
+            _add_time("compile_time_ms", t0)
+            dispatch._lru_put(
+                _segment_cache, sig, jfn,
+                evict_counter="segment_cache_evictions",
+                cap=int(flags.flag("eager_segment_cache_size")),
+            )
+            dispatch._counters["async_compile_joins"] += 1
+            dispatch._counters["segment_cache_hits"] += 1
+            t0 = time.perf_counter()
+            out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals))
+            _add_time("replay_time_ms", t0)
+        else:
+            submitted = None
+            if _async.enabled():
+                jfn_bg = _build_segment_fn(plan, check)
+                ext_snapshot = list(seg.ext_vals)
+
+                def _compile_job(_jfn=jfn_bg, _ext=ext_snapshot):
+                    # jax AOT: trace + compile from the snapshot's avals
+                    # without EXECUTING the program (a plain first call
+                    # would run the whole segment on device a second time,
+                    # racing the main thread's bridged execution for the
+                    # accelerator). The Compiled takes the place of the
+                    # jitted wrapper in _segment_cache: avals — weak_type
+                    # included — are part of the cache signature, so every
+                    # later flush of this signature calls it with exactly
+                    # the avals it was lowered for.
+                    return _jfn.lower(_ext).compile()
+
+                submitted = _async.submit(_compile_job)
+            if submitted is not None:
+                # async bridge: run the SAME op plan eagerly for immediate
+                # results (identical ops and vjps — the rung the fault
+                # fallback below already relies on) while the fused program
+                # compiles off-thread. Fault injection, retries, and ladder
+                # accounting wrap this main-thread execution as usual.
+                with _pending_lock:
+                    _pending_seg_compiles[sig] = submitted
+                    # entries normally pop at the join; a signature-churning
+                    # loop never joins, so bound the map (oldest first —
+                    # dicts preserve insertion order) instead of pinning
+                    # compiled programs for signatures that never recur
+                    while len(_pending_seg_compiles) > 64:
+                        _pending_seg_compiles.pop(
+                            next(iter(_pending_seg_compiles))
+                        )
+                dispatch._counters["async_bridge_flushes"] += 1
+                t0 = time.perf_counter()
+                out = dispatch._rexec(
+                    "segment",
+                    lambda: _segment_fn(plan, check)(seg.ext_vals),
+                    fresh=True,
+                )
+                _add_time("replay_time_ms", t0)
+                bridged = True
+            else:
+                jfn = _build_segment_fn(plan, check)
+                t0 = time.perf_counter()
+                out = dispatch._rexec(
+                    "segment", lambda: jfn(seg.ext_vals), fresh=True
+                )
+                _add_time("compile_time_ms", t0)
     except BaseException as e:
+        # a failed flush must leave no pending background compile keyed by
+        # its signature: the submitted job compiled THIS segment's plan, and
+        # a later (healthy) flush of the same signature joining it would
+        # re-raise this flush's failure instead of compiling cleanly
+        if fresh:
+            with _pending_lock:
+                _pending_seg_compiles.pop(sig, None)
         # graceful degradation (paddle.resilience): when the FUSED launch
         # keeps failing transiently (retries exhausted), re-execute the
         # same plan per-op — identical ops and vjps, one rung down the
@@ -429,7 +541,9 @@ def _flush(seg: _Segment, reason: str):
         for _ in plan:  # per-op programs, and the step is no longer capturable
             dispatch._count_program("op")
     if fused:
-        if fresh:
+        if fresh and not bridged:
+            # the bridged path has no jfn yet — its fused program installs
+            # at the join (next flush of this signature), never a None here
             dispatch._lru_put(
                 _segment_cache, sig, jfn,
                 evict_counter="segment_cache_evictions",
@@ -592,11 +706,17 @@ def lazy_apply(
     if hit is not None:
         out_specs, is_seq = hit
     else:
+        t0 = time.perf_counter()
         try:
             out_specs, is_seq = _infer_out_specs(fn, kw, arg_specs)
         except Exception:
+            # book only the failed inference itself — the fallback flush
+            # below times its own work (replay/compile), and a finally here
+            # would double-count it under trace_time_ms
+            _add_time("trace_time_ms", t0)
             flush_if_pending("fallback_infer")
             return _FALLBACK
+        _add_time("trace_time_ms", t0)
         # capped alongside the per-op compile caches (host-only metadata, no
         # jit wrappers, so no eviction counter)
         dispatch._lru_put(_aval_cache, aval_key, (out_specs, is_seq))
@@ -714,16 +834,26 @@ def _new_tensor(value, stop_gradient):
 # ---------------------------------------------------------------------------
 _capture_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
 
-# events a capturable step consists of, in order; kept tiny — anything else
+# events a capturable step consists of, in order; kept small — anything else
 # (per-op fallbacks, extra flushes, per-node backward sweeps) marks the step
-# dirty / pattern-mismatched and the controller simply keeps observing
-_MAX_OBSERVED_EVENTS = 8
+# dirty / pattern-mismatched and the controller simply keeps observing. A
+# k-step gradient-accumulation cycle observes [seg, bwd] * k before its one
+# optimizer.step(), so the cap bounds the capturable accumulation period
+# (k <= 32) rather than sitting at the plain 2-event step.
+_MAX_OBSERVED_EVENTS = 64
 
 
 class _Observer:
-    """Per-thread step-signature observer / arming state."""
+    """Per-thread step-signature observer / arming state.
 
-    __slots__ = ("events", "dirty", "prev", "stable", "armed")
+    `cycle_len` is the armed accumulation period k (1 = plain step): the
+    boundary pattern [seg, bwd] repeated k times before one optimizer.step()
+    is *periodic* — once armed, microsteps 0..k-2 replay as one captured
+    accumulate-only program each and microstep k-1 defers into the full
+    captured update. `pos` tracks the position inside the current cycle."""
+
+    __slots__ = ("events", "dirty", "prev", "stable", "armed", "cycle_len",
+                 "pos")
 
     def __init__(self):
         self.events: List[Tuple] = []
@@ -731,14 +861,27 @@ class _Observer:
         self.prev: Optional[Tuple] = None
         self.stable = 0
         self.armed: Optional[Tuple] = None  # (seg_sig, tape_key, opt_fp)
+        self.cycle_len = 1
+        self.pos = 0
+
+
+def _disarm(obs: "_Observer"):
+    obs.armed, obs.prev, obs.stable = None, None, 0
+    obs.cycle_len, obs.pos = 1, 0
 
 
 class _DeferredStep:
-    """One backward deferred between loss.backward() and optimizer.step()."""
+    """One backward deferred between loss.backward() and optimizer.step().
+
+    `grad_prev_vals` is None for a plain step; for the final microstep of an
+    accumulation cycle it holds each leaf's k-1-step partial grad sum — a
+    program input of the captured update, and the value the abort path
+    restores before re-running the real sweep."""
 
     __slots__ = (
         "segment", "stub_seg", "root", "seg_sig", "tape_key",
         "leaves", "leaf_slots", "leaf_grads", "expected_opt_fp",
+        "grad_prev_vals",
     )
 
 
@@ -751,6 +894,10 @@ class _CaptureEntry:
 
     __slots__ = ("exe", "param_idx", "extra_idx", "param_slots",
                  "extra_slots", "rest_slots", "warmed", "rescue",
+                 # async host pipeline: the in-flight background AOT
+                 # compile (FLAGS_eager_async_compile); steps arriving
+                 # before it finishes resolve on the 3-program path
+                 "pending",
                  # static-analysis surface: the raw (unjitted) step fn, the
                  # arg ShapeDtypeStructs of the first replay, and whether
                  # params/state were donated — captured_step_program()
@@ -808,19 +955,31 @@ def _capture_fallback(reason: str):
     rs[reason] = rs.get(reason, 0) + 1
 
 
-def _opt_fingerprint(opt) -> Tuple:
+def _opt_fingerprint(opt) -> Optional[Tuple]:
     """Hashable identity of the optimizer part of a step signature: rule
-    type + global AND per-param hypers + weight decay + clip-absence + the
-    ids of the params that will be updated. Per-param overrides (e.g.
-    AdamW's apply_decay_param_fun exclusions) are baked into the compiled
-    executable, so they MUST key it — same convention as _apply_fused's
-    _jit_update_cache key. lr VALUE is excluded (schedulers may vary it per
-    step; it is a traced input of the captured program).
+    type + global AND per-param hypers + weight decay + the grad-clip
+    fingerprint + the ids of the params that will be updated. Per-param
+    overrides (e.g. AdamW's apply_decay_param_fun exclusions) are baked
+    into the compiled executable, so they MUST key it — same convention as
+    _apply_fused's _jit_update_cache key. lr VALUE is excluded (schedulers
+    may vary it per step; it is a traced input of the captured program).
+
+    The clip fingerprint is (type tag, hypers) for the three built-in clip
+    configs and ("none",) for no clip — those fold into the captured trace
+    as pure functions of the tape grads (nn/clip.py). A CUSTOM clip
+    (anything overriding _clip) has semantics the capture cannot reproduce:
+    clip_fingerprint returns None and so does this fingerprint, which keeps
+    the step on the eager 3-program path.
 
     Deliberately NOT memoized: per-param overrides can only be validated by
     recomputing them (a memo keyed on anything cheaper replays stale
     hypers), and the per-step cost equals what _apply_fused already pays to
     rebuild per_hypers — work a captured step skips entirely."""
+    from ..nn.clip import clip_fingerprint
+
+    clip_fp = clip_fingerprint(getattr(opt, "_grad_clip", None))
+    if clip_fp is None:
+        return None
     upd = [
         p for p in opt._param_list()
         if not p.stop_gradient and p.grad is not None
@@ -830,7 +989,10 @@ def _opt_fingerprint(opt) -> Tuple:
         tuple(sorted(opt._hyper().items())),
         tuple(tuple(sorted(opt._per_param_hyper(p).items())) for p in upd),
         opt._weight_decay,
-        getattr(opt, "_grad_clip", None) is None,
+        clip_fp,
+        # the Pallas fused-update enablement changes the traced program
+        (bool(flags.flag("pallas_fused_update")),
+         bool(flags.flag("pallas_update_interpret"))),
         tuple(id(p) for p in upd),
     )
 
@@ -843,43 +1005,68 @@ def _step_boundary(opt):
     events, dirty = obs.events, obs.dirty
     obs.events, obs.dirty = [], False
     opt_fp = None
-    if (
+    k = len(events) // 2
+    # a capturable step is PERIODIC: [seg, bwd] repeated k times before this
+    # one optimizer.step(). k == 1 is the plain train step; k > 1 is k-step
+    # gradient accumulation — all k forward segments share one signature and
+    # all k backwards share one tape. Once armed, microsteps 0..k-2 replay
+    # as one captured accumulate-only program each and microstep k-1 defers
+    # into the full captured update program.
+    periodic = (
         not dirty
-        and len(events) == 2
-        and events[0][0] == "seg"
-        and events[1][0] == "bwd"
-        # grad clipping reads (and rewrites) grads between backward and the
-        # update — that read would abort every deferred step, so never arm
-        and getattr(opt, "_grad_clip", None) is None
-    ):
+        and k >= 1
+        and len(events) == 2 * k
+        and all(
+            events[2 * i][0] == "seg" and events[2 * i][1] == events[0][1]
+            for i in range(k)
+        )
+        and all(
+            events[2 * i + 1][0] == "bwd" and events[2 * i + 1][1] == events[1][1]
+            for i in range(k)
+        )
+    )
+    if periodic:
         try:
+            # returns None for custom grad-clip classes — the built-in
+            # clips fold into the captured trace as pure functions of the
+            # tape grads (nn/clip.py); custom ones keep the eager path
             opt_fp = _opt_fingerprint(opt)
         except Exception:
             opt_fp = None
     if opt_fp is None:
-        obs.prev, obs.stable, obs.armed = None, 0, None
+        _disarm(obs)
         return
-    sig = (events[0][1], events[1][1], opt_fp)
+    sig = (events[0][1], events[1][1], opt_fp, k)
     if sig == obs.prev:
         obs.stable += 1
     else:
         obs.prev, obs.stable = sig, 1
-    obs.armed = (
+    armed = (
         sig if obs.stable >= int(flags.flag("eager_capture_warmup")) else None
     )
-    if obs.armed is not None:
+    if armed is not None:
         from . import dispatch
 
         if not dispatch._resilience_module().runtime.captured_tier_ok(
             hash(events[0][1])
         ):
-            obs.armed = None  # ladder demoted this signature — don't arm
+            armed = None  # ladder demoted this signature — don't arm
+    if armed is not None and obs.armed != armed:
+        obs.cycle_len, obs.pos = k, 0
+    obs.armed = armed
 
 
 def step_capture_backward(root) -> bool:
-    """run_backward's capture hook: defer this backward when the controller
-    is armed and the pending segment + tape match the armed signature.
-    Returns True when deferred (the caller returns without sweeping)."""
+    """run_backward's capture hook. With the controller armed and the
+    pending segment + tape matching the armed signature, this backward is
+    taken over by the capture machinery; returns True when the caller must
+    return without sweeping.
+
+    Plain step (cycle_len == 1) and the LAST microstep of an accumulation
+    cycle: the backward is DEFERRED — the whole step resolves at
+    optimizer.step() as one donated program. Accumulate-only microsteps
+    (pos < cycle_len - 1): forward + backward + grad-accumulate replay HERE
+    as one captured program and the grads become concrete immediately."""
     if not _capture_on():
         return False
     obs = getattr(_tls, "observer", None)
@@ -902,10 +1089,10 @@ def step_capture_backward(root) -> bool:
         # degradation ladder demoted this step signature: stay on the
         # 3-program path until the cooldown re-promotes it
         return False
-    armed_seg, armed_tape, armed_opt = obs.armed
+    armed_seg, armed_tape, armed_opt, cycle_len = obs.armed
     if seg_sig != armed_seg:
         _capture_fallback("signature_mismatch")
-        obs.armed = None
+        _disarm(obs)
         return False
     seg_nodes = {id(op.node) for op in seg.ops if op.record}
     struct = dispatch._tape_structure(
@@ -913,12 +1100,12 @@ def step_capture_backward(root) -> bool:
     )
     if struct is None:
         _capture_fallback("tape_ineligible")
-        obs.armed = None
+        _disarm(obs)
         return False
     tape_key, order_nodes, leaves = struct
     if tape_key != armed_tape:
         _capture_fallback("tape_mismatch")
-        obs.armed = None
+        _disarm(obs)
         return False
     if len(order_nodes) != len(seg_nodes):
         # the segment recorded differentiable ops that are NOT ancestors of
@@ -926,25 +1113,45 @@ def step_capture_backward(root) -> bool:
         # closures for a later backward of their own, which the captured
         # replay cannot — keep such steps on the 3-program path
         _capture_fallback("non_tape_recorded_ops")
-        obs.armed = None
+        _disarm(obs)
         return False
     # every tape leaf must be a distinct concrete external input of the
-    # segment with no pre-existing grad (accumulation steps never capture)
+    # segment. Grad state must match the cycle position: the FIRST backward
+    # of a cycle starts from grad=None (run_backward creates fresh grads),
+    # later microsteps accumulate into an existing concrete grad — any other
+    # mix (stale grads at cycle start, a cleared grad mid-cycle) is a
+    # pattern the capture cannot reproduce and falls back.
+    pos = obs.pos if cycle_len > 1 else 0
     slots: List[int] = []
     ineligible = None
     for t in leaves:
         v = t._value
         slot = None if type(v) is LazyRef else seg.ext_ids.get(id(v))
-        if slot is None or t.grad is not None:
+        if slot is None:
             ineligible = "leaf_ineligible"
+            break
+        g = t.grad
+        if pos == 0:
+            if g is not None:
+                ineligible = "leaf_ineligible"
+                break
+        elif g is None or type(g._value) is LazyRef:
+            ineligible = "accum_grad_ineligible"
             break
         slots.append(slot)
     if ineligible is None and len(set(slots)) != len(slots):
         ineligible = "aliased_leaves"
     if ineligible is not None:
         _capture_fallback(ineligible)
-        obs.armed = None
+        _disarm(obs)
         return False
+
+    if cycle_len > 1 and pos < cycle_len - 1:
+        # accumulate-only microstep: replay forward + backward (+ grad
+        # accumulate) as ONE captured program right now. Nothing defers; a
+        # failure simply returns False and the normal flush + sweep runs.
+        return _run_accum_microstep(seg, root, seg_sig, tape_key, leaves,
+                                    slots, pos, obs)
 
     # defer: detach the pending segment (later ops open a fresh one) and
     # hand every leaf a placeholder grad whose read resolves — or aborts —
@@ -961,22 +1168,162 @@ def step_capture_backward(root) -> bool:
     rec.leaf_slots = slots
     rec.leaf_grads = []
     rec.expected_opt_fp = armed_opt
-    for i, t in enumerate(leaves):
-        v = t._value
-        ref = LazyRef(stub_seg, i, 0, tuple(v.shape), v.dtype)
-        gt = _new_tensor(ref, stop_gradient=True)
-        t.grad = gt
-        rec.leaf_grads.append((t, gt, ref))
+    rec.grad_prev_vals = None
+    if pos > 0:
+        # final microstep of an accumulation cycle: the captured update
+        # consumes the k-1 partial sums. Keep each leaf's EXISTING grad
+        # tensor (eager semantics mutate it in place) but swap its value
+        # for a placeholder ref so any read before optimizer.step() aborts;
+        # the previous partial sums ride along for the program inputs and
+        # for the abort path's restore.
+        rec.grad_prev_vals = [t.grad._value for t in leaves]
+        for i, t in enumerate(leaves):
+            v = t._value
+            ref = LazyRef(stub_seg, i, 0, tuple(v.shape), v.dtype)
+            gt = t.grad
+            gt._value = ref
+            rec.leaf_grads.append((t, gt, ref))
+    else:
+        for i, t in enumerate(leaves):
+            v = t._value
+            ref = LazyRef(stub_seg, i, 0, tuple(v.shape), v.dtype)
+            gt = _new_tensor(ref, stop_gradient=True)
+            t.grad = gt
+            rec.leaf_grads.append((t, gt, ref))
     _tls.capture_deferred = rec
     return True
 
 
-def _abort_capture(reason: str):
+def _accum_step_fn(plan, n_ext, leaf_slots, root_op, root_out,
+                   seed_shape, seed_dtype, with_grad_in):
+    """Raw accumulate-only microstep program: forward replay + whole-program
+    vjp over every tape leaf (+ add into the incoming partial grad sums).
+    Same gradient contract as the full captured step (_plan_capture_forward
+    stop-gradients every non-diff input position), and the accumulate order
+    matches the eager sweep exactly: prev + new."""
+    fwd = _plan_capture_forward(plan)
+    leaf_slot_set = set(leaf_slots)
+    rest_slots = [s for s in range(n_ext) if s not in leaf_slot_set]
+
+    def accum_fn(leaf_vals, grad_in, rest_vals):
+        ext = [None] * n_ext
+        for s, v in zip(rest_slots, rest_vals):
+            ext[s] = v
+
+        def loss_of(lv):
+            e = list(ext)
+            for s, v in zip(leaf_slots, lv):
+                e[s] = v
+            results = fwd(e)
+            return results[root_op][root_out], results
+
+        _loss, vjp, results = jax.vjp(loss_of, tuple(leaf_vals), has_aux=True)
+        (g,) = vjp(jnp.ones(seed_shape, seed_dtype))
+        if with_grad_in:
+            g = tuple(a + b for a, b in zip(grad_in, g))
+        return results, tuple(g)
+
+    return accum_fn, rest_slots
+
+
+def _run_accum_microstep(seg, root, seg_sig, tape_key, leaves, slots, pos,
+                         obs) -> bool:
+    """Build/replay the captured accumulate-only program for one
+    armed microstep; True when it resolved the backward (grads concrete).
+
+    The incoming partial-sum grad buffers are NOT donated: the graceful
+    fallback contract (a real fault resolves the microstep on the normal
+    flush + sweep path) must still be able to read them — only the k-th
+    microstep's update program donates params and optimizer state."""
+    from . import dispatch
+
+    with_grad_in = pos > 0
+    key = (seg_sig, tape_key, "accum", with_grad_in)
+    try:
+        entry = dispatch._lru_get(_capture_cache, key)
+    except TypeError:
+        return False
+    rv = root._value
+    lkey = hash(seg_sig)
+    try:
+        if entry is None:
+            accum_fn, rest_slots = _accum_step_fn(
+                _seg_plan(seg), len(seg.ext_vals), tuple(slots),
+                rv._op_index, rv._out_index, rv._shape, rv._dtype,
+                with_grad_in,
+            )
+            entry = (jax.jit(accum_fn), rest_slots)
+            dispatch._counters["capture_accum_builds"] += 1
+            dispatch._lru_put(
+                _capture_cache, key, entry,
+                evict_counter="capture_evictions",
+                cap=int(flags.flag("eager_capture_cache_size")),
+            )
+            fresh = True
+        else:
+            fresh = False
+        jfn, rest_slots = entry
+        ext = seg.ext_vals
+        args = (
+            tuple(ext[s] for s in slots),
+            tuple(leaves[i].grad._value for i in range(len(leaves)))
+            if with_grad_in else (),
+            tuple(ext[s] for s in rest_slots),
+        )
+        t0 = time.perf_counter()
+        out = dispatch._rexec(
+            "captured", lambda: jfn(*args), fresh=fresh, ladder_key=lkey,
+        )
+        _add_time("compile_time_ms" if fresh else "replay_time_ms", t0)
+    except BaseException as e:
+        if not isinstance(e, Exception):
+            raise
+        # any build/compile/runtime error: counted, then the normal flush +
+        # tape-backward path resolves this microstep with identical numerics
+        _capture_fallback("accum_error")
+        _disarm(obs)
+        return False
+    results, g_out = out
+    dispatch._count_program("captured")
+    dispatch._counters["capture_accum_replays"] += 1
+
+    # the captured program subsumes the segment flush (same write-back as
+    # _run_captured, minus vjp closures — a second backward raises)
+    seg.flushed = True
+    if getattr(_tls, "segment", None) is seg:
+        _tls.segment = None
+    for op, outs in zip(seg.ops, results):
+        for (ref, t), val in zip(op.outs, outs):
+            ref._concrete = val
+            if t._value is ref:
+                t._value = val
+        if op.record:
+            op.node.out_avals = [(tuple(v.shape), v.dtype) for v in outs]
+    seg.ops = []
+    from .tensor import Tensor
+
+    for t, g in zip(leaves, g_out):
+        if with_grad_in:
+            # eager parity: the sweep mutates the existing grad tensor in
+            # place (t.grad._value = prev + new) — same object identity
+            t.grad._value = g
+        else:
+            t.grad = Tensor(g, stop_gradient=True)
+    obs.pos = pos + 1
+    return True
+
+
+def _abort_capture(reason: str, fallback: bool = True):
     """Resolve a deferred captured-step backward on the normal 3-program
     path: flush the segment (which populates the tape's vjp closures), run
     the real backward, and fill the placeholder grads. Numerics match the
     never-captured path exactly; the event is counted as a capture
-    fallback and the controller re-observes from scratch."""
+    fallback and the controller re-observes from scratch.
+
+    `fallback=False` is the async-compile pending resolution: the step
+    resolves the same safe way, but it is NOT a capture fallback — the
+    controller stays armed so the next occurrence joins the background
+    build (counted separately as capture_build_pending_steps)."""
     from . import dispatch
 
     rec = getattr(_tls, "capture_deferred", None)
@@ -984,21 +1331,33 @@ def _abort_capture(reason: str):
         return
     _tls.capture_deferred = None
     rec.stub_seg.flushed = True
-    _capture_fallback(reason)
     obs = getattr(_tls, "observer", None)
-    if obs is not None:
-        obs.armed, obs.prev, obs.stable = None, None, 0
+    if fallback:
+        _capture_fallback(reason)
+        if obs is not None:
+            _disarm(obs)
+            obs.events, obs.dirty = [], False
+    elif obs is not None:
         obs.events, obs.dirty = [], False
-    # leaves had no grad when the backward was deferred, so the real sweep
-    # must compute from scratch — exactly what the eager ordering did: the
-    # backward wrote a fresh grad FIRST, any later user write/clear of
-    # t.grad then replaced it. Reproduce that: run the sweep over grad=None
-    # leaves, give the placeholder tensor the computed value (whoever saved
-    # p.grad at backward() time sees the real gradient), and put back the
-    # user's replacement if there was one.
+        obs.pos = 0  # the cycle completed on the 3-program path
+    # Reproduce the eager ordering exactly: the backward writes grads FIRST
+    # (a fresh tensor for a plain step; in-place accumulation into the
+    # restored k-1 partial sum for an accumulation cycle), any later user
+    # write/clear of t.grad then replaced it. So: run the sweep over the
+    # restored grad state, give the placeholder its computed value (whoever
+    # saved p.grad at backward() time sees the real gradient), and put back
+    # the user's replacement if there was one.
     saved = [(t, gt, ref, t.grad) for t, gt, ref in rec.leaf_grads]
-    for t, _gt, _ref, _cur in saved:
-        t.grad = None
+    if rec.grad_prev_vals is None:
+        for t, _gt, _ref, _cur in saved:
+            t.grad = None
+    else:
+        # final accumulation microstep: restore the partial sums so the
+        # sweep accumulates into them (t.grad._value = prev + new), exactly
+        # what the eager path would have produced
+        for (t, gt, _ref, _cur), prev in zip(saved, rec.grad_prev_vals):
+            gt._value = prev
+            t.grad = gt
     if not rec.segment.flushed:
         _flush(rec.segment, "capture_abort")
     root = rec.root
@@ -1050,12 +1409,18 @@ def _plan_capture_forward(plan):
 
 
 def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
-    """Trace + jit the whole step — forward plan, loss vjp, optimizer
-    update — as ONE program with params and optimizer state donated."""
+    """Trace + jit the whole step — forward plan, loss vjp, grad clip,
+    optimizer update — as ONE program with params and optimizer state
+    donated."""
+    from ..nn.clip import capture_clip_fn
+
     seg = rec.segment
     leaves = rec.leaves
-    if getattr(opt, "_grad_clip", None) is not None:
-        raise _CaptureIneligible("grad_clip")
+    clip = getattr(opt, "_grad_clip", None)
+    clip_fn = capture_clip_fn(clip)
+    if clip is not None and clip_fn is None:
+        # custom clip subclass: semantics the pure fold cannot cover
+        raise _CaptureIneligible("grad_clip_custom")
     leaf_pos = {id(t): i for i, t in enumerate(leaves)}
     params = [
         p for p in opt._param_list()
@@ -1089,8 +1454,9 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
 
     rescue_on = _rescue.active()
     apply_update = make_fused_update(opt, params, sentinel=rescue_on)
+    has_grad_in = rec.grad_prev_vals is not None
 
-    def step_fn(p_vals, sts, lr, extra_vals, rest_vals):
+    def step_fn(p_vals, sts, lr, extra_vals, rest_vals, gp_in, gx_in):
         ext = [None] * n_ext
         for s, v in zip(rest_slots, rest_vals):
             ext[s] = v
@@ -1109,14 +1475,27 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
         )
         del loss_val  # the loss is results[root_op][root_out]
         gp, gx = vjp(jnp.ones(seed_shape, seed_dtype))
+        if has_grad_in:
+            # accumulation: fold this microstep's grads into the k-1-step
+            # partial sums, prev + new — the eager sweep's accumulate order
+            gp = tuple(a + b for a, b in zip(gp_in, gp))
+            gx = tuple(a + b for a, b in zip(gx_in, gx))
+        # grad clipping (built-in configs only): the SAME pure function the
+        # eager Optimizer.step() applies between backward and the fused
+        # update (nn/clip.py _pure), over the param grads in param-list
+        # order — global-norm reduction order and all. The update (and the
+        # non-finite sentinel, when on) sees the CLIPPED grads; the grads
+        # written back to p.grad stay unclipped, exactly like the eager
+        # path, which never writes the clipped values back.
+        upd_g = tuple(clip_fn(list(gp))) if clip_fn is not None else gp
         if rescue_on:
             # numeric-rescue sentinel (paddle.resilience): one extra scalar
             # output of the SAME program; the update is where-gated on it
             # in-program, so a non-finite step leaves params/state untouched
             # at zero extra launches
-            new_p, new_s, bad = apply_update(p_vals, gp, lr, sts)
+            new_p, new_s, bad = apply_update(p_vals, upd_g, lr, sts)
             return results, gp, gx, tuple(new_p), tuple(new_s), bad
-        new_p, new_s = apply_update(p_vals, gp, lr, sts)
+        new_p, new_s = apply_update(p_vals, upd_g, lr, sts)
         return results, gp, gx, tuple(new_p), tuple(new_s)
 
     entry = _CaptureEntry()
@@ -1138,7 +1517,51 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     entry.extra_slots = extra_slots
     entry.rest_slots = rest_slots
     entry.warmed = False
+    entry.pending = None
     return entry
+
+
+def _aot_compile(exe, specs):
+    """Background-thread half of an async capture build: trace + XLA-compile
+    the jitted step over abstract avals (jax AOT). Returns the Compiled
+    executable; donation is part of the lowering, so the later replay on the
+    main thread consumes its buffers exactly like a plain jit call."""
+    import warnings
+
+    with warnings.catch_warnings():
+        # backends without real donation (CPU) warn at compile time
+        warnings.filterwarnings("ignore", message=".*onated buffer.*")
+        return exe.lower(*specs).compile()
+
+
+def _capture_args(rec: _DeferredStep, opt, entry: _CaptureEntry):
+    """The concrete argument tuple of one captured-step replay (also used at
+    async-build submission time to derive the AOT lowering avals)."""
+    seg = rec.segment
+    leaves = rec.leaves
+    params = [leaves[i] for i in entry.param_idx]
+    ext = seg.ext_vals
+    states = []
+    for p in params:
+        st = opt._accumulators.get(id(p))
+        if st is None:
+            st = opt._create_state(p)
+        states.append(st)
+    lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
+    if rec.grad_prev_vals is None:
+        gp_in, gx_in = (), ()
+    else:
+        gp_in = tuple(rec.grad_prev_vals[i] for i in entry.param_idx)
+        gx_in = tuple(rec.grad_prev_vals[i] for i in entry.extra_idx)
+    return params, states, (
+        tuple(ext[s] for s in entry.param_slots),
+        tuple(states),
+        lr,
+        tuple(ext[s] for s in entry.extra_slots),
+        tuple(ext[s] for s in entry.rest_slots),
+        gp_in,
+        gx_in,
+    )
 
 
 def _capture_arg_roles(entry: _CaptureEntry):
@@ -1146,7 +1569,7 @@ def _capture_arg_roles(entry: _CaptureEntry):
     program traced from entry.arg_specs — donate_argnums=(0, 1) donates the
     leaves of the param and optimizer-state pytrees, which flatten first."""
     leaves = jax.tree_util.tree_leaves
-    p_specs, s_specs, _lr, extra, rest = entry.arg_specs
+    p_specs, s_specs, _lr, extra, rest, gp_in, gx_in = entry.arg_specs
     n_p, n_s = len(leaves(p_specs)), len(leaves(s_specs))
     roles = (
         [("param", f"param{i}") for i in range(n_p)]
@@ -1154,6 +1577,8 @@ def _capture_arg_roles(entry: _CaptureEntry):
         + [("arg", "lr")]
         + [("feed", f"batch{i}") for i in range(len(leaves(extra)))]
         + [("arg", f"ext{i}") for i in range(len(leaves(rest)))]
+        + [("arg", f"grad_in{i}")
+           for i in range(len(leaves(gp_in)) + len(leaves(gx_in)))]
     )
     donated = tuple(range(n_p + n_s)) if entry.donated else ()
     return roles, donated
@@ -1194,31 +1619,17 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
 
     seg = rec.segment
     leaves = rec.leaves
-    params = [leaves[i] for i in entry.param_idx]
     ext = seg.ext_vals
-    for p, s in zip(params, entry.param_slots):
-        if p._value is not ext[s]:
+    for i, s in zip(entry.param_idx, entry.param_slots):
+        if leaves[i]._value is not ext[s]:
             raise _CaptureIneligible("param_rebound")
-    for t, gt, _ref in rec.leaf_grads:
-        if t.grad is not gt:
+    for t, gt, ref in rec.leaf_grads:
+        if t.grad is not gt or gt._value is not ref:
             # the user wrote/cleared a .grad between backward() and step():
             # the eager path would feed THAT value to the update — abort so
             # the normal path does exactly that
             raise _CaptureIneligible("grad_replaced")
-    states = []
-    for p in params:
-        st = opt._accumulators.get(id(p))
-        if st is None:
-            st = opt._create_state(p)
-        states.append(st)
-    lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
-    args = (
-        tuple(ext[s] for s in entry.param_slots),
-        tuple(states),
-        lr,
-        tuple(ext[s] for s in entry.extra_slots),
-        tuple(ext[s] for s in entry.rest_slots),
-    )
+    params, states, args = _capture_args(rec, opt, entry)
     if entry.arg_specs is None:
         entry.arg_specs = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), args
@@ -1237,23 +1648,28 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     # deleted buffers, so such faults skip in-place retry and resolve via
     # the 3-program fallback (injected faults raise pre-launch and retry)
     unsafe = entry.donated
+    t0 = time.perf_counter()
     if entry.warmed:
         out = dispatch._rexec(
             "captured", lambda: entry.exe(*args), ladder_key=lkey,
             retry_unsafe=unsafe,
         )
+        _add_time("replay_time_ms", t0)
     else:
         import warnings
 
         def _first_run():
             with warnings.catch_warnings():
-                # first call compiles; backends without real buffer donation
-                # (CPU) warn that donated buffers were unused — benign here
+                # first call compiles (unless the async pipeline already
+                # AOT-compiled it off-thread); backends without real buffer
+                # donation (CPU) warn that donated buffers were unused —
+                # benign here
                 warnings.filterwarnings("ignore", message=".*onated buffer.*")
                 return entry.exe(*args)
 
         out = dispatch._rexec("captured", _first_run, fresh=True,
                               ladder_key=lkey, retry_unsafe=unsafe)
+        _add_time("compile_time_ms", t0)
         entry.warmed = True
     if entry.rescue:
         results, gp, gx, new_p, new_s, bad = out
@@ -1300,6 +1716,7 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     obs = getattr(_tls, "observer", None)
     if obs is not None:
         obs.events, obs.dirty = [], False  # stays armed for the next step
+        obs.pos = 0  # an accumulation cycle completed; next one starts fresh
     if bad is not None:
         from ..resilience import rescue as _rescue
 
@@ -1357,6 +1774,7 @@ def step_capture_step(optimizer) -> bool:
 
     key = (rec.seg_sig, rec.tape_key, opt_fp,
            bool(flags.flag("eager_capture_donate")),
+           rec.grad_prev_vals is not None,  # accumulation: grad-in program
            _rescue.active())  # the sentinel changes the traced program
     try:
         entry = dispatch._lru_get(_capture_cache, key)
@@ -1366,8 +1784,27 @@ def step_capture_step(optimizer) -> bool:
         return fallback("unhashable_key")
     try:
         if entry is None:
-            entry = dispatch._rexec(
-                "captured", lambda: _build_captured_step(rec, optimizer),
+            def _build_and_submit():
+                # trace-free build (jax.jit is lazy); with the async
+                # pipeline on, the expensive trace + XLA compile moves to
+                # the background thread as an AOT lower().compile() over
+                # the arg avals — real buffers never cross the thread
+                # boundary, so donation stays a replay-time-only effect
+                e = _build_captured_step(rec, optimizer)
+                if not _async.enabled():
+                    return e, None
+                _p, _s, cargs = _capture_args(rec, optimizer, e)
+                e.arg_specs = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+                    cargs,
+                )
+                exe, specs = e.exe, e.arg_specs
+                fut = _async.submit(lambda: _aot_compile(exe, specs))
+                e.pending = fut  # None when the queue is saturated
+                return e, fut
+
+            entry, fut = dispatch._rexec(
+                "captured", _build_and_submit,
                 fresh=True, ladder_key=hash(rec.seg_sig),
             )
             dispatch._counters["capture_builds"] += 1
@@ -1376,6 +1813,33 @@ def step_capture_step(optimizer) -> bool:
                 evict_counter="capture_evictions",
                 cap=int(flags.flag("eager_capture_cache_size")),
             )
+            if fut is not None:
+                # resolve THIS step on the 3-program path while the
+                # executable compiles off-thread — not a capture fallback:
+                # the controller stays armed and the next occurrence of
+                # this signature joins the finished compile
+                dispatch._counters["capture_async_builds"] += 1
+                dispatch._counters["capture_build_pending_steps"] += 1
+                _abort_capture("build_pending", fallback=False)
+                flush_if_pending("optimizer_step")
+                return False
+        elif entry.pending is not None:
+            fut = entry.pending
+            if not fut.done():
+                dispatch._counters["capture_build_pending_steps"] += 1
+                _abort_capture("build_pending", fallback=False)
+                flush_if_pending("optimizer_step")
+                return False
+            entry.pending = None
+            try:
+                entry.exe = fut.result()  # the AOT-compiled executable
+            except Exception:
+                # compile-thread failure: drop the entry so a later cycle
+                # rebuilds from scratch, then surface the error with its
+                # original traceback through the capture_error contract
+                _capture_cache.pop(key, None)
+                raise
+            dispatch._counters["async_compile_joins"] += 1
         return _run_captured(rec, optimizer, entry)
     except _CaptureIneligible as e:
         return fallback(e.reason)
@@ -1421,4 +1885,10 @@ def step_capture_state() -> Dict[str, Any]:
         "stable_steps": 0 if obs is None else obs.stable,
         "deferred": getattr(_tls, "capture_deferred", None) is not None,
         "cached_steps": len(_capture_cache),
+        # accumulation-cycle state: period k (1 = plain step) and the
+        # position inside the current cycle
+        "cycle_len": 1 if obs is None else obs.cycle_len,
+        "cycle_pos": 0 if obs is None else obs.pos,
+        # async host pipeline: background compiles still in flight
+        "pending_compiles": _async.pending_jobs(),
     }

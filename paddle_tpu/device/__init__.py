@@ -37,12 +37,15 @@ def synchronize(device=None):
     """Block until all queued device work is done.
 
     Also a lazy-dispatch materialization point: any pending deferred-eager
-    segment (FLAGS_eager_lazy_dispatch) is flushed as one program first, so
-    after synchronize() every live Tensor holds a concrete, ready array.
+    segment (FLAGS_eager_lazy_dispatch) is flushed as one program first, and
+    every in-flight background compile (FLAGS_eager_async_compile) is
+    joined, so after synchronize() every live Tensor holds a concrete,
+    ready array and no host-pipeline work remains outstanding.
     """
     from ..core import lazy
 
     lazy.flush_if_pending("explicit_sync")
+    lazy.drain_async()
     for arr in jax.live_arrays():
         arr.block_until_ready()
 

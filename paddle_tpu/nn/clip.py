@@ -1,17 +1,83 @@
 """Gradient clipping. Reference: python/paddle/fluid/clip.py
 (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm — used by optimizers
-via grad_clip=...)."""
+via grad_clip=...).
+
+Each built-in clip is defined by ONE pure function over the raw grad arrays
+(`_pure()`), used by both callers:
+
+  - the eager path (`Optimizer.step()` -> `_clip(params_grads)`) applies it
+    to concrete grads between backward() and the fused update;
+  - the whole-step capture controller (core/lazy.py) folds the SAME
+    function into the captured forward+backward+update trace, so a step
+    with grad clipping still replays as one donated XLA program, bitwise
+    equal to the eager composition.
+
+`clip_fingerprint()` is the capture controller's hashable identity of a
+clip config (type + hyperparameters); it returns None for custom
+subclasses (anything overriding `_clip`), which keeps them on the eager
+3-program path rather than mis-capturing unknown semantics.
+"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor
 
+__all__ = [
+    "ClipGradBase",
+    "ClipGradByValue",
+    "ClipGradByNorm",
+    "ClipGradByGlobalNorm",
+    "capture_clip_fn",
+    "clip_fingerprint",
+]
+
 
 class ClipGradBase:
+    def _pure(self):
+        """Pure `list[grad arrays] -> list[clipped arrays]`, or None when
+        the clip has no pure form (custom subclasses)."""
+        return None
+
+    def _fingerprint(self):
+        """Hashable (type tag, hypers) identity, or None."""
+        return None
+
+    @no_grad()
     def _clip(self, params_grads):
-        raise NotImplementedError
+        fn = self._pure()
+        if fn is None:
+            raise NotImplementedError
+        # run the pure clip as ONE jitted program (cached on the instance;
+        # retraces per grad-aval set). Besides costing one dispatch instead
+        # of several, this keeps the eager clip bitwise-identical to the
+        # SAME function inlined into the captured whole-step trace — XLA
+        # fuses a jitted elementwise chain the same way in both, while
+        # op-by-op eager execution could differ in the low bits. The cache
+        # is keyed by the fingerprint: _pure() closes over the hypers, so a
+        # mutated clip_norm must rebuild (the capture path re-fingerprints
+        # live values and the two must stay in lockstep).
+        fp = self._fingerprint()
+        cached = self.__dict__.get("_jit_pure")
+        jfn = cached[1] if cached is not None and cached[0] == fp else None
+        if jfn is None:
+            jfn = jax.jit(fn)
+            self._jit_pure = (fp, jfn)
+        from ..core.lazy import materialize
+
+        clipped = jfn(
+            [materialize(g._value) for _, g in params_grads if g is not None]
+        )
+        out, i = [], 0
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(clipped[i])))
+                i += 1
+        return out
 
     def __call__(self, params_grads):
         return self._clip(params_grads)
@@ -22,32 +88,37 @@ class ClipGradByValue(ClipGradBase):
         self.max = float(max)
         self.min = float(min) if min is not None else -self.max
 
-    @no_grad()
-    def _clip(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
-        return out
+    def _pure(self):
+        lo, hi = self.min, self.max
+
+        def fn(g_vals):
+            return [jnp.clip(g, lo, hi) for g in g_vals]
+
+        return fn
+
+    def _fingerprint(self):
+        return ("value", self.min, self.max)
 
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
 
-    @no_grad()
-    def _clip(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
-            scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
-            out.append((p, Tensor(g._value * scale)))
-        return out
+    def _pure(self):
+        clip_norm = self.clip_norm
+
+        def fn(g_vals):
+            out = []
+            for g in g_vals:
+                norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+                scale = jnp.where(norm > clip_norm, clip_norm / norm, 1.0)
+                out.append(g * scale)
+            return out
+
+        return fn
+
+    def _fingerprint(self):
+        return ("norm", self.clip_norm)
 
 
 class ClipGradByGlobalNorm(ClipGradBase):
@@ -57,24 +128,50 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def __init__(self, clip_norm, group_name="default_group"):
         self.clip_norm = float(clip_norm)
 
-    @no_grad()
-    def _clip(self, params_grads):
-        sq = [
-            jnp.sum(jnp.square(g._value.astype(jnp.float32)))
-            for _, g in params_grads
-            if g is not None
-        ]
-        if not sq:
-            return params_grads
-        global_norm = jnp.sqrt(sum(sq))
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-            else:
-                out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
-        return out
+    def _pure(self):
+        clip_norm = self.clip_norm
+
+        def fn(g_vals):
+            sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in g_vals]
+            if not sq:
+                return []
+            global_norm = jnp.sqrt(sum(sq))
+            scale = clip_norm / jnp.maximum(global_norm, clip_norm)
+            return [(g * scale).astype(g.dtype) for g in g_vals]
+
+        return fn
+
+    def _fingerprint(self):
+        return ("global_norm", self.clip_norm)
+
+
+_BUILTIN_CLIPS = (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
+
+
+def _is_builtin(clip) -> bool:
+    # exact type AND the stock _clip: a subclass (or an instance of a
+    # builtin with an overridden _clip) has semantics the pure form does
+    # not cover — such clips stay on the eager path
+    return type(clip) in _BUILTIN_CLIPS and type(clip)._clip is ClipGradBase._clip
+
+
+def capture_clip_fn(clip):
+    """The pure clip function for the capture trace, or None when `clip` is
+    not one of the stock clip configs."""
+    if clip is None or not _is_builtin(clip):
+        return None
+    return clip._pure()
+
+
+def clip_fingerprint(clip):
+    """Hashable identity of a clip config for the capture step signature:
+    ("none",) for no clip, (tag, hypers...) for the three built-in clips,
+    None when the clip is custom (step is then never armed for capture)."""
+    if clip is None:
+        return ("none",)
+    if not _is_builtin(clip):
+        return None
+    return clip._fingerprint()
 
 
 GradientClipByValue = ClipGradByValue

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags as _flags
 from ..core import lazy as _lazy
 from ..core.dispatch import _count_program, no_grad
 from ..core.tensor import Tensor
@@ -42,32 +43,53 @@ def make_fused_update(opt, params, sentinel=False):
     applier returns a third output — `any(~isfinite(g))` over every grad —
     and where-gates the whole update on it: a non-finite step returns the
     ORIGINAL params and state. The scan and the gate are folded into the
-    same traced program, so rescue adds zero program launches."""
+    same traced program, so rescue adds zero program launches.
+
+    With FLAGS_pallas_fused_update (on TPU, or under the interpret flag),
+    eligible parameters route through the hand-written Pallas kernel
+    (ops/pallas/fused_update.py): the whole elementwise update chain — and
+    the sentinel gate — runs as one VMEM pass per buffer. Ineligible
+    params (unsupported rule, dtype, or tile size) keep the lax rule in
+    the SAME traced program, so the callers' 1/3-program arithmetic never
+    changes. The enablement is part of both compile-cache keys
+    (_apply_fused's and the capture controller's), so flipping the flag
+    retraces instead of replaying a stale program."""
+    from ..ops.pallas import fused_update as _pfu
+
     rule = type(opt)._update
     hypers = [dict(opt._hyper(), **opt._per_param_hyper(p)) for p in params]
     ctx = object.__new__(type(opt))
     ctx._weight_decay = opt._weight_decay
+    kind = _pfu.rule_kind(type(opt)) if _pfu.enabled() else None
 
     def apply_update(p_vals, g_vals, lr, states):
+        bad = None
+        if sentinel:
+            bad = jnp.asarray(False)
+            for gv in g_vals:
+                bad = bad | jnp.any(~jnp.isfinite(gv))
         new_ps, new_sts = [], []
         for pv, gv, st, hy in zip(p_vals, g_vals, states, hypers):
             if gv.dtype != pv.dtype:
                 gv = gv.astype(pv.dtype)
-            np_, nst = rule(ctx, pv, gv, lr, st, **hy)
+            if kind is not None and _pfu.supported(kind, pv, gv, st):
+                # sentinel gating happens IN-KERNEL (bad rides in SMEM) —
+                # these outputs must not be re-gated below
+                np_, nst = _pfu.param_update(
+                    kind, pv, gv, lr, st, hy,
+                    wd=ctx._weight_decay, bad=bad,
+                )
+            else:
+                np_, nst = rule(ctx, pv, gv, lr, st, **hy)
+                if bad is not None:
+                    np_ = jnp.where(bad, pv, np_)
+                    nst = jax.tree_util.tree_map(
+                        lambda o, n: jnp.where(bad, o, n), st, nst
+                    )
             new_ps.append(np_)
             new_sts.append(nst)
         if not sentinel:
             return new_ps, new_sts
-        bad = jnp.asarray(False)
-        for gv in g_vals:
-            bad = bad | jnp.any(~jnp.isfinite(gv))
-        new_ps = [
-            jnp.where(bad, pv, nv) for pv, nv in zip(p_vals, new_ps)
-        ]
-        new_sts = [
-            jax.tree_util.tree_map(lambda o, n: jnp.where(bad, o, n), st, nst)
-            for st, nst in zip(states, new_sts)
-        ]
         return new_ps, new_sts, bad
 
     return apply_update
@@ -208,11 +230,18 @@ class Optimizer:
         per_hypers = tuple(
             tuple(sorted(self._per_param_hyper(p).items())) for p in params
         )
+        # the Pallas fused-update enablement changes the traced program —
+        # it must key the cache so flipping the flag retraces
+        pallas = (
+            bool(_flags.flag("pallas_fused_update")),
+            bool(_flags.flag("pallas_update_interpret")),
+        )
         sig = (
             tuple(sorted(self._hyper().items())),
             per_hypers,
             self._weight_decay,
             sentinel,
+            pallas,
             tuple(
                 (id(p), p._value.shape, p._value.dtype, g.dtype)
                 for p, g in zip(params, g_vals)
@@ -228,6 +257,7 @@ class Optimizer:
                 per_hypers,
                 self._weight_decay,
                 sentinel,
+                pallas,
                 tuple(
                     (p._value.shape, str(p._value.dtype), str(g.dtype))
                     for p, g in zip(params, g_vals)

@@ -322,6 +322,10 @@ def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
     for _ in range(max(0, warmup)):
         step_fn(*args, **kwargs)
     lazy.flush_if_pending("measure_programs")
+    # join any in-flight background compiles (FLAGS_eager_async_compile):
+    # the measured step must replay finished programs, not race the
+    # background thread into another bridged/pending resolution
+    lazy.drain_async()
     reset_dispatch_counters()
     out = step_fn(*args, **kwargs)
     lazy.flush_if_pending("measure_programs")

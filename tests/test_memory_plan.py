@@ -42,8 +42,12 @@ def live_bytes():
 
 @pytest.fixture
 def lazy_capture_mode():
+    # async compile pinned off: these tests inspect the captured program
+    # right after a fixed number of steps, and must not race the background
+    # build thread (tests/test_step_capture.py covers the async pipeline)
     paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
-                      "FLAGS_eager_step_capture": True})
+                      "FLAGS_eager_step_capture": True,
+                      "FLAGS_eager_async_compile": False})
     try:
         yield
     finally:
@@ -51,6 +55,7 @@ def lazy_capture_mode():
         paddle.set_flags({"FLAGS_eager_lazy_dispatch": False,
                           "FLAGS_eager_step_capture": True,
                           "FLAGS_eager_capture_donate": True,
+                          "FLAGS_eager_async_compile": True,
                           "FLAGS_check_programs": 0})
 
 

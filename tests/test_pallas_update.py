@@ -1,0 +1,202 @@
+"""Pallas fused optimizer-update kernel (FLAGS_pallas_fused_update).
+
+Interpret-mode (CPU) parity of ops/pallas/fused_update.py against the lax
+rule composition it replaces: same formulas, one VMEM pass per buffer, the
+numeric-rescue sentinel gated in-kernel, and the 1-program-per-step budget
+preserved under whole-step capture. On hardware the kernel path is gated to
+TPU backends; these tests force the interpreter via
+FLAGS_pallas_update_interpret so the kernel itself runs everywhere.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+from paddle_tpu.core import lazy
+from paddle_tpu.ops.pallas import fused_update as pfu
+
+
+@pytest.fixture
+def pallas_mode():
+    prof.reset_dispatch_counters()
+    try:
+        yield
+    finally:
+        lazy.flush_if_pending("test_teardown")
+        paddle.set_flags({
+            "FLAGS_pallas_fused_update": False,
+            "FLAGS_pallas_update_interpret": False,
+            "FLAGS_eager_lazy_dispatch": False,
+            "FLAGS_numeric_rescue": "",
+        })
+
+
+def _trainer(opt_factory, nan_at=None, n=5):
+    paddle.seed(0)
+    # first Linear's weight is 8*128=1024 elements (kernel-tiled); the
+    # second layer's (128, 3) weight and the biases take the lax fallback,
+    # proving mixed eligibility composes inside one update program
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 128, bias_attr=False),
+        paddle.nn.ReLU(),
+        paddle.nn.Linear(128, 3),
+    )
+    opt = opt_factory(model.parameters())
+    loss_fn = paddle.nn.MSELoss()
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+    losses = []
+    for i in range(n):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        if nan_at is not None and i == nan_at:
+            p0 = list(model.parameters())[0]
+            p0.grad = paddle.to_tensor(
+                np.full(p0.shape, np.nan, np.float32))
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    params = [np.asarray(p.numpy()) for p in model.parameters()]
+    states = [
+        {k: np.asarray(v) for k, v in
+         (opt._accumulators.get(id(p)) or {}).items()}
+        for p in model.parameters()
+    ]
+    return losses, params, states
+
+
+_FACTORIES = {
+    "sgd": lambda ps: paddle.optimizer.SGD(
+        learning_rate=1e-2, parameters=ps, weight_decay=0.01),
+    "momentum": lambda ps: paddle.optimizer.Momentum(
+        learning_rate=1e-2, momentum=0.9, use_nesterov=True, parameters=ps),
+    "adam": lambda ps: paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=ps),
+}
+
+
+def _set_pallas(on):
+    paddle.set_flags({"FLAGS_pallas_fused_update": on,
+                      "FLAGS_pallas_update_interpret": on})
+
+
+@pytest.mark.parametrize("kind", sorted(_FACTORIES))
+def test_kernel_matches_lax_rule(pallas_mode, kind):
+    _set_pallas(False)
+    l_ref, p_ref, s_ref = _trainer(_FACTORIES[kind])
+    _set_pallas(True)
+    l_ker, p_ker, s_ker = _trainer(_FACTORIES[kind])
+    assert l_ker == l_ref
+    for a, b in zip(p_ker, p_ref):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_ker, s_ref):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+@pytest.mark.parametrize("kind", ["sgd", "adam"])
+def test_kernel_sentinel_gates_in_kernel(pallas_mode, kind):
+    """numeric_rescue=skip with a NaN-poisoned grad: the in-kernel gate
+    must leave params and state untouched for that step, matching the lax
+    path's where-gated outputs exactly."""
+    paddle.set_flags({"FLAGS_numeric_rescue": "skip"})
+    _set_pallas(False)
+    l_ref, p_ref, s_ref = _trainer(_FACTORIES[kind], nan_at=2)
+    _set_pallas(True)
+    l_ker, p_ker, s_ker = _trainer(_FACTORIES[kind], nan_at=2)
+    assert l_ker == l_ref
+    for a, b in zip(p_ker, p_ref):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_ker, s_ref):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+    assert all(np.isfinite(p).all() for p in p_ker)
+
+
+def test_kernel_under_capture_stays_one_program(pallas_mode):
+    """The pallas_call is an op INSIDE the one donated captured program —
+    programs-per-step stays 1 with the kernel on."""
+    _set_pallas(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True,
+                      "FLAGS_eager_async_compile": False})
+    lazy._tls.observer = None
+    lazy._capture_cache.clear()
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 128, bias_attr=False), paddle.nn.ReLU(),
+        paddle.nn.Linear(128, 3),
+    )
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.MSELoss()
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+
+    def step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    c = prof.measure_programs(step, warmup=3)
+    assert c["programs"] == 1, c
+    assert c["captured_programs"] == 1, c
+    assert c["capture_fallbacks"] == 0, c
+
+
+def test_flag_flip_retraces_instead_of_replaying_stale(pallas_mode):
+    """Flipping FLAGS_pallas_fused_update between steps must miss both
+    compile caches (the enablement is part of the keys), not replay a
+    program traced under the other setting — results stay identical."""
+    _set_pallas(False)
+    paddle.seed(0)
+    model = paddle.nn.Linear(8, 128, bias_attr=False)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.MSELoss()
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 128)).astype(np.float32))
+
+    def step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    a = step()
+    _set_pallas(True)
+    b = step()
+    _set_pallas(False)
+    c = step()
+    assert np.isfinite([a, b, c]).all()
+
+
+def test_eligibility_rules():
+    from paddle_tpu.optimizer.optimizer import SGD, Adam, AdamW, Momentum
+
+    import jax.numpy as jnp
+
+    assert pfu.rule_kind(SGD) == "sgd"
+    assert pfu.rule_kind(Momentum) == "momentum"
+    assert pfu.rule_kind(Adam) == "adam"
+    assert pfu.rule_kind(AdamW) is None  # decoupled decay: lax path
+
+    class CustomSGD(SGD):
+        def _update(self, p, g, lr, state):
+            return p, state
+
+    assert pfu.rule_kind(CustomSGD) is None
+
+    p = jnp.zeros((8, 128), jnp.float32)
+    assert pfu.supported("sgd", p, p, {})
+    assert not pfu.supported("sgd", p[:, :100], p[:, :100], {})  # tile size
+    assert not pfu.supported(
+        "sgd", p.astype(jnp.bfloat16), p.astype(jnp.bfloat16), {})
+    assert not pfu.supported(None, p, p, {})

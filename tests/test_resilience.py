@@ -41,11 +41,16 @@ def _clean_resilience():
         "FLAGS_fault_inject": "",
         "FLAGS_retry_backoff_ms": 0.0,  # keep the suite fast
         "FLAGS_numeric_rescue": "",
+        # synchronous compiles: these tests assert exact per-step capture /
+        # program counts; the async pipeline has its own regression below
+        # (test_async_compile_keeps_faults_and_ladder_working)
+        "FLAGS_eager_async_compile": False,
     })
     try:
         yield
     finally:
         lazy.flush_if_pending("test_teardown")
+        lazy.drain_async()
         paddle.set_flags({
             "FLAGS_fault_inject": "",
             "FLAGS_retry_max": 2,
@@ -57,6 +62,7 @@ def _clean_resilience():
             "FLAGS_check_nan_inf": False,
             "FLAGS_eager_lazy_dispatch": False,
             "FLAGS_eager_step_capture": True,
+            "FLAGS_eager_async_compile": True,
         })
         res.reset()
 
@@ -617,3 +623,44 @@ def test_chaos_probe_cli():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "ALL SCENARIOS PASSED" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# PR 6: the async host pipeline must not bypass resilience — fault injection
+# and ladder demotion act on the MAIN thread even while fresh programs
+# compile on the background thread
+# ---------------------------------------------------------------------------
+def test_async_compile_keeps_faults_and_ladder_working():
+    _set_tier("lazy")
+    paddle.set_flags({
+        "FLAGS_eager_async_compile": True,
+        "FLAGS_retry_max": 1,
+        "FLAGS_ladder_demote_after": 2,
+        "FLAGS_ladder_cooldown_steps": 3,
+    })
+    lazy._segment_cache.clear()
+    lazy._pending_seg_compiles.clear()
+    # clean async run: bitwise-identical to the synchronous path
+    clean, _ = _run(4)
+    lazy.drain_async()
+    paddle.set_flags({"FLAGS_eager_async_compile": False})
+    lazy._segment_cache.clear()
+    sync_run, _ = _run(4)
+    assert clean == sync_run
+    # injected segment faults with retries exhausted: every bridged/joined
+    # flush still routes through the resilience executor on the main thread
+    # — the per-op fallback completes each step with identical numerics and
+    # the ladder demotes the lazy tier after demote_after disruptive faults
+    paddle.set_flags({"FLAGS_eager_async_compile": True})
+    lazy._segment_cache.clear()
+    lazy._pending_seg_compiles.clear()
+    prof.reset_dispatch_counters()
+    paddle.set_flags({"FLAGS_fault_inject": "execute:segment:p=1:x=9"})
+    faulted, _ = _run(4)
+    lazy.drain_async()
+    c = prof.dispatch_counters()
+    assert faulted == clean
+    assert c["segment_per_op_fallbacks"] >= 1, c
+    assert c["retry_exhausted"] >= 1, c
+    assert c["ladder_demotions"] >= 1, c
+    paddle.set_flags({"FLAGS_fault_inject": ""})

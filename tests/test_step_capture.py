@@ -22,21 +22,27 @@ from paddle_tpu.core import lazy
 @pytest.fixture
 def capture_mode():
     # fresh controller state per test: a stale armed signature from another
-    # test's model must not leak into this one's counters
+    # test's model must not leak into this one's counters. Async compile is
+    # pinned OFF so these tests exercise the synchronous capture semantics
+    # (exact per-step program counts); the async pipeline has its own test
+    # section below.
     lazy._tls.observer = None
     lazy._capture_cache.clear()
     prof.reset_dispatch_counters()
     paddle.set_flags({
         "FLAGS_eager_lazy_dispatch": True,
         "FLAGS_eager_step_capture": True,
+        "FLAGS_eager_async_compile": False,
     })
     try:
         yield
     finally:
         lazy.flush_if_pending("test_teardown")
+        lazy.drain_async()
         paddle.set_flags({
             "FLAGS_eager_lazy_dispatch": False,
             "FLAGS_eager_step_capture": True,
+            "FLAGS_eager_async_compile": True,
         })
         lazy._tls.observer = None
 
@@ -618,3 +624,362 @@ def test_dispatch_counters_expose_capture_keys():
               "capture_fallbacks", "capture_evictions",
               "capture_fallback_reasons"):
         assert k in c, c
+
+
+# ---------------------------------------------------------------------------
+# PR 6 capture coverage: grad clipping folds into the captured step
+# ---------------------------------------------------------------------------
+_CLIP_MAKERS = {
+    "global_norm": lambda: paddle.nn.ClipGradByGlobalNorm(0.5),
+    "norm": lambda: paddle.nn.ClipGradByNorm(0.5),
+    "value": lambda: paddle.nn.ClipGradByValue(0.01),
+}
+
+
+def _clip_trainer(clip_maker, accum=1, seed=0, lr=1e-2, bsz=4):
+    paddle.seed(seed)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+    )
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters(),
+                                grad_clip=clip_maker() if clip_maker else None)
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((bsz, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (bsz,)))
+
+    def cycle():
+        for _ in range(accum):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return model, opt, cycle
+
+
+def _run_cycles(lazy_on, clip_maker, accum, n):
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                      "FLAGS_eager_step_capture": lazy_on})
+    try:
+        model, opt, cycle = _clip_trainer(clip_maker, accum)
+        losses = [float(cycle()) for _ in range(n + 1)]
+        return losses, _snapshot(model, opt)
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+
+
+@pytest.mark.parametrize("clip_kind", sorted(_CLIP_MAKERS))
+def test_grad_clip_steps_capture_bitwise(capture_mode, clip_kind):
+    """Each built-in clip type reaches the captured tier (1 program per
+    steady-state step) with bitwise-identical losses/params/state vs the
+    per-op path, and ZERO entries in the fallback histogram."""
+    maker = _CLIP_MAKERS[clip_kind]
+    l_ref, (p_ref, s_ref) = _run_cycles(False, maker, 1, 5)
+    prof.reset_dispatch_counters()
+    l_cap, (p_cap, s_cap) = _run_cycles(True, maker, 1, 5)
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 3, c
+    assert c["capture_fallbacks"] == 0, c
+    assert c["capture_fallback_reasons"] == {}, c
+    assert l_cap == l_ref
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_cap, s_ref):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_grad_clip_captured_step_is_one_program(capture_mode):
+    _model, _opt, cycle = _clip_trainer(_CLIP_MAKERS["global_norm"])
+    c = prof.measure_programs(cycle, warmup=3)
+    assert c["programs"] == 1, c
+    assert c["captured_programs"] == 1, c
+    assert c["capture_fallbacks"] == 0, c
+
+
+def test_grad_clip_unclipped_grads_written_back(capture_mode):
+    """After a captured clipped step, p.grad must hold the UNCLIPPED
+    gradient (the eager clip never writes clipped values back)."""
+
+    def run(lazy_on):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        model, opt, cycle = _clip_trainer(_CLIP_MAKERS["value"])
+        for _ in range(4):
+            cycle()
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        # one more step, grads read after step() and before clear_grad()
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        y = paddle.to_tensor(np.zeros((4,), np.int64))
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        grads = [np.asarray(p.grad.numpy()) for p in model.parameters()]
+        opt.clear_grad()
+        return grads
+
+    g_ref = run(False)
+    prof.reset_dispatch_counters()
+    g_cap = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    for a, b in zip(g_cap, g_ref):
+        np.testing.assert_array_equal(a, b)
+    # the clip clamps to +-0.01: prove write-back is NOT the clipped value
+    assert any(np.abs(a).max() > 0.01 for a in g_cap)
+
+
+def test_custom_clip_subclass_stays_on_eager_path(capture_mode):
+    """A clip subclass overriding _clip has unknown semantics: the step must
+    never arm for capture, and its custom behavior must keep applying."""
+
+    class Halver(paddle.nn.ClipGradByGlobalNorm):
+        def _clip(self, params_grads):
+            return [(p, None if g is None else g * 0.5) for p, g in params_grads]
+
+    def run(lazy_on, n=5):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        model, opt, cycle = _clip_trainer(lambda: Halver(0.5))
+        return [float(cycle()) for _ in range(n)], _snapshot(model, opt)
+
+    l_ref, (p_ref, _) = run(False)
+    prof.reset_dispatch_counters()
+    l_cap, (p_cap, _) = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] == 0, c
+    assert l_cap == l_ref
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# PR 6 capture coverage: k-step gradient accumulation is a periodic signature
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("k", [2, 4])
+def test_accumulation_cycle_captures_bitwise(capture_mode, k):
+    """k-step accumulation reaches the captured tier: k-1 accumulate-only
+    microsteps replay as ONE captured program each, the k-th defers into the
+    donated update program — bitwise equal to the per-op path, zero
+    steady-state fallbacks."""
+    l_ref, (p_ref, s_ref) = _run_cycles(False, None, k, 4)
+    prof.reset_dispatch_counters()
+    l_cap, (p_cap, s_cap) = _run_cycles(True, None, k, 4)
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 2, c
+    assert c["capture_accum_replays"] >= 2 * (k - 1), c
+    assert c["capture_fallbacks"] == 0, c
+    assert c["capture_fallback_reasons"] == {}, c
+    assert l_cap == l_ref
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_cap, s_ref):
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+
+
+def test_accumulation_with_clip_captures_bitwise(capture_mode):
+    """Accumulation + global-norm clip compose: the clip applies once to
+    the accumulated totals inside the captured update program."""
+    maker = _CLIP_MAKERS["global_norm"]
+    l_ref, (p_ref, _) = _run_cycles(False, maker, 2, 4)
+    prof.reset_dispatch_counters()
+    l_cap, (p_cap, _) = _run_cycles(True, maker, 2, 4)
+    c = prof.dispatch_counters()
+    assert c["capture_replays"] >= 2, c
+    assert c["capture_accum_replays"] >= 2, c
+    assert c["capture_fallbacks"] == 0, c
+    assert l_cap == l_ref
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_accumulation_update_step_is_one_program(capture_mode):
+    """Per-cycle budget at k=4: 3 accumulate programs + 1 update program."""
+    _model, _opt, cycle = _clip_trainer(None, accum=4)
+    c = prof.measure_programs(cycle, warmup=4)
+    assert c["programs"] == 4, c
+    assert c["captured_programs"] == 4, c
+    assert c["capture_replays"] == 1, c
+    assert c["capture_accum_replays"] == 3, c
+    assert c["capture_fallbacks"] == 0, c
+    assert c["_capture_state"]["cycle_len"] == 4, c["_capture_state"]
+
+
+def test_accumulation_grad_read_mid_cycle_aborts_correctly(capture_mode):
+    """Reading p.grad between the FINAL backward and optimizer.step() of an
+    armed accumulation cycle aborts the deferred update: the partial sums
+    must be restored, the real sweep accumulates into them, and the read
+    (and the step) match the per-op path bitwise."""
+
+    def run(lazy_on, k=2):
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": lazy_on,
+                          "FLAGS_eager_step_capture": lazy_on})
+        model, opt, cycle = _clip_trainer(None, accum=k)
+        for _ in range(4):
+            cycle()
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        y = paddle.to_tensor(np.zeros((4,), np.int64))
+        for _ in range(k):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+        # grad read between final backward and step -> abort on lazy path
+        g = np.asarray(list(model.parameters())[0].grad.numpy())
+        opt.step()
+        opt.clear_grad()
+        return g, [np.asarray(p.numpy()) for p in model.parameters()]
+
+    g_ref, p_ref = run(False)
+    prof.reset_dispatch_counters()
+    g_cap, p_cap = run(True)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    c = prof.dispatch_counters()
+    assert c["capture_fallbacks"] >= 1, c
+    np.testing.assert_array_equal(g_cap, g_ref)
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# PR 6 async host pipeline (FLAGS_eager_async_compile)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def async_mode():
+    """Like capture_mode, but with the background compile pipeline ON."""
+    lazy._tls.observer = None
+    lazy._capture_cache.clear()
+    lazy._segment_cache.clear()
+    lazy._pending_seg_compiles.clear()
+    prof.reset_dispatch_counters()
+    paddle.set_flags({
+        "FLAGS_eager_lazy_dispatch": True,
+        "FLAGS_eager_step_capture": True,
+        "FLAGS_eager_async_compile": True,
+    })
+    try:
+        yield
+    finally:
+        lazy.flush_if_pending("test_teardown")
+        lazy.drain_async()
+        lazy._pending_seg_compiles.clear()
+        paddle.set_flags({
+            "FLAGS_eager_lazy_dispatch": False,
+            "FLAGS_eager_step_capture": True,
+            "FLAGS_eager_async_compile": True,
+        })
+        lazy._tls.observer = None
+
+
+def test_async_segment_bridge_then_join(async_mode):
+    """First flush of a fresh signature executes its plan eagerly (bridge)
+    while the fused program compiles off-thread; the next flush of the same
+    signature joins and installs it — numerics identical throughout."""
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    y = (x * 2.0 + 1.0).sum()
+    v1 = float(y)  # bridged flush
+    c = prof.dispatch_counters()
+    assert c["async_bridge_flushes"] >= 1, c
+    assert c["async_compiles"] >= 1, c
+    lazy.drain_async()
+    y2 = (x * 2.0 + 1.0).sum()
+    v2 = float(y2)  # joins the finished compile
+    c = prof.dispatch_counters()
+    assert c["async_compile_joins"] >= 1, c
+    assert v1 == v2 == float(np.sum(np.arange(8, dtype=np.float32) * 2 + 1))
+    # third flush replays the installed executable (ordinary cache hit)
+    v3 = float((x * 2.0 + 1.0).sum())
+    assert v3 == v1
+
+
+def test_async_compile_error_surfaces_at_join(async_mode, monkeypatch):
+    """A compile-thread exception must re-raise at the JOIN point with its
+    original type (the bridged first flush executed eagerly and succeeded),
+    and the flush after that must recover with a clean fresh compile."""
+    x = paddle.to_tensor(np.ones(16, np.float32))
+    real_build = lazy._build_segment_fn
+    calls = []
+
+    def broken_build(plan, check=False):
+        calls.append(1)
+        if len(calls) == 1:
+            class Boom:
+                # poisons the compile job whichever way it compiles the
+                # jitted segment (AOT lower().compile() or a warm-up call)
+                def lower(self, *a, **k):
+                    raise TypeError("injected compile-thread failure")
+
+                def __call__(self, ext):
+                    raise TypeError("injected compile-thread failure")
+
+            return Boom()
+        return real_build(plan, check)
+
+    monkeypatch.setattr(lazy, "_build_segment_fn", broken_build)
+    # first flush: the bridge executes the raw op plan eagerly (succeeds)
+    # while the POISONED jfn compiles/fails on the background thread
+    v1 = float((x * 3.0).sum())
+    assert v1 == 48.0
+    lazy.drain_async()
+    # second flush of the same signature joins the failed future: the
+    # compile-thread exception re-raises here with its original type
+    with pytest.raises(TypeError, match="injected compile-thread failure"):
+        float((x * 3.0).sum())
+    # the poisoned future was dropped at the join: the next flush compiles
+    # fresh (real build now) and the signature fully recovers
+    v3 = float((x * 3.0).sum())
+    assert v3 == 48.0
+
+
+def test_async_capture_reaches_one_program_and_matches(async_mode):
+    """With async compile on, the armed step resolves pending builds on the
+    3-program path (counted, NOT a fallback), joins the finished AOT
+    executable, and steady state is 1 donated program — bitwise equal to
+    the per-op path."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False,
+                      "FLAGS_eager_step_capture": False})
+    model_r, opt_r, cycle_r = _clip_trainer(_CLIP_MAKERS["global_norm"])
+    l_ref = [float(cycle_r()) for _ in range(7)]
+    p_ref = [np.asarray(p.numpy()) for p in model_r.parameters()]
+
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True})
+    prof.reset_dispatch_counters()
+    model, opt, cycle = _clip_trainer(_CLIP_MAKERS["global_norm"])
+    losses = []
+    for i in range(6):
+        losses.append(float(cycle()))
+        paddle.device.synchronize()  # join background builds between steps
+    c = prof.dispatch_counters()
+    assert c["capture_async_builds"] >= 1, c
+    assert c["capture_build_pending_steps"] >= 1, c
+    assert c["capture_replays"] >= 1, c
+    assert c["capture_fallbacks"] == 0, c  # pending steps are NOT fallbacks
+    # steady state: exactly one donated program per step
+    prof.reset_dispatch_counters()
+    losses.append(float(cycle()))
+    c = prof.dispatch_counters()
+    assert c["programs"] == 1 and c["captured_programs"] == 1, c
+    p_cap = [np.asarray(p.numpy()) for p in model.parameters()]
+    assert losses == l_ref
+    for a, b in zip(p_cap, p_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_host_time_moves_off_critical_path(async_mode):
+    """The timers must show background compile work (async_compile_ms) and
+    cached replays, and the bridged first flush must not block on a fused
+    compile (its blocking compile_time_ms stays near zero)."""
+    x = paddle.to_tensor(np.ones((32, 32), np.float32))
+    float(paddle.matmul(x, x).mean())  # bridged
+    c = prof.dispatch_counters()
+    assert c["async_bridge_flushes"] >= 1
+    lazy.drain_async()
+    c = prof.dispatch_counters()
+    assert c["async_compile_ms"] > 0.0, c
+    float(paddle.matmul(x, x).mean())  # join + replay
+    c = prof.dispatch_counters()
+    assert c["replay_time_ms"] > 0.0, c
