@@ -473,6 +473,69 @@ def bench_mnist_eager(steps=30, bsz=64):
     return rec
 
 
+def bench_serving(n_requests=12, max_new=24):
+    """The serving row (ROADMAP open item 2): the paddle.serving
+    continuous-batching engine over a small GPT — p50/p99 per-token latency,
+    requests/s/chip, tokens/s/chip, programs-per-decode-step (must be 1.0:
+    each decode step is one captured donated replay), and KV block-pool
+    occupancy. BENCH_SERVING_MODEL=345m scales the model up."""
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as prof
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining, gpt2_345m
+
+    paddle.seed(0)
+    which = os.environ.get("BENCH_SERVING_MODEL", "tiny")
+    if which == "345m":
+        cfg = gpt2_345m(max_seq_len=2048)
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=512)
+    cfg.dropout = 0.0
+    cfg.attn_dropout = 0.0
+    model = GPTForPretraining(cfg)
+    model.eval()
+    engine = serving.Engine(model, serving.ServingConfig(
+        block_size=16, prompt_buckets=[32, 64, 128]))
+    rng = np.random.default_rng(0)
+    lens = [32, 64, 48, 128, 64, 32]
+    prompts = [rng.integers(1, cfg.vocab_size, lens[i % len(lens)])
+               for i in range(n_requests)]
+    # warm with the SAME mix: every (prompt bucket, batch bucket, context
+    # bucket) signature the measured window will hit compiles here, so the
+    # window is pure steady-state replay (capture_builds_steady must be 0)
+    engine.serve(prompts, max_new_tokens=max_new)
+    prof.reset_dispatch_counters()
+    engine.reset_stats()  # percentiles must not include warm-window compiles
+    t0 = time.time()
+    resps = engine.serve(prompts, max_new_tokens=max_new)
+    dt = time.time() - t0
+    c = prof.dispatch_counters()
+    st = engine.stats()
+    completed = sum(1 for r in resps if r.ok)
+    tokens = sum(len(r.tokens) for r in resps if r.ok)
+    programs_per_decode = (
+        (c["serve_capture_replays"] - c["serve_prefills"])
+        / max(1, c["serve_decode_steps"]))
+    rec = {
+        "metric": "serving_requests_per_sec_per_chip",
+        "value": round(completed / dt, 2), "unit": "requests/s/chip",
+        "tokens_per_sec_per_chip": round(tokens / dt, 1),
+        "token_lat_p50_ms": st["token_lat_p50_ms"],
+        "token_lat_p99_ms": st["token_lat_p99_ms"],
+        "programs_per_decode_step": round(programs_per_decode, 3),
+        "decode_steps": c["serve_decode_steps"],
+        "capture_builds_steady": c["serve_capture_builds"],
+        "kv_pool_blocks": st["pool_blocks"],
+        "kv_pool_peak_occupancy": st["pool_peak_occupancy"],
+        "requests": n_requests, "completed": completed,
+        "dropped": c["serve_requests_dropped"],
+    }
+    if "est_decode_peak_hbm_mb" in st:
+        rec["est_decode_peak_hbm_mb"] = st["est_decode_peak_hbm_mb"]
+    return rec
+
+
 def _resilience_block(steps=8, bsz=16):
     """Resilience micro-probe for the BENCH_* trajectory (ISSUE 5): retries/
     fallbacks under an injected fault plan, per-step recovery overhead, and
@@ -673,6 +736,7 @@ def main():
             ("resnet50", bench_resnet50),
             ("bert", bench_bert),
             ("gpt_longseq", bench_gpt_longseq),
+            ("serving", bench_serving),
             ("mnist", bench_mnist_eager),
             ("ernie_ctr", bench_ernie_ctr),
             ("ps_table", bench_ps_table),

@@ -110,6 +110,7 @@ for _pkg in (
     "signal",
     "onnx",
     "inference",
+    "serving",
     "device",
     "hub",
     "utils",

@@ -63,8 +63,10 @@ from . import (
 )
 
 __all__ = [
+    "BlockPoolPlan",
     "Buffer",
     "MemoryPlan",
+    "plan_block_pool",
     "plan_memory",
     "captured_step_plans",
     "device_hbm_bytes",
@@ -693,6 +695,78 @@ def traced_program_diags(trace_thunk, roles, donated,
         return run_passes(ctx, ["memory_budget", "donation_safety"])
     except Exception:
         return []
+
+
+@dataclasses.dataclass
+class BlockPoolPlan:
+    """Planner verdict sizing a paged KV block pool (paddle.serving).
+
+    ``num_blocks`` is None when no budget is configured anywhere (flag,
+    argument, or detected device HBM) — the caller applies its own default.
+    ``overhead_bytes`` is the decode program's estimated peak *excluding*
+    the pool itself: weights, activations, the gathered block views, block
+    tables. The pool gets whatever the budget leaves."""
+
+    num_blocks: Optional[int]
+    block_bytes: int
+    budget_bytes: Optional[int]
+    overhead_bytes: int
+    trace_peak_bytes: int
+
+    @property
+    def est_peak_hbm_mb(self) -> float:
+        """Estimated peak of the traced decode program (MB)."""
+        return self.trace_peak_bytes / _MB
+
+    def pool_bytes(self, num_blocks: Optional[int] = None) -> int:
+        n = self.num_blocks if num_blocks is None else num_blocks
+        return int(n or 0) * self.block_bytes
+
+
+def plan_block_pool(trace_thunk, *, block_bytes: int,
+                    pool_bytes_in_trace: int = 0,
+                    budget_mb: Optional[float] = None,
+                    roles: Sequence = (), donated: Sequence[int] = (),
+                    source: str = "serving-decode") -> BlockPoolPlan:
+    """Size a paged KV block pool against the memory budget — the serving
+    half of the ``memory_budget`` pass: trace the decode program once (no
+    compile) over a MINIMAL pool, estimate its peak with the liveness
+    planner, subtract the minimal pool's own bytes to get the non-pool
+    overhead, and floor-divide the remaining budget by the per-block cost.
+    The engine then refuses admission past the resulting pool instead of
+    letting XLA OOM mid-decode.
+
+    Budget precedence: explicit ``budget_mb`` > FLAGS_memory_budget_mb > the
+    detected device HBM; with none of the three, ``num_blocks`` is None.
+    Tracing failures fall back to an overhead of 0 (budget // block_bytes)
+    rather than breaking engine construction."""
+    if budget_mb is None:
+        flagged = float(_flags.flag("memory_budget_mb"))
+        budget_mb = flagged if flagged > 0 else None
+    budget_bytes = int(budget_mb * _MB) if budget_mb is not None else None
+    if budget_bytes is None:
+        hbm = device_hbm_bytes()
+        budget_bytes = int(hbm) if hbm else None
+
+    peak = 0
+    try:
+        closed = trace_thunk()
+        ctx = Context(closed, list(roles), source, donated=tuple(donated))
+        peak = plan_memory(ctx, donated=tuple(donated)).peak_bytes
+    except Exception:
+        peak = int(pool_bytes_in_trace)
+    overhead = max(0, int(peak) - int(pool_bytes_in_trace))
+
+    num_blocks: Optional[int] = None
+    if budget_bytes is not None:
+        num_blocks = max(0, (budget_bytes - overhead) // int(block_bytes))
+    return BlockPoolPlan(
+        num_blocks=num_blocks,
+        block_bytes=int(block_bytes),
+        budget_bytes=budget_bytes,
+        overhead_bytes=overhead,
+        trace_peak_bytes=int(peak),
+    )
 
 
 def captured_step_plans():

@@ -138,6 +138,22 @@ def reset_dispatch_counters():
         segment_per_op_fallbacks=0,
         preemptions=0,
         emergency_saves=0,
+        # serving runtime (paddle.serving): decode-mode capture builds /
+        # replays / tier fallbacks / LRU evictions, engine step + admission
+        # accounting (serve_requests_dropped must stay 0 — the chaos serve
+        # gate fails on anything else)
+        serve_capture_builds=0,
+        serve_capture_replays=0,
+        serve_capture_fallbacks=0,
+        serve_capture_evictions=0,
+        serve_prefills=0,
+        serve_decode_steps=0,
+        serve_admission_refusals=0,
+        serve_requests_completed=0,
+        serve_requests_rejected=0,
+        serve_requests_dropped=0,
+        serve_request_requeues=0,
+        serve_preempt_drains=0,
         flush_reasons={},
         capture_fallback_reasons={},
         fault_sites={},

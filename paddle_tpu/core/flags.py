@@ -287,6 +287,72 @@ define_flag(
     "lr multiplier applied by the 'lr_backoff' numeric-rescue policy on "
     "each rescued step",
 )
+# ---------------------------------------------------------------------------
+# Serving runtime (paddle.serving — see SERVING.md)
+# ---------------------------------------------------------------------------
+define_flag(
+    "serving_block_size", 16,
+    "tokens per KV-cache block in the paddle.serving paged cache: every "
+    "sequence's context is stored as a chain of fixed-size blocks drawn "
+    "from one shared pool, so HBM is bounded by the pool — not by "
+    "max_seq_len times the number of admitted sequences",
+)
+define_flag(
+    "serving_num_blocks", 0,
+    "KV block-pool size of the paddle.serving engine (shared logical "
+    "blocks, each spanning all layers). 0 = derive from the memory budget: "
+    "the PR-4 planner traces the decode program, subtracts its non-pool "
+    "peak from FLAGS_memory_budget_mb (or detected device HBM), and "
+    "floor-divides by the per-block bytes; when no budget is configured "
+    "either, a 256-block default applies",
+)
+define_flag(
+    "serving_prompt_buckets", "32,64,128",
+    "ascending prompt-length pad boundaries for the serving prefill "
+    "programs (io/bucketing.py BucketSpec policy): each admitted prompt is "
+    "padded up to its bucket so the number of compiled prefill programs is "
+    "bounded; lengths beyond the table round up to multiples of the "
+    "largest boundary. Every boundary must divide evenly into "
+    "FLAGS_serving_block_size blocks",
+)
+define_flag(
+    "serving_decode_batch_buckets", "1,2,4,8",
+    "ascending decode batch-size buckets for continuous batching: each "
+    "decode step pads its active-sequence batch up to a bucket (idle rows "
+    "attend a per-slot scratch block), so one captured decode program per "
+    "(batch bucket, context bucket) signature serves steady state",
+)
+define_flag(
+    "serving_capture", True,
+    "capture each serving prefill/decode signature as ONE XLA program "
+    "(decode-mode capture, core/lazy.py) and replay it from an LRU cache; "
+    "off = every serve step runs per-op eager",
+)
+define_flag(
+    "serving_capture_donate", True,
+    "donate the paged KV block-pool buffers to the captured decode "
+    "program so each step updates the pool in place (no second pool in "
+    "HBM); 0 keeps 1-program capture without donation for code that holds "
+    "pool aliases across steps",
+)
+define_flag(
+    "serving_capture_cache_size", 16,
+    "LRU cap on captured serving programs (prefill + decode signatures; "
+    "0 = unbounded); evictions are counted in "
+    "paddle.profiler.dispatch_counters()['serve_capture_evictions']",
+)
+define_flag(
+    "serving_max_new_tokens", 128,
+    "default generation cap per serving request when the request does not "
+    "set max_new_tokens",
+)
+define_flag(
+    "serving_request_retries", 2,
+    "times the serving engine re-enqueues a request whose sequence was "
+    "torn down by a non-recoverable (non-injected) fault mid-decode "
+    "before answering it with an error response; greedy decode is "
+    "deterministic, so a re-run reproduces the same tokens",
+)
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
 define_flag(
     "use_flash_attention",

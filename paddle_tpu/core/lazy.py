@@ -50,6 +50,9 @@ __all__ = [
     "materialize",
     "pending_op_count",
     "pending_segment_jaxpr",
+    "reset_serve_programs",
+    "serve_capture_state",
+    "serve_program",
     "step_capture_state",
 ]
 
@@ -1873,6 +1876,135 @@ def step_capture_step(optimizer) -> bool:
         # 3-program path instead of crashing optimizer.step() (and the
         # deferred placeholder grads must not outlive the failure)
         return fallback("capture_error")
+
+
+# ---------------------------------------------------------------------------
+# Decode-mode capture (paddle.serving)
+#
+# The whole-step controller above captures TRAINING steps by observing the
+# eager event stream. Inference has no backward/optimizer to observe — a
+# serving engine knows its step boundaries exactly — so decode-mode capture
+# is the direct half of the same contract (the CUDA-Graphs capture/replay
+# idiom from PAPERS.md): a pure step function, keyed by its bucket
+# signature, jitted ONCE with the paged KV block pool donated, replayed from
+# an LRU cache. Per-op dispatch inside the traced function already falls
+# back to the per-op path on tracer args (lazy_apply's tracer bail-out), so
+# the SAME paddle-ops function serves all three execution tiers:
+#
+#   captured  jit(fn, donate_argnums=pools)  — 1 donated program per step
+#   lazy      jit(fn)                        — 1 program, inputs retained
+#                                              (the retry-safe middle rung)
+#   per-op    fn(*args) eagerly              — the ladder floor
+#
+# Build/replay/fallback/eviction counts land in
+# paddle.profiler.dispatch_counters() under the serve_capture_* keys.
+# ---------------------------------------------------------------------------
+_serve_cache: "OrderedDict[Tuple, _ServeProgram]" = OrderedDict()
+
+
+class _ServeProgram:
+    """One captured serving program (a prefill or decode bucket signature)."""
+
+    __slots__ = ("key", "fn", "donate_argnums", "_exe_donate", "_exe_plain",
+                 "_built_donate", "_built_plain", "__weakref__")
+
+    def __init__(self, key, fn, donate_argnums):
+        self.key = key
+        self.fn = fn
+        self.donate_argnums = tuple(donate_argnums)
+        self._exe_donate = None
+        self._exe_plain = None
+        self._built_donate = False
+        self._built_plain = False
+
+    def built(self, donate: bool = True) -> bool:
+        return self._built_donate if donate else self._built_plain
+
+    def run(self, args, donate: bool = True):
+        """Replay the captured program (building it on first use).
+
+        ``donate=True`` consumes the buffers at ``donate_argnums`` in place
+        (the captured tier); ``donate=False`` is the retry-safe middle rung
+        — same single program, inputs retained."""
+        import warnings as _warnings
+
+        from . import dispatch
+
+        if donate and self.donate_argnums:
+            if self._exe_donate is None:
+                self._exe_donate = jax.jit(
+                    self.fn, donate_argnums=self.donate_argnums
+                )
+            exe, fresh = self._exe_donate, not self._built_donate
+        else:
+            if self._exe_plain is None:
+                self._exe_plain = jax.jit(self.fn)
+            exe, fresh = self._exe_plain, not self._built_plain
+        t0 = time.perf_counter()
+        if fresh:
+            # first call = trace + XLA compile; backends without real
+            # donation (CPU) warn at compile time — same suppression as the
+            # training capture's _aot_compile
+            with _warnings.catch_warnings():
+                _warnings.filterwarnings("ignore", message=".*onated buffer.*")
+                out = exe(*args)
+            if donate and self.donate_argnums:
+                self._built_donate = True
+            else:
+                self._built_plain = True
+            dispatch._counters["serve_capture_builds"] += 1
+            _add_time("compile_time_ms", t0)
+        else:
+            out = exe(*args)
+            dispatch._counters["serve_capture_replays"] += 1
+            _add_time("replay_time_ms", t0)
+        return out
+
+
+def serve_program(key: Tuple, fn: Callable, donate_argnums=()) -> _ServeProgram:
+    """The decode-mode capture cache: one ``_ServeProgram`` per bucket
+    signature, LRU-bounded by FLAGS_serving_capture_cache_size. A re-used
+    key returns the cached handle (its compiled executables intact), so a
+    steady-state decode loop replays without recompiling — verified by the
+    serve_capture_builds counter staying flat."""
+    from . import dispatch
+
+    prog = _serve_cache.get(key)
+    if prog is not None:
+        _serve_cache.move_to_end(key)
+        return prog
+    prog = _ServeProgram(key, fn, donate_argnums)
+    _serve_cache[key] = prog
+    cap = int(flags.flag("serving_capture_cache_size"))
+    while cap > 0 and len(_serve_cache) > cap:
+        _serve_cache.popitem(last=False)
+        dispatch._counters["serve_capture_evictions"] += 1
+    return prog
+
+
+def reset_serve_programs(owner=None):
+    """Drop captured serving programs: all of them (test isolation), or —
+    with ``owner`` set — only the ones whose key belongs to that engine uid
+    (Engine.close(): a dead engine's step-function closures hold the model
+    and would otherwise sit in the cache until LRU pressure evicts them)."""
+    if owner is None:
+        _serve_cache.clear()
+        return
+    for key in [k for k in _serve_cache
+                if len(k) > 1 and k[1] == owner]:
+        del _serve_cache[key]
+
+
+def serve_capture_state() -> Dict[str, Any]:
+    """Snapshot of the decode-mode capture cache (bench.py's serving record
+    and tests read this)."""
+    return {
+        "cached_programs": len(_serve_cache),
+        "built_programs": sum(
+            1 for p in _serve_cache.values()
+            if p._built_donate or p._built_plain
+        ),
+    }
 
 
 def step_capture_state() -> Dict[str, Any]:

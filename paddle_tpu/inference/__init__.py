@@ -26,6 +26,7 @@ import numpy as np
 
 __all__ = [
     "Config",
+    "GenerativePredictor",
     "Predictor",
     "Tensor",
     "create_predictor",
@@ -69,6 +70,8 @@ class Config:
         self._memory_optim = True
         self._ir_optim = True
         self._threads = 1
+        self._generative_model = None
+        self._serving_opts: Dict = {}
 
     # --- model location -------------------------------------------------
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
@@ -118,18 +121,48 @@ class Config:
     def set_cpu_math_library_num_threads(self, n: int):
         self._threads = n
 
+    # --- generative serving (paddle.serving) --------------------------------
+    def enable_generative_serving(self, model, **serving_opts):
+        """Route this predictor onto the paddle.serving continuous-batching
+        engine instead of the plain StableHLO executor: ``model`` is a live
+        generative LM (``models.gpt.GPTForPretraining``-shaped — KV-cache
+        decode through per-layer cache views). ``serving_opts`` forward to
+        ``serving.ServingConfig`` (block_size, prompt_buckets, ...).
+        ``enable_memory_optim`` then controls whether the paged KV block
+        pool is sized by the memory planner against FLAGS_memory_budget_mb
+        (on, the default) or left at the unbudgeted default size (off)."""
+        self._generative_model = model
+        self._serving_opts = dict(serving_opts)
+
+    def is_generative(self) -> bool:
+        return self._generative_model is not None
+
     # --- optimization toggles (XLA always optimizes; kept for parity) ------
     def switch_ir_optim(self, flag: bool = True):
         self._ir_optim = flag
 
     def enable_memory_optim(self, flag: bool = True):
+        """For generative serving predictors this is a REAL knob: on, the
+        paged KV block pool is budgeted by the static memory planner
+        (analysis.memory.plan_block_pool) and admission is refused past the
+        budget; off, the pool takes the unbudgeted default size. For plain
+        StableHLO predictors XLA already plans buffers — kept for parity."""
         self._memory_optim = flag
 
     def enable_tensorrt_engine(self, *a, **k):
-        warnings.warn("TensorRT is not applicable on TPU; the XLA program is already fused")
+        warnings.warn(
+            "enable_tensorrt_engine is a no-op on TPU and deprecated here: "
+            "the XLA program is already fused; for generative-model serving "
+            "use Config.enable_generative_serving (paddle.serving)",
+            DeprecationWarning, stacklevel=2,
+        )
 
     def enable_mkldnn(self, *a, **k):
-        pass
+        warnings.warn(
+            "enable_mkldnn is a no-op on TPU and deprecated here: XLA owns "
+            "kernel selection",
+            DeprecationWarning, stacklevel=2,
+        )
 
     def switch_use_feed_fetch_ops(self, flag: bool):
         pass
@@ -277,8 +310,132 @@ class Predictor:
         pass
 
 
-def create_predictor(config: Config) -> Predictor:
-    """reference: paddle_infer::CreatePredictor (inference/api/paddle_inference_api.h)."""
+class GenerativePredictor:
+    """Predictor-surface adapter over the paddle.serving engine — what
+    ``create_predictor`` returns for a Config with
+    ``enable_generative_serving`` set. Zero-copy handles stay: feed
+    ``input_ids`` ([b, s] int, one prompt per row) and optionally
+    ``prompt_lens`` ([b] int true lengths for right-padded rows); after
+    ``run()`` the ``tokens`` handle holds [b, max_new] generated ids,
+    -1-padded past each row's completion."""
+
+    def __init__(self, config: Config):
+        from .. import serving as _serving
+
+        self._config = config
+        opts = dict(config._serving_opts)
+        self._max_new = int(opts.pop("max_new_tokens", 0)) or None
+        self._eos = opts.pop("eos_token_id", None)
+        if not config._memory_optim:
+            # memory_optim off: skip planner budgeting, take the default pool
+            opts.setdefault("num_blocks", 0)
+            from ..serving.cache import default_num_blocks
+
+            opts["num_blocks"] = opts["num_blocks"] or default_num_blocks()
+        self._engine = _serving.Engine(
+            config._generative_model,
+            _serving.ServingConfig(**opts) if opts else None,
+        )
+        self._inputs = {
+            "input_ids": Tensor("input_ids", np.int64),
+            "prompt_lens": Tensor("prompt_lens", np.int64),
+        }
+        self._outputs = {"tokens": Tensor("tokens")}
+
+    def get_input_names(self) -> List[str]:
+        return ["input_ids", "prompt_lens"]
+
+    def get_output_names(self) -> List[str]:
+        return ["tokens"]
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    @property
+    def engine(self):
+        """The underlying paddle.serving.Engine (stats(), submit(), ...)."""
+        return self._engine
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            self._inputs["input_ids"].copy_from_cpu(inputs[0])
+            if len(inputs) > 1:
+                self._inputs["prompt_lens"].copy_from_cpu(inputs[1])
+            else:
+                # a list-style call without lens must not inherit a stale
+                # prompt_lens handle from a previous run
+                self._inputs["prompt_lens"]._value = None
+        ids_h = self._inputs["input_ids"]
+        if ids_h._value is None:
+            raise RuntimeError("input 'input_ids' not set; call copy_from_cpu first")
+        ids = np.asarray(jax.device_get(ids_h._value))
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        lens_h = self._inputs["prompt_lens"]
+        lens = (
+            np.asarray(jax.device_get(lens_h._value)).reshape(-1).astype(int)
+            if lens_h._value is not None
+            else np.full((ids.shape[0],), ids.shape[1], int)
+        )
+        if lens.shape[0] != ids.shape[0]:
+            raise ValueError(
+                f"prompt_lens has {lens.shape[0]} entries for a batch of "
+                f"{ids.shape[0]} prompts"
+            )
+        if ((lens < 1) | (lens > ids.shape[1])).any():
+            raise ValueError(
+                f"prompt_lens entries must be in [1, {ids.shape[1]}] "
+                f"(the input_ids width); got {lens.tolist()}"
+            )
+        prompts = [ids[i, : int(lens[i])] for i in range(ids.shape[0])]
+        resps = self._engine.serve(
+            prompts, max_new_tokens=self._max_new, eos_token_id=self._eos)
+        # fixed documented shape [b, max_new], -1-padded past each row's
+        # completion (EOS can end a row early)
+        width = self._max_new or self._engine._default_max_new
+        out = np.full((len(resps), max(1, width)), -1, np.int64)
+        for i, r in enumerate(resps):
+            if not r.ok:
+                raise RuntimeError(
+                    f"serving request {r.request_id} failed: {r.status}: "
+                    f"{r.error}"
+                )
+            out[i, : len(r.tokens)] = r.tokens
+        self._outputs["tokens"]._value = jnp.asarray(out)
+        if inputs is not None:
+            return [out]
+        return True
+
+    def clone(self) -> "GenerativePredictor":
+        """Share the engine (a serving engine is already a concurrent
+        multiplexer); fresh IO handles — the Predictor.clone()/PredictorPool
+        contract."""
+        p = object.__new__(GenerativePredictor)
+        p._config = self._config
+        p._max_new = self._max_new
+        p._eos = self._eos
+        p._engine = self._engine
+        p._inputs = {
+            "input_ids": Tensor("input_ids", np.int64),
+            "prompt_lens": Tensor("prompt_lens", np.int64),
+        }
+        p._outputs = {"tokens": Tensor("tokens")}
+        return p
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config):
+    """reference: paddle_infer::CreatePredictor (inference/api/paddle_inference_api.h).
+    A Config with ``enable_generative_serving(model)`` routes onto the
+    paddle.serving continuous-batching engine; otherwise the StableHLO
+    artifact predictor loads as before."""
+    if config.is_generative():
+        return GenerativePredictor(config)
     return Predictor(config)
 
 

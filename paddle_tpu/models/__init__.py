@@ -6,6 +6,7 @@ external repos (PaddleNLP/FleetX); here they are first-class so the
 distributed engine has in-tree users.
 """
 from .gpt import (  # noqa: F401
+    CacheOverflow,
     GPTConfig,
     GPTForPretraining,
     GPTModel,

@@ -64,6 +64,24 @@ def _sp(x, cfg, *spec):
     return with_sharding_constraint(x, *spec)
 
 
+class CacheOverflow(ValueError):
+    """Structured KV-cache overflow: a generation step would write past the
+    cache capacity. A REQUEST-level verdict, not a run-killer — the serving
+    scheduler (paddle.serving) catches it and answers the offending request
+    with an error response while the rest of the batch keeps decoding.
+    Subclasses ValueError so pre-existing callers that caught the old
+    ValueError keep working."""
+
+    def __init__(self, need: int, capacity: int, detail: str = ""):
+        self.need = int(need)
+        self.capacity = int(capacity)
+        suffix = f" ({detail})" if detail else ""
+        super().__init__(
+            f"KV cache overflow: need {need} positions > capacity "
+            f"{capacity}{suffix}"
+        )
+
+
 def convert_legacy_qkv_state_dict(state_dict, num_heads: int):
     """One-time converter for checkpoints saved before the fused-qkv layout
     switched from 3-major ([h, 3, H, hd] over the output dim) to heads-major
@@ -113,6 +131,18 @@ class GPTAttention(nn.Layer):
         cfg = self.cfg
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [b, s, 3h] sharded on mp
+        if cache is not None and not isinstance(cache, dict):
+            # paged KV view (paddle.serving.PagedCacheView): block storage,
+            # per-row lengths, and the block table live in the view; the
+            # attention math is the paged_decode_attention analogue of
+            # cached_attention below (bitwise-equal over the same context)
+            qkv = qkv.reshape([b, s, self.num_heads, 3, self.head_dim])
+            q, k, v = qkv.unstack(axis=3)
+            out = cache.append_attend(
+                q, k, v, scale=1.0 / math.sqrt(self.head_dim)
+            )
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.out_proj(out)
         # heads-major fused-qkv layout (Megatron-style): 3h splits as
         # H x 3 x hd so the mp sharding of the fused dim lands on the
         # HEADS subdim (divisible by mp). The 3-major layout put mp on the
@@ -141,9 +171,9 @@ class GPTAttention(nn.Layer):
                 )
                 cache["len"] = 0
             if cache["len"] + s > cfg.max_seq_len:
-                raise ValueError(
-                    f"KV cache overflow: {cache['len']} + {s} > "
-                    f"max_seq_len {cfg.max_seq_len}"
+                raise CacheOverflow(
+                    cache["len"] + s, cfg.max_seq_len,
+                    detail=f"cached {cache['len']} + new {s} > max_seq_len",
                 )
             cur = paddle.Tensor(_np.int32(cache["len"]), stop_gradient=True)
             out, nk, nv = _apply(
@@ -257,9 +287,15 @@ class GPTEmbeddings(nn.Layer):
         )
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, input_ids, pos_offset: int = 0):
+    def forward(self, input_ids, pos_offset=0):
         s = input_ids.shape[1]
-        pos = paddle.arange(s, dtype="int64").unsqueeze(0) + pos_offset
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        if isinstance(pos_offset, paddle.Tensor):
+            # per-row offsets (continuous-batching decode: every sequence in
+            # the batch sits at its own position) — [b] broadcasts to [b, s]
+            pos = pos + pos_offset.astype("int64").unsqueeze(-1)
+        else:
+            pos = pos + pos_offset
         h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         h = _sp(h, self.cfg, ("dp", "sharding"), "sep", None)
         return self.dropout(h)

@@ -775,6 +775,73 @@ def cached_attention(q, k_cache, v_cache, k_new, v_new, cur_len, *, scale):
     return out.astype(q.dtype), k_cache, v_cache
 
 
+def paged_decode_attention(q, k_pool, v_pool, tables, lens, k_new, v_new, *,
+                           scale, block_size, prefill=False):
+    """Paged-KV variant of ``cached_attention`` (the vLLM PagedAttention
+    idiom over the same math): each sequence's context lives as a chain of
+    fixed-size blocks in one shared pool instead of a private
+    ``[b, max_len, h, d]`` buffer, so serving memory is bounded by the pool
+    — not by ``max_seq_len × admitted sequences``.
+
+      q            [b, s, h, d]   query chunk (s == 1 for decode steps)
+      k/v_pool     [n_blocks, block_size, h, d]  the shared block pool
+      tables       [b, n_blk] int32  physical block id per logical block
+      lens         [b] int32  tokens already cached per row (pre-append)
+      k/v_new      [b, s, h, d]   this chunk's K/V, written at lens..lens+s-1
+
+    Returns ``(out [b, s, h, d], k_pool, v_pool)`` with the new rows
+    written. The attention math — einsum strings, prefix+causal mask with
+    the same -1e30 fill, softmax — is kept LINE-IDENTICAL to
+    ``cached_attention`` so a paged decode is bitwise-equal to the
+    fixed-shape cache path over the same context length: the gathered
+    block view holds the same values the fixed cache would, masked
+    positions contribute exactly 0 after softmax, and 0·garbage == 0.
+
+    ``prefill=True`` (static) asserts the chunk starts at position 0 with
+    ``s`` a block multiple and writes whole blocks in one vectorized
+    scatter; the general path (decode: s == 1) unrolls over s. Rows padded
+    into a batch bucket must point their table at a PRIVATE scratch block
+    (one per batch slot) so no two rows scatter into the same block.
+    """
+    b, s = q.shape[0], q.shape[1]
+    if prefill:
+        if s % block_size != 0:
+            raise ValueError(
+                f"paged prefill chunk length {s} is not a multiple of "
+                f"block_size {block_size}"
+            )
+        nb = s // block_size
+        k_vals = k_new.astype(k_pool.dtype).reshape(
+            (b, nb, block_size) + tuple(k_new.shape[2:]))
+        v_vals = v_new.astype(v_pool.dtype).reshape(
+            (b, nb, block_size) + tuple(v_new.shape[2:]))
+        k_pool = k_pool.at[tables[:, :nb]].set(k_vals)
+        v_pool = v_pool.at[tables[:, :nb]].set(v_vals)
+    else:
+        for i in range(s):  # s is static (1 for decode) — unrolls
+            pos = (lens + i).astype(jnp.int32)
+            blk = jnp.take_along_axis(
+                tables, (pos // block_size)[:, None], axis=1)[:, 0]
+            off = pos % block_size
+            k_pool = k_pool.at[blk, off].set(k_new[:, i].astype(k_pool.dtype))
+            v_pool = v_pool.at[blk, off].set(v_new[:, i].astype(v_pool.dtype))
+    n_blk = tables.shape[1]
+    L = n_blk * block_size
+    k_cache = k_pool[tables].reshape((b, L) + tuple(k_pool.shape[-2:]))
+    v_cache = v_pool[tables].reshape((b, L) + tuple(v_pool.shape[-2:]))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * np.float32(scale)
+    # token i of the new chunk may attend positions j <= lens + i — the
+    # cached_attention mask with a per-row cur
+    allowed = (
+        jnp.arange(L)[None, None, :]
+        <= (lens[:, None] + jnp.arange(s)[None, :])[:, :, None]
+    )  # [b, s_new, L]
+    logits = jnp.where(allowed[:, None], logits, np.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    return out.astype(q.dtype), k_pool, v_pool
+
+
 def flash_scaled_dot_product_attention(q, k, v, *, scale=None, is_causal=False):
     """Pallas flash kernel path (ops/pallas/flash_attention.py — the
     fused_attention_op.cu replacement): O(S·D) memory instead of the O(S²)

@@ -19,8 +19,8 @@ Spec grammar (comma-separated clauses, tokens separated by ':'):
                                retry through (default 1)
               | <word>         target filter: a site name for execute/
                                compile/hang/kill (op, segment, backward,
-                               optimizer, captured, checkpoint) or a value
-                               target for nan (grads)
+                               optimizer, captured, checkpoint, prefill,
+                               decode) or a value target for nan (grads)
 
 Decisions are SEEDED per (clause, site, step) from FLAGS_fault_seed, so a
 failing run replays exactly: the same step faults at the same site every
@@ -54,11 +54,13 @@ __all__ = [
 _KINDS = ("execute", "compile", "hang", "nan", "kill")
 
 # the closed set of site targets a clause may name: the execution choke
-# points routed through resilience.runtime.execute, plus the nan-injection
+# points routed through resilience.runtime.execute (including the serving
+# engine's prefill/decode program launches), plus the nan-injection
 # targets — validated at parse time so a typo'd site fails loud instead of
 # silently matching nothing
 _SITES = frozenset((
     "op", "segment", "backward", "optimizer", "captured", "checkpoint",
+    "prefill", "decode",
     "grads",
 ))
 

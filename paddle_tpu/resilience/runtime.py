@@ -30,8 +30,16 @@ __all__ = ["execute", "lazy_tier_ok", "captured_tier_ok", "on_step_end",
 
 # site → ladder tier that owns faults there. Per-op/backward/optimizer
 # programs run at the ladder floor (retried, never demoted); checkpoint IO
-# is not an execution tier.
-_SITE_TIER = {"segment": "lazy", "captured": "captured"}
+# is not an execution tier. The serving engine's prefill/decode launches
+# run at the captured tier keyed by their bucket signature — a disruptive
+# fault demotes that ONE bucket's program captured→lazy→per-op while other
+# buckets keep replaying their captured executables.
+_SITE_TIER = {
+    "segment": "lazy",
+    "captured": "captured",
+    "prefill": "captured",
+    "decode": "captured",
+}
 
 # exception type names that must pass through untouched: control-flow and
 # verdict exceptions, not faults (counted elsewhere or not at all)

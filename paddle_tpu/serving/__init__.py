@@ -1,0 +1,55 @@
+"""paddle.serving — the continuous-batching inference runtime.
+
+The front door that turns the framework's inference ingredients into
+requests/second (ROADMAP open item 2): a request queue feeding
+shape-bucketed continuous batches (the ``io/bucketing.py`` padding-policy
+idiom), a **paged KV cache** whose block pool is sized up front by the PR 4
+memory planner (``analysis.memory.plan_block_pool`` — admission is refused
+past ``FLAGS_memory_budget_mb`` instead of OOMing), and prefill/decode
+steps captured as **one donated XLA program per bucket signature** via the
+decode-mode capture in ``core/lazy.py`` (the CUDA-Graphs capture/replay
+contract from PAPERS.md, generalized beyond training). The resilience
+ladder runs through the serve loop: a transient fault mid-decode demotes
+that bucket's program captured → lazy → per-op and retries the batch
+without dropping requests; SIGTERM drains in-flight sequences before exit.
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    model = GPTForPretraining(GPTConfig(...))
+    engine = paddle.serving.Engine(model)
+    rid = engine.submit(prompt_ids, max_new_tokens=64, eos_token_id=0)
+    engine.run_until_idle()
+    print(engine.response(rid).tokens)
+
+See SERVING.md for the queue/bucket/paged-cache design and the flags
+(``paddle.describe_flags('serving')``).
+"""
+from __future__ import annotations
+
+from .cache import BlockPool, PagedCacheView  # noqa: F401
+from .engine import Engine, ServingConfig  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Request,
+    RequestQueue,
+    Response,
+    ServingBuckets,
+)
+
+__all__ = [
+    "BlockPool",
+    "Engine",
+    "PagedCacheView",
+    "Request",
+    "RequestQueue",
+    "Response",
+    "ServingBuckets",
+    "ServingConfig",
+    "create_engine",
+]
+
+
+def create_engine(model, **kwargs) -> Engine:
+    """Build an :class:`Engine` with keyword config (the
+    ``inference.create_predictor`` idiom for the serving surface)."""
+    return Engine(model, ServingConfig(**kwargs) if kwargs else None)

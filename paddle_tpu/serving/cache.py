@@ -1,0 +1,178 @@
+"""Paged KV cache: a planner-budgeted block pool + per-layer batch views.
+
+The vLLM PagedAttention idiom applied to this framework's fixed-shape
+decode: instead of one private ``[b, max_seq_len, h, d]`` K/V buffer per
+sequence (``models/gpt.py`` dict caches), every sequence's context is a
+chain of fixed-size blocks drawn from ONE shared pool per layer. HBM is
+bounded by the pool — which the PR 4 memory planner sizes up front against
+``FLAGS_memory_budget_mb`` (``analysis.memory.plan_block_pool``) — and the
+scheduler refuses admission when no blocks are free instead of letting XLA
+OOM mid-decode. Completed sequences recycle their blocks without
+recompiling anything: the decode program is a function of the block TABLE,
+not of which physical blocks a sequence happens to own.
+
+The attention math itself lives in ``ops/nn_ops.py paged_decode_attention``
+and is line-identical to ``cached_attention``'s einsum/mask/softmax chain,
+so paged decode is bitwise-equal to the fixed-shape cache path over the
+same context length (tests/test_serving.py asserts this).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+
+__all__ = ["BlockPool", "PagedCacheView"]
+
+
+class BlockPool:
+    """The shared K/V block storage plus its free-list.
+
+    One *logical* block spans every layer: ``alloc`` hands out physical ids
+    valid across all ``layers`` pool arrays, so a sequence's block table is
+    layer-independent (the vLLM layout). Ids ``0..scratch_slots-1`` are
+    reserved scratch blocks — one per decode-batch slot — that padded batch
+    rows write into, each slot its own block so no two rows ever scatter
+    into the same physical block.
+    """
+
+    def __init__(self, *, layers: int, heads: int, head_dim: int,
+                 block_size: int, num_blocks: int, scratch_slots: int,
+                 dtype: str = "float32"):
+        if num_blocks < 1:
+            raise ValueError(
+                f"BlockPool needs at least 1 allocatable block, got "
+                f"{num_blocks} — raise FLAGS_memory_budget_mb or "
+                "FLAGS_serving_num_blocks"
+            )
+        self.layers = int(layers)
+        self.block_size = int(block_size)
+        self.scratch_slots = int(scratch_slots)
+        self._num_blocks = int(num_blocks)
+        total = self._num_blocks + self.scratch_slots
+        shape = (total, self.block_size, int(heads), int(head_dim))
+        self.dtype = np.dtype(dtype)
+        # raw jax arrays (not Tensors): the decode program takes and returns
+        # them wholesale, donated in place under the captured tier
+        self.k: List = [jnp.zeros(shape, self.dtype) for _ in range(layers)]
+        self.v: List = [jnp.zeros(shape, self.dtype) for _ in range(layers)]
+        self._free = list(range(self.scratch_slots, total))
+        self._peak_used = 0
+
+    # -- bookkeeping --------------------------------------------------------
+    @property
+    def num_blocks(self) -> int:
+        """Allocatable blocks (excluding scratch)."""
+        return self._num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self._num_blocks - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.used_blocks / max(1, self._num_blocks)
+
+    @property
+    def peak_occupancy(self) -> float:
+        return self._peak_used / max(1, self._num_blocks)
+
+    def block_bytes(self) -> int:
+        """Bytes of ONE logical block across all layers (K and V)."""
+        head_shape = self.k[0].shape[2:]
+        per_layer = self.block_size * int(np.prod(head_shape)) * self.dtype.itemsize
+        return 2 * self.layers * per_layer
+
+    # -- alloc/free ---------------------------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n physical block ids, or None when the pool is momentarily full
+        (backpressure — the scheduler waits for a completion). A request
+        that could NEVER fit raises CacheOverflow — the request-level
+        reject, not an OOM."""
+        from ..models.gpt import CacheOverflow  # deferred: import-cycle safe
+
+        if n > self._num_blocks:
+            raise CacheOverflow(
+                n, self._num_blocks,
+                detail="blocks needed exceed the planner-budgeted pool",
+            )
+        if n > len(self._free):
+            return None
+        ids, self._free = self._free[:n], self._free[n:]
+        self._peak_used = max(self._peak_used, self.used_blocks)
+        return ids
+
+    def free(self, ids: Sequence[int]):
+        for i in ids:
+            if i < self.scratch_slots:
+                raise ValueError(f"block {i} is a reserved scratch slot")
+        self._free.extend(int(i) for i in ids)
+
+    def reset_storage(self):
+        """Fresh zeroed pool arrays (same shapes/free-list untouched) — the
+        conservative recovery after a REAL fault mid-decode on the donated
+        tier, when the consumed pool buffers can no longer be trusted."""
+        shape, dt = self.k[0].shape, self.k[0].dtype
+        self.k = [jnp.zeros(shape, dt) for _ in range(self.layers)]
+        self.v = [jnp.zeros(shape, dt) for _ in range(self.layers)]
+
+
+class _BatchState:
+    """Per-forward holder threading the pool arrays through the layer stack:
+    each layer's view reads its pool entry and writes back the updated one,
+    so after the forward the state holds the post-step pools."""
+
+    __slots__ = ("k_pools", "v_pools", "tables", "lens", "prefill")
+
+    def __init__(self, k_pools, v_pools, tables, lens, prefill: bool):
+        self.k_pools = list(k_pools)
+        self.v_pools = list(v_pools)
+        self.tables = tables
+        self.lens = lens
+        self.prefill = prefill
+
+
+class PagedCacheView:
+    """What ``GPTAttention.forward`` sees as its ``cache``: a per-layer
+    handle onto the shared :class:`_BatchState`. ``append_attend`` writes
+    this chunk's K/V into the pool at each row's next positions and attends
+    over the gathered block view — one fused op
+    (``ops.nn_ops.paged_decode_attention``) dispatched through the normal
+    per-op path, so it works identically per-op eager, under lazy dispatch,
+    and inside a decode-mode capture trace."""
+
+    __slots__ = ("_state", "layer", "block_size")
+
+    def __init__(self, state: _BatchState, layer: int, block_size: int):
+        self._state = state
+        self.layer = int(layer)
+        self.block_size = int(block_size)
+
+    def append_attend(self, q, k, v, *, scale):
+        from ..core.dispatch import apply as _apply
+        from ..ops import nn_ops as _ops
+
+        st = self._state
+        out, nk, nv = _apply(
+            _ops.paged_decode_attention, q,
+            st.k_pools[self.layer], st.v_pools[self.layer],
+            st.tables, st.lens, k, v,
+            scale=scale, block_size=self.block_size, prefill=st.prefill,
+            op_name="paged_decode_attention",
+        )
+        st.k_pools[self.layer] = nk
+        st.v_pools[self.layer] = nv
+        return out
+
+
+def default_num_blocks() -> int:
+    """Pool size when neither FLAGS_serving_num_blocks nor any memory budget
+    is configured."""
+    n = int(flags.flag("serving_num_blocks"))
+    return n if n > 0 else 256

@@ -1,0 +1,716 @@
+"""The serving engine: continuous batching over a paged, planner-budgeted
+KV cache, with prefill and decode captured as single donated XLA programs.
+
+One ``Engine`` owns one model and runs a simple synchronous loop:
+
+    admit (queue → blocks → prefill)  →  decode every active group once
+    →  recycle completed sequences' blocks  →  repeat
+
+Every program launch goes through three execution tiers, the serving
+instance of the resilience ladder (captured → lazy → per-op):
+
+  captured   ``jit(step_fn, donate_argnums=pools)`` — ONE donated program
+             per bucket signature (decode-mode capture, ``core/lazy.py``),
+             pool buffers updated in place;
+  lazy       the same jitted program WITHOUT donation — the retry-safe
+             middle rung (inputs retained, so a transient fault replays);
+  per-op     the same Python function eagerly — the ladder floor, each op
+             individually retried by the per-op resilience site.
+
+All three tiers run the SAME function over the SAME buffers, so numerics
+never change across rungs — a mid-decode fault demotes the bucket's
+program and the batch retries without dropping a request. Injected faults
+(FLAGS_fault_inject) raise before the program runs, so the fallback rungs
+reuse the intact pool; a REAL fault on the donated rung conservatively
+resets the pool and re-enqueues every in-flight sequence (greedy decode is
+deterministic, so re-runs reproduce the same tokens).
+"""
+from __future__ import annotations
+
+import itertools
+import signal as _signal
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+from ..core.dispatch import no_grad
+from .cache import BlockPool, PagedCacheView, _BatchState, default_num_blocks
+from .scheduler import (
+    Request,
+    RequestQueue,
+    Response,
+    Sequence,
+    ServingBuckets,
+    group_for_decode,
+)
+
+__all__ = ["Engine", "ServingConfig"]
+
+_ENGINE_IDS = itertools.count(1)
+
+
+# -- module-level op helpers (cacheable tokens for the per-op jit cache) ----
+def _decode_pick(logits):
+    """Greedy next token from a decode chunk's last position."""
+    row = logits[:, -1, :]
+    return row, jnp.argmax(row, axis=-1).astype(jnp.int32)
+
+
+def _prefill_pick(logits, plen):
+    """Greedy next token from the TRUE last prompt position (the prompt is
+    padded to its bucket; positions >= plen are pad lanes)."""
+    idx = (plen.astype(jnp.int32) - 1)[:, None, None]
+    row = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+    return row, jnp.argmax(row, axis=-1).astype(jnp.int32)
+
+
+def _raw(t):
+    """Tensor → raw (materialized) jax value; raw values pass through."""
+    from ..core.lazy import materialize
+    from ..core.tensor import Tensor
+
+    return materialize(t._value if isinstance(t, Tensor) else t)
+
+
+class _PoolsConsumed(RuntimeError):
+    """A REAL (non-injected) fault escaped the donated rung: the pool
+    buffers may have been consumed by XLA. Recovery resets the pool."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+@dataclass
+class ServingConfig:
+    """Engine knobs. ``None``/0 fields fall back to their FLAGS_serving_*
+    defaults (see ``paddle.describe_flags('serving')``)."""
+
+    block_size: int = 0
+    num_blocks: int = 0              # 0 = planner-budgeted (plan_block_pool)
+    prompt_buckets: Optional[List[int]] = None
+    decode_batch_buckets: Optional[List[int]] = None
+    max_new_tokens: int = 0          # default per-request cap
+    memory_budget_mb: Optional[float] = None  # None = FLAGS_memory_budget_mb
+    keep_logits: bool = False        # responses carry per-token logits rows
+    dtype: str = "float32"
+    # model geometry — inferred from model.cfg when present
+    layers: Optional[int] = None
+    heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    max_positions: Optional[int] = None
+
+
+class Engine:
+    """Continuous-batching serving runtime over one generative model.
+
+    ``model`` must accept ``model(ids, caches=views, pos_offset=tensor)``
+    with a list of per-layer cache views and return ``[b, s, vocab]``
+    logits — ``models.gpt.GPTForPretraining`` is the flagship shape.
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None):
+        cfg = config or ServingConfig()
+        self._uid = next(_ENGINE_IDS)
+        self._model = model
+        if hasattr(model, "eval"):
+            model.eval()
+        mcfg = getattr(model, "cfg", None)
+        self._layers = cfg.layers or getattr(mcfg, "num_layers", None)
+        heads = cfg.heads or getattr(mcfg, "num_heads", None)
+        head_dim = cfg.head_dim
+        if head_dim is None and mcfg is not None:
+            head_dim = mcfg.hidden_size // mcfg.num_heads
+        if not (self._layers and heads and head_dim):
+            raise ValueError(
+                "cannot infer model geometry; pass ServingConfig(layers=, "
+                "heads=, head_dim=)"
+            )
+        self._max_positions = (
+            cfg.max_positions or getattr(mcfg, "max_seq_len", None) or 1 << 30
+        )
+        self._block_size = int(cfg.block_size) or int(
+            flags.flag("serving_block_size"))
+        self._default_max_new = int(cfg.max_new_tokens) or int(
+            flags.flag("serving_max_new_tokens"))
+        self._keep_logits = bool(cfg.keep_logits)
+        self._buckets = ServingBuckets(
+            block_size=self._block_size,
+            prompt_buckets=cfg.prompt_buckets,
+            decode_batch_buckets=cfg.decode_batch_buckets,
+        )
+        scratch = self._buckets.max_decode_batch
+
+        self._decode_fn = self._make_decode_fn()
+        self._prefill_fn = self._make_prefill_fn()
+
+        # -- block-pool sizing: explicit > planner budget > default --------
+        self._pool_plan = None
+        # planner-budgeted engines also bound per-request context by the
+        # geometry the planner actually traced (set in _plan_pool): the
+        # budget guarantee only covers signatures no larger than the traced
+        # worst case, so bigger requests are refused at admission
+        self._plan_ctx_blocks: Optional[int] = None
+        num_blocks = int(cfg.num_blocks) or int(flags.flag("serving_num_blocks"))
+        block_bytes = (
+            2 * self._layers * self._block_size * int(heads) * int(head_dim)
+            * np.dtype(cfg.dtype).itemsize
+        )
+        if num_blocks <= 0:
+            self._pool_plan = self._plan_pool(
+                heads=int(heads), head_dim=int(head_dim), dtype=cfg.dtype,
+                scratch=scratch, block_bytes=block_bytes,
+                budget_mb=cfg.memory_budget_mb,
+            )
+            if self._pool_plan.num_blocks is None:
+                num_blocks = default_num_blocks()
+                self._plan_ctx_blocks = None  # no budget — nothing to cap
+            else:
+                num_blocks = int(self._pool_plan.num_blocks)
+                if num_blocks < 1:
+                    raise ValueError(
+                        "memory budget leaves no room for a KV block pool: "
+                        f"decode-program overhead is ~"
+                        f"{self._pool_plan.overhead_bytes / 2**20:.1f} MB of "
+                        f"a {self._pool_plan.budget_bytes / 2**20:.1f} MB "
+                        "budget (FLAGS_memory_budget_mb)"
+                    )
+        self._pool = BlockPool(
+            layers=self._layers, heads=int(heads), head_dim=int(head_dim),
+            block_size=self._block_size, num_blocks=num_blocks,
+            scratch_slots=scratch, dtype=cfg.dtype,
+        )
+
+        self._queue = RequestQueue()
+        self._active: List[Sequence] = []
+        self._responses: Dict[int, Response] = {}
+        # ids accepted into the queue but not yet answered — the drop
+        # tripwire run_until_idle audits (every accepted request must end
+        # with exactly one Response; anything else is a counted drop)
+        self._accepted: set = set()
+        self._draining = False
+        self._prev_handlers: Dict[int, Any] = {}
+        # bounded reservoir: long-running engines must not grow host memory
+        # with total traffic (stats() percentiles cover the recent window)
+        from collections import deque as _deque
+
+        self._token_lat_ms = _deque(maxlen=4096)
+        self._decode_rows = 0
+        # lifetime per-engine outcome counts (responses themselves are
+        # evicted by serve()/pop_response, so stats can't scan them)
+        self._n_completed = 0
+        self._n_rejected = 0
+        self._n_errors = 0
+
+    # ------------------------------------------------------------------
+    # step functions (shared by all three execution tiers)
+    # ------------------------------------------------------------------
+    def _make_decode_fn(self) -> Callable:
+        model, layers, bs = self._model, self._layers, self._block_size
+
+        def decode_fn(k_pools, v_pools, tables, lens, tokens):
+            from ..core.dispatch import apply as _apply
+            from ..core.tensor import Tensor
+
+            st = _BatchState(k_pools, v_pools, tables, lens, prefill=False)
+            views = [PagedCacheView(st, i, bs) for i in range(layers)]
+            ids = Tensor(tokens.astype(jnp.int64)[:, None], stop_gradient=True)
+            pos = Tensor(lens, stop_gradient=True)
+            with no_grad():
+                logits = model(ids, caches=views, pos_offset=pos)
+            row, nxt = _apply(_decode_pick, logits, op_name="serve_decode_pick")
+            return (
+                tuple(_raw(t) for t in st.k_pools),
+                tuple(_raw(t) for t in st.v_pools),
+                _raw(row), _raw(nxt),
+            )
+
+        return decode_fn
+
+    def _make_prefill_fn(self) -> Callable:
+        model, layers, bs = self._model, self._layers, self._block_size
+
+        def prefill_fn(k_pools, v_pools, tables, ids, plen):
+            from ..core.dispatch import apply as _apply
+            from ..core.tensor import Tensor
+
+            lens = jnp.zeros((ids.shape[0],), jnp.int32)
+            st = _BatchState(k_pools, v_pools, tables, lens, prefill=True)
+            views = [PagedCacheView(st, i, bs) for i in range(layers)]
+            with no_grad():
+                logits = model(Tensor(ids, stop_gradient=True),
+                               caches=views, pos_offset=0)
+            row, nxt = _apply(_prefill_pick, logits, plen,
+                              op_name="serve_prefill_pick")
+            return (
+                tuple(_raw(t) for t in st.k_pools),
+                tuple(_raw(t) for t in st.v_pools),
+                _raw(row), _raw(nxt),
+            )
+
+        return prefill_fn
+
+    # ------------------------------------------------------------------
+    # planner-budgeted pool sizing
+    # ------------------------------------------------------------------
+    def _plan_pool(self, *, heads, head_dim, dtype, scratch, block_bytes,
+                   budget_mb):
+        """Trace the WORST-CASE decode signature once (largest batch bucket
+        × largest context bucket) and hand the liveness planner the job of
+        splitting the budget between program overhead and the pool."""
+        from ..analysis import memory as _mem
+
+        B = self._buckets.max_decode_batch
+        nblk = self._buckets.ctx_blocks(
+            self._buckets.prompt_spec.boundaries[-1], self._default_max_new)
+        self._plan_ctx_blocks = nblk
+        n_total = scratch + B * nblk
+        pshape = (n_total, self._block_size, heads, head_dim)
+        pool_spec = jax.ShapeDtypeStruct(pshape, np.dtype(dtype))
+        k_specs = tuple(pool_spec for _ in range(self._layers))
+        t_spec = jax.ShapeDtypeStruct((B, nblk), np.int32)
+        l_spec = jax.ShapeDtypeStruct((B,), np.int32)
+        roles = (
+            [("buffer", f"k_pool{i}") for i in range(self._layers)]
+            + [("buffer", f"v_pool{i}") for i in range(self._layers)]
+            + [("feed", "block_tables"), ("feed", "seq_lens"),
+               ("feed", "tokens")]
+        )
+        donated = tuple(range(2 * self._layers))
+        pool_bytes_in_trace = (
+            2 * self._layers * int(np.prod(pshape)) * np.dtype(dtype).itemsize
+        )
+        return _mem.plan_block_pool(
+            lambda: jax.make_jaxpr(self._decode_fn)(
+                k_specs, k_specs, t_spec, l_spec, l_spec),
+            block_bytes=block_bytes,
+            pool_bytes_in_trace=pool_bytes_in_trace,
+            budget_mb=budget_mb,
+            roles=roles, donated=donated,
+        )
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None) -> int:
+        """Queue one request; returns its request id. Requests that can
+        NEVER be served (context exceeds the budgeted pool or the model's
+        positions) are rejected immediately with a Response — admission
+        refusal, not an OOM."""
+        from ..core import dispatch
+
+        req = Request(
+            prompt=np.asarray(prompt),
+            max_new_tokens=max_new_tokens or self._default_max_new,
+            eos_token_id=eos_token_id,
+        )
+        if self._draining:
+            self._reject(req, "engine is draining (preemption)")
+            return req.request_id
+        plen = int(req.prompt.size)
+        ctx = (self._buckets.prompt_bucket(plen) + req.max_new_tokens)
+        if ctx > self._max_positions:
+            self._reject(
+                req,
+                f"context {ctx} exceeds the model's max positions "
+                f"{self._max_positions}",
+            )
+            return req.request_id
+        n_blk = self._buckets.ctx_blocks(plen, req.max_new_tokens)
+        cap = self._pool.num_blocks
+        if self._plan_ctx_blocks is not None:
+            # the memory budget was proven only for decode signatures up to
+            # the planner's traced worst case — a wider context would gather
+            # a bigger block view than the overhead estimate covers, exactly
+            # the OOM the budget exists to prevent
+            cap = min(cap, self._plan_ctx_blocks)
+        if n_blk > cap:
+            dispatch._counters["serve_admission_refusals"] += 1
+            self._reject(
+                req,
+                f"KV cache overflow: request needs {n_blk} blocks > "
+                f"admissible context {cap} "
+                "(planner-budgeted by FLAGS_memory_budget_mb)",
+            )
+            return req.request_id
+        self._queue.push(req)
+        self._accepted.add(req.request_id)
+        return req.request_id
+
+    def response(self, request_id: int) -> Optional[Response]:
+        return self._responses.get(request_id)
+
+    def pop_response(self, request_id: int) -> Optional[Response]:
+        """``response()`` + evict — long-running callers retrieve results
+        with this so the response map doesn't grow with total traffic."""
+        return self._responses.pop(request_id, None)
+
+    def step(self):
+        """One scheduler tick: admit + prefill what fits, then one decode
+        step for every active group."""
+        from ..resilience import runtime as _rt
+
+        self._admit()
+        groups = group_for_decode(self._active)
+        for n_blk in sorted(groups):
+            seqs = groups[n_blk]
+            cap = self._buckets.max_decode_batch
+            for i in range(0, len(seqs), cap):
+                # pool recovery (_recover_pools) tears down EVERY active
+                # sequence mid-tick: drop stale snapshot entries and, if a
+                # batch reports the pool was rebuilt, abort this tick —
+                # the requeued sequences re-prefill on the next one
+                chunk = [s for s in seqs[i:i + cap] if s in self._active]
+                if chunk and not self._decode_batch(chunk, n_blk):
+                    _rt.on_step_end()
+                    return
+        _rt.on_step_end()
+
+    def run_until_idle(self):
+        """Drive the loop until every accepted request has a response."""
+        while self._queue or self._active:
+            self.step()
+        self._audit_drops()
+
+    def _audit_drops(self):
+        """The zero-drop tripwire: at idle, every accepted request must
+        have produced exactly one Response. Anything missing is counted in
+        serve_requests_dropped (the chaos gates fail on it) and answered
+        with an error response so no caller ever hangs on a lost id."""
+        from ..core import dispatch
+
+        missing = self._accepted - set(self._responses)
+        for rid in missing:
+            dispatch._counters["serve_requests_dropped"] += 1
+            self._responses[rid] = Response(
+                request_id=rid, status="error",
+                error="request lost by the engine (dropped) — engine bug",
+                done_time=time.time(),
+            )
+        self._accepted.clear()
+
+    def serve(self, requests: Seq, **submit_kw) -> List[Response]:
+        """Convenience: submit every prompt, run to completion, return (and
+        evict) the responses in submit order."""
+        ids = [self.submit(p, **submit_kw) for p in requests]
+        self.run_until_idle()
+        return [self.pop_response(i) for i in ids]
+
+    # -- preemption ------------------------------------------------------
+    def begin_drain(self):
+        """Stop admitting NEW requests; everything already submitted still
+        completes (the SIGTERM drain contract — zero dropped requests)."""
+        from ..core import dispatch
+
+        if not self._draining:
+            self._draining = True
+            dispatch._counters["serve_preempt_drains"] += 1
+
+    def install_preemption_handler(self, signals=(_signal.SIGTERM,)):
+        for s in signals:
+            if s in self._prev_handlers:
+                continue  # already installed — keep the ORIGINAL previous
+            self._prev_handlers[s] = _signal.signal(
+                s, lambda signum, frame: self.begin_drain())
+
+    def uninstall_preemption_handler(self):
+        for s, h in self._prev_handlers.items():
+            _signal.signal(s, h)
+        self._prev_handlers.clear()
+
+    def drain(self) -> List[Response]:
+        """begin_drain + run to idle; returns every retained response."""
+        self.begin_drain()
+        self.run_until_idle()
+        return list(self._responses.values())
+
+    def close(self):
+        """Release this engine's captured programs from the decode-mode
+        capture cache (their closures hold the model) and restore any
+        signal handlers. Safe to call twice."""
+        from ..core.lazy import reset_serve_programs
+
+        self.uninstall_preemption_handler()
+        reset_serve_programs(owner=self._uid)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter shutdown — caches are going away anyway
+
+    # -- introspection ---------------------------------------------------
+    def reset_stats(self):
+        """Drop the latency samples (e.g. after a warm-up window, so
+        steady-state percentiles don't average in compile time). Counters
+        in dispatch_counters() reset separately; pool peak occupancy is
+        lifetime."""
+        self._token_lat_ms.clear()
+        self._decode_rows = 0
+
+    def stats(self) -> Dict[str, Any]:
+        from ..core.lazy import serve_capture_state
+
+        lat = np.asarray(self._token_lat_ms, np.float64)
+        out = {
+            "completed": self._n_completed,
+            "rejected": self._n_rejected,
+            "errors": self._n_errors,
+            "pending": len(self._queue) + len(self._active),
+            "pool_blocks": self._pool.num_blocks,
+            "pool_occupancy": round(self._pool.occupancy(), 4),
+            "pool_peak_occupancy": round(self._pool.peak_occupancy, 4),
+            "token_lat_p50_ms": (
+                round(float(np.percentile(lat, 50)), 3) if lat.size else None),
+            "token_lat_p99_ms": (
+                round(float(np.percentile(lat, 99)), 3) if lat.size else None),
+            "capture": serve_capture_state(),
+        }
+        if self._pool_plan is not None:
+            out["est_decode_peak_hbm_mb"] = round(
+                self._pool_plan.est_peak_hbm_mb, 2)
+            out["pool_overhead_mb"] = round(
+                self._pool_plan.overhead_bytes / 2**20, 2)
+        return out
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _reject(self, req: Request, why: str):
+        from ..core import dispatch
+
+        dispatch._counters["serve_requests_rejected"] += 1
+        self._n_rejected += 1
+        self._responses[req.request_id] = Response(
+            request_id=req.request_id, status="rejected", error=why,
+            prompt_len=int(req.prompt.size), submit_time=req.submit_time,
+        )
+
+    def _error(self, req: Request, why: str, seq: Optional[Sequence] = None):
+        self._n_errors += 1
+        self._responses[req.request_id] = Response(
+            request_id=req.request_id, status="error", error=why,
+            tokens=list(seq.tokens) if seq is not None else [],
+            prompt_len=int(req.prompt.size), submit_time=req.submit_time,
+            done_time=time.time(),
+        )
+
+    def _complete(self, seq: Sequence):
+        from ..core import dispatch
+
+        self._active.remove(seq)
+        self._pool.free(seq.blocks)
+        dispatch._counters["serve_requests_completed"] += 1
+        self._n_completed += 1
+        self._responses[seq.req.request_id] = Response(
+            request_id=seq.req.request_id, status="ok",
+            tokens=list(seq.tokens), prompt_len=int(seq.req.prompt.size),
+            submit_time=seq.req.submit_time,
+            first_token_time=getattr(seq.req, "_first_token_time", None),
+            done_time=time.time(),
+            logits=list(seq.logits) if self._keep_logits else None,
+        )
+
+    def _requeue_seq(self, seq: Sequence, err: BaseException):
+        """Tear one sequence down and re-run it from its prompt (greedy
+        decode is deterministic — the re-run reproduces the same tokens).
+        Past the retry budget, the request gets an error response."""
+        from ..core import dispatch
+
+        if seq in self._active:
+            self._active.remove(seq)
+        self._pool.free(seq.blocks)
+        req = seq.req
+        req.retries += 1
+        if req.retries > int(flags.flag("serving_request_retries")):
+            self._error(req,
+                        f"failed after {req.retries - 1} retries: {err}", seq)
+            return
+        dispatch._counters["serve_request_requeues"] += 1
+        self._queue.push_front(req)
+
+    def _recover_pools(self, err: _PoolsConsumed):
+        """A real fault escaped the donated rung: the pool buffers may be
+        consumed. Rebuild the storage and restart every in-flight
+        sequence."""
+        self._pool.reset_storage()
+        for seq in list(self._active):
+            self._requeue_seq(seq, err.cause)
+
+    def _admit(self):
+        from ..models.gpt import CacheOverflow
+
+        while True:
+            req = self._queue.peek()
+            if req is None:
+                return
+            n_blk = self._buckets.ctx_blocks(
+                int(req.prompt.size), req.max_new_tokens)
+            try:
+                blocks = self._pool.alloc(n_blk)
+            except CacheOverflow as e:
+                from ..core import dispatch
+
+                self._queue.pop()
+                dispatch._counters["serve_admission_refusals"] += 1
+                self._reject(req, str(e))
+                continue
+            if blocks is None:
+                return  # backpressure: wait for a completion to free blocks
+            self._queue.pop()
+            seq = Sequence(req, blocks, n_blk)
+            try:
+                self._prefill(seq)
+            except _PoolsConsumed as e:
+                self._active.append(seq)  # so recovery requeues it too
+                self._recover_pools(e)
+                return
+            except Exception as e:  # tiers exhausted — requeue just this one
+                self._requeue_seq(seq, e)
+                return
+
+    def _prefill(self, seq: Sequence):
+        from ..core import dispatch
+
+        req = seq.req
+        plen = int(req.prompt.size)
+        padded = self._buckets.pad_prompt(req.prompt)
+        P = int(padded.shape[-1])
+        args = (
+            tuple(self._pool.k), tuple(self._pool.v),
+            jnp.asarray(np.asarray([seq.table_row()], np.int32)),
+            jnp.asarray(padded[None, :].astype(np.int64)),
+            jnp.asarray(np.asarray([plen], np.int32)),
+        )
+        key = ("prefill", self._uid, P, seq.n_blk)
+        t0 = time.perf_counter()
+        k_pools, v_pools, row, nxt = self._run_tiered(
+            "prefill", key, self._prefill_fn, args)
+        self._pool.k, self._pool.v = list(k_pools), list(v_pools)
+        tok = int(np.asarray(jax.device_get(nxt))[0])
+        dispatch._counters["serve_prefills"] += 1
+        self._token_lat_ms.append((time.perf_counter() - t0) * 1000.0)
+        seq.length = plen
+        seq.tokens.append(tok)
+        seq.last_token = tok
+        req._first_token_time = time.time()
+        if self._keep_logits:
+            seq.logits.append(np.asarray(jax.device_get(row))[0])
+        self._active.append(seq)
+        if seq.done:
+            self._complete(seq)
+
+    def _decode_batch(self, seqs: List[Sequence], n_blk: int) -> bool:
+        """One decode step for one batch. Returns False only when a real
+        fault forced a pool rebuild (the caller must abort its group
+        snapshot for this tick)."""
+        from ..core import dispatch
+        from ..models.gpt import CacheOverflow
+
+        # sequences at context capacity can't take another token — finish
+        # them with what they have rather than corrupting a neighbor block
+        ready = []
+        for s in seqs:
+            if s.length + 1 > s.n_blk * self._block_size:
+                self._active.remove(s)
+                self._pool.free(s.blocks)
+                self._error(
+                    s.req,
+                    str(CacheOverflow(s.length + 1,
+                                      s.n_blk * self._block_size)),
+                    s,
+                )
+            else:
+                ready.append(s)
+        if not ready:
+            return True
+        B = self._buckets.batch_bucket(len(ready))
+        rows = [s.table_row() for s in ready]
+        lens = [s.length for s in ready]
+        toks = [s.last_token for s in ready]
+        for slot in range(len(ready), B):  # pad rows → per-slot scratch block
+            rows.append([slot] * n_blk)
+            lens.append(0)
+            toks.append(0)
+        args = (
+            tuple(self._pool.k), tuple(self._pool.v),
+            jnp.asarray(np.asarray(rows, np.int32)),
+            jnp.asarray(np.asarray(lens, np.int32)),
+            jnp.asarray(np.asarray(toks, np.int32)),
+        )
+        key = ("decode", self._uid, B, n_blk)
+        t0 = time.perf_counter()
+        try:
+            k_pools, v_pools, row, nxt = self._run_tiered(
+                "decode", key, self._decode_fn, args)
+        except _PoolsConsumed as e:
+            self._recover_pools(e)
+            return False
+        except Exception as e:  # every tier failed — requeue this batch only
+            for s in ready:
+                self._requeue_seq(s, e)
+            return True
+        self._pool.k, self._pool.v = list(k_pools), list(v_pools)
+        out = np.asarray(jax.device_get(nxt))
+        row_np = (
+            np.asarray(jax.device_get(row)) if self._keep_logits else None)
+        step_ms = (time.perf_counter() - t0) * 1000.0
+        dispatch._counters["serve_decode_steps"] += 1
+        self._decode_rows += len(ready)
+        for i, s in enumerate(ready):
+            tok = int(out[i])
+            s.length += 1
+            s.tokens.append(tok)
+            s.last_token = tok
+            if row_np is not None:
+                s.logits.append(row_np[i])
+            self._token_lat_ms.append(step_ms)
+            if s.done:
+                self._complete(s)
+        return True
+
+    def _run_tiered(self, kind: str, key, fn, args):
+        """captured (donated) → lazy (same program, no donation) → per-op."""
+        from ..core import dispatch
+        from ..core import lazy as _lazy
+        from ..resilience import faults as _faults
+        from ..resilience import runtime as _rt
+
+        if not flags.flag("serving_capture"):
+            return _rt.execute(kind, lambda: fn(*args))
+        donate = bool(flags.flag("serving_capture_donate"))
+        prog = _lazy.serve_program(key, fn, donate_argnums=(0, 1))
+        if donate and _rt.captured_tier_ok(key):
+            try:
+                return _rt.execute(
+                    kind, lambda: prog.run(args, donate=True),
+                    fresh=not prog.built(True), ladder_key=key,
+                    retry_unsafe=True,
+                )
+            except Exception as e:
+                dispatch._counters["serve_capture_fallbacks"] += 1
+                if not isinstance(e, _faults.InjectedFault):
+                    # the donated program may have consumed the pool before
+                    # failing — never reuse those buffers
+                    raise _PoolsConsumed(e)
+                # injected faults raise BEFORE the program runs: inputs are
+                # intact, take the retry-safe rung with the same buffers
+        try:
+            return _rt.execute(
+                kind, lambda: prog.run(args, donate=False),
+                fresh=not prog.built(False), ladder_key=key,
+            )
+        except Exception:
+            # the non-donated rung never consumed its inputs, so the floor
+            # is safe for injected AND real faults alike (a fused-program-
+            # only flake completes per-op; a deterministic bug fails again
+            # below and propagates to the requeue/error path)
+            dispatch._counters["serve_capture_fallbacks"] += 1
+        # ladder floor: plain eager — every op is its own resilience site
+        return fn(*args)

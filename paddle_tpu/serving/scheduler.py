@@ -1,0 +1,220 @@
+"""Request queue + shape-bucketed continuous-batching scheduler state.
+
+Under XLA every distinct shape is a compilation, so the scheduler's whole
+job is to funnel arbitrary traffic into a SMALL set of program signatures
+(the ``io/bucketing.py`` padding-policy idiom, applied twice):
+
+  - prompts pad up to a prompt-length bucket → one cached prefill program
+    per (prompt bucket, context bucket);
+  - each decode step pads its active-sequence batch up to a batch-size
+    bucket → one captured decode program per (batch bucket, context
+    bucket), idle rows pointed at per-slot scratch blocks.
+
+Admission is planner-budgeted: a request whose context chain can never fit
+the block pool is REJECTED up front (``CacheOverflow`` → an error response,
+not a dead engine), and a request that merely has to wait for free blocks
+queues — continuous batching refills decode slots as sequences complete.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import flags
+from ..io.bucketing import BucketSpec
+
+__all__ = ["Request", "Response", "RequestQueue", "ServingBuckets"]
+
+_REQUEST_IDS = itertools.count(1)
+
+
+@dataclass
+class Request:
+    """One generation request: a prompt and its decode limits."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    request_id: int = field(default_factory=lambda: next(_REQUEST_IDS))
+    submit_time: float = field(default_factory=time.time)
+    # times the engine has torn this request down and re-enqueued it after
+    # a non-recoverable fault (bounded by FLAGS_serving_request_retries)
+    retries: int = 0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int64).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(self.max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+@dataclass
+class Response:
+    """The engine's answer. ``status`` is one of:
+
+    - ``"ok"``        every requested token generated (or EOS hit)
+    - ``"rejected"``  refused at admission (budget overflow / draining)
+    - ``"error"``     accepted but failed after the retry budget
+
+    A request is NEVER silently dropped: every submitted request gets
+    exactly one Response (the chaos serve gate fails otherwise)."""
+
+    request_id: int
+    status: str
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+    prompt_len: int = 0
+    # wall-clock timing (seconds since epoch): submit → first token → done
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    done_time: Optional[float] = None
+    # per-generated-token logits rows ([vocab] float arrays) when the
+    # engine runs with keep_logits=True (parity tests / debugging)
+    logits: Optional[List[np.ndarray]] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.submit_time) * 1000.0
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        if self.done_time is None:
+            return None
+        return (self.done_time - self.submit_time) * 1000.0
+
+
+class RequestQueue:
+    """FIFO admission queue. Single-threaded engines drive it directly;
+    ``submit`` is safe to call from a signal handler (deque.append is
+    atomic)."""
+
+    def __init__(self):
+        self._q: deque = deque()
+
+    def push(self, req: Request):
+        self._q.append(req)
+
+    def push_front(self, req: Request):
+        self._q.appendleft(req)
+
+    def peek(self) -> Optional[Request]:
+        return self._q[0] if self._q else None
+
+    def pop(self) -> Optional[Request]:
+        return self._q.popleft() if self._q else None
+
+    def __len__(self):
+        return len(self._q)
+
+    def __bool__(self):
+        return bool(self._q)
+
+
+def _validate_buckets(out: List[int], origin) -> List[int]:
+    if not out or sorted(out) != out or any(b <= 0 for b in out):
+        raise ValueError(
+            f"bucket list {origin!r} must be ascending positive ints")
+    return out
+
+
+def _parse_buckets(text: str) -> List[int]:
+    out = [int(t) for t in str(text).split(",") if t.strip()]
+    return _validate_buckets(out, text)
+
+
+class ServingBuckets:
+    """Both bucket tables plus the context arithmetic, validated against the
+    block size once at engine construction."""
+
+    def __init__(self, *, block_size: int,
+                 prompt_buckets: Optional[List[int]] = None,
+                 decode_batch_buckets: Optional[List[int]] = None):
+        self.block_size = int(block_size)
+        pb = (_validate_buckets([int(b) for b in prompt_buckets],
+                                prompt_buckets)
+              if prompt_buckets is not None
+              else _parse_buckets(flags.flag("serving_prompt_buckets")))
+        for b in pb:
+            if b % self.block_size != 0:
+                raise ValueError(
+                    f"prompt bucket {b} is not a multiple of "
+                    f"FLAGS_serving_block_size={self.block_size}"
+                )
+        # BucketSpec gives the rounding rule AND the recompile-budget
+        # warning (each distinct padded shape is one compiled prefill)
+        self.prompt_spec = BucketSpec(boundaries=pb, axis=-1, pad_value=0)
+        db = (_validate_buckets([int(b) for b in decode_batch_buckets],
+                                decode_batch_buckets)
+              if decode_batch_buckets is not None
+              else _parse_buckets(flags.flag("serving_decode_batch_buckets")))
+        self.decode_batch_buckets = db
+
+    @property
+    def max_decode_batch(self) -> int:
+        return self.decode_batch_buckets[-1]
+
+    def prompt_bucket(self, length: int) -> int:
+        return self.prompt_spec.bucket_for(int(length))
+
+    def batch_bucket(self, n: int) -> int:
+        for b in self.decode_batch_buckets:
+            if n <= b:
+                return b
+        return self.decode_batch_buckets[-1]
+
+    def ctx_blocks(self, prompt_len: int, max_new: int) -> int:
+        """Logical blocks a sequence needs for its whole life: the padded
+        prompt plus every token it may generate, rounded up to blocks."""
+        ctx = self.prompt_bucket(prompt_len) + int(max_new)
+        return -(-ctx // self.block_size)
+
+    def pad_prompt(self, prompt: np.ndarray) -> np.ndarray:
+        return self.prompt_spec.pad(np.asarray(prompt, np.int64))
+
+
+class Sequence:
+    """One admitted, in-flight generation."""
+
+    __slots__ = ("req", "blocks", "n_blk", "length", "tokens", "last_token",
+                 "logits")
+
+    def __init__(self, req: Request, blocks: List[int], n_blk: int):
+        self.req = req
+        self.blocks = blocks
+        self.n_blk = int(n_blk)
+        self.length = 0          # tokens currently cached (post-prefill)
+        self.tokens: List[int] = []
+        self.last_token: int = 0
+        self.logits: List[np.ndarray] = []
+
+    @property
+    def done(self) -> bool:
+        if len(self.tokens) >= self.req.max_new_tokens:
+            return True
+        eos = self.req.eos_token_id
+        return eos is not None and bool(self.tokens) and self.tokens[-1] == eos
+
+    def table_row(self) -> List[int]:
+        return list(self.blocks)
+
+
+def group_for_decode(active: List[Sequence]) -> Dict[int, List[Sequence]]:
+    """Continuous batching: bucket the active set by context width (table
+    shape) — each group decodes as one padded batch per step."""
+    groups: Dict[int, List[Sequence]] = {}
+    for s in active:
+        groups.setdefault(s.n_blk, []).append(s)
+    return groups

@@ -40,6 +40,7 @@ SUBMODULES = [
     "vision.models",
     "vision.ops",
     "inference",
+    "serving",
     "device",
     "profiler",
     "resilience",

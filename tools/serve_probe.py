@@ -1,0 +1,193 @@
+"""Serve probe: the paddle.serving engine under a canned chaos plan.
+
+The CI-facing proof of the ISSUE-7 acceptance criterion (wired like
+tools/chaos_probe.py: tests/test_serving.py runs this CLI and CI fails on a
+nonzero exit): a scripted request mix must complete EVERY request — with
+token output identical to the fault-free fixed-shape reference — under
+
+  parity        fault-free serve vs per-request model.generate()
+  faults        injected transient execute faults at p=0.2 (retry recovery)
+  storm         guaranteed per-step decode faults exhausting the retry
+                budget: the ladder demotes the bucket captured→lazy(→per-op)
+                and every request still completes with the same tokens
+  sigterm       SIGTERM mid-serve → drain: everything already submitted
+                completes, new submissions are rejected, nothing drops
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/serve_probe.py [--requests 6] [--max-new 8]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.profiler as prof  # noqa: E402
+import paddle_tpu.resilience as res  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+
+VOCAB = 64
+
+
+def _build(seed=7):
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _mix(n):
+    rng = np.random.default_rng(11)
+    lens = [8, 16, 5, 8, 12, 16]
+    return [rng.integers(1, VOCAB, lens[i % len(lens)]) for i in range(n)]
+
+
+def _engine(model):
+    return serving.Engine(model, serving.ServingConfig(
+        block_size=8, prompt_buckets=[8, 16], num_blocks=24))
+
+
+def _fresh(spec=""):
+    from paddle_tpu.core.lazy import reset_serve_programs
+
+    res.reset()
+    prof.reset_dispatch_counters()
+    reset_serve_programs()
+    paddle.set_flags({"FLAGS_fault_inject": spec,
+                      "FLAGS_retry_backoff_ms": 0.5})
+
+
+def _tokens(resps):
+    return [list(r.tokens) for r in resps]
+
+
+def scenario_parity(model, prompts, max_new, results):
+    _fresh()
+    eng = _engine(model)
+    resps = eng.serve(prompts, max_new_tokens=max_new)
+    ref = []
+    for p in prompts:
+        out = model.generate(
+            paddle.to_tensor(np.asarray(p, np.int64)[None, :]),
+            max_new_tokens=max_new,
+        ).numpy()[0, len(p):]
+        ref.append([int(t) for t in out])
+    ok = all(r.ok for r in resps) and _tokens(resps) == ref
+    results.append({"scenario": "parity", "ok": ok,
+                    "requests": len(prompts),
+                    "completed": sum(r.ok for r in resps)})
+    return _tokens(resps)
+
+
+def scenario_faults(model, prompts, max_new, clean, results):
+    _fresh("execute:p=0.2")
+    eng = _engine(model)
+    resps = eng.serve(prompts, max_new_tokens=max_new)
+    c = prof.dispatch_counters()
+    ok = (all(r.ok for r in resps) and _tokens(resps) == clean
+          and c["serve_requests_dropped"] == 0)
+    results.append({
+        "scenario": "faults/p=0.2", "ok": ok,
+        "injected": c["injected_faults"], "retries": c["retry_attempts"],
+        "fallbacks": c["serve_capture_fallbacks"],
+        "dropped": c["serve_requests_dropped"],
+    })
+
+
+def scenario_storm(model, prompts, max_new, clean, results):
+    _fresh("execute:p=1:x=3:decode")
+    eng = _engine(model)
+    resps = eng.serve(prompts, max_new_tokens=max_new)
+    c = prof.dispatch_counters()
+    ok = (all(r.ok for r in resps) and _tokens(resps) == clean
+          and c["serve_capture_fallbacks"] > 0
+          and c["serve_requests_dropped"] == 0)
+    results.append({
+        "scenario": "storm/decode", "ok": ok,
+        "fallbacks": c["serve_capture_fallbacks"],
+        "demotions": c["ladder_demotions"],
+        "retry_exhausted": c["retry_exhausted"],
+        "dropped": c["serve_requests_dropped"],
+    })
+
+
+def scenario_sigterm(model, prompts, max_new, clean, results):
+    """SIGTERM lands mid-serve (a timer thread signals our own pid): the
+    installed handler flips the engine into drain mode — every request
+    submitted BEFORE the signal completes with the right tokens, a request
+    submitted after is rejected, zero drops."""
+    _fresh()
+    eng = _engine(model)
+    eng.install_preemption_handler()
+    late_status = {}
+    try:
+        ids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.step()  # prefills + first decode step in flight
+        killer = threading.Timer(
+            0.01, lambda: os.kill(os.getpid(), signal.SIGTERM))
+        killer.start()
+        killer.join()
+        eng.run_until_idle()  # the drain
+        late = eng.submit(prompts[0], max_new_tokens=max_new)
+        late_status["late"] = eng.response(late).status
+        resps = [eng.response(i) for i in ids]
+    finally:
+        eng.uninstall_preemption_handler()
+    c = prof.dispatch_counters()
+    ok = (all(r is not None and r.ok for r in resps)
+          and _tokens(resps) == clean
+          and late_status.get("late") == "rejected"
+          and c["serve_preempt_drains"] >= 1
+          and c["serve_requests_dropped"] == 0)
+    results.append({
+        "scenario": "sigterm-drain", "ok": ok,
+        "drains": c["serve_preempt_drains"],
+        "late_submit": late_status.get("late"),
+        "dropped": c["serve_requests_dropped"],
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--max-new", type=int, default=8)
+    args = ap.parse_args()
+
+    model = _build()
+    prompts = _mix(args.requests)
+    results = []
+    clean = scenario_parity(model, prompts, args.max_new, results)
+    scenario_faults(model, prompts, args.max_new, clean, results)
+    scenario_storm(model, prompts, args.max_new, clean, results)
+    scenario_sigterm(model, prompts, args.max_new, clean, results)
+    _fresh()
+
+    for r in results:
+        print(json.dumps(r))
+    if all(r["ok"] for r in results):
+        print("ALL SCENARIOS PASSED")
+        return 0
+    print("SCENARIO FAILURES:", [r["scenario"] for r in results if not r["ok"]])
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
