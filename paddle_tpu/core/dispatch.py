@@ -138,6 +138,22 @@ def reset_dispatch_counters():
         segment_per_op_fallbacks=0,
         preemptions=0,
         emergency_saves=0,
+        # checkpoint pipeline (distributed/checkpoint.py): boundary device
+        # snapshots, async vs sync persists, emergency saves that joined an
+        # in-flight persist instead of redoing it, and the per-phase time
+        # split (snapshot is the only step-path cost; transfer + commit run
+        # on the background persist thread). ckpt_auto_save_freq is a gauge:
+        # the cadence tuner's current save frequency.
+        ckpt_snapshots=0,
+        ckpt_async_saves=0,
+        ckpt_sync_saves=0,
+        ckpt_emergency_joined_inflight=0,
+        ckpt_snapshot_ms=0.0,
+        ckpt_transfer_ms=0.0,
+        ckpt_commit_ms=0.0,
+        ckpt_pipeline_stall_ms=0.0,
+        ckpt_cadence_retunes=0,
+        ckpt_auto_save_freq=0,
         # serving runtime (paddle.serving): decode-mode capture builds /
         # replays / tier fallbacks / LRU evictions, engine step + admission
         # accounting (serve_requests_dropped must stay 0 — the chaos serve

@@ -11,12 +11,17 @@ from the param specs (the converter.py role).
 """
 from __future__ import annotations
 
+import math
 import os
-from typing import Any, Dict, Optional
+import threading
+import time
+from typing import Any, Dict, Optional, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor
 
@@ -31,6 +36,8 @@ __all__ = [
     "save_state_dict",
     "load_state_dict",
     "AsyncCheckpointer",
+    "CadenceTuner",
+    "CheckpointCadence",
     "TrainingState",
     "restore_training_state",
     "train_epoch_range",
@@ -49,10 +56,74 @@ def _ckpt_io(thunk):
     return _rrt.execute("checkpoint", thunk)
 
 
+def _counters():
+    from ..core import dispatch
+
+    return dispatch._counters
+
+
 def _to_arrays(state_dict: Dict[str, Any]):
     return {
         k: (v._value if isinstance(v, Tensor) else v) for k, v in state_dict.items()
     }
+
+
+# ---------------------------------------------------------------------------
+# Snapshot phase (CheckFreq two-phase discipline, phase 1): a cheap
+# ON-DEVICE copy of every buffer at the step boundary. The copy must exist
+# before the next step runs — under whole-step capture the params and
+# optimizer accumulators are DONATED to the next captured program, which
+# invalidates the live buffers; a deferred host read would race it. One
+# jitted copy program per state structure (jax caches by pytree/avals).
+# ---------------------------------------------------------------------------
+@jax.jit
+def _copy_tree(arrays):
+    return jax.tree_util.tree_map(jnp.copy, arrays)
+
+
+def _device_snapshot(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Boundary snapshot: bitwise the state at the moment of the call,
+    immune to later in-place donation/mutation of the live buffers."""
+    if hasattr(state_dict, "refresh"):
+        state_dict.refresh()  # TrainingState: re-snapshot optimizer moments
+    from ..core import lazy
+
+    # resolve pending lazy/captured work so the snapshot sees the committed
+    # step-boundary values, not a half-flushed segment
+    lazy.flush_if_pending("checkpoint_snapshot")
+    arrays, other = {}, {}
+    for k, v in state_dict.items():
+        val = v._value if isinstance(v, Tensor) else v
+        if isinstance(val, jax.Array):
+            arrays[k] = val
+        elif isinstance(val, np.ndarray):
+            # host array: plain copy — routing it through the jitted copy
+            # would silently downcast int64/float64 under x64-disabled jax
+            other[k] = val.copy()
+        else:
+            other[k] = val
+    copied = _copy_tree(arrays) if arrays else {}
+    jax.block_until_ready(copied)
+    copied = dict(copied)
+    copied.update(other)
+    return copied
+
+
+class _SaveJob:
+    """One in-flight persist: the boundary snapshot plus completion state."""
+
+    __slots__ = ("step", "snapshot", "tuner", "profiling", "done", "error",
+                 "thread")
+
+    def __init__(self, step: int, snapshot: Dict[str, Any], tuner=None,
+                 profiling: bool = False):
+        self.step = step
+        self.snapshot = snapshot
+        self.tuner = tuner
+        self.profiling = profiling  # first save: costs are one-time, dropped
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = False):
@@ -108,7 +179,17 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, mesh=None):
 class AsyncCheckpointer:
     """Async sharded checkpoint manager with retention (keeps training
     stepping while the previous snapshot flushes — the reference's
-    checkpoint_saver.py + HDFS push, minus the filesystem zoo)."""
+    checkpoint_saver.py + HDFS push, minus the filesystem zoo).
+
+    CheckFreq pipeline (FLAGS_ckpt_async, default on): `save()` pays only a
+    fast on-device boundary snapshot on the step path; the device→host
+    transfer, serialization, and two-phase commit run on a background
+    thread overlapping the following steps. The pipeline is single-slot —
+    a new save first joins the previous in-flight persist (the stall, if
+    any, is counted as checkpoint overhead), so commits land in step order
+    and the LATEST pointer can never name a partially-persisted snapshot.
+    Set `tuner` to a CadenceTuner to feed it measured snapshot/persist
+    costs (save_freq="auto" wiring does this automatically)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
@@ -123,6 +204,13 @@ class AsyncCheckpointer:
         else:
             self._mgr = None
         self.max_to_keep = max_to_keep
+        self.tuner: Optional["CadenceTuner"] = None
+        self._inflight: Optional[_SaveJob] = None
+        self._last_error: Optional[BaseException] = None
+        # serializes every commit (background persist, sync save, emergency
+        # save): concurrent writers must never interleave payload renames
+        # with LATEST pointer updates
+        self._commit_lock = threading.Lock()
 
     # -- crash-consistent commit protocol (fallback backend) ----------------
     # 1. payload → hidden temp file; 2. atomic rename to the numeric name;
@@ -131,6 +219,12 @@ class AsyncCheckpointer:
     # untouched) or the new complete one — never a corrupt "latest".
     # Orbax runs its own equivalent temp-dir + rename commit.
     def _write_latest(self, step: int):
+        # commit order == step order by construction: the single-slot
+        # pipeline joins the previous persist before starting the next, and
+        # every commit (background, sync, emergency) holds _commit_lock —
+        # so an unconditional pointer write can never move backwards within
+        # a run, and a REUSED directory's stale pointer is overwritten
+        # rather than pinning the old run's snapshot
         tmp = os.path.join(self.directory, f".{_LATEST}.tmp.{os.getpid()}")
         with open(tmp, "w") as f:
             f.write(str(step))
@@ -154,30 +248,151 @@ class AsyncCheckpointer:
                 except OSError:
                     pass
 
-    def save(self, step: int, state_dict: Dict[str, Any]):
-        if hasattr(state_dict, "refresh"):
-            state_dict.refresh()  # TrainingState: re-snapshot moments
-        if self._mgr is not None:
-            arrays = _to_arrays(state_dict)
-            _ckpt_io(lambda: self._mgr.save(step, args=ocp.args.StandardSave(arrays)))
-            return
-        from ..framework.io_utils import save as _save
-        from ..resilience import faults as _faults
+    # -- persist phase (CheckFreq phase 2: transfer + serialize + commit) ---
+    def _persist(self, job: _SaveJob):
+        c = _counters()
+        try:
+            t0 = time.perf_counter()
+            if self._mgr is not None:
+                # orbax gets the DEVICE arrays: each host writes only its
+                # local shards (gathering to numpy here would break — or
+                # silently unshard — multi-host sharded saves); the
+                # device→host transfer happens inside orbax's commit, so
+                # ckpt_transfer_ms stays 0 on this backend
+                t1 = t0
 
-        def _commit():
-            final = os.path.join(self.directory, str(step))
-            tmp = os.path.join(self.directory, f".snap.{step}.{os.getpid()}")
-            _save(state_dict, tmp)
-            # chaos harness kill point: snapshot bytes written but not yet
-            # committed — a kill here must leave the previous LATEST intact
-            _faults.maybe_kill("checkpoint")
-            os.replace(tmp, final)
-            self._retain()
-            self._write_latest(step)
+                def _commit_orbax():
+                    with self._commit_lock:
+                        self._mgr.save(
+                            job.step,
+                            args=ocp.args.StandardSave(job.snapshot),
+                        )
+                        self._mgr.wait_until_finished()
 
-        _ckpt_io(_commit)
+                _ckpt_io(_commit_orbax)
+            else:
+                host = {
+                    k: (np.asarray(v) if isinstance(v, jax.Array) else v)
+                    for k, v in job.snapshot.items()
+                }
+                t1 = time.perf_counter()
+                c["ckpt_transfer_ms"] += (t1 - t0) * 1000.0
+                from ..framework.io_utils import save as _save
+                from ..resilience import faults as _faults
+
+                def _commit():
+                    final = os.path.join(self.directory, str(job.step))
+                    tmp = os.path.join(
+                        self.directory, f".snap.{job.step}.{os.getpid()}"
+                    )
+                    with self._commit_lock:
+                        _save(host, tmp)
+                        # chaos harness kill point: snapshot bytes written
+                        # but not yet committed — a kill here must leave the
+                        # previous LATEST intact
+                        _faults.maybe_kill("checkpoint")
+                        os.replace(tmp, final)
+                        self._retain()
+                        self._write_latest(job.step)
+
+                _ckpt_io(_commit)
+            t2 = time.perf_counter()
+            c["ckpt_commit_ms"] += (t2 - t1) * 1000.0
+            if job.tuner is not None:
+                job.tuner.observe_persist((t2 - t0) * 1000.0,
+                                          profiling=job.profiling)
+        except BaseException as e:  # re-raised at the next join/wait
+            job.error = e
+        finally:
+            job.done.set()
+
+    def _join_inflight(self, reraise: bool = True,
+                       count_stall: bool = True) -> float:
+        """Wait out the in-flight persist; returns the stall in ms. A
+        persist error surfaces here (or is parked on `last_error` when the
+        caller cannot raise, e.g. restore). `count_stall=False` for drains
+        that are not on the step path (wait/restore) — the stall counter
+        tracks training-time pipeline stalls only."""
+        job = self._inflight
+        if job is None:
+            return 0.0
+        t0 = time.perf_counter()
+        job.done.wait()
+        if job.thread is not None:
+            job.thread.join()
+        self._inflight = None
+        stall_ms = (time.perf_counter() - t0) * 1000.0
+        if count_stall:
+            _counters()["ckpt_pipeline_stall_ms"] += stall_ms
+        if job.error is not None:
+            self._last_error = job.error
+            if reraise:
+                raise job.error
+        return stall_ms
+
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        return self._last_error
+
+    def save(self, step: int, state_dict: Dict[str, Any],
+             blocking: Optional[bool] = None):
+        """Two-phase save: on-device boundary snapshot (step path), then
+        persist — in the background when FLAGS_ckpt_async is on and
+        `blocking` isn't forced, synchronously otherwise."""
+        if blocking is None:
+            blocking = not bool(flags.flag("ckpt_async"))
+        c = _counters()
+        stall_ms = self._join_inflight()  # single-slot pipeline
+        t0 = time.perf_counter()
+        snapshot = _device_snapshot(state_dict)
+        snap_ms = (time.perf_counter() - t0) * 1000.0
+        c["ckpt_snapshots"] += 1
+        c["ckpt_snapshot_ms"] += snap_ms
+        tuner = self.tuner
+        profiling = tuner is not None and not tuner._profiled
+        if tuner is not None:
+            # the step path paid the snapshot plus any pipeline stall
+            tuner.observe_snapshot(snap_ms, stall_ms)
+        job = _SaveJob(step, snapshot, tuner, profiling=profiling)
+        if blocking:
+            c["ckpt_sync_saves"] += 1
+            self._persist(job)
+            if job.error is not None:
+                self._last_error = job.error
+                raise job.error
+        else:
+            c["ckpt_async_saves"] += 1
+            job.thread = threading.Thread(
+                target=self._persist, args=(job,), daemon=True,
+                name=f"ckpt-persist-{step}",
+            )
+            self._inflight = job
+            job.thread.start()
+
+    def emergency_save(self, step: int, state_dict: Dict[str, Any]):
+        """Preemption-path save: join an in-flight persist that already
+        covers this boundary instead of redoing it; supersede anything else
+        with a synchronous save. Commits stay serialized either way, so the
+        LATEST pointer can never name a partially-persisted snapshot."""
+        job = self._inflight
+        if job is not None and job.step == step:
+            try:
+                self._join_inflight()
+                _counters()["ckpt_emergency_joined_inflight"] += 1
+                return
+            except Exception:
+                pass  # persist failed — fall through to the sync save
+        # a stale failure from an EARLIER step's persist must not abort the
+        # emergency snapshot — the process is about to exit and this save
+        # is the last chance at durability; drain without re-raising
+        self._join_inflight(reraise=False, count_stall=False)
+        self.save(step, state_dict, blocking=True)
 
     def restore_latest(self, state_dict: Dict[str, Any]) -> Optional[int]:
+        # an in-flight persist may still be writing the newest snapshot;
+        # join it first (its failure must not fail the restore — the disk
+        # candidates below are the source of truth)
+        self._join_inflight(reraise=False, count_stall=False)
         if hasattr(state_dict, "refresh"):
             # TrainingState: materialize missing optimizer accumulators so
             # the restore template covers the saved moment entries
@@ -225,15 +440,224 @@ class AsyncCheckpointer:
         return None
 
     def wait(self):
+        """Block until every issued save is durably committed; re-raises a
+        background persist failure."""
+        self._join_inflight(count_stall=False)
         if self._mgr is not None:
             self._mgr.wait_until_finished()
 
 
-def _train_range(count: int, checkpointer, state_dict, save_freq: int,
+# ---------------------------------------------------------------------------
+# CheckFreq auto-tuned cadence: pick save_freq so measured checkpoint
+# overhead stays under the FLAGS_ckpt_overhead_pct budget.
+#
+# Only the snapshot (plus any pipeline stall) runs on the step path, so per
+# checkpoint the training loop pays `snapshot_ms`; amortized over
+# `save_freq` steps of `step_ms` each, overhead = snapshot_ms /
+# (save_freq * step_ms). Solving for the budget:
+#
+#     save_freq >= snapshot_ms / (budget_frac * step_ms)
+#
+# A second constraint keeps the pipeline stall-free: the background persist
+# of one snapshot must finish before the next save joins it, i.e.
+# save_freq >= persist_ms / step_ms. The tuner takes the max of both,
+# clamped to [1, FLAGS_ckpt_cadence_max], and re-tunes when the step-time
+# EMA drifts more than FLAGS_ckpt_retune_pct from its value at the last
+# tune (e.g. a degradation-ladder demotion changed steady-state step time).
+#
+# Both constraints carry noise headroom: the EMAs predict MEAN costs, so a
+# cadence that lands exactly on a constraint in expectation violates it
+# whenever a GC pause stretches one snapshot (overhead past the budget) or
+# a few steps run faster than their EMA (the persist no longer fits and
+# the next save stalls joining it). _BUDGET_HEADROOM tunes to 80% of the
+# budget; _PIPELINE_HEADROOM schedules saves 1.25x the persist/step ratio
+# apart. Together they keep the REALIZED overhead (what the acceptance
+# gate measures) under the configured budget.
+# ---------------------------------------------------------------------------
+_BUDGET_HEADROOM = 0.8
+_PIPELINE_HEADROOM = 1.25
+class CadenceTuner:
+    """Measures steady-state step time + checkpoint costs and auto-tunes
+    the save frequency against an overhead budget (CheckFreq, FAST '21)."""
+
+    def __init__(self, overhead_pct: Optional[float] = None,
+                 warmup_steps: int = 3, ema_alpha: float = 0.25):
+        from ..profiler import StepTimer
+
+        self.overhead_pct = (
+            float(overhead_pct) if overhead_pct is not None
+            else float(flags.flag("ckpt_overhead_pct"))
+        )
+        self.warmup_steps = int(warmup_steps)
+        # ema_alpha governs the per-step time EMA; the sparser snapshot /
+        # persist cost EMAs use a fixed 0.5 per-save weight (see
+        # observe_snapshot)
+        self.timer = StepTimer(alpha=ema_alpha)
+        self.snapshot_ms: Optional[float] = None  # EMA of step-path cost
+        self.persist_ms: Optional[float] = None   # EMA of background persist
+        self.save_freq: Optional[int] = None
+        self.retunes = 0
+        self._since_save = 0
+        self._overhead_ms = 0.0
+        self._profiled = False  # first save = CheckFreq's profiling phase
+        self._lock = threading.Lock()  # persist times arrive off-thread
+
+    # -- observations -------------------------------------------------------
+    def observe_step(self, dt_s: float):
+        with self._lock:
+            self.timer.observe(dt_s)
+            if (self.save_freq is not None and self.timer.drift_pct()
+                    > float(flags.flag("ckpt_retune_pct"))):
+                self._retune(drift=True)
+
+    def observe_snapshot(self, snap_ms: float, stall_ms: float = 0.0):
+        """Step-path cost of one save. `snap_ms` (the intrinsic device
+        snapshot) feeds the cadence arithmetic; the pipeline stall only
+        counts as realized overhead — the persist-fits-between-saves
+        constraint is what eliminates it. The first save is the profiling
+        measurement: it pays the copy-program jit compile and backend
+        setup, one-time costs that would seed the EMA orders of magnitude
+        too high (and the cadence correspondingly too long) — it is
+        dropped entirely; the SECOND save, with warm caches, seeds the
+        steady-state costs. EMA weight is 0.5 per save: cost observations
+        are sparse (one per cadence interval), so they adapt fast."""
+        with self._lock:
+            if not self._profiled:
+                self._profiled = True  # profiling save: costs discarded
+                return
+            self._overhead_ms += snap_ms + stall_ms
+            self.snapshot_ms = (
+                snap_ms if self.snapshot_ms is None
+                else self.snapshot_ms + 0.5 * (snap_ms - self.snapshot_ms)
+            )
+            self._retune()
+
+    def observe_persist(self, ms: float, profiling: bool = False):
+        """Background transfer+serialize+commit duration (off-thread).
+        `profiling=True` marks the first save's persist (backend init,
+        one-time) — discarded like its snapshot."""
+        if profiling:
+            return
+        with self._lock:
+            self.persist_ms = (
+                ms if self.persist_ms is None
+                else self.persist_ms + 0.5 * (ms - self.persist_ms)
+            )
+            self._retune()
+
+    # -- policy -------------------------------------------------------------
+    def _retune(self, drift: bool = False):
+        step_ms = self.timer.ema_ms
+        # both costs must be measured before a frequency exists: tuning
+        # from the snapshot alone would schedule the next save before the
+        # (unknown, possibly much longer) persist can drain — a guaranteed
+        # pipeline stall on the step path
+        if not step_ms or self.snapshot_ms is None or self.persist_ms is None:
+            return
+        budget_frac = max(self.overhead_pct, 1e-6) / 100.0 * _BUDGET_HEADROOM
+        freq = math.ceil(self.snapshot_ms / (budget_frac * step_ms))
+        if self.persist_ms:
+            freq = max(freq, math.ceil(
+                self.persist_ms * _PIPELINE_HEADROOM / step_ms))
+        freq = max(1, min(freq, int(flags.flag("ckpt_cadence_max"))))
+        # `retunes` counts step-time-drift re-tunes (the ladder-demotion
+        # signal), not routine cost-EMA refinement between adjacent freqs
+        if drift and freq != self.save_freq:
+            self.retunes += 1
+            _counters()["ckpt_cadence_retunes"] += 1
+        self.save_freq = freq
+        self.timer.mark()
+        _counters()["ckpt_auto_save_freq"] = freq
+
+    def should_save(self) -> bool:
+        """Call once per step boundary (after observe_step)."""
+        with self._lock:
+            self._since_save += 1
+            if self.save_freq is None:
+                # bootstrap: one early save measures snapshot/persist cost;
+                # until then there is nothing to tune against
+                if self.timer.count >= self.warmup_steps:
+                    self._since_save = 0
+                    return True
+                return False
+            if self._since_save >= self.save_freq:
+                self._since_save = 0
+                return True
+            return False
+
+    def measured_overhead_pct(self) -> float:
+        """Realized step-path checkpoint overhead vs total compute."""
+        with self._lock:
+            if not self.timer.total_ms:
+                return 0.0
+            return self._overhead_ms / self.timer.total_ms * 100.0
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "budget_pct": self.overhead_pct,
+            "step_time_ms": round(self.timer.ema_ms or 0.0, 3),
+            "snapshot_ms": round(self.snapshot_ms or 0.0, 3),
+            "persist_ms": round(self.persist_ms or 0.0, 3),
+            "save_freq": self.save_freq,
+            "retunes": self.retunes,
+            "measured_overhead_pct": round(self.measured_overhead_pct(), 3),
+        }
+
+
+class CheckpointCadence:
+    """Boundary-save policy shared by train_step_range / train_epoch_range,
+    hapi `Model.fit` and the `ModelCheckpoint` callback: a fixed integer
+    `save_freq` (0 = never), or `"auto"` for CheckFreq cadence tuning under
+    the FLAGS_ckpt_overhead_pct budget."""
+
+    def __init__(self, checkpointer, state_dict,
+                 save_freq: Union[int, str, None]):
+        self.checkpointer = checkpointer
+        self.state_dict = state_dict
+        self.enabled = checkpointer is not None and state_dict is not None
+        self.tuner: Optional[CadenceTuner] = None
+        if isinstance(save_freq, str):
+            if save_freq != "auto":
+                raise ValueError(
+                    f"save_freq must be an int or 'auto', got {save_freq!r}"
+                )
+            self.save_freq: Union[int, str] = "auto"
+            if self.enabled:
+                self.tuner = CadenceTuner()
+                checkpointer.tuner = self.tuner
+        else:
+            self.save_freq = int(save_freq or 0)
+
+    def boundary(self, index: int, dt_s: float) -> bool:
+        """Step/epoch-boundary tick: feeds the tuner and fires the save
+        when the cadence says so. Returns True when a save was issued."""
+        if not self.enabled:
+            return False
+        if self.tuner is not None:
+            self.tuner.observe_step(dt_s)
+            if not self.tuner.should_save():
+                return False
+            inflight = getattr(self.checkpointer, "_inflight", None)
+            if (self.tuner.save_freq is None and inflight is not None
+                    and not inflight.done.is_set()):
+                # bootstrap: the profiling save's persist is still
+                # flushing — issuing the seeding save now would stall the
+                # step path joining it and poison the overhead account;
+                # wait for an idle pipeline (the seeding costs must be
+                # steady-state ones)
+                return False
+        elif not (self.save_freq and (index + 1) % self.save_freq == 0):
+            return False
+        self.checkpointer.save(index, self.state_dict)
+        return True
+
+
+def _train_range(count: int, checkpointer, state_dict, save_freq,
                  guard, optimizer):
-    """Shared restore → yield → boundary-check → periodic-save protocol
+    """Shared restore → yield → boundary-check → cadenced-save protocol
     behind train_epoch_range / train_step_range (they differ only in the
     granularity of `count` and the save_freq default)."""
+    cadence = CheckpointCadence(checkpointer, state_dict, save_freq)
     start = 0
     if checkpointer is not None and state_dict is not None:
         restored = checkpointer.restore_latest(state_dict)
@@ -245,21 +669,31 @@ def _train_range(count: int, checkpointer, state_dict, save_freq: int,
         guard.install()
     try:
         for i in range(start, count):
+            t0 = time.perf_counter()
             yield i
+            dt = time.perf_counter() - t0
             if guard is not None:
                 guard.step_boundary(i)  # raises Preempted after a signal
-            if (checkpointer is not None and state_dict is not None
-                    and save_freq and (i + 1) % save_freq == 0):
-                checkpointer.save(i, state_dict)
+            cadence.boundary(i, dt)
+        if checkpointer is not None:
+            checkpointer.wait()  # normal path: surface persist failures
     finally:
         if guard is not None:
             guard.uninstall()
-    if checkpointer is not None:
-        checkpointer.wait()
+        if checkpointer is not None:
+            # break/exception path: the last async save still runs on a
+            # daemon thread — drain it so the commit lands before the
+            # consumer moves on (swallow: a persist error must not mask
+            # the propagating exception / GeneratorExit)
+            try:
+                checkpointer.wait()
+            except Exception:
+                pass
 
 
 def train_epoch_range(max_epoch_num: int, checkpointer: Optional[AsyncCheckpointer] = None,
-                      state_dict: Optional[Dict] = None, save_freq: int = 1,
+                      state_dict: Optional[Dict] = None,
+                      save_freq: Union[int, str] = 1,
                       guard=None, optimizer=None):
     """reference: auto_checkpoint.py:598 train_epoch_range — a generator
     wrapping the epoch loop that restores the last epoch on (re)start and
@@ -277,7 +711,8 @@ def train_epoch_range(max_epoch_num: int, checkpointer: Optional[AsyncCheckpoint
 
 
 def train_step_range(max_steps: int, checkpointer: Optional[AsyncCheckpointer] = None,
-                     state_dict: Optional[Dict] = None, save_freq: int = 0,
+                     state_dict: Optional[Dict] = None,
+                     save_freq: Union[int, str] = 0,
                      guard=None, optimizer=None):
     """Step-granular, preemption-safe resume loop (paddle.resilience).
 
@@ -287,7 +722,11 @@ def train_step_range(max_steps: int, checkpointer: Optional[AsyncCheckpointer] =
     `Preempted` — a relaunch resumes at the next step, so at most the step
     that was in flight when the process actually died is lost (CheckFreq's
     bound, with frequency-based saves via `save_freq` as the crash
-    backstop). Pass `optimizer` to restore its accumulators from the
+    backstop). `save_freq="auto"` turns on CheckFreq cadence tuning: a
+    CadenceTuner measures steady-state step time and the per-save
+    snapshot/persist cost, then picks the frequency that keeps measured
+    checkpoint overhead under FLAGS_ckpt_overhead_pct, re-tuning when step
+    time drifts. Pass `optimizer` to restore its accumulators from the
     snapshot (see `training_state`)."""
     return _train_range(max_steps, checkpointer, state_dict, save_freq,
                         guard, optimizer)

@@ -94,7 +94,13 @@ class Model:
         callbacks=None,
         **kwargs,
     ):
-        """reference: model.py fit."""
+        """reference: model.py fit.
+
+        `save_dir` checkpoints through `paddle.distributed.checkpoint.
+        AsyncCheckpointer` (pipelined snapshot + background commit) every
+        `save_freq` epochs; `save_freq="auto"` tunes the cadence against
+        the FLAGS_ckpt_overhead_pct budget (CheckFreq). A classic
+        `final.pdparams`/`final.pdopt` pair is written at train end."""
         train_loader = (
             train_data
             if isinstance(train_data, DataLoader)
@@ -120,6 +126,19 @@ class Model:
                 "metrics": ["loss"] + [m.name() for m in self._metrics],
             }
         )
+        # periodic saving rides the shared checkpoint machinery via the
+        # ModelCheckpoint callback (paddle.distributed.checkpoint): async
+        # pipelined snapshots with retention + crash-consistent LATEST
+        # pointer instead of ad-hoc per-epoch file writes, save_freq="auto"
+        # gets the CheckFreq cadence tuner under the FLAGS_ckpt_overhead_pct
+        # budget, and a classic final.pdparams/.pdopt pair lands at train
+        # end for Model.load workflows
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+
+            ckpt_cb = ModelCheckpoint(save_freq=save_freq, save_dir=save_dir)
+            ckpt_cb.set_model(self)
+            cbks.append(ckpt_cb)
         self.stop_training = False  # stale stop from a previous fit()
         cbks.on_train_begin()
         for epoch in range(epochs):
@@ -139,8 +158,6 @@ class Model:
                 eval_logs = self.evaluate(eval_loader, verbose=0)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(os.path.join(save_dir, str(epoch)))
         cbks.on_train_end(logs if "logs" in dir() else {})
         return self
 

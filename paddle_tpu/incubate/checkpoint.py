@@ -12,11 +12,26 @@ budgets (the cross-process bus tests).
 """
 from __future__ import annotations
 
-__all__ = ["auto_checkpoint", "train_epoch_range", "AsyncCheckpointer"]
+__all__ = [
+    "auto_checkpoint",
+    "train_epoch_range",
+    "train_step_range",
+    "training_state",
+    "AsyncCheckpointer",
+    "CadenceTuner",
+]
+
+_FORWARDED = (
+    "train_epoch_range",
+    "train_step_range",
+    "training_state",
+    "AsyncCheckpointer",
+    "CadenceTuner",
+)
 
 
 def __getattr__(name):
-    if name in ("train_epoch_range", "AsyncCheckpointer"):
+    if name in _FORWARDED:
         from ..distributed import checkpoint as _ckpt
 
         return getattr(_ckpt, name)
@@ -25,5 +40,13 @@ def __getattr__(name):
 
         from ..distributed import checkpoint as _ckpt
 
-        return SimpleNamespace(train_epoch_range=_ckpt.train_epoch_range)
+        # the whole auto-checkpoint surface rides the one AsyncCheckpointer
+        # + cadence machinery (save_freq="auto" for the CheckFreq tuner)
+        return SimpleNamespace(
+            train_epoch_range=_ckpt.train_epoch_range,
+            train_step_range=_ckpt.train_step_range,
+            training_state=_ckpt.training_state,
+            AsyncCheckpointer=_ckpt.AsyncCheckpointer,
+            CadenceTuner=_ckpt.CadenceTuner,
+        )
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

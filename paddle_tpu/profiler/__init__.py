@@ -42,6 +42,7 @@ __all__ = [
     "dispatch_counters",
     "reset_dispatch_counters",
     "measure_programs",
+    "StepTimer",
 ]
 
 
@@ -304,6 +305,55 @@ benchmark_timer = _Timer()
 
 def benchmark():
     return benchmark_timer
+
+
+class StepTimer:
+    """Steady-state step-time tracker: an EMA over per-step wall time with
+    drift detection against the value at the last `mark()`.
+
+    The per-step companion of `measure_programs`' one-shot counters: callers
+    either bracket each step with `lap()` or feed measured durations to
+    `observe(dt_s)`. The checkpoint cadence tuner
+    (paddle.distributed.checkpoint.CadenceTuner) reads `ema_ms` for the
+    CheckFreq overhead arithmetic and `drift_pct()` to decide when a shifted
+    steady state (e.g. after a degradation-ladder demotion) warrants
+    re-tuning."""
+
+    def __init__(self, alpha: float = 0.25):
+        self.alpha = float(alpha)
+        self.ema_ms: Optional[float] = None
+        self.total_ms = 0.0
+        self.count = 0
+        self._marked_ms: Optional[float] = None
+        self._lap_t0: Optional[float] = None
+
+    def observe(self, dt_s: float):
+        ms = float(dt_s) * 1000.0
+        self.total_ms += ms
+        self.count += 1
+        if self.ema_ms is None:
+            self.ema_ms = ms
+        else:
+            self.ema_ms += self.alpha * (ms - self.ema_ms)
+        return self.ema_ms
+
+    def lap(self):
+        """Call once per step boundary; the first call only starts the
+        clock, each later call records the elapsed step."""
+        now = time.perf_counter()
+        if self._lap_t0 is not None:
+            self.observe(now - self._lap_t0)
+        self._lap_t0 = now
+
+    def mark(self):
+        """Remember the current EMA as the drift baseline."""
+        self._marked_ms = self.ema_ms
+
+    def drift_pct(self) -> float:
+        """Percent drift of the EMA from the value at the last mark()."""
+        if not self._marked_ms or self.ema_ms is None:
+            return 0.0
+        return abs(self.ema_ms - self._marked_ms) / self._marked_ms * 100.0
 
 
 def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):

@@ -111,7 +111,12 @@ class PreemptionGuard:
 
     # -- boundary protocol ---------------------------------------------------
     def emergency_save(self, step: int):
-        """Flush in-flight lazy/captured work, then snapshot synchronously."""
+        """Flush in-flight lazy/captured work, then make this boundary's
+        snapshot durable before the process exits: an in-flight async save
+        that already covers the boundary is joined (not redone), anything
+        else is superseded by a synchronous save — commits are serialized
+        either way, so the LATEST pointer can never name a
+        partially-persisted snapshot."""
         from ..core import dispatch, lazy
 
         # resolve any pending segment or deferred captured backward first:
@@ -119,7 +124,11 @@ class PreemptionGuard:
         # path (capture abort) — state is consistent before the snapshot
         lazy.flush_if_pending("preemption")
         if self.checkpointer is not None and self.state_dict is not None:
-            self.checkpointer.save(step, self.state_dict)
+            emergency = getattr(self.checkpointer, "emergency_save", None)
+            if emergency is not None:
+                emergency(step, self.state_dict)
+            else:  # duck-typed checkpointer without the join/supersede path
+                self.checkpointer.save(step, self.state_dict)
             self.checkpointer.wait()
             dispatch._counters["emergency_saves"] += 1
 

@@ -216,7 +216,9 @@ def _train_one(net, opt, seed=0):
 
 def test_atomic_save_survives_crash_before_commit(tmp_path, monkeypatch):
     """A crash between payload write and rename leaves the previous
-    snapshot as the restorable latest (fallback backend commit protocol)."""
+    snapshot as the restorable latest (fallback backend commit protocol).
+    With the pipelined save the failure happens on the background persist
+    thread and surfaces at the join (wait)."""
     import paddle_tpu.distributed.checkpoint as ckmod
 
     monkeypatch.setattr(ckmod, "_HAS_ORBAX", False)
@@ -225,6 +227,7 @@ def test_atomic_save_survives_crash_before_commit(tmp_path, monkeypatch):
     state = training_state(net, opt)
     ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
     ck.save(0, state)
+    ck.wait()
     w0 = net.weight.numpy().copy()
     _train_one(net, opt, seed=1)
 
@@ -238,8 +241,9 @@ def test_atomic_save_survives_crash_before_commit(tmp_path, monkeypatch):
         return real_replace(src, dst)
 
     monkeypatch.setattr(os, "replace", dying_replace)
+    ck.save(1, state)
     with pytest.raises(RuntimeError):
-        ck.save(1, state)
+        ck.wait()
     monkeypatch.setattr(os, "replace", real_replace)
 
     net2, opt2 = _make(seed=55)
@@ -262,6 +266,7 @@ def test_restore_skips_corrupt_latest_snapshot(tmp_path, monkeypatch):
     w0 = net.weight.numpy().copy()
     _train_one(net, opt, seed=1)
     ck.save(1, state)
+    ck.wait()  # commit step 1 before tearing its bytes
     # corrupt the newest snapshot on disk (truncated pickle)
     with open(str(tmp_path / "ck" / "1"), "wb") as f:
         f.write(b"\x80\x04 torn")
@@ -292,6 +297,358 @@ def test_train_step_range_periodic_save_crash_resume(tmp_path):
         _train_one(net2, opt2, seed=step)
         resumed.append(step)
     assert resumed == [4, 5, 6, 7, 8, 9]  # steps 4..5 lost <= save_freq
+
+
+# ---------------------------------------------------------------------------
+# CheckFreq pipeline (ISSUE 8): snapshot/step overlap + auto-tuned cadence
+# ---------------------------------------------------------------------------
+def _mlp_trainer(seed=0):
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+    )
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (4,)))
+
+    def step():
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    return net, opt, step
+
+
+def test_snapshot_overlap_bitwise_boundary_state(tmp_path, monkeypatch):
+    """The tentpole overlap contract: a snapshot taken at step k is bitwise
+    the step-k state even when steps k+1..k+3 run donated captured updates
+    while the save is still persisting in the background. The persist is
+    artificially slowed so it provably commits AFTER the live buffers
+    moved on — a save that read the live state at commit time would
+    serialize step k+3, not step k."""
+    import time as _time
+
+    import paddle_tpu.distributed.checkpoint as ckmod
+    import paddle_tpu.framework.io_utils as ioumod
+    from paddle_tpu.core import lazy
+
+    monkeypatch.setattr(ckmod, "_HAS_ORBAX", False)
+    real_save = ioumod.save
+
+    def slow_save(obj, path, **kw):
+        _time.sleep(0.2)  # the 3 following steps finish well within this
+        return real_save(obj, path, **kw)
+
+    monkeypatch.setattr(ioumod, "save", slow_save)
+
+    lazy._tls.observer = None
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True,
+                      "FLAGS_eager_async_compile": False})
+    try:
+        net, opt, step = _mlp_trainer()
+        for _ in range(5):  # arm + build + replay the donated captured step
+            step()
+        import paddle_tpu.profiler as prof
+
+        prof.reset_dispatch_counters()
+        step()  # step k: the boundary state to snapshot
+        state = training_state(net, opt)
+        state.refresh()
+        boundary = {k: np.asarray(v._value).copy() for k, v in state.items()}
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+        ck.save(0, state)  # async: persist overlaps the next steps
+        for _ in range(3):  # steps k+1..k+3 mutate/donate the live buffers
+            step()
+        ck.wait()
+        c = prof.dispatch_counters()
+        assert c["ckpt_async_saves"] == 1
+        assert c["capture_replays"] >= 3  # the overlapped steps ran captured
+        # the live state moved on...
+        state.refresh()
+        moved = any(
+            not np.array_equal(np.asarray(state[k]._value), boundary[k])
+            for k in boundary
+        )
+        assert moved
+        # ...but the restored snapshot is bitwise the step-k boundary
+        from paddle_tpu.distributed.checkpoint import restore_training_state
+
+        net2, opt2, _ = _mlp_trainer(seed=99)
+        ck2 = AsyncCheckpointer(str(tmp_path / "ck"))
+        state2 = training_state(net2, opt2)
+        assert ck2.restore_latest(state2) == 0
+        restore_training_state(state2, optimizer=opt2)
+        state2.refresh()
+        for k, v in boundary.items():
+            np.testing.assert_array_equal(np.asarray(state2[k]._value), v)
+    finally:
+        lazy.flush_if_pending("test_teardown")
+        lazy.drain_async()
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False,
+                          "FLAGS_eager_step_capture": True,
+                          "FLAGS_eager_async_compile": True})
+        lazy._tls.observer = None
+
+
+def test_cadence_tuner_arithmetic():
+    """CheckFreq cadence algebra: freq = max(snapshot-under-budget,
+    persist-fits-between-saves), re-tuned on step-time drift."""
+    from paddle_tpu.distributed.checkpoint import CadenceTuner
+
+    t = CadenceTuner(overhead_pct=3.5)
+    for _ in range(5):
+        t.observe_step(0.010)  # 10 ms steady state
+    assert t.save_freq is None  # nothing to tune until a save is measured
+    # first save = profiling phase: its compile-inflated costs are
+    # DISCARDED, not seeded — else freq starts orders of magnitude too long
+    t.observe_snapshot(2000.0)
+    t.observe_persist(5000.0, profiling=True)
+    assert t.save_freq is None and t.snapshot_ms is None
+    t.observe_snapshot(2.0)  # second save: warm caches, steady 2 ms cost
+    # no frequency until BOTH costs are known (tuning from the snapshot
+    # alone would schedule the next save into the still-unknown persist)
+    assert t.save_freq is None
+    t.observe_persist(30.0)  # ceil(30*1.25/10)=4 < 8: budget rules
+    # tuned against 80% of the budget (noise headroom):
+    # ceil(2.0 / (0.8 * 0.035 * 10)) = 8
+    assert t.save_freq == 8
+    # persist EMA 140 ms -> pipeline (1.25x headroom) rules: ceil(17.5)=18
+    t.observe_persist(250.0)
+    assert t.save_freq == 18
+    # steady state slows 5x (e.g. ladder demotion): drift re-tunes
+    before = t.retunes
+    for _ in range(30):
+        t.observe_step(0.050)
+    assert t.retunes > before
+    # snapshot EMA 2ms vs 50ms steps: budget gives ceil(2/(.8*.035*50))=2;
+    # persist 140ms gives ceil(140*1.25/50)=4 — pipeline constraint wins
+    assert t.save_freq == 4
+
+
+def test_auto_cadence_overhead_under_budget(tmp_path, monkeypatch):
+    """save_freq='auto' end-to-end: measured checkpoint overhead lands
+    under the FLAGS_ckpt_overhead_pct budget on a sleep-paced loop."""
+    import time as _time
+
+    import paddle_tpu.distributed.checkpoint as ckmod
+    import paddle_tpu.profiler as prof
+
+    monkeypatch.setattr(ckmod, "_HAS_ORBAX", False)
+    net, opt = _make()
+    prof.reset_dispatch_counters()
+    ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    state = training_state(net, opt)
+    for step in train_step_range(80, ck, state, save_freq="auto"):
+        _train_one(net, opt, seed=step)
+        _time.sleep(0.010)  # paced so the ~ms persist fits between saves
+    tuner = ck.tuner
+    assert tuner is not None and tuner.save_freq is not None
+    budget = float(paddle.get_flags("FLAGS_ckpt_overhead_pct")[
+        "FLAGS_ckpt_overhead_pct"])
+    assert tuner.measured_overhead_pct() <= budget
+    c = prof.dispatch_counters()
+    assert c["ckpt_snapshots"] >= 2  # bootstrap + at least one cadenced save
+    assert c["ckpt_async_saves"] == c["ckpt_snapshots"]
+    assert c["ckpt_auto_save_freq"] == tuner.save_freq
+    # the tuned cadence must actually have bounded work loss: a restore
+    # lands within save_freq steps of the end
+    net2, opt2 = _make(seed=31)
+    got = AsyncCheckpointer(str(tmp_path / "ck")).restore_latest(
+        training_state(net2, opt2))
+    assert got is not None and got >= 79 - 2 * tuner.save_freq
+
+
+def test_save_freq_rejects_unknown_string(tmp_path):
+    net, opt = _make()
+    ck = AsyncCheckpointer(str(tmp_path / "ck"))
+    with pytest.raises(ValueError):
+        list(train_step_range(2, ck, training_state(net, opt),
+                              save_freq="adaptive"))
+
+
+def test_emergency_save_joins_inflight(tmp_path, monkeypatch):
+    """The LATEST-pointer interleave fix: an emergency save at a boundary
+    whose async persist is already in flight JOINS it instead of racing a
+    second commit; commits stay serialized and the pointer names the
+    completed snapshot."""
+    import time as _time
+
+    import paddle_tpu.distributed.checkpoint as ckmod
+    import paddle_tpu.framework.io_utils as ioumod
+    import paddle_tpu.profiler as prof
+
+    monkeypatch.setattr(ckmod, "_HAS_ORBAX", False)
+    real_save = ioumod.save
+
+    def slow_save(obj, path, **kw):
+        _time.sleep(0.15)
+        return real_save(obj, path, **kw)
+
+    monkeypatch.setattr(ioumod, "save", slow_save)
+    net, opt = _make()
+    _train_one(net, opt)
+    state = training_state(net, opt)
+    ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+    prof.reset_dispatch_counters()
+    ck.save(3, state)            # in flight (slowed)
+    ck.emergency_save(3, state)  # same boundary: join, don't redo
+    c = prof.dispatch_counters()
+    assert c["ckpt_emergency_joined_inflight"] == 1
+    assert c["ckpt_sync_saves"] == 0
+    assert c["ckpt_snapshots"] == 1
+    assert ck._read_latest() == 3
+    # a DIFFERENT boundary supersedes with a synchronous save
+    _train_one(net, opt, seed=2)
+    ck.save(4, state)
+    _train_one(net, opt, seed=3)
+    ck.emergency_save(5, state)
+    c = prof.dispatch_counters()
+    assert c["ckpt_sync_saves"] == 1
+    assert ck._read_latest() == 5
+
+
+def test_emergency_save_survives_stale_persist_failure(tmp_path, monkeypatch):
+    """A failed earlier async persist must not abort a later emergency
+    save — the process is exiting and that save is the last chance at
+    durability (the stale error is parked, not re-raised)."""
+    import paddle_tpu.distributed.checkpoint as ckmod
+    import paddle_tpu.framework.io_utils as ioumod
+
+    monkeypatch.setattr(ckmod, "_HAS_ORBAX", False)
+    net, opt = _make()
+    _train_one(net, opt)
+    state = training_state(net, opt)
+    ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+    real_save = ioumod.save
+    fail_once = []
+
+    def failing_save(obj, path, **kw):
+        if not fail_once:
+            fail_once.append(1)
+            raise RuntimeError("disk hiccup")
+        return real_save(obj, path, **kw)
+
+    monkeypatch.setattr(ioumod, "save", failing_save)
+    ck.save(2, state)  # async persist of step 2 fails in the background
+    _train_one(net, opt, seed=1)
+    ck.emergency_save(5, state)  # must not re-raise the step-2 error
+    assert ck.last_error is not None  # ...but the failure is recorded
+    net2, opt2 = _make(seed=41)
+    got = AsyncCheckpointer(str(tmp_path / "ck")).restore_latest(
+        training_state(net2, opt2))
+    assert got == 5
+    np.testing.assert_array_equal(net2.weight.numpy(), net.weight.numpy())
+
+
+def test_train_step_range_break_drains_inflight_save(tmp_path, monkeypatch):
+    """Breaking out of the resume loop must still drain the in-flight
+    background persist — the commit lands even though wait() was never
+    reached on the normal path."""
+    import time as _time
+
+    import paddle_tpu.distributed.checkpoint as ckmod
+    import paddle_tpu.framework.io_utils as ioumod
+
+    monkeypatch.setattr(ckmod, "_HAS_ORBAX", False)
+    real_save = ioumod.save
+
+    def slow_save(obj, path, **kw):
+        _time.sleep(0.15)
+        return real_save(obj, path, **kw)
+
+    monkeypatch.setattr(ioumod, "save", slow_save)
+    net, opt = _make()
+    ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    state = training_state(net, opt)
+    for step in train_step_range(10, ck, state, save_freq=1):
+        _train_one(net, opt, seed=step)
+        if step == 2:
+            # break skips step 2's boundary (its save is never issued);
+            # the slowed persist of step 1 is the one in flight
+            break
+    net2, opt2 = _make(seed=13)
+    got = AsyncCheckpointer(str(tmp_path / "ck")).restore_latest(
+        training_state(net2, opt2))
+    # without the generator-close drain this is racy (0 or 1 depending on
+    # whether the daemon thread got there first); with it, deterministic
+    assert got == 1
+
+
+@pytest.mark.slow
+def test_crash_during_async_save_subprocess(tmp_path):
+    """Overlap + crash consistency together: the process keeps training
+    while the background persist of step 1 runs, then dies (kill:checkpoint
+    → os._exit(137) between payload write and commit) — restore_latest
+    must return the previous intact checkpoint."""
+    script = textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, sys.argv[2])
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import time
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed.checkpoint as ckmod
+        import paddle_tpu.framework.io_utils as ioumod
+        ckmod._HAS_ORBAX = False
+        real_save = ioumod.save
+        def slow_save(obj, path, **kw):
+            time.sleep(0.3)  # keep the persist in flight while we train on
+            return real_save(obj, path, **kw)
+        ioumod.save = slow_save
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        rng = np.random.default_rng(0)
+        X = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+        Y = paddle.to_tensor(rng.standard_normal((8, 3)).astype(np.float32))
+        def one():
+            loss = ((net(X) - Y) ** 2).mean(); loss.backward()
+            opt.step(); opt.clear_grad()
+        one()
+        state = ckmod.training_state(net, opt)
+        ck = ckmod.AsyncCheckpointer(sys.argv[1], max_to_keep=3)
+        ck.save(0, state)
+        ck.wait()
+        np.save(os.path.join(sys.argv[1], "expect_w.npy"), net.weight.numpy())
+        one()
+        paddle.set_flags({"FLAGS_fault_inject": "kill:checkpoint"})
+        ck.save(1, state)   # async persist armed with the kill
+        one(); one()        # training overlaps the doomed persist
+        ck.wait()           # join -> os._exit(137) fired mid-commit
+        print("UNREACHABLE")
+    """)
+    ckdir = str(tmp_path / "ck")
+    os.makedirs(ckdir, exist_ok=True)
+    out = subprocess.run(
+        [sys.executable, "-c", script, ckdir, REPO],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 137, (out.returncode, out.stdout, out.stderr)
+    assert "UNREACHABLE" not in out.stdout
+
+    import paddle_tpu.distributed.checkpoint as ckmod
+
+    prev = ckmod._HAS_ORBAX
+    ckmod._HAS_ORBAX = False
+    try:
+        net, opt = _make(seed=77)
+        ck = AsyncCheckpointer(ckdir, max_to_keep=3)
+        got = ck.restore_latest(training_state(net, opt))
+    finally:
+        ckmod._HAS_ORBAX = prev
+    assert got == 0  # step-1 persist never committed; step 0 intact
+    np.testing.assert_array_equal(
+        net.weight.numpy(), np.load(os.path.join(ckdir, "expect_w.npy"))
+    )
 
 
 @pytest.mark.slow
@@ -325,7 +682,8 @@ def test_injected_kill_mid_save_subprocess(tmp_path):
         loss = ((net(X) - Y) ** 2).mean(); loss.backward()
         opt.step(); opt.clear_grad()
         paddle.set_flags({"FLAGS_fault_inject": "kill:checkpoint"})
-        ck.save(1, state)   # os._exit(137) fires mid-commit
+        ck.save(1, state)   # os._exit(137) fires mid-commit (persist thread)
+        ck.wait()
         print("UNREACHABLE")
     """)
     ckdir = str(tmp_path / "ck")
@@ -352,3 +710,20 @@ def test_injected_kill_mid_save_subprocess(tmp_path):
     np.testing.assert_array_equal(
         net.weight.numpy(), np.load(os.path.join(ckdir, "expect_w.npy"))
     )
+
+
+@pytest.mark.slow
+def test_chaos_fleet_probe_cli():
+    """The fleet-scale chaos gate (ISSUE 8 acceptance): N worker processes
+    coordinated through the elastic TCP lease/KV layer survive host
+    SIGKILL, a fleet/PS partition, and lease expiry — every scenario
+    resumes with ≤1-step loss and a bitwise-identical final state vs the
+    fault-free run."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_fleet_probe.py"),
+         "--np", "2", "--steps", "16"],
+        capture_output=True, text=True, timeout=540,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "ALL SCENARIOS PASSED" in out.stdout

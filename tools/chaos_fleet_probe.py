@@ -1,0 +1,455 @@
+"""Fleet-scale chaos gate: multi-process training under host kills, fleet/PS
+partitions, and lease expiry (ISSUE 8 — CheckFreq at mesh scale).
+
+`chaos_probe.py` proves single-process recovery; this probe proves the
+"≤1-step loss, bitwise-identical final state" guarantee survives the faults
+only a FLEET can have. N worker processes coordinate through the elastic
+TCP lease/KV layer (`distributed/fleet/elastic.py` over the PS wire): each
+registers a TTL lease, barriers on full membership, then trains a
+deterministic model with pipelined AsyncCheckpointer saves
+(`train_step_range`) while heartbeating every step. The supervisor then
+does its worst:
+
+  sigkill     SIGKILL one worker mid-step; relaunch it. The relaunch must
+              resume from its checkpoint losing ≤1 completed step, and
+              every worker's final state (params + Adam moments) must be
+              bitwise-identical to the fault-free baseline.
+  partition   stop the KV master mid-run (fleet/PS network partition).
+              Workers must keep training through the outage (heartbeats
+              fail soft), re-lease when the master returns, and finish
+              bitwise-identical.
+  lease       one worker wedges (stalls past the TTL without
+              heartbeating). The supervisor observes its lease expire in
+              the KV view, declares the host dead (SIGKILL), relaunches —
+              same ≤1-step-loss + bitwise bound.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_fleet_probe.py \
+        [--np 2] [--steps 20] [--scenario all|sigkill|partition|lease]
+
+Exits nonzero on any unrecovered fault. Wired into CI as a slow-marked
+subprocess test (tests/test_checkpoint_resume.py), like serve_probe /
+chaos_probe.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JOB_ID = "chaosfleet"
+STEP_SLEEP = 0.05  # widens the mid-step kill window; also paces heartbeats
+
+
+# ---------------------------------------------------------------------------
+# Worker: deterministic trainer + lease/heartbeat through the elastic layer
+# ---------------------------------------------------------------------------
+def worker_main(args):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.checkpoint as ckmod
+    from paddle_tpu.distributed.checkpoint import (
+        AsyncCheckpointer,
+        train_step_range,
+        training_state,
+    )
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.resilience import PreemptionGuard
+
+    # the fallback two-phase commit (tmp → rename → LATEST last) is the
+    # protocol under test; orbax would hide it behind its own commit
+    ckmod._HAS_ORBAX = False
+
+    wdir = args.dir
+    os.makedirs(wdir, exist_ok=True)
+    log_path = os.path.join(wdir, "log.txt")
+
+    def log(line):
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+
+    log(f"start {os.getpid()}")
+
+    mgr = ElasticManager(
+        lambda: None, job_id=JOB_ID, master=args.master,
+        heartbeat_ttl=args.ttl,
+    )
+    mgr.register()
+    if args.barrier:
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            alive = mgr.alive_nodes()
+            if alive is not None and len(alive) >= args.np:
+                break
+            mgr.heartbeat()
+            time.sleep(0.05)
+        else:
+            log("barrier-timeout")
+            return 3
+    log("barrier")
+
+    # deterministic workload: data is a pure function of (worker seed, step)
+    paddle.seed(1000 + args.worker_id)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+    )
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    rng = np.random.default_rng(100 + args.worker_id)
+    batches = [
+        (rng.standard_normal((4, 8)).astype(np.float32),
+         rng.standard_normal((4, 4)).astype(np.float32))
+        for _ in range(args.steps)
+    ]
+
+    ck = AsyncCheckpointer(os.path.join(wdir, "ck"), max_to_keep=3)
+    state = training_state(net, opt)
+    save_freq = "auto" if args.save_freq == "auto" else int(args.save_freq)
+    first = True
+    for step in train_step_range(args.steps, ck, state, save_freq=save_freq,
+                                 guard=PreemptionGuard(), optimizer=opt):
+        if first:
+            log(f"resume {step}")
+            first = False
+        x, y = batches[step]
+        loss = ((net(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lv = float(loss)
+        time.sleep(STEP_SLEEP)
+        mgr.heartbeat()
+        if args.stall_at is not None and step == args.stall_at:
+            # wedged host: no heartbeats for > TTL (lease must expire)
+            log(f"stall {step}")
+            time.sleep(args.ttl * 4)
+        log(f"done {step} {lv:.9g}")
+    state.refresh()
+    np.savez(os.path.join(wdir, "final.npz"),
+             **{k: np.asarray(v._value) for k, v in state.items()})
+    log("final")
+    mgr.deregister()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: fleet lifecycle + fault injection + verdicts
+# ---------------------------------------------------------------------------
+def _spawn(worker_id, master, wdir, steps, np_, ttl, save_freq="1",
+           barrier=True, stall_at=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--worker-id", str(worker_id), "--master", master,
+           "--dir", wdir, "--steps", str(steps), "--np", str(np_),
+           "--ttl", str(ttl), "--save-freq", str(save_freq)]
+    if not barrier:
+        cmd.append("--no-barrier")
+    if stall_at is not None:
+        cmd += ["--stall-at", str(stall_at)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_CURRENT_ENDPOINT=f"w{worker_id}")
+    os.makedirs(wdir, exist_ok=True)
+    errlog = open(os.path.join(wdir, "stderr.txt"), "ab")
+    return subprocess.Popen(cmd, env=env, stdout=errlog, stderr=errlog)
+
+
+def _log_lines(wdir):
+    try:
+        with open(os.path.join(wdir, "log.txt")) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def _done_steps(lines):
+    return [int(ln.split()[1]) for ln in lines if ln.startswith("done ")]
+
+
+def _wait_done_at_least(wdir, k, timeout=60):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        steps = _done_steps(_log_lines(wdir))
+        if steps and max(steps) >= k:
+            return max(steps)
+        time.sleep(0.02)
+    raise TimeoutError(f"worker in {wdir} never reached step {k}")
+
+
+def _load_final(wdir):
+    import numpy as np
+
+    path = os.path.join(wdir, "final.npz")
+    with np.load(path) as z:
+        return {k: z[k].copy() for k in z.files}
+
+
+def _finals_bitwise_equal(a, b):
+    import numpy as np
+
+    if set(a) != set(b):
+        return False
+    return all(np.asarray(a[k]).tobytes() == np.asarray(b[k]).tobytes()
+               for k in a)
+
+
+def _steps_lost(lines):
+    """Completed-but-lost work across the LAST relaunch: steps the worker
+    had logged `done` for before dying that it had to redo (or lost). 0
+    when the run never relaunched."""
+    starts = [i for i, ln in enumerate(lines) if ln.startswith("start ")]
+    if len(starts) < 2:
+        return 0
+    before = _done_steps(lines[: starts[-1]])
+    resume = [int(ln.split()[1]) for ln in lines[starts[-1]:]
+              if ln.startswith("resume ")]
+    if not before or not resume:
+        return 0
+    return max(0, (before[-1] + 1) - resume[0])
+
+
+def _start_master(port=0, retries=20):
+    from paddle_tpu.distributed.fleet.elastic import start_master
+
+    last = None
+    for _ in range(retries):
+        try:
+            return start_master(port)
+        except Exception as e:  # port in TIME_WAIT after a partition restart
+            last = e
+            time.sleep(0.25)
+    raise RuntimeError(f"could not start KV master on port {port}: {last}")
+
+
+def _kv_alive(master, timeout=1.0):
+    from paddle_tpu.distributed.ps import PsClient
+
+    try:
+        alive = PsClient([master]).kv_alive(f"elastic/{JOB_ID}/")
+    except ConnectionError:
+        return None
+    return sorted(k.split("/")[-1] for k in alive)
+
+
+def _run_fleet(root, master, np_, steps, save_freq="1"):
+    """Launch np_ workers, wait for clean exit, return worker dirs."""
+    dirs = [os.path.join(root, f"w{i}") for i in range(np_)]
+    procs = [_spawn(i, master, dirs[i], steps, np_, ttl=1.5,
+                    save_freq=save_freq) for i in range(np_)]
+    rcs = [p.wait(timeout=120) for p in procs]
+    if any(rc != 0 for rc in rcs):
+        raise RuntimeError(f"fleet run failed: rcs={rcs}")
+    return dirs
+
+
+def _baseline(root, master, np_, steps):
+    dirs = _run_fleet(os.path.join(root, "baseline"), master, np_, steps)
+    return [_load_final(d) for d in dirs]
+
+
+def scenario_sigkill(root, master, np_, steps, baseline, results):
+    ttl = 1.5
+    dirs = [os.path.join(root, "sigkill", f"w{i}") for i in range(np_)]
+    procs = [_spawn(i, master, dirs[i], steps, np_, ttl) for i in range(np_)]
+    victim = np_ - 1
+    try:
+        _wait_done_at_least(dirs[victim], steps // 3)
+        procs[victim].send_signal(signal.SIGKILL)  # host dies mid-step
+        procs[victim].wait()
+        # elastic semantics: the supervisor relaunches the dead host; the
+        # relaunch resumes from its own checkpoint (no barrier — survivors
+        # may already be done)
+        procs[victim] = _spawn(victim, master, dirs[victim], steps, np_,
+                               ttl, barrier=False)
+        rcs = [p.wait(timeout=120) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    finals = [_load_final(d) for d in dirs]
+    lost = _steps_lost(_log_lines(dirs[victim]))
+    bitwise = all(_finals_bitwise_equal(f, b)
+                  for f, b in zip(finals, baseline))
+    ok = all(rc == 0 for rc in rcs) and lost <= 1 and bitwise
+    results.append({
+        "scenario": "sigkill", "ok": ok, "rcs": rcs,
+        "steps_lost": lost, "bitwise_identical": bitwise,
+    })
+    return ok
+
+
+def scenario_partition(root, np_, steps, results):
+    ttl = 1.5
+    # longer run than the other scenarios so the fleet is still training
+    # through the outage window — which means finals differ from the main
+    # baseline (they depend on step count), so this scenario runs its own
+    # fault-free reference fleet first
+    steps = max(steps, 60)
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    port = srv.port
+    baseline = [
+        _load_final(d) for d in
+        _run_fleet(os.path.join(root, "partition-baseline"), master, np_,
+                   steps)
+    ]
+    dirs = [os.path.join(root, "partition", f"w{i}") for i in range(np_)]
+    procs = [_spawn(i, master, dirs[i], steps, np_, ttl) for i in range(np_)]
+    progressed = relive = running_at_heal = False
+    try:
+        for d in dirs:
+            _wait_done_at_least(d, 2)
+        srv.stop()  # fleet/PS partition: every heartbeat now fails
+        before = [max(_done_steps(_log_lines(d)), default=-1) for d in dirs]
+        time.sleep(ttl)  # a full TTL with no master
+        after = [max(_done_steps(_log_lines(d)), default=-1) for d in dirs]
+        progressed = all(a > b for a, b in zip(after, before))
+        srv = _start_master(port)  # partition heals (same endpoint)
+        # workers still running at heal time must re-lease on their next
+        # heartbeat; if the whole fleet already finished during the outage
+        # there is nothing left to observe and the condition is vacuous
+        running_at_heal = any(p.poll() is None for p in procs)
+        t0 = time.time()
+        while time.time() - t0 < 15 and running_at_heal and not relive:
+            if _kv_alive(master):
+                relive = True
+            elif all(p.poll() is not None for p in procs):
+                break  # fleet drained before any heartbeat hit the master
+            else:
+                time.sleep(0.1)
+        rcs = [p.wait(timeout=120) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+    finals = [_load_final(d) for d in dirs]
+    bitwise = all(_finals_bitwise_equal(f, b)
+                  for f, b in zip(finals, baseline))
+    # re-lease is part of the documented guarantee: gate on it whenever
+    # workers were still alive to demonstrate it
+    release_ok = relive or not running_at_heal
+    ok = (all(rc == 0 for rc in rcs) and progressed and bitwise
+          and release_ok
+          and all(_steps_lost(_log_lines(d)) == 0 for d in dirs))
+    results.append({
+        "scenario": "partition", "ok": ok, "rcs": rcs,
+        "trained_through_outage": progressed,
+        "re_leased_after_heal": relive,
+        "workers_running_at_heal": running_at_heal,
+        "bitwise_identical": bitwise,
+    })
+    return ok
+
+
+def scenario_lease(root, master, np_, steps, baseline, results):
+    ttl = 1.0
+    dirs = [os.path.join(root, "lease", f"w{i}") for i in range(np_)]
+    victim = np_ - 1
+    stall_at = max(2, steps // 3)
+    procs = [
+        _spawn(i, master, dirs[i], steps, np_, ttl,
+               stall_at=stall_at if i == victim else None)
+        for i in range(np_)
+    ]
+    expired = False
+    try:
+        _wait_done_at_least(dirs[victim], stall_at - 1)
+        # the victim is now wedged (no heartbeats): its lease must expire
+        # out of the KV view while the process is still alive
+        t0 = time.time()
+        while time.time() - t0 < ttl * 4:
+            alive = _kv_alive(master)
+            if (alive is not None and f"w{victim}" not in alive
+                    and procs[victim].poll() is None):
+                expired = True
+                break
+            time.sleep(0.1)
+        # supervisor declares the wedged host dead and replaces it
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        procs[victim] = _spawn(victim, master, dirs[victim], steps, np_,
+                               ttl, barrier=False)
+        rcs = [p.wait(timeout=120) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    finals = [_load_final(d) for d in dirs]
+    lost = _steps_lost(_log_lines(dirs[victim]))
+    bitwise = all(_finals_bitwise_equal(f, b)
+                  for f, b in zip(finals, baseline))
+    ok = (all(rc == 0 for rc in rcs) and expired and lost <= 1 and bitwise)
+    results.append({
+        "scenario": "lease-expiry", "ok": ok, "rcs": rcs,
+        "lease_expired_observed": expired,
+        "steps_lost": lost, "bitwise_identical": bitwise,
+    })
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--np", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", "sigkill", "partition", "lease"])
+    # worker mode (internal)
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--worker-id", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--master", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--dir", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--ttl", type=float, default=1.5,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--save-freq", default="1", help=argparse.SUPPRESS)
+    ap.add_argument("--no-barrier", dest="barrier", action="store_false",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--stall-at", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args)
+
+    sys.path.insert(0, REPO)
+    results = []
+    ok = True
+    with tempfile.TemporaryDirectory() as root:
+        srv = _start_master(0)
+        master = f"127.0.0.1:{srv.port}"
+        try:
+            baseline = None
+            if args.scenario in ("all", "sigkill", "lease"):
+                baseline = _baseline(root, master, args.np, args.steps)
+            if args.scenario in ("all", "sigkill"):
+                ok &= scenario_sigkill(root, master, args.np, args.steps,
+                                       baseline, results)
+            if args.scenario in ("all", "lease"):
+                ok &= scenario_lease(root, master, args.np, args.steps,
+                                     baseline, results)
+        finally:
+            srv.stop()
+        if args.scenario in ("all", "partition"):
+            # runs its own master (it must die and come back mid-run)
+            ok &= scenario_partition(root, args.np, args.steps, results)
+
+    for r in results:
+        print(json.dumps(r))
+    print("ALL SCENARIOS PASSED" if ok else "UNRECOVERED FLEET FAULTS",
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

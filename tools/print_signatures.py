@@ -29,6 +29,7 @@ SUBMODULES = [
     "linalg",
     "metric",
     "distributed",
+    "distributed.checkpoint",
     "distributed.fleet",
     "distribution",
     "sparse",
