@@ -691,6 +691,31 @@ def _checkpoint_block(steps=120, bsz=16):
     }
 
 
+def _observability_block(steps=6, bsz=8):
+    """Observability probe for the BENCH_* trajectory (ISSUE 9): tracing-on
+    overhead of the flight recorder at its default ring size (gated <1% by
+    tools/obs_probe.py; recorded here per round), events/step at the
+    captured steady state, and the per-emit cost split (on-mode vs the
+    off-mode fast path). Delegates to the one measurement definition in
+    tools/obs_probe.py."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import paddle_tpu as paddle
+    import paddle_tpu.resilience as res
+    from obs_probe import _batches as _obs_batches
+    from obs_probe import measure_trace_overhead
+
+    try:
+        return measure_trace_overhead(_obs_batches(steps, bsz))
+    finally:
+        paddle.set_flags({"FLAGS_fault_inject": "",
+                          "FLAGS_trace_ring_size": 4096,
+                          "FLAGS_eager_lazy_dispatch": False,
+                          "FLAGS_eager_step_capture": True,
+                          "FLAGS_retry_backoff_ms": 5.0})
+        res.reset()
+
+
 def _backend_or_skip():
     """Probe the accelerator backend before any model builds. When the
     TPU/axon backend cannot initialize (tunnel down, relay unavailable),
@@ -820,6 +845,14 @@ def main():
             result["checkpoint"] = _checkpoint_block()
         except Exception as e:
             print(f"# checkpoint block FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    # observability trajectory block (flight-recorder overhead %, events/
+    # step, per-emit cost) — BENCH_OBSERVABILITY=0 skips it
+    if os.environ.get("BENCH_OBSERVABILITY", "1") == "1":
+        try:
+            result["observability"] = _observability_block()
+        except Exception as e:
+            print(f"# observability block FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)

@@ -690,12 +690,22 @@ def enforce(diags: List[Diagnostic], where: str, level: Optional[int] = None):
     for d in diags:
         warnings.warn(f"[{where}] {d}", stacklevel=3)
     if level >= 2 and errors:
-        raise ProgramVerificationError(
+        err = ProgramVerificationError(
             f"{where}: program verification failed with "
             f"{len(errors)} error-severity diagnostic(s):\n"
             + "\n".join(f"  {d}" for d in errors),
             diags,
         )
+        try:
+            from ..profiler import trace as _trace
+
+            _trace.dump_postmortem(
+                "verification_failed", exc=err, where=where,
+                diagnostics=[str(d) for d in errors],
+            )
+        except Exception:
+            pass  # the verdict must surface even if the dump fails
+        raise err
     return diags
 
 

@@ -86,14 +86,12 @@ def submit(job: Callable[[], object]) -> Optional[Future]:
             dt = (time.perf_counter() - t0) * 1000.0
             with _lock:
                 _pending -= 1
-                try:
-                    dispatch._counters["async_compile_ms"] += dt
-                except KeyError:
-                    # raced a reset_dispatch_counters() on the main thread
-                    # (clear() before the defaults repopulate): drop the
-                    # sample — raising from this finally would replace the
-                    # job's compiled executable in the Future
-                    pass
+            # race-free against reset_dispatch_counters(): _counter_add
+            # takes the counters lock and defaults a missing key, so a
+            # concurrent reset can neither KeyError out of this finally
+            # (which would replace the job's compiled executable in the
+            # Future) nor lose the sample into a half-rebuilt dict
+            dispatch._counter_add("async_compile_ms", dt)
 
     fut = ex.submit(run)
     dispatch._counters["async_compiles"] += 1

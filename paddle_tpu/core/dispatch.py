@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 import threading
 from collections import OrderedDict
+from types import MappingProxyType
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -44,6 +45,7 @@ __all__ = [
 _tls = threading.local()
 _amp = None  # lazily bound paddle_tpu.amp module (circular at import time)
 _res = None  # lazily bound paddle_tpu.resilience (same circularity)
+_trace = None  # lazily bound paddle_tpu.profiler.trace (same circularity)
 
 
 def _amp_module():
@@ -70,6 +72,20 @@ def _rexec(site, thunk, **kw):
     return _resilience_module().runtime.execute(site, thunk, **kw)
 
 
+def _trace_module():
+    global _trace
+    if _trace is None:
+        from ..profiler import trace as _trace_mod
+
+        _trace = _trace_mod
+    return _trace
+
+
+def _emit(kind, site="", **attrs):
+    """Flight-recorder emit (paddle.profiler.trace), lazily bound."""
+    _trace_module().emit(kind, site=site, **attrs)
+
+
 # ---------------------------------------------------------------------------
 # Dispatch counters: device-program launches by category, lazy-segment flush
 # accounting, and compile-cache hit/miss/eviction counts. Readable via
@@ -78,9 +94,31 @@ def _rexec(site, thunk, **kw):
 # update) — the unit PROFILE_EAGER.md's relay-turnaround arithmetic uses.
 # ---------------------------------------------------------------------------
 _counters: Dict[str, Any] = {}
+# serializes reset against off-thread counter updates (the background
+# compile worker, checkpoint persist threads): a clear()+update() reset
+# racing a worker's read-modify-write must neither drop the worker's sample
+# into a half-rebuilt dict nor KeyError out of its finally block
+_counters_lock = threading.Lock()
+
+
+def _counter_add(key: str, n):
+    """Race-free off-thread counter update (see _counters_lock)."""
+    with _counters_lock:
+        _counters[key] = _counters.get(key, type(n)()) + n
+
+
+def _counter_set(key: str, v):
+    """Race-free off-thread gauge write (see _counters_lock)."""
+    with _counters_lock:
+        _counters[key] = v
 
 
 def reset_dispatch_counters():
+    with _counters_lock:
+        _reset_counters_locked()
+
+
+def _reset_counters_locked():
     _counters.clear()
     _counters.update(
         programs=0,
@@ -182,6 +220,7 @@ reset_dispatch_counters()
 def _count_program(kind: str = "op"):
     _counters["programs"] += 1
     _counters[kind + "_programs"] += 1
+    _emit("program", site=kind)
     if kind == "op":
         # per-op program launches make a step ineligible for whole-step
         # capture; the observer (when active) marks the step dirty
@@ -189,11 +228,16 @@ def _count_program(kind: str = "op"):
 
 
 def dispatch_counters() -> Dict[str, Any]:
+    """IMMUTABLE point-in-time snapshot of the dispatch counter family
+    (nested reason/site dicts included). Callers needing a mutable or
+    JSON-serializable copy must copy the nested maps too —
+    ``{k: dict(v) if isinstance(v, Mapping) else v for k, v in c.items()}``
+    (what ``measure_programs`` does); the live store is internal
+    (``_counters``)."""
     out = dict(_counters)
-    out["flush_reasons"] = dict(_counters["flush_reasons"])
-    out["capture_fallback_reasons"] = dict(_counters["capture_fallback_reasons"])
-    out["fault_sites"] = dict(_counters["fault_sites"])
-    return out
+    for k in ("flush_reasons", "capture_fallback_reasons", "fault_sites"):
+        out[k] = MappingProxyType(dict(_counters[k]))
+    return MappingProxyType(out)
 
 
 def _grad_state():

@@ -322,6 +322,40 @@ define_flag(
     "degradation-ladder demotion changes steady-state step time)",
 )
 # ---------------------------------------------------------------------------
+# Runtime observability (paddle.profiler.trace — see OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+define_flag(
+    "trace_ring_size", 4096,
+    "capacity of the flight recorder — the bounded in-memory ring of "
+    "structured runtime events (paddle.profiler.trace) emitted at the "
+    "execution choke points: program launches, segment flushes with their "
+    "reasons, capture build/replay/fallback, async-compile submits/joins, "
+    "retries and faults, ladder demotions, serving request phases, and "
+    "checkpoint pipeline phases. Default on; 0 disables emission entirely "
+    "(the off-mode fast path is one dict read per would-be event)",
+)
+define_flag(
+    "trace_stall_ms", 0.0,
+    "step-stall watchdog threshold: when > 0, a background watchdog "
+    "observes the step heartbeat (resilience.runtime.on_step_end) and — if "
+    "no step boundary lands for this many ms — emits a 'stall' event and "
+    "dumps a crash postmortem (FLAGS_postmortem_dir). One postmortem per "
+    "stall episode; the next completed step re-arms it. 0 = off",
+)
+define_flag(
+    "postmortem_dir", "",
+    "directory for crash postmortems: unrecovered faults, Preempted, "
+    "ProgramVerificationError, and step-stall watchdog trips dump a JSON "
+    "file here with the flight recorder's event tail, the unified metrics "
+    "snapshot (dispatch counters included), a live-buffer memory snapshot, "
+    "and the resilience/ladder state. Empty = postmortems disabled",
+)
+define_flag(
+    "postmortem_events", 256,
+    "number of trailing flight-recorder events included in each postmortem "
+    "dump (the event tail that explains what led up to the crash)",
+)
+# ---------------------------------------------------------------------------
 # Serving runtime (paddle.serving — see SERVING.md)
 # ---------------------------------------------------------------------------
 define_flag(

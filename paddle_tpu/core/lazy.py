@@ -399,6 +399,7 @@ def _flush(seg: _Segment, reason: str):
         return
 
     check = bool(flags.flag("check_nan_inf"))
+    n_ops = len(seg.ops)
     sig = _seg_signature(seg)
     jfn = dispatch._lru_get(_segment_cache, sig)
     fresh = jfn is None
@@ -458,6 +459,7 @@ def _flush(seg: _Segment, reason: str):
             )
             dispatch._counters["async_compile_joins"] += 1
             dispatch._counters["segment_cache_hits"] += 1
+            dispatch._emit("async_join", site="segment")
             t0 = time.perf_counter()
             out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals))
             _add_time("replay_time_ms", t0)
@@ -497,6 +499,8 @@ def _flush(seg: _Segment, reason: str):
                             next(iter(_pending_seg_compiles))
                         )
                 dispatch._counters["async_bridge_flushes"] += 1
+                dispatch._emit("async_compile", site="segment",
+                               phase="submit")
                 t0 = time.perf_counter()
                 out = dispatch._rexec(
                     "segment",
@@ -556,6 +560,12 @@ def _flush(seg: _Segment, reason: str):
     dispatch._counters["segments_flushed"] += 1
     reasons = dispatch._counters["flush_reasons"]
     reasons[reason] = reasons.get(reason, 0) + 1
+    dispatch._emit(
+        "flush", site="segment", reason=reason, ops=n_ops,
+        cache=("join" if (fresh and fut is not None)
+               else "miss" if fresh else "hit"),
+        fused=fused, bridged=bridged,
+    )
     if fused:
         _observe_event(("seg", sig))
 
@@ -956,6 +966,8 @@ def _capture_fallback(reason: str):
     dispatch._counters["capture_fallbacks"] += 1
     rs = dispatch._counters["capture_fallback_reasons"]
     rs[reason] = rs.get(reason, 0) + 1
+    dispatch._emit("capture", site="captured", phase="fallback",
+                   reason=reason)
 
 
 def _opt_fingerprint(opt) -> Optional[Tuple]:
@@ -1289,6 +1301,8 @@ def _run_accum_microstep(seg, root, seg_sig, tape_key, leaves, slots, pos,
     results, g_out = out
     dispatch._count_program("captured")
     dispatch._counters["capture_accum_replays"] += 1
+    dispatch._emit("capture", site="captured", phase="accum_replay",
+                   pos=pos)
 
     # the captured program subsumes the segment flush (same write-back as
     # _run_captured, minus vjp closures — a second backward raises)
@@ -1691,6 +1705,8 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     _tls.last_capture_entry = weakref.ref(entry)
     dispatch._count_program("captured")
     dispatch._counters["capture_replays"] += 1
+    dispatch._emit("capture", site="captured", phase="replay",
+                   donated=entry.donated)
 
     # the captured program subsumes the segment flush: write every op
     # output back exactly like _flush does (minus the vjp closures, which
@@ -1811,6 +1827,8 @@ def step_capture_step(optimizer) -> bool:
                 fresh=True, ladder_key=hash(rec.seg_sig),
             )
             dispatch._counters["capture_builds"] += 1
+            dispatch._emit("capture", site="captured", phase="build",
+                           background=fut is not None)
             dispatch._lru_put(
                 _capture_cache, key, entry,
                 evict_counter="capture_evictions",
@@ -1823,6 +1841,8 @@ def step_capture_step(optimizer) -> bool:
                 # this signature joins the finished compile
                 dispatch._counters["capture_async_builds"] += 1
                 dispatch._counters["capture_build_pending_steps"] += 1
+                dispatch._emit("capture", site="captured",
+                               phase="build_pending")
                 _abort_capture("build_pending", fallback=False)
                 flush_if_pending("optimizer_step")
                 return False
@@ -1830,6 +1850,8 @@ def step_capture_step(optimizer) -> bool:
             fut = entry.pending
             if not fut.done():
                 dispatch._counters["capture_build_pending_steps"] += 1
+                dispatch._emit("capture", site="captured",
+                               phase="build_pending")
                 _abort_capture("build_pending", fallback=False)
                 flush_if_pending("optimizer_step")
                 return False
@@ -1843,6 +1865,7 @@ def step_capture_step(optimizer) -> bool:
                 _capture_cache.pop(key, None)
                 raise
             dispatch._counters["async_compile_joins"] += 1
+            dispatch._emit("async_join", site="captured")
         return _run_captured(rec, optimizer, entry)
     except _CaptureIneligible as e:
         return fallback(e.reason)
@@ -1953,6 +1976,9 @@ class _ServeProgram:
             else:
                 self._built_plain = True
             dispatch._counters["serve_capture_builds"] += 1
+            dispatch._emit("serve_capture", site="captured", phase="build",
+                           key=str(self.key), donated=bool(
+                               donate and self.donate_argnums))
             _add_time("compile_time_ms", t0)
         else:
             out = exe(*args)

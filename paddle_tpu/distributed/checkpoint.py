@@ -62,6 +62,20 @@ def _counters():
     return dispatch._counters
 
 
+def _counter_add(key: str, n: float):
+    """Race-free counter update for the background persist thread (shares
+    the reset lock with dispatch.reset_dispatch_counters)."""
+    from ..core import dispatch
+
+    dispatch._counter_add(key, n)
+
+
+def _emit(kind: str, **attrs):
+    from ..core import dispatch
+
+    dispatch._emit(kind, site="checkpoint", **attrs)
+
+
 def _to_arrays(state_dict: Dict[str, Any]):
     return {
         k: (v._value if isinstance(v, Tensor) else v) for k, v in state_dict.items()
@@ -250,7 +264,6 @@ class AsyncCheckpointer:
 
     # -- persist phase (CheckFreq phase 2: transfer + serialize + commit) ---
     def _persist(self, job: _SaveJob):
-        c = _counters()
         try:
             t0 = time.perf_counter()
             if self._mgr is not None:
@@ -276,7 +289,9 @@ class AsyncCheckpointer:
                     for k, v in job.snapshot.items()
                 }
                 t1 = time.perf_counter()
-                c["ckpt_transfer_ms"] += (t1 - t0) * 1000.0
+                _counter_add("ckpt_transfer_ms", (t1 - t0) * 1000.0)
+                _emit("ckpt", phase="transfer", step=job.step,
+                      ms=round((t1 - t0) * 1000.0, 3))
                 from ..framework.io_utils import save as _save
                 from ..resilience import faults as _faults
 
@@ -297,7 +312,9 @@ class AsyncCheckpointer:
 
                 _ckpt_io(_commit)
             t2 = time.perf_counter()
-            c["ckpt_commit_ms"] += (t2 - t1) * 1000.0
+            _counter_add("ckpt_commit_ms", (t2 - t1) * 1000.0)
+            _emit("ckpt", phase="commit", step=job.step,
+                  ms=round((t2 - t1) * 1000.0, 3))
             if job.tuner is not None:
                 job.tuner.observe_persist((t2 - t0) * 1000.0,
                                           profiling=job.profiling)
@@ -324,6 +341,9 @@ class AsyncCheckpointer:
         stall_ms = (time.perf_counter() - t0) * 1000.0
         if count_stall:
             _counters()["ckpt_pipeline_stall_ms"] += stall_ms
+            if stall_ms >= 1.0:  # a real wait, not clock noise
+                _emit("ckpt", phase="stall", step=job.step,
+                      ms=round(stall_ms, 3))
         if job.error is not None:
             self._last_error = job.error
             if reraise:
@@ -348,6 +368,8 @@ class AsyncCheckpointer:
         snap_ms = (time.perf_counter() - t0) * 1000.0
         c["ckpt_snapshots"] += 1
         c["ckpt_snapshot_ms"] += snap_ms
+        _emit("ckpt", phase="snapshot", step=step, ms=round(snap_ms, 3),
+              blocking=bool(blocking))
         tuner = self.tuner
         profiling = tuner is not None and not tuner._profiled
         if tuner is not None:
@@ -561,13 +583,17 @@ class CadenceTuner:
                 self.persist_ms * _PIPELINE_HEADROOM / step_ms))
         freq = max(1, min(freq, int(flags.flag("ckpt_cadence_max"))))
         # `retunes` counts step-time-drift re-tunes (the ladder-demotion
-        # signal), not routine cost-EMA refinement between adjacent freqs
+        # signal), not routine cost-EMA refinement between adjacent freqs.
+        # _retune also runs on the background persist thread
+        # (observe_persist), so these counter writes take the locked path
         if drift and freq != self.save_freq:
             self.retunes += 1
-            _counters()["ckpt_cadence_retunes"] += 1
+            _counter_add("ckpt_cadence_retunes", 1)
         self.save_freq = freq
         self.timer.mark()
-        _counters()["ckpt_auto_save_freq"] = freq
+        from ..core import dispatch as _dispatch
+
+        _dispatch._counter_set("ckpt_auto_save_freq", freq)
 
     def should_save(self) -> bool:
         """Call once per step boundary (after observe_step)."""
@@ -680,6 +706,15 @@ def _train_range(count: int, checkpointer, state_dict, save_freq,
     finally:
         if guard is not None:
             guard.uninstall()
+        # the loop is over — no more step heartbeats will arrive, which is
+        # indistinguishable from a stall; stand the watchdog down so a
+        # cleanly finished run never dumps a spurious stall postmortem
+        try:
+            from ..profiler import trace as _trace
+
+            _trace.watchdog_disarm()
+        except Exception:
+            pass
         if checkpointer is not None:
             # break/exception path: the last async save still runs on a
             # daemon thread — drain it so the commit lands before the

@@ -159,6 +159,16 @@ class Model:
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_train_end(logs if "logs" in dir() else {})
+        # training is over — no more step heartbeats will arrive, which is
+        # indistinguishable from a stall; stand the watchdog down so a
+        # finished fit() (or a following long eval) never dumps a spurious
+        # stall postmortem (FLAGS_trace_stall_ms)
+        try:
+            from ..profiler import trace as _trace
+
+            _trace.watchdog_disarm()
+        except Exception:
+            pass
         return self
 
     def _split_batch(self, batch):

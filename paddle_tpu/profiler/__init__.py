@@ -16,6 +16,7 @@ import json
 import os
 import threading
 import time
+from collections.abc import Mapping as _MappingABC
 from enum import Enum
 from typing import Callable, Iterable, Optional
 
@@ -28,6 +29,13 @@ from ..core.dispatch import (  # noqa: F401
     dispatch_counters,
     reset_dispatch_counters,
 )
+
+# runtime observability (OBSERVABILITY.md): the flight recorder (bounded
+# ring of structured runtime events + crash postmortems + stall watchdog)
+# and the unified typed metrics registry (counters/gauges/histograms with
+# Prometheus exposition; the dispatch counters are adopted at snapshot time)
+from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
 
 __all__ = [
     "Profiler",
@@ -42,6 +50,8 @@ __all__ = [
     "dispatch_counters",
     "reset_dispatch_counters",
     "measure_programs",
+    "metrics",
+    "trace",
     "StepTimer",
 ]
 
@@ -242,18 +252,28 @@ class Profiler:
         return False
 
     def export(self, path: str, format: str = "json"):
-        """Write host-span chrome trace; device XPlane dir noted in metadata."""
+        """Write the merged chrome trace: RecordEvent host spans PLUS the
+        flight recorder's runtime events — instants on a dedicated lane
+        for flushes/captures/faults/ladder transitions, and per-request
+        async lanes (ph b/n/e keyed by request id) for serving, so a
+        continuous-batching interleave or a ladder demotion is visible on
+        one timeline. Device XPlane dir noted in metadata."""
+        from . import trace as _trace
+
         with _events_lock:
             events = list(_host_events)
-        trace = {
+        flight = _trace.events()
+        events = events + _trace.chrome_trace_events(flight)
+        doc = {
             "traceEvents": events,
             "metadata": {
                 "device_trace_dir": self._device_dir,
                 "framework": "paddle_tpu",
+                "flight_recorder_events": len(flight),
             },
         }
         with open(path, "w") as f:
-            json.dump(trace, f)
+            json.dump(doc, f, default=str)
         return path
 
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
@@ -379,7 +399,13 @@ def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):
     reset_dispatch_counters()
     out = step_fn(*args, **kwargs)
     lazy.flush_if_pending("measure_programs")
-    counters = dispatch_counters()
+    # dispatch_counters() is an immutable snapshot — annotate a DEEP copy
+    # (nested reason/site maps included), so callers can mutate or
+    # json.dumps the measurement without tripping over a mappingproxy
+    counters = {
+        k: dict(v) if isinstance(v, _MappingABC) else v
+        for k, v in dispatch_counters().items()
+    }
     counters["_step_result"] = out
     counters["_capture_state"] = lazy.step_capture_state()
     counters["_memory"] = _memory_snapshot(counters)

@@ -1,0 +1,419 @@
+"""paddle.profiler.trace — the flight recorder.
+
+A bounded in-memory ring of structured runtime events
+``{ts, kind, site, step, attrs}`` emitted at the execution choke points
+(the always-cheap structured event layer the paper's HostTracer/
+ChromeTracingLogger stack argues for, SURVEY.md §5):
+
+  program          every device-program launch, by category
+                   (op/segment/backward/optimizer/captured)
+  flush            lazy-segment flush: reason, cache hit/miss/join,
+                   fused vs bridged vs per-op fallback
+  async_compile /  background-compile submissions and the joins that
+  async_join       install their executables
+  capture          whole-step capture build/replay/fallback WITH REASON
+  serve_capture    decode-mode capture builds (serving bucket programs)
+  fault / retry    every resilience event: classification, attempt,
+                   backoff, disruptive verdict
+  ladder           degradation-ladder demotions and re-promotions
+  serve            serving request lanes: admit/reject/prefill/decode/
+                   complete/error/requeue, with request ids
+  ckpt             checkpoint pipeline phases: snapshot/persist/commit/
+                   stall, with per-phase ms
+  stall            the step-stall watchdog fired
+  preempt          a preemption signal reached the step boundary
+
+The ring (``FLAGS_trace_ring_size``, default on) is a ``deque(maxlen=N)``
+— append is O(1) and effectively free next to a device launch; with the
+flag at 0 the emit fast path is a single dict read. ``Profiler.export``
+merges these events (and per-request serving lanes) into the chrome trace;
+crash postmortems dump the event tail plus the unified metrics snapshot to
+``FLAGS_postmortem_dir`` as JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback as _tb
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..core import flags as _flags
+
+__all__ = [
+    "TraceEvent",
+    "clear",
+    "dump_postmortem",
+    "emit",
+    "enabled",
+    "events",
+    "last_postmortem_path",
+    "step_heartbeat",
+    "watchdog_disarm",
+]
+
+# direct reference to the flag registry entry: the emit fast path reads one
+# dict key instead of going through flags.flag()'s name normalization
+_ring_entry = _flags._registry["trace_ring_size"]
+
+# wall-clock anchor for the perf_counter timestamps events carry: postmortem
+# and chrome-trace consumers need absolute time, emit must not pay a second
+# clock read
+_ANCHOR_WALL = time.time()
+_ANCHOR_NS = time.perf_counter_ns()
+
+_ring: Optional[deque] = None
+_ring_lock = threading.Lock()  # guards ring (re)creation only, not append
+_faults = None  # lazily bound resilience.faults (step auto-fill)
+
+
+class TraceEvent:
+    """One flight-recorder event. ``ts`` is ``time.perf_counter_ns()`` at
+    emit (monotonic, directly comparable to RecordEvent's host spans);
+    ``wall_time`` derives the absolute time from the module anchor."""
+
+    __slots__ = ("ts", "kind", "site", "step", "attrs")
+
+    def __init__(self, ts: int, kind: str, site: str, step: int,
+                 attrs: Optional[Dict[str, Any]]):
+        self.ts = ts
+        self.kind = kind
+        self.site = site
+        self.step = step
+        self.attrs = attrs
+
+    @property
+    def wall_time(self) -> float:
+        return _ANCHOR_WALL + (self.ts - _ANCHOR_NS) / 1e9
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "ts": round(self.wall_time, 6),
+            "kind": self.kind,
+            "site": self.site,
+            "step": self.step,
+            "attrs": dict(self.attrs) if self.attrs else {},
+        }
+
+    def __repr__(self):
+        a = f" {self.attrs}" if self.attrs else ""
+        return f"<TraceEvent {self.kind}/{self.site} step={self.step}{a}>"
+
+
+def enabled() -> bool:
+    return int(_ring_entry["value"]) > 0
+
+
+def _current_step() -> int:
+    global _faults
+    if _faults is None:
+        from ..resilience import faults as _f
+
+        _faults = _f
+    return _faults.current_step()
+
+
+def emit(kind: str, site: str = "", step: Optional[int] = None, **attrs):
+    """Record one event. Near-zero overhead by construction: off mode is a
+    dict read + falsy test; on mode is one clock read and a bounded-deque
+    append (no locks — deque.append is atomic under the GIL)."""
+    size = _ring_entry["value"]
+    if not size:
+        return None
+    size = int(size)
+    if size <= 0:
+        return None  # a negative flag value means off, not a hot-path raise
+    global _ring
+    ring = _ring
+    if ring is None or ring.maxlen != size:
+        # (re)configure: flag changed since the last emit. Old events are
+        # carried over so a resize doesn't silently drop history. Creation
+        # is locked so two threads racing the first emit (or a resize)
+        # can't each install a ring and lose the other's events; the hot
+        # append path below stays lock-free. Copying the old ring iterates
+        # it while unlocked emitters may still append — retry the rare
+        # 'mutated during iteration' race, and as a last resort start
+        # empty: diagnostics must never add a second failure.
+        with _ring_lock:
+            ring = _ring
+            if ring is None or ring.maxlen != size:
+                for _ in range(4):
+                    try:
+                        ring = deque(_ring or (), maxlen=size)
+                        break
+                    except RuntimeError:
+                        continue
+                else:
+                    ring = deque(maxlen=size)
+                _ring = ring
+    if step is None:
+        step = _current_step()
+    ev = TraceEvent(time.perf_counter_ns(), kind, site, step, attrs or None)
+    ring.append(ev)
+    return ev
+
+
+def events(last: Optional[int] = None) -> List[TraceEvent]:
+    """Snapshot of the ring, oldest first (optionally only the trailing
+    ``last`` events). Safe against concurrent emits: the copy retries the
+    rare 'deque mutated during iteration' race instead of locking the emit
+    path."""
+    ring = _ring
+    if ring is None:
+        return []
+    for _ in range(8):
+        try:
+            out = list(ring)
+            break
+        except RuntimeError:
+            continue
+    else:  # sustained concurrent churn: drain via indexed access
+        out = [ring[i] for i in range(len(ring))]
+    if last is not None and last >= 0:
+        out = out[-last:] if last else []
+    return out
+
+
+def clear():
+    """Drop every recorded event (test isolation / fresh measurement)."""
+    ring = _ring
+    if ring is not None:
+        ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# Crash postmortems: dump the event tail + unified metrics + memory snapshot
+# + resilience state as one JSON file in FLAGS_postmortem_dir.
+# ---------------------------------------------------------------------------
+_pm_lock = threading.Lock()
+_pm_last_path: Optional[str] = None
+_pm_seq = 0
+_pm_active = False  # re-entrance guard: a postmortem must never postmortem
+
+
+def last_postmortem_path() -> Optional[str]:
+    return _pm_last_path
+
+
+def dump_postmortem(reason: str, exc: Optional[BaseException] = None,
+                    **attrs) -> Optional[str]:
+    """Write one postmortem JSON; returns its path, or None when
+    ``FLAGS_postmortem_dir`` is unset (the default) or the dump itself
+    fails — a diagnostics path must never add a second crash."""
+    global _pm_last_path, _pm_seq, _pm_active
+    directory = str(_flags.flag("postmortem_dir"))
+    if not directory:
+        return None
+    with _pm_lock:
+        if _pm_active:
+            return None
+        _pm_active = True
+        try:
+            _pm_seq += 1
+            seq = _pm_seq
+            doc = _build_postmortem(reason, exc, attrs)
+            os.makedirs(directory, exist_ok=True)
+            name = f"postmortem_{reason}_{os.getpid()}_{seq:04d}.json"
+            path = os.path.join(directory, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+            os.replace(tmp, path)
+            _pm_last_path = path
+            emit("postmortem", site=reason, path=path)
+            return path
+        except Exception:
+            return None
+        finally:
+            _pm_active = False
+
+
+def _build_postmortem(reason, exc, attrs) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "reason": reason,
+        "time": time.time(),
+        "pid": os.getpid(),
+        "attrs": {k: v for k, v in (attrs or {}).items()},
+    }
+    try:
+        doc["step"] = _current_step()
+    except Exception:
+        doc["step"] = None
+    if exc is not None:
+        doc["exception"] = {
+            "type": type(exc).__name__,
+            "message": str(exc),
+            "traceback": _tb.format_exception(type(exc), exc,
+                                              exc.__traceback__),
+        }
+    tail = int(_flags.flag("postmortem_events"))
+    doc["events"] = [e.as_dict() for e in events(last=max(0, tail))]
+    # unified metrics: registry-native + the adopted dispatch counters
+    try:
+        from . import metrics as _metrics
+
+        doc["metrics"] = _metrics.snapshot(include_dispatch=True)
+    except Exception:
+        doc["metrics"] = None
+    try:
+        import jax
+
+        live = jax.live_arrays()
+        doc["memory"] = {
+            "live_buffer_bytes": int(
+                sum(int(getattr(a, "nbytes", 0) or 0) for a in live)
+            ),
+            "live_buffer_count": len(live),
+        }
+    except Exception:
+        doc["memory"] = None
+    try:
+        from ..resilience import runtime as _rt
+
+        doc["resilience"] = _rt.state()
+    except Exception:
+        doc["resilience"] = None
+    return doc
+
+
+def read_postmortem(path: str) -> Dict[str, Any]:
+    """Load one postmortem JSON (tools/tests convenience)."""
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Step-stall watchdog (FLAGS_trace_stall_ms): a daemon thread that watches
+# the step heartbeat (resilience.runtime.on_step_end) and dumps a 'stall'
+# postmortem when no boundary lands inside the threshold. One trip per
+# episode; the next heartbeat re-arms.
+# ---------------------------------------------------------------------------
+_wd_lock = threading.Lock()
+_wd_thread: Optional[threading.Thread] = None
+_wd_last_hb: Optional[int] = None
+_wd_fired = False
+_wd_stalls = 0
+
+
+def step_heartbeat():
+    """Step-boundary tick (called from resilience.runtime.on_step_end).
+    Re-arms the watchdog and starts it on first use when
+    FLAGS_trace_stall_ms > 0."""
+    global _wd_last_hb, _wd_fired
+    _wd_last_hb = time.perf_counter_ns()
+    _wd_fired = False
+    if float(_flags.flag("trace_stall_ms")) > 0 and _wd_thread is None:
+        _start_watchdog()
+
+
+def watchdog_disarm():
+    """Stand down the stall watchdog until the next heartbeat. A training
+    loop that ENDS looks exactly like a stalled one — no more step
+    boundaries — so clean completion must disarm (train_step_range /
+    train_epoch_range do this in their finally) or every finished run
+    would dump a spurious stall postmortem."""
+    global _wd_last_hb, _wd_fired
+    _wd_last_hb = None
+    _wd_fired = False
+
+
+def stall_count() -> int:
+    return _wd_stalls
+
+
+def _start_watchdog():
+    global _wd_thread
+    with _wd_lock:
+        if _wd_thread is not None:
+            return
+        t = threading.Thread(target=_watchdog_loop, daemon=True,
+                             name="paddle-stall-watchdog")
+        _wd_thread = t
+        t.start()
+
+
+def _watchdog_loop():
+    global _wd_fired, _wd_stalls
+    while True:
+        ms = float(_flags.flag("trace_stall_ms"))
+        if ms <= 0:
+            time.sleep(0.25)
+            continue
+        time.sleep(min(max(ms / 2000.0, 0.005), 0.5))
+        hb = _wd_last_hb
+        if hb is None or _wd_fired:
+            continue
+        stalled_ms = (time.perf_counter_ns() - hb) / 1e6
+        if stalled_ms >= ms:
+            _wd_fired = True
+            _wd_stalls += 1
+            emit("stall", site="watchdog", stalled_ms=round(stalled_ms, 1),
+                 threshold_ms=ms)
+            dump_postmortem("stall", stalled_ms=round(stalled_ms, 1),
+                            threshold_ms=ms)
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace conversion: flight events become instants on a dedicated
+# lane; serving events become per-request async lanes (ph b/n/e keyed by
+# request id), so a continuous-batching interleave or a ladder demotion is
+# visible on one timeline next to the RecordEvent host spans.
+# ---------------------------------------------------------------------------
+_FLIGHT_TID = 1
+_SERVE_END_PHASES = frozenset(("complete", "error", "reject"))
+
+
+def chrome_trace_events(evts: Optional[List[TraceEvent]] = None):
+    pid = os.getpid()
+    src = events() if evts is None else evts
+    # a request's lane begins at its admit event; any serve event for a
+    # request WITHOUT a begin in the window — rejected at submit, or its
+    # admit already evicted from the ring — renders as a plain thread
+    # instant (ph "i"), since async events without an enclosing b/e pair
+    # (lone "e" OR lone "n") are dropped as malformed by trace viewers
+    admitted = {
+        (ev.attrs or {}).get("rid")
+        for ev in src
+        if ev.kind == "serve" and (ev.attrs or {}).get("phase") == "admit"
+    }
+    out = []
+    for ev in src:
+        ts_us = ev.ts / 1000.0
+        attrs = dict(ev.attrs) if ev.attrs else {}
+        if ev.kind == "serve":
+            phase = attrs.pop("phase", "")
+            rids = attrs.pop("rids", None)
+            if rids is None:
+                rid = attrs.pop("rid", None)
+                rids = [] if rid is None else [rid]
+            for rid in rids:
+                args = dict(attrs, phase=phase, step=ev.step)
+                if rid not in admitted:
+                    out.append({
+                        "name": f"serve:{phase}", "cat": "serving",
+                        "ph": "i", "s": "t", "ts": ts_us, "pid": pid,
+                        "tid": _FLIGHT_TID, "args": dict(args, rid=rid),
+                    })
+                    continue
+                if phase == "admit":
+                    ph = "b"
+                elif phase in _SERVE_END_PHASES:
+                    ph = "e"
+                else:
+                    ph = "n"
+                out.append({
+                    "name": "request", "cat": "serving", "ph": ph,
+                    "id": str(rid), "ts": ts_us, "pid": pid,
+                    "tid": _FLIGHT_TID,
+                    "args": args,
+                })
+            continue
+        name = ev.kind if not ev.site else f"{ev.kind}:{ev.site}"
+        out.append({
+            "name": name, "cat": "flight", "ph": "i", "s": "t",
+            "ts": ts_us, "pid": pid, "tid": _FLIGHT_TID,
+            "args": dict(attrs, step=ev.step),
+        })
+    return out

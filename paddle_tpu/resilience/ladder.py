@@ -104,6 +104,7 @@ class DegradationLadder:
         if not st.demoted and st.faults >= self.policy.demote_after:
             st.demoted = True
             self._count("ladder_demotions")
+            self._emit("demote", tier, key, st.faults)
             if tier == "lazy":
                 self._lazy_demoted = True
 
@@ -119,6 +120,7 @@ class DegradationLadder:
                     st.faults = 0
                     st.clean_steps = 0
                     self._count("ladder_promotions")
+                    self._emit("promote", tier, _key, 0)
                     if tier == "lazy":
                         self._lazy_demoted = any(
                             s.demoted for (t, _k), s in self._states.items()
@@ -151,6 +153,13 @@ class DegradationLadder:
         from ..core import dispatch
 
         dispatch._counters[name] += 1
+
+    @staticmethod
+    def _emit(action: str, tier: str, key, faults: int):
+        from ..core import dispatch
+
+        dispatch._emit("ladder", site=tier, action=action,
+                       key=None if key is None else str(key), faults=faults)
 
 
 _ladder = DegradationLadder()

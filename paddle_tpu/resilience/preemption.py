@@ -140,5 +140,16 @@ class PreemptionGuard:
         if self.on_preempt is not None:
             self.on_preempt(step)
         self.emergency_save(step)
-        raise Preempted(self.signum if self.signum is not None else signal.SIGTERM,
-                        step)
+        signum = self.signum if self.signum is not None else signal.SIGTERM
+        exc = Preempted(signum, step)
+        try:
+            from ..profiler import trace as _trace
+
+            _trace.emit("preempt", site="guard", step=step, signum=signum)
+            # the emergency snapshot is durable by now; the postmortem
+            # records what the run looked like at the boundary it exits on
+            _trace.dump_postmortem("preempted", exc=exc, signum=signum,
+                                   last_completed_step=step)
+        except Exception:
+            pass  # diagnostics must never block the preemption exit
+        raise exc

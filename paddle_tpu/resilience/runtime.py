@@ -110,17 +110,37 @@ def execute(site: str, thunk: Callable[[], Any], *, fresh: bool = False,
             disruptive = not replayable or attempt >= max_retries
             _record_fault(site, e, transient, ladder_key, disruptive)
             if not replayable:
+                _postmortem_escape(site, e, attempt)
                 raise
             if attempt >= max_retries:
                 _disp()._counters["retry_exhausted"] += 1
+                _postmortem_escape(site, e, attempt)
                 raise
             attempt += 1
             d = _disp()
             d._counters["retry_attempts"] += 1
             delay = _retry.default_policy().delay_ms(attempt)
+            d._emit("retry", site=site, attempt=attempt,
+                    delay_ms=round(delay, 2), error=type(e).__name__)
             if delay > 0:
                 time.sleep(delay / 1000.0)
             d._counters["retry_backoff_ms"] += delay
+
+
+def _postmortem_escape(site: str, e: BaseException, attempt: int):
+    """An unrecovered fault is escaping execute() — fatal, donated-input
+    unsafe, or retries exhausted. Dump a crash postmortem (no-op unless
+    FLAGS_postmortem_dir is set): even when a HIGHER tier's fallback later
+    completes the step, the dump records why this launch failed — site,
+    retries, classification, and the flight recorder's event tail."""
+    try:
+        _disp()._trace_module().dump_postmortem(
+            "unrecovered_fault", exc=e, site=site, retries=attempt,
+            transient=_retry.is_transient(e),
+            injected=isinstance(e, faults.InjectedFault),
+        )
+    except Exception:
+        pass  # diagnostics must never add a second failure
 
 
 def _record_fault(site: str, e: BaseException, transient: bool,
@@ -130,9 +150,12 @@ def _record_fault(site: str, e: BaseException, transient: bool,
     c["fault_events"] += 1
     sites = c["fault_sites"]
     sites[site] = sites.get(site, 0) + 1
-    if isinstance(e, faults.InjectedFault):
+    injected = isinstance(e, faults.InjectedFault)
+    if injected:
         c["injected_faults"] += 1
     c["transient_faults" if transient else "fatal_faults"] += 1
+    d._emit("fault", site=site, error=type(e).__name__, transient=transient,
+            injected=injected, disruptive=disruptive)
     # only DISRUPTIVE faults (fatal, or transient with retries exhausted)
     # count toward ladder demotion: a retried-and-recovered fault re-ran the
     # exact same program, so it never perturbs numerics — demoting on it
@@ -155,9 +178,14 @@ def captured_tier_ok(key: Hashable = None) -> bool:
 
 def on_step_end():
     """Optimizer.step boundary tick: advances the fault-injection step
-    counter and the ladder's cooldown clocks."""
+    counter, the ladder's cooldown clocks, and the stall watchdog's
+    heartbeat (paddle.profiler.trace / FLAGS_trace_stall_ms)."""
     faults.advance_step()
     _ladder.degradation_ladder().step_end()
+    try:
+        _disp()._trace_module().step_heartbeat()
+    except Exception:
+        pass  # observability must never break the step boundary
 
 
 def state() -> dict:

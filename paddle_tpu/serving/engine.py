@@ -195,11 +195,20 @@ class Engine:
         self._accepted: set = set()
         self._draining = False
         self._prev_handlers: Dict[int, Any] = {}
-        # bounded reservoir: long-running engines must not grow host memory
-        # with total traffic (stats() percentiles cover the recent window)
-        from collections import deque as _deque
+        # streaming log-bucketed histogram (paddle.profiler.metrics): O(1)
+        # observe, fixed memory, LIFETIME coverage — replaces the old
+        # 4096-entry recent-window reservoir whose stats() paid an
+        # np.percentile over a copy on every call. Registered in the
+        # default registry (labeled by engine uid) so Prometheus exposition
+        # and postmortems see per-engine latency; close() unregisters.
+        from ..profiler import metrics as _metrics
 
-        self._token_lat_ms = _deque(maxlen=4096)
+        self._token_lat = _metrics.default_registry().histogram(
+            "serve_token_lat_ms",
+            doc="per-token serving latency (first token incl. prefill, "
+                "then one sample per decoded token), ms",
+            labels={"engine": str(self._uid)},
+        )
         self._decode_rows = 0
         # lifetime per-engine outcome counts (responses themselves are
         # evicted by serve()/pop_response, so stats can't scan them)
@@ -341,6 +350,8 @@ class Engine:
             return req.request_id
         self._queue.push(req)
         self._accepted.add(req.request_id)
+        dispatch._emit("serve", site="engine", phase="admit",
+                       rid=req.request_id, prompt_len=plen, blocks=n_blk)
         return req.request_id
 
     def response(self, request_id: int) -> Optional[Response]:
@@ -432,12 +443,16 @@ class Engine:
 
     def close(self):
         """Release this engine's captured programs from the decode-mode
-        capture cache (their closures hold the model) and restore any
-        signal handlers. Safe to call twice."""
+        capture cache (their closures hold the model), unregister its
+        latency histogram, and restore any signal handlers. Safe to call
+        twice."""
         from ..core.lazy import reset_serve_programs
+        from ..profiler import metrics as _metrics
 
         self.uninstall_preemption_handler()
         reset_serve_programs(owner=self._uid)
+        _metrics.default_registry().remove(
+            "serve_token_lat_ms", labels={"engine": str(self._uid)})
 
     def __del__(self):
         try:
@@ -447,17 +462,21 @@ class Engine:
 
     # -- introspection ---------------------------------------------------
     def reset_stats(self):
-        """Drop the latency samples (e.g. after a warm-up window, so
+        """Drop the latency histogram (e.g. after a warm-up window, so
         steady-state percentiles don't average in compile time). Counters
         in dispatch_counters() reset separately; pool peak occupancy is
         lifetime."""
-        self._token_lat_ms.clear()
+        self._token_lat.reset()
         self._decode_rows = 0
 
     def stats(self) -> Dict[str, Any]:
+        """Percentiles come from the streaming histogram: O(buckets), no
+        reservoir copy, lifetime coverage (bounded relative error from the
+        log bucketing — see profiler.metrics.Histogram)."""
         from ..core.lazy import serve_capture_state
 
-        lat = np.asarray(self._token_lat_ms, np.float64)
+        p50 = self._token_lat.quantile(0.5)
+        p99 = self._token_lat.quantile(0.99)
         out = {
             "completed": self._n_completed,
             "rejected": self._n_rejected,
@@ -466,10 +485,9 @@ class Engine:
             "pool_blocks": self._pool.num_blocks,
             "pool_occupancy": round(self._pool.occupancy(), 4),
             "pool_peak_occupancy": round(self._pool.peak_occupancy, 4),
-            "token_lat_p50_ms": (
-                round(float(np.percentile(lat, 50)), 3) if lat.size else None),
-            "token_lat_p99_ms": (
-                round(float(np.percentile(lat, 99)), 3) if lat.size else None),
+            "token_lat_p50_ms": None if p50 is None else round(p50, 3),
+            "token_lat_p99_ms": None if p99 is None else round(p99, 3),
+            "token_lat_count": self._token_lat.count,
             "capture": serve_capture_state(),
         }
         if self._pool_plan is not None:
@@ -491,8 +509,12 @@ class Engine:
             request_id=req.request_id, status="rejected", error=why,
             prompt_len=int(req.prompt.size), submit_time=req.submit_time,
         )
+        dispatch._emit("serve", site="engine", phase="reject",
+                       rid=req.request_id, why=why[:120])
 
     def _error(self, req: Request, why: str, seq: Optional[Sequence] = None):
+        from ..core import dispatch
+
         self._n_errors += 1
         self._responses[req.request_id] = Response(
             request_id=req.request_id, status="error", error=why,
@@ -500,6 +522,8 @@ class Engine:
             prompt_len=int(req.prompt.size), submit_time=req.submit_time,
             done_time=time.time(),
         )
+        dispatch._emit("serve", site="engine", phase="error",
+                       rid=req.request_id, why=why[:120])
 
     def _complete(self, seq: Sequence):
         from ..core import dispatch
@@ -507,6 +531,8 @@ class Engine:
         self._active.remove(seq)
         self._pool.free(seq.blocks)
         dispatch._counters["serve_requests_completed"] += 1
+        dispatch._emit("serve", site="engine", phase="complete",
+                       rid=seq.req.request_id, tokens=len(seq.tokens))
         self._n_completed += 1
         self._responses[seq.req.request_id] = Response(
             request_id=seq.req.request_id, status="ok",
@@ -533,6 +559,9 @@ class Engine:
                         f"failed after {req.retries - 1} retries: {err}", seq)
             return
         dispatch._counters["serve_request_requeues"] += 1
+        dispatch._emit("serve", site="engine", phase="requeue",
+                       rid=req.request_id, retries=req.retries,
+                       error=type(err).__name__)
         self._queue.push_front(req)
 
     def _recover_pools(self, err: _PoolsConsumed):
@@ -595,7 +624,11 @@ class Engine:
         self._pool.k, self._pool.v = list(k_pools), list(v_pools)
         tok = int(np.asarray(jax.device_get(nxt))[0])
         dispatch._counters["serve_prefills"] += 1
-        self._token_lat_ms.append((time.perf_counter() - t0) * 1000.0)
+        prefill_ms = (time.perf_counter() - t0) * 1000.0
+        self._token_lat.observe(prefill_ms)
+        dispatch._emit("serve", site="engine", phase="prefill",
+                       rid=req.request_id, bucket=P, blocks=seq.n_blk,
+                       ms=round(prefill_ms, 3))
         seq.length = plen
         seq.tokens.append(tok)
         seq.last_token = tok
@@ -662,6 +695,9 @@ class Engine:
             np.asarray(jax.device_get(row)) if self._keep_logits else None)
         step_ms = (time.perf_counter() - t0) * 1000.0
         dispatch._counters["serve_decode_steps"] += 1
+        dispatch._emit("serve", site="engine", phase="decode",
+                       rids=tuple(s.req.request_id for s in ready),
+                       batch=B, blocks=n_blk, ms=round(step_ms, 3))
         self._decode_rows += len(ready)
         for i, s in enumerate(ready):
             tok = int(out[i])
@@ -670,7 +706,7 @@ class Engine:
             s.last_token = tok
             if row_np is not None:
                 s.logits.append(row_np[i])
-            self._token_lat_ms.append(step_ms)
+            self._token_lat.observe(step_ms)
             if s.done:
                 self._complete(s)
         return True

@@ -1,0 +1,550 @@
+"""Runtime observability (ISSUE 9): flight recorder, unified metrics
+registry, crash postmortems, and the merged chrome trace.
+
+Acceptance slices covered here:
+  - the flight-recorder ring is bounded under sustained load, ordered, and
+    free on the off-mode fast path;
+  - a forced unrecovered fault dumps a postmortem JSON (subprocess) whose
+    event tail explains the fault (site, retries);
+  - Prometheus text exposition round-trips against the snapshot API;
+  - serving request lanes join into the merged chrome trace (b/n/e async
+    events per request id);
+  - dispatch_counters() is an immutable snapshot; capture fallback-reason
+    events match the capture_fallback_reasons histogram;
+  - the step-stall watchdog trips once per episode and re-arms.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+from paddle_tpu.profiler import metrics, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    res.reset()
+    prof.reset_dispatch_counters()
+    trace.clear()
+    yield
+    paddle.set_flags({
+        "FLAGS_trace_ring_size": 4096,
+        "FLAGS_trace_stall_ms": 0.0,
+        "FLAGS_postmortem_dir": "",
+        "FLAGS_fault_inject": "",
+        "FLAGS_eager_lazy_dispatch": False,
+        "FLAGS_retry_backoff_ms": 5.0,
+        "FLAGS_retry_max": 2,
+    })
+    res.reset()
+    trace.clear()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring mechanics
+# ---------------------------------------------------------------------------
+def test_ring_bounded_under_sustained_load_and_ordered():
+    paddle.set_flags({"FLAGS_trace_ring_size": 128})
+    trace.clear()
+    for i in range(5000):
+        trace.emit("probe", site="test", step=0, i=i)
+    evs = trace.events()
+    assert len(evs) == 128  # bounded, not 5000
+    # ordering: the ring keeps the newest events, oldest first
+    idx = [e.attrs["i"] for e in evs]
+    assert idx == list(range(5000 - 128, 5000))
+    ts = [e.ts for e in evs]
+    assert ts == sorted(ts)
+    # tail selection
+    assert [e.attrs["i"] for e in trace.events(last=3)] == [4997, 4998, 4999]
+
+
+def test_ring_off_mode_fast_path_and_resize():
+    paddle.set_flags({"FLAGS_trace_ring_size": 0})
+    trace.clear()
+    assert not trace.enabled()
+    assert trace.emit("probe", site="x") is None
+    assert trace.events() == []
+    # off mode must be CHEAP: no event objects, no clock reads — bound the
+    # per-call cost loosely (it's one dict read + a falsy test)
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        trace.emit("probe")
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    assert per_call_us < 5.0, f"off-mode emit costs {per_call_us:.2f}us"
+    # re-enable: emission resumes with the new capacity
+    paddle.set_flags({"FLAGS_trace_ring_size": 16})
+    for i in range(40):
+        trace.emit("probe", i=i)
+    assert len(trace.events()) == 16
+
+
+def test_events_auto_fill_step_from_fault_clock():
+    paddle.set_flags({"FLAGS_trace_ring_size": 64})
+    res.reset()
+    from paddle_tpu.resilience import faults
+
+    faults.advance_step()
+    faults.advance_step()
+    ev = trace.emit("probe", site="x")
+    assert ev.step == 2
+    assert trace.emit("probe", step=7).step == 7
+
+
+# ---------------------------------------------------------------------------
+# runtime events at the choke points
+# ---------------------------------------------------------------------------
+def _lenet_free_step():
+    paddle.seed(0)
+    w = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    loss = (x @ w).sum()
+    loss.backward()
+    return w
+
+
+def test_flush_and_program_events_under_lazy_dispatch():
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_trace_ring_size": 4096})
+    trace.clear()
+    _lenet_free_step()
+    kinds = {(e.kind, e.site) for e in trace.events()}
+    assert ("flush", "segment") in kinds
+    assert ("program", "segment") in kinds
+    assert ("program", "backward") in kinds
+    flush = [e for e in trace.events() if e.kind == "flush"][0]
+    assert flush.attrs["reason"] in ("backward", "sync")
+    assert flush.attrs["cache"] in ("hit", "miss", "join")
+
+
+def test_capture_fallback_reason_events_match_counters():
+    """The fallback-reason event stream must agree with the
+    capture_fallback_reasons histogram — the obs_probe gate's contract."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True,
+                      "FLAGS_trace_ring_size": 4096})
+    trace.clear()
+    prof.reset_dispatch_counters()
+    paddle.seed(0)
+    w = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32),
+                         stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for step in range(5):
+        loss = (x @ w).sum()
+        loss.backward()
+        if step >= 3:
+            # reading the grad between backward and step aborts a deferred
+            # captured step — a counted, reasoned fallback
+            _ = w.grad.numpy()
+        opt.step()
+        opt.clear_grad()
+    reasons = dict(prof.dispatch_counters()["capture_fallback_reasons"])
+    ev_reasons = {}
+    for e in trace.events():
+        if e.kind == "capture" and e.attrs and e.attrs.get("phase") == "fallback":
+            r = e.attrs["reason"]
+            ev_reasons[r] = ev_reasons.get(r, 0) + 1
+    assert reasons, "expected at least one capture fallback"
+    assert ev_reasons == reasons
+
+
+def test_fault_retry_and_ladder_events():
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_fault_inject": "execute:segment:p=1:x=1",
+                      "FLAGS_retry_backoff_ms": 0.1,
+                      "FLAGS_trace_ring_size": 4096})
+    trace.clear()
+    _lenet_free_step()
+    paddle.set_flags({"FLAGS_fault_inject": ""})
+    kinds = [e.kind for e in trace.events()]
+    assert "fault" in kinds and "retry" in kinds
+    fault = [e for e in trace.events() if e.kind == "fault"][0]
+    assert fault.site == "segment"
+    assert fault.attrs["injected"] and fault.attrs["transient"]
+    retry = [e for e in trace.events() if e.kind == "retry"][0]
+    assert retry.attrs["attempt"] == 1
+
+
+def test_ckpt_events():
+    from paddle_tpu.distributed.checkpoint import AsyncCheckpointer
+
+    paddle.set_flags({"FLAGS_trace_ring_size": 4096})
+    trace.clear()
+    w = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with tempfile.TemporaryDirectory() as d:
+        ck = AsyncCheckpointer(d, max_to_keep=1)
+        ck.save(0, {"w": w})
+        ck.wait()
+    phases = {e.attrs["phase"] for e in trace.events() if e.kind == "ckpt"}
+    assert "snapshot" in phases and "commit" in phases
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+def test_registry_types_and_snapshot():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("events", doc="events seen")
+    c.inc()
+    c.inc(2)
+    g = reg.gauge("depth")
+    g.set(3)
+    g.add(-1)
+    h = reg.histogram("lat_ms")
+    for v in (1.0, 2.0, 4.0, 1000.0):
+        h.observe(v)
+    assert reg.counter("events") is c  # get-or-create returns the SAME object
+    with pytest.raises(TypeError):
+        reg.gauge("events")  # type conflict fails loud
+    assert reg.histogram("lat_ms") is h
+    with pytest.raises(ValueError):
+        # a DIFFERENT bucket geometry must not silently hand back the old
+        # one (the caller would run with 3x the expected quantile error)
+        reg.histogram("lat_ms", factor=1.05)
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotonic
+    snap = reg.snapshot(include_dispatch=False)
+    assert snap["counters"]["events"] == 3
+    assert snap["gauges"]["depth"] == 2
+    hd = snap["histograms"]["lat_ms"]
+    assert hd["count"] == 4 and hd["min"] == 1.0 and hd["max"] == 1000.0
+    # mutating the snapshot never touches live state
+    snap["counters"]["events"] = 0
+    assert reg.snapshot(include_dispatch=False)["counters"]["events"] == 3
+
+
+def test_histogram_quantiles_bounded_error():
+    h = metrics.Histogram()
+    rng = np.random.default_rng(0)
+    samples = rng.lognormal(mean=1.0, sigma=1.0, size=20_000)
+    for v in samples:
+        h.observe(float(v))
+    for q in (0.5, 0.9, 0.99):
+        est = h.quantile(q)
+        true = float(np.quantile(samples, q))
+        assert abs(est - true) / true < 0.16, (q, est, true)
+    assert h.quantile(0.0) == float(samples.min())
+    assert h.quantile(1.0) == float(samples.max())
+    h.reset()
+    assert h.quantile(0.5) is None and h.count == 0
+
+
+def test_prometheus_text_round_trip():
+    reg = metrics.MetricsRegistry()
+    reg.counter("requests", labels={"engine": "1"}).inc(5)
+    reg.gauge("pool_occupancy").set(0.25)
+    h = reg.histogram("lat_ms")
+    for v in (1.0, 2.0, 300.0):
+        h.observe(v)
+    text = reg.prometheus_text(include_dispatch=False)
+    parsed = metrics.parse_prometheus_text(text)
+    snap = reg.snapshot(include_dispatch=False)
+    assert parsed['paddle_requests{engine="1"}'] == snap["counters"][
+        'requests{engine="1"}'] == 5
+    assert parsed["paddle_pool_occupancy"] == 0.25
+    assert parsed["paddle_lat_ms_count"] == 3
+    assert abs(parsed["paddle_lat_ms_sum"] - 303.0) < 1e-6
+    # cumulative buckets: the +Inf bucket equals the count
+    inf_buckets = [v for k, v in parsed.items()
+                   if k.startswith("paddle_lat_ms_bucket") and "+Inf" in k]
+    assert inf_buckets and inf_buckets[-1] == 3
+    # TYPE lines present for scrapers
+    assert "# TYPE paddle_lat_ms histogram" in text
+    assert "# TYPE paddle_requests counter" in text
+
+
+def test_dispatch_counters_adopted_by_registry():
+    prof.reset_dispatch_counters()
+    _ = paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0
+    snap = metrics.snapshot()
+    assert snap["counters"]["programs"] >= 1
+    text = metrics.prometheus_text()
+    parsed = metrics.parse_prometheus_text(text)
+    assert parsed["paddle_programs"] >= 1
+    # nested reason dicts become labeled counter families
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True})
+    _lenet_free_step()
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    parsed = metrics.parse_prometheus_text(metrics.prometheus_text())
+    assert any(k.startswith("paddle_flush_reasons{reason=")
+               for k in parsed)
+
+
+def test_dispatch_counters_snapshot_is_immutable():
+    c = prof.dispatch_counters()
+    with pytest.raises(TypeError):
+        c["programs"] = 0
+    with pytest.raises(TypeError):
+        c["flush_reasons"]["x"] = 1
+    # measure_programs annotates a DEEP copy: nested reason maps are plain
+    # dicts again, so the measurement is mutable and JSON-serializable
+    out = prof.measure_programs(
+        lambda: paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0)
+    assert "_capture_state" in out and "_step_result" in out
+    out["flush_reasons"]["x"] = 1  # mutable
+    json.dumps({k: v for k, v in out.items() if not k.startswith("_")})
+
+
+def test_counter_reset_race_free_helper():
+    from paddle_tpu.core import dispatch
+
+    prof.reset_dispatch_counters()
+    dispatch._counter_add("async_compile_ms", 1.5)
+    assert prof.dispatch_counters()["async_compile_ms"] == 1.5
+    # after a reset, an off-thread add lands on the fresh dict (no KeyError)
+    prof.reset_dispatch_counters()
+    dispatch._counter_add("async_compile_ms", 2.0)
+    assert prof.dispatch_counters()["async_compile_ms"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# serving: histogram-backed stats + request-span join in the chrome trace
+# ---------------------------------------------------------------------------
+def _tiny_engine():
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return serving.Engine(m, serving.ServingConfig(
+        block_size=8, prompt_buckets=[8], num_blocks=24))
+
+
+def test_serving_stats_backed_by_histogram():
+    eng = _tiny_engine()
+    try:
+        resp = eng.serve([[1, 2, 3], [4, 5]], max_new_tokens=4)
+        assert all(r.status == "ok" for r in resp)
+        st = eng.stats()
+        assert st["token_lat_p50_ms"] is not None
+        assert st["token_lat_p99_ms"] >= st["token_lat_p50_ms"]
+        assert st["token_lat_count"] >= 8  # lifetime samples, no reservoir
+        # the histogram is registered (prometheus sees per-engine latency)
+        parsed = metrics.parse_prometheus_text(metrics.prometheus_text())
+        assert any(k.startswith("paddle_serve_token_lat_ms_count")
+                   for k in parsed)
+        eng.reset_stats()
+        assert eng.stats()["token_lat_p50_ms"] is None
+    finally:
+        eng.close()
+    # close() unregisters the per-engine histogram
+    assert not any(
+        m.name == "serve_token_lat_ms"
+        and m.labels.get("engine") == str(eng._uid)
+        for m in metrics.default_registry().metrics()
+    )
+
+
+def test_serving_request_span_join_in_chrome_trace():
+    paddle.set_flags({"FLAGS_trace_ring_size": 4096})
+    trace.clear()
+    eng = _tiny_engine()
+    try:
+        ids = [eng.submit([1, 2, 3], max_new_tokens=4),
+               eng.submit([4, 5], max_new_tokens=4)]
+        # rejected at submit (context beyond the model's positions): its
+        # lane never began, so it must render as an instant — an unmatched
+        # async-end would be dropped as malformed by perfetto
+        rejected = eng.submit([1] * 8, max_new_tokens=1000)
+        eng.run_until_idle()
+    finally:
+        eng.close()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        prof.Profiler(timer_only=True).export(path)
+        doc = json.load(open(path))
+    serve_evs = [e for e in doc["traceEvents"] if e.get("cat") == "serving"]
+    for rid in ids:
+        lane = [e for e in serve_evs if e.get("id") == str(rid)]
+        phs = [e["ph"] for e in lane]
+        # each request is one async lane: begin (admit) ... instants
+        # (prefill/decode ticks) ... end (complete)
+        assert phs[0] == "b" and phs[-1] == "e", phs
+        assert "n" in phs
+        phases = [e["args"]["phase"] for e in lane]
+        assert "prefill" in phases and "decode" in phases
+        # timestamps are ordered within a lane
+        ts = [e["ts"] for e in lane]
+        assert ts == sorted(ts)
+    # the rejected request never began a lane: no async events carry its
+    # id (a lone "e"/"n" would be dropped as malformed); it shows up as a
+    # plain instant instead
+    assert not any(e.get("id") == str(rejected) for e in serve_evs)
+    rej_inst = [e for e in serve_evs
+                if e["ph"] == "i" and e["args"].get("rid") == rejected]
+    assert rej_inst and rej_inst[0]["name"] == "serve:reject"
+    # flight instants share the timeline (flush/capture/program events)
+    assert any(e.get("cat") == "flight" for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# postmortems
+# ---------------------------------------------------------------------------
+_PM_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["FLAGS_postmortem_dir"] = sys.argv[1]
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+import paddle_tpu as paddle
+
+# an injected fault that outlives the retry budget is unrecovered at the
+# per-op floor: it must propagate AND dump a postmortem on the way out
+paddle.set_flags({"FLAGS_fault_inject": "execute:op:p=1:x=99",
+                  "FLAGS_retry_max": 1, "FLAGS_retry_backoff_ms": 0.1})
+try:
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    (x + x).numpy()
+    sys.exit(3)  # UNREACHABLE: the fault must fire
+except Exception as e:
+    print("fault:", type(e).__name__)
+sys.exit(0)
+"""
+
+
+@pytest.mark.slow
+def test_postmortem_on_injected_fatal_fault_subprocess():
+    with tempfile.TemporaryDirectory() as d:
+        script = os.path.join(d, "crash.py")
+        with open(script, "w") as f:
+            f.write(_PM_SCRIPT)
+        out = subprocess.run(
+            [sys.executable, script, d, REPO], capture_output=True,
+            text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        pms = [f for f in os.listdir(d) if f.startswith("postmortem_")]
+        assert pms, "no postmortem written"
+        doc = json.load(open(os.path.join(d, sorted(pms)[0])))
+    assert doc["reason"] == "unrecovered_fault"
+    assert doc["attrs"]["site"] == "op"
+    assert doc["attrs"]["retries"] == 1
+    assert doc["exception"]["type"] == "InjectedExecuteError"
+    # the event tail explains the fault: fault + retry events at the site
+    kinds = [(e["kind"], e["site"]) for e in doc["events"]]
+    assert ("fault", "op") in kinds and ("retry", "op") in kinds
+    # metrics snapshot rode along (dispatch counters adopted)
+    assert doc["metrics"]["counters"]["retry_exhausted"] >= 1
+    assert doc["memory"] is None or "live_buffer_count" in doc["memory"]
+
+
+def test_postmortem_disabled_by_default_and_inline_dump():
+    assert paddle.get_flags("FLAGS_postmortem_dir")["FLAGS_postmortem_dir"] == ""
+    assert trace.dump_postmortem("probe") is None  # no dir — no-op
+    with tempfile.TemporaryDirectory() as d:
+        paddle.set_flags({"FLAGS_postmortem_dir": d,
+                          "FLAGS_postmortem_events": 5})
+        for i in range(20):
+            trace.emit("probe", i=i)
+        path = trace.dump_postmortem("probe", extra="x")
+        assert path and os.path.exists(path)
+        doc = json.load(open(path))
+        probes = [e for e in doc["events"] if e["kind"] == "probe"]
+        assert len(probes) <= 5  # FLAGS_postmortem_events caps the tail
+        assert doc["attrs"]["extra"] == "x"
+        paddle.set_flags({"FLAGS_postmortem_dir": ""})
+
+
+def test_preempted_postmortem():
+    from paddle_tpu.resilience import Preempted, PreemptionGuard
+
+    with tempfile.TemporaryDirectory() as d:
+        paddle.set_flags({"FLAGS_postmortem_dir": d})
+        guard = PreemptionGuard()
+        guard.preempted = True
+        guard.signum = 15
+        with pytest.raises(Preempted):
+            guard.step_boundary(4)
+        pms = [f for f in os.listdir(d) if "preempted" in f]
+        assert len(pms) == 1
+        doc = json.load(open(os.path.join(d, pms[0])))
+        assert doc["attrs"]["last_completed_step"] == 4
+        paddle.set_flags({"FLAGS_postmortem_dir": ""})
+
+
+def test_verification_error_postmortem():
+    import jax.numpy as jnp
+    from paddle_tpu import analysis
+
+    with tempfile.TemporaryDirectory() as d:
+        paddle.set_flags({"FLAGS_postmortem_dir": d})
+        import jax
+
+        # unguarded log: a numeric-hazard ERROR diagnostic at level 2
+        jaxpr = jax.make_jaxpr(lambda x: jnp.log(x))(
+            jax.ShapeDtypeStruct((4,), jnp.float32))
+        diags = analysis.check(jaxpr, source="test")
+        try:
+            analysis.enforce(diags, where="test", level=2)
+            raised = False
+        except analysis.ProgramVerificationError:
+            raised = True
+        pms = [f for f in os.listdir(d) if "verification" in f]
+        assert raised == bool(pms)  # dump iff the verdict raised
+        if raised:
+            doc = json.load(open(os.path.join(d, pms[0])))
+            assert doc["exception"]["type"] == "ProgramVerificationError"
+        paddle.set_flags({"FLAGS_postmortem_dir": ""})
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+def test_stall_watchdog_trips_once_per_episode():
+    with tempfile.TemporaryDirectory() as d:
+        paddle.set_flags({"FLAGS_trace_stall_ms": 60.0,
+                          "FLAGS_postmortem_dir": d})
+        before = trace.stall_count()
+        trace.step_heartbeat()
+        deadline = time.time() + 5.0
+        while trace.stall_count() == before and time.time() < deadline:
+            time.sleep(0.02)
+        assert trace.stall_count() == before + 1
+        # one trip per episode: no second dump while stalled
+        time.sleep(0.2)
+        assert trace.stall_count() == before + 1
+        pms = [f for f in os.listdir(d) if "stall" in f]
+        assert len(pms) == 1
+        doc = json.load(open(os.path.join(d, pms[0])))
+        assert doc["attrs"]["stalled_ms"] >= 60.0
+        # a disarmed watchdog stays quiet: a finished training loop looks
+        # exactly like a stall, so train_step_range disarms in its finally
+        trace.step_heartbeat()
+        trace.watchdog_disarm()
+        time.sleep(0.25)
+        assert trace.stall_count() == before + 1
+        assert len([f for f in os.listdir(d) if "stall" in f]) == 1
+        paddle.set_flags({"FLAGS_trace_stall_ms": 0.0,
+                          "FLAGS_postmortem_dir": ""})
+
+
+# ---------------------------------------------------------------------------
+# the obs probe CLI gate (subprocess — slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_obs_probe_cli():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_probe.py"),
+         "--steps", "6", "--batch", "8"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL SCENARIOS PASSED" in out.stdout

@@ -1,0 +1,335 @@
+"""Observability probe: the flight recorder / postmortem / overhead gate.
+
+The CI-facing proof of the ISSUE-9 acceptance criteria, run on the LeNet
+example (and a tiny GPT serving engine):
+
+  chaos-events          LeNet under execute:p=0.2,compile:p=0.2 recovers
+                        bitwise, and the capture fallback-reason EVENTS in
+                        the flight recorder match the
+                        capture_fallback_reasons counter histogram exactly
+  unrecovered-postmortem a fault storm that outlives the retry budget at
+                        the captured tier dumps a postmortem JSON whose
+                        event tail explains the fault — site, retries, and
+                        the ladder demotion that followed — while the run
+                        itself completes on the fallback path
+  serving-lanes         the merged chrome trace contains one async lane
+                        per served request (b/n/e events keyed by id)
+  trace-overhead        tracing on (default ring) costs < 1% steps/s vs
+                        FLAGS_trace_ring_size=0, measured on the captured
+                        steady state; events/step is reported
+
+Exits nonzero on any failed gate (tests/test_observability.py runs this
+CLI as a slow subprocess test).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/obs_probe.py [--steps 6] [--batch 8]
+                                                [--overhead-budget-pct 1.0]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+from paddle_tpu.profiler import trace
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# the one shared LeNet probe harness — obs and chaos gates must compare
+# bitwise baselines built from the SAME recipe, so there is one copy
+from chaos_probe import _batches, _build, _one_step  # noqa: E402
+
+STEPS = 6
+BATCH = 8
+
+
+def _run(batches, seed=0):
+    net, opt, loss_fn = _build(seed)
+    return [_one_step(net, opt, loss_fn, xy) for xy in batches]
+
+
+def _fresh(fault_spec=""):
+    res.reset()
+    prof.reset_dispatch_counters()
+    trace.clear()
+    paddle.set_flags({"FLAGS_fault_inject": fault_spec,
+                      "FLAGS_retry_backoff_ms": 0.5})
+
+
+def _fallback_reason_events():
+    out = {}
+    for e in trace.events():
+        if (e.kind == "capture" and e.attrs
+                and e.attrs.get("phase") == "fallback"):
+            r = e.attrs["reason"]
+            out[r] = out.get(r, 0) + 1
+    return out
+
+
+def scenario_chaos_events(batches, results):
+    """Injected chaos recovers bitwise AND the fallback-reason event stream
+    agrees with the counter histogram. The event/counter equality only
+    holds while the ring retains the whole run, so it is sized to the run
+    (counters are lifetime; a saturated ring would fail the gate with zero
+    real defects)."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True,
+                      "FLAGS_trace_ring_size": max(
+                          4096, 512 * (len(batches) + 4))})
+    _fresh()
+    clean = _run(batches)
+    _fresh("execute:p=0.2,compile:p=0.2")
+    faulted = _run(batches)
+    c = prof.dispatch_counters()
+    counter_reasons = dict(c["capture_fallback_reasons"])
+    event_reasons = _fallback_reason_events()
+    fault_events = [e for e in trace.events() if e.kind == "fault"]
+    ring_ok = len(trace.events()) < int(
+        paddle.get_flags("FLAGS_trace_ring_size")["FLAGS_trace_ring_size"])
+    _fresh()
+    paddle.set_flags({"FLAGS_trace_ring_size": 4096})
+    ok = (faulted == clean
+          and ring_ok  # nothing evicted — the comparisons below are valid
+          and event_reasons == counter_reasons
+          and len(fault_events) == c["fault_events"])
+    results.append({
+        "scenario": "chaos-events",
+        "ok": ok,
+        "final_loss_clean": clean[-1],
+        "final_loss_faulted": faulted[-1],
+        "injected_faults": c["injected_faults"],
+        "fault_events_in_ring": len(fault_events),
+        "fallback_reasons_counters": counter_reasons,
+        "fallback_reasons_events": event_reasons,
+    })
+    return ok
+
+
+def scenario_unrecovered_postmortem(batches, results, pmdir):
+    """A storm that outlives the retry budget at the captured tier: the
+    fault escapes execute() (postmortem) and the ladder demotes, while the
+    run itself finishes on the fallback path bitwise-identical."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True})
+    _fresh()
+    clean = _run(batches)
+    _fresh("execute:captured:p=1:x=5")
+    paddle.set_flags({"FLAGS_postmortem_dir": pmdir,
+                      "FLAGS_retry_max": 1,
+                      "FLAGS_ladder_demote_after": 1,
+                      "FLAGS_ladder_cooldown_steps": 100})
+    stormed = _run(batches)
+    paddle.set_flags({"FLAGS_postmortem_dir": "",
+                      "FLAGS_retry_max": 2,
+                      "FLAGS_ladder_demote_after": 2,
+                      "FLAGS_ladder_cooldown_steps": 8})
+    _fresh()
+    pms = sorted(f for f in os.listdir(pmdir)
+                 if f.startswith("postmortem_unrecovered_fault"))
+    ok = stormed == clean and bool(pms)
+    doc = None
+    if pms:
+        with open(os.path.join(pmdir, pms[0])) as f:
+            doc = json.load(f)
+        tail = doc["events"]
+        kinds = [(e["kind"], e["site"]) for e in tail]
+        fault_tail = [e for e in tail if e["kind"] == "fault"
+                      and e["site"] == "captured"]
+        ladder_tail = [e for e in tail if e["kind"] == "ladder"]
+        # the tail must EXPLAIN the fault: the site that failed, the retry
+        # that preceded the escape, and the ladder transition it caused
+        ok = (ok
+              and doc["attrs"]["site"] == "captured"
+              and doc["attrs"]["retries"] >= 1
+              and bool(fault_tail)
+              and ("retry", "captured") in kinds
+              and any(e["attrs"]["action"] == "demote" for e in ladder_tail)
+              and doc["metrics"]["counters"]["retry_exhausted"] >= 1)
+    results.append({
+        "scenario": "unrecovered-postmortem",
+        "ok": ok,
+        "final_loss_clean": clean[-1],
+        "final_loss_storm": stormed[-1],
+        "postmortems": pms,
+        "postmortem_site": None if doc is None else doc["attrs"].get("site"),
+        "postmortem_retries": None if doc is None else doc["attrs"].get("retries"),
+        "postmortem_tail_events": None if doc is None else len(doc["events"]),
+    })
+    return ok
+
+
+def scenario_serving_lanes(results):
+    """The merged chrome trace shows per-request serving lanes."""
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    _fresh()
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    eng = serving.Engine(model, serving.ServingConfig(
+        block_size=8, prompt_buckets=[8], num_blocks=24))
+    try:
+        ids = [eng.submit([1, 2, 3], max_new_tokens=4),
+               eng.submit([5, 6], max_new_tokens=4),
+               eng.submit([7, 8, 9, 10], max_new_tokens=4)]
+        eng.run_until_idle()
+        stats = eng.stats()
+    finally:
+        eng.close()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        prof.Profiler(timer_only=True).export(path)
+        with open(path) as f:
+            doc = json.load(f)
+    serve_evs = [e for e in doc["traceEvents"] if e.get("cat") == "serving"]
+    lanes_ok = True
+    for rid in ids:
+        phs = [e["ph"] for e in serve_evs if e["id"] == str(rid)]
+        lanes_ok &= bool(phs) and phs[0] == "b" and phs[-1] == "e" and "n" in phs
+    ok = lanes_ok and stats["token_lat_p50_ms"] is not None
+    results.append({
+        "scenario": "serving-lanes",
+        "ok": ok,
+        "requests": len(ids),
+        "serving_trace_events": len(serve_evs),
+        "token_lat_p50_ms": stats["token_lat_p50_ms"],
+        "token_lat_p99_ms": stats["token_lat_p99_ms"],
+    })
+    return ok
+
+
+def measure_trace_overhead(batches, reps=4):
+    """Tracing-on overhead on the captured steady state, two ways.
+
+    The GATED number is analytic: (per-emit cost with the ring on − the
+    off-mode fast-path cost) × events/step, as a fraction of the median
+    step time. Emitting events is the ONLY work the flag adds, the emit
+    microcost is stable to ~0.1 µs, and events/step is deterministic at
+    steady state — so this bound is reproducible on a box whose wall clock
+    swings ±30% second to second (where a direct A/B at 1% precision is
+    noise). The A/B window delta is reported alongside, unguarded, as the
+    sanity check that nothing outside emit() changed."""
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True})
+    _fresh()
+    net, opt, loss_fn = _build()
+    for xy in batches * 3:  # warm up into captured steady state
+        _one_step(net, opt, loss_fn, xy)
+
+    def window(steps=20):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            _one_step(net, opt, loss_fn, batches[i % len(batches)])
+        return (time.perf_counter() - t0) / steps
+
+    # -- per-emit microcost, on-mode vs off-mode fast path ------------------
+    def emit_cost_us(ring, n=50_000):
+        paddle.set_flags({"FLAGS_trace_ring_size": ring})
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(n):
+                # no step= — the runtime's emit sites all take the
+                # current_step() auto-fill path, so its cost must be part
+                # of the measured per-emit delta
+                trace.emit("probe", site="bench", i=i)
+            dt = (time.perf_counter() - t0) / n * 1e6
+            best = dt if best is None else min(best, dt)
+        return best
+
+    emit_on_us = emit_cost_us(4096)
+    emit_off_us = emit_cost_us(0)
+
+    # -- events/step + step time at steady state ----------------------------
+    paddle.set_flags({"FLAGS_trace_ring_size": 4096})
+    window(2)
+    trace.clear()
+    t_on = min(window() for _ in range(reps))
+    events_per_step = len(trace.events()) / (reps * 20 + 0.0)
+    paddle.set_flags({"FLAGS_trace_ring_size": 0})
+    window(2)
+    t_off = min(window() for _ in range(reps))
+    paddle.set_flags({"FLAGS_trace_ring_size": 4096})
+
+    step_us = min(t_on, t_off) * 1e6
+    overhead_pct = max(0.0, emit_on_us - emit_off_us) * events_per_step \
+        / step_us * 100.0
+    return {
+        "emit_on_us": round(emit_on_us, 3),
+        "emit_off_us": round(emit_off_us, 3),
+        "events_per_step": round(events_per_step, 2),
+        "step_ms": round(step_us / 1000.0, 3),
+        "overhead_pct": round(overhead_pct, 4),
+        # informational: wall-clock A/B (noise-dominated on shared boxes)
+        "ab_step_ms_trace_on": round(t_on * 1000.0, 3),
+        "ab_step_ms_trace_off": round(t_off * 1000.0, 3),
+        "ab_delta_pct": round((t_on - t_off) / t_off * 100.0, 2),
+    }
+
+
+def scenario_trace_overhead(batches, results, budget_pct):
+    m = measure_trace_overhead(batches)
+    ok = m["overhead_pct"] < budget_pct
+    results.append(dict({"scenario": "trace-overhead", "ok": ok,
+                         "budget_pct": budget_pct}, **m))
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=STEPS)
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--overhead-budget-pct", type=float, default=1.0)
+    ap.add_argument("--skip-overhead", action="store_true",
+                    help="skip the (timing-sensitive) overhead gate")
+    args = ap.parse_args(argv)
+
+    batches = _batches(args.steps, args.batch)
+    results = []
+    ok = True
+    try:
+        ok &= scenario_chaos_events(batches, results)
+        with tempfile.TemporaryDirectory() as pmdir:
+            ok &= scenario_unrecovered_postmortem(batches, results, pmdir)
+        ok &= scenario_serving_lanes(results)
+        if not args.skip_overhead:
+            ok &= scenario_trace_overhead(batches, results,
+                                          args.overhead_budget_pct)
+    finally:
+        paddle.set_flags({
+            "FLAGS_fault_inject": "",
+            "FLAGS_postmortem_dir": "",
+            "FLAGS_trace_ring_size": 4096,
+            "FLAGS_eager_lazy_dispatch": False,
+            "FLAGS_eager_step_capture": True,
+            "FLAGS_retry_backoff_ms": 5.0,
+            "FLAGS_retry_max": 2,
+        })
+        res.reset()
+
+    for r in results:
+        print(json.dumps(r))
+    print("ALL SCENARIOS PASSED" if ok else "OBSERVABILITY GATE FAILED",
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,13 +140,13 @@ def main():
             print(f"    segments_flushed={c['segments_flushed']} "
                   f"cache hits/misses={c['segment_cache_hits']}/"
                   f"{c['segment_cache_misses']} "
-                  f"flush_reasons={c['flush_reasons']}")
+                  f"flush_reasons={dict(c['flush_reasons'])}")
         if capture:
             print(f"    capture replays={c['capture_replays']} "
                   f"accum_replays={c['capture_accum_replays']} "
                   f"builds={c['capture_builds']} "
                   f"fallbacks={c['capture_fallbacks']} "
-                  f"fallback_reasons={c['capture_fallback_reasons']}")
+                  f"fallback_reasons={dict(c['capture_fallback_reasons'])}")
             # steady-state contract: every update step replayed captured
             # (programs = 1 update + k-1 accumulate microsteps per cycle)
             # and the fallback histogram stayed empty

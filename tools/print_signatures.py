@@ -44,6 +44,8 @@ SUBMODULES = [
     "serving",
     "device",
     "profiler",
+    "profiler.metrics",
+    "profiler.trace",
     "resilience",
     "quantization",
     "incubate",
