@@ -536,6 +536,72 @@ def bench_serving(n_requests=12, max_new=24):
     return rec
 
 
+def bench_serving_overload(n=12, max_new=16):
+    """The overload row (ISSUE 11): the engine under a 2× sustained
+    oversubmit with the queue-wait p99 trip wire open — goodput (completed
+    requests/s), shed rate, and interactive p99 latency vs its deadline.
+    The engine must keep interactive goodput while batch sheds with
+    structured retriable responses: zero drops, zero leaked KV blocks."""
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as prof
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                    num_heads=8, max_seq_len=512, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    paddle.set_flags({"FLAGS_serving_queue_wait_p99_ms": 1.0,
+                      "FLAGS_serving_queue_max": 64})
+    try:
+        engine = serving.Engine(model, serving.ServingConfig(
+            block_size=16, prompt_buckets=[32, 64]))
+        rng = np.random.default_rng(0)
+        warm = [rng.integers(1, cfg.vocab_size, 32) for _ in range(10)]
+        # warm: compile + seed cost EMAs + arm the trip wire's sample gate
+        engine.serve(warm, max_new_tokens=max_new)
+        prof.reset_dispatch_counters()
+        engine.reset_stats()
+        deadline_ms = 120_000.0
+        subs = []
+        t0 = time.time()
+        for _ in range(n):  # 2x: every interactive has a batch twin
+            for prio in ("interactive", "batch"):
+                rid = engine.submit(
+                    rng.integers(1, cfg.vocab_size, 32),
+                    max_new_tokens=max_new, deadline_ms=deadline_ms,
+                    priority=prio)
+                subs.append((rid, prio))
+        engine.run_until_idle()
+        dt = time.time() - t0
+        resps = {rid: engine.pop_response(rid) for rid, _ in subs}
+        c = prof.dispatch_counters()
+    finally:
+        paddle.set_flags({"FLAGS_serving_queue_wait_p99_ms": 0.0,
+                          "FLAGS_serving_queue_max": 256})
+    inter = [resps[r] for r, p in subs if p == "interactive"]
+    lat = [r.latency_ms for r in inter if r is not None and r.ok]
+    completed = sum(1 for r in resps.values() if r is not None and r.ok)
+    shed = sum(1 for r in resps.values()
+               if r is not None and r.status == "overloaded")
+    return {
+        "metric": "serving_overload_goodput_req_per_sec",
+        "value": round(completed / dt, 2), "unit": "requests/s/chip",
+        "offered": len(subs), "completed": completed,
+        "shed": shed, "shed_rate": round(shed / len(subs), 3),
+        "interactive_completed": sum(1 for r in inter if r.ok),
+        "interactive_p99_ms": (
+            round(float(np.percentile(lat, 99)), 1) if lat else None),
+        "interactive_deadline_ms": deadline_ms,
+        "expired": c["serve_deadline_expired"],
+        "dropped": c["serve_requests_dropped"],
+        "block_leaks": c["serve_block_leaks"],
+        "engine_health": engine.stats()["health"],
+    }
+
+
 def _resilience_block(steps=8, bsz=16):
     """Resilience micro-probe for the BENCH_* trajectory (ISSUE 5): retries/
     fallbacks under an injected fault plan, per-step recovery overhead, and
@@ -863,6 +929,7 @@ def main():
             ("bert", bench_bert),
             ("gpt_longseq", bench_gpt_longseq),
             ("serving", bench_serving),
+            ("serving_overload", bench_serving_overload),
             ("mnist", bench_mnist_eager),
             ("ernie_ctr", bench_ernie_ctr),
             ("ps_table", bench_ps_table),

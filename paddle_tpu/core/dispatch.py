@@ -208,6 +208,19 @@ def _reset_counters_locked():
         serve_requests_dropped=0,
         serve_request_requeues=0,
         serve_preempt_drains=0,
+        # overload robustness (ISSUE 11): SLO-aware admission sheds
+        # ('overloaded' responses, by reason — the queue-wait trip wire
+        # is serve_shed_reasons['queue_p99']), deadline expiries (by
+        # stage: queued/prefill/decode), supervisor-driven engine
+        # restarts, engine health transitions, and the pool-leak tripwire
+        # run_until_idle audits (must stay 0, like serve_requests_dropped)
+        serve_requests_shed=0,
+        serve_deadline_expired=0,
+        serve_engine_restarts=0,
+        serve_health_transitions=0,
+        serve_block_leaks=0,
+        serve_shed_reasons={},
+        serve_expire_stages={},
         flush_reasons={},
         capture_fallback_reasons={},
         fault_sites={},
@@ -235,8 +248,9 @@ def dispatch_counters() -> Dict[str, Any]:
     (what ``measure_programs`` does); the live store is internal
     (``_counters``)."""
     out = dict(_counters)
-    for k in ("flush_reasons", "capture_fallback_reasons", "fault_sites"):
-        out[k] = MappingProxyType(dict(_counters[k]))
+    for k, v in out.items():
+        if isinstance(v, dict):  # reason/site/stage families
+            out[k] = MappingProxyType(dict(v))
     return MappingProxyType(out)
 
 

@@ -421,6 +421,47 @@ define_flag(
     "before answering it with an error response; greedy decode is "
     "deterministic, so a re-run reproduces the same tokens",
 )
+define_flag(
+    "serving_default_deadline_ms", 0.0,
+    "default per-request deadline for the paddle.serving engine, in ms "
+    "from submit: requests that do not set deadline_ms inherit this. The "
+    "deadline is enforced at admission (predicted misses are shed with a "
+    "retriable 'overloaded' response), in queue (expired requests answer "
+    "'timeout' before wasting a prefill), and mid-decode (expired "
+    "sequences leave the batch with a partial 'timeout' response, per "
+    "FLAGS_serving_deadline_partial). 0 = no default deadline",
+)
+define_flag(
+    "serving_deadline_partial", True,
+    "what a sequence that passes its deadline MID-DECODE answers: on (the "
+    "default), a 'timeout' response carrying the tokens generated so far "
+    "(partial output is usable under greedy decode); off, the 'timeout' "
+    "response carries no tokens. Either way the request gets a terminal "
+    "response and its KV blocks are recycled — never a hang or a drop",
+)
+define_flag(
+    "serving_queue_max", 256,
+    "cap on the serving RequestQueue (queued, not-yet-admitted requests): "
+    "a submit past the cap is shed immediately with a structured, "
+    "retriable 'overloaded' response instead of growing host memory "
+    "without bound. 0 = unbounded (the pre-overload-control behavior)",
+)
+define_flag(
+    "serving_queue_wait_p99_ms", 0.0,
+    "queue-wait p99 trip wire for SLO-aware admission: when the streaming "
+    "p99 of observed queue wait (serve_queue_wait_ms histogram) exceeds "
+    "this many ms, newly arriving batch-priority requests are shed with "
+    "'overloaded' until the p99 recovers — batch traffic sheds first so "
+    "it cannot starve interactive under a storm. 0 = trip wire off",
+)
+define_flag(
+    "serving_max_engine_restarts", 3,
+    "restarts the serving Supervisor may attempt on a wedged or crashed "
+    "engine (tick exceptions escaping the resilience ladder, or the "
+    "FLAGS_trace_stall_ms watchdog firing mid-tick) before failing "
+    "cleanly: past the cap every queued and in-flight request is answered "
+    "with an error response and the engine goes 'dead' — zero hangs",
+)
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
 define_flag(
     "use_flash_attention",

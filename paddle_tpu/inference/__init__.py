@@ -28,6 +28,7 @@ __all__ = [
     "Config",
     "GenerativePredictor",
     "Predictor",
+    "PredictorPool",
     "Tensor",
     "create_predictor",
     "PrecisionType",
@@ -292,6 +293,15 @@ class Predictor:
             return [np.asarray(jax.device_get(o)) for o in outs]
         return True
 
+    def health(self) -> str:
+        """Health of the replica behind this predictor. A plain StableHLO
+        predictor is stateless — always ``"ready"`` (the serving-backed
+        GenerativePredictor reports its engine's live state)."""
+        return "ready"
+
+    def serviceable(self) -> bool:
+        return True
+
     def clone(self) -> "Predictor":
         """Share the deserialized program + weights; fresh IO handles
         (reference: AnalysisPredictor::Clone shares the scope/engine)."""
@@ -358,6 +368,14 @@ class GenerativePredictor:
     def engine(self):
         """The underlying paddle.serving.Engine (stats(), submit(), ...)."""
         return self._engine
+
+    def health(self) -> str:
+        """The engine's live health state (warming/ready/degraded/
+        draining/dead) — what PredictorPool.acquire routes on."""
+        return self._engine.health
+
+    def serviceable(self) -> bool:
+        return self._engine.serviceable()
 
     def run(self, inputs=None):
         if inputs is not None:
@@ -478,17 +496,61 @@ def get_trt_runtime_version():
 
 
 class PredictorPool:
-    """Pool of cloned predictors for concurrent serving (reference:
-    paddle_infer.PredictorPool over AnalysisPredictor::Clone)."""
+    """Pool of predictors for concurrent serving (reference:
+    paddle_infer.PredictorPool over AnalysisPredictor::Clone).
 
-    def __init__(self, config: Config, size: int = 1):
+    ``clone=True`` (the default, the reference contract) shares the
+    loaded program/engine across the pool; ``clone=False`` builds
+    independent replicas via ``create_predictor`` — for generative
+    serving configs that means one Engine each, which is what makes the
+    health-aware routing in :meth:`acquire` meaningful (clones of one
+    engine get sick together)."""
+
+    def __init__(self, config: Config, size: int = 1, clone: bool = True):
         if size < 1:
             raise ValueError("pool size must be >= 1")
         first = create_predictor(config)
-        self._predictors = [first] + [first.clone() for _ in range(size - 1)]
+        if clone:
+            rest = [first.clone() for _ in range(size - 1)]
+        else:
+            rest = [create_predictor(config) for _ in range(size - 1)]
+        self._predictors = [first] + rest
+        self._rr = 0
 
     def retrieve(self, idx: int) -> Predictor:
         return self._predictors[idx]
+
+    def acquire(self) -> Predictor:
+        """The next predictor that will accept work, round-robin, routing
+        around unhealthy replicas: draining/dead engines are skipped, and
+        'ready'/'warming' replicas are preferred over 'degraded' ones (a
+        degraded replica still serves when it is all that's left). Raises
+        when every replica is dead/draining — fail loud, never hang."""
+        n = len(self._predictors)
+        degraded = None
+        for i in range(n):
+            idx = (self._rr + i) % n
+            p = self._predictors[idx]
+            if not p.serviceable():
+                continue
+            if p.health() == "degraded":
+                if degraded is None:
+                    degraded = (idx, p)
+                continue
+            self._rr = (idx + 1) % n
+            return p
+        if degraded is not None:
+            # an all-degraded fleet must still round-robin, not pin every
+            # request to the first degraded replica in rotation order
+            idx, p = degraded
+            self._rr = (idx + 1) % n
+            return p
+        raise RuntimeError(
+            "PredictorPool.acquire: no serviceable replica "
+            f"(healths: {[p.health() for p in self._predictors]})")
+
+    def healths(self) -> List[str]:
+        return [p.health() for p in self._predictors]
 
     def __len__(self):
         return len(self._predictors)

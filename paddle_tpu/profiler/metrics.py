@@ -266,6 +266,8 @@ _DISPATCH_LABEL_KEYS = {
     "flush_reasons": "reason",
     "capture_fallback_reasons": "reason",
     "fault_sites": "site",
+    "serve_shed_reasons": "reason",
+    "serve_expire_stages": "stage",
 }
 
 

@@ -44,12 +44,14 @@ from ..core import flags as _flags
 
 __all__ = [
     "TraceEvent",
+    "add_stall_listener",
     "clear",
     "dump_postmortem",
     "emit",
     "enabled",
     "events",
     "last_postmortem_path",
+    "remove_stall_listener",
     "step_heartbeat",
     "watchdog_disarm",
 ]
@@ -295,6 +297,26 @@ _wd_thread: Optional[threading.Thread] = None
 _wd_last_hb: Optional[int] = None
 _wd_fired = False
 _wd_stalls = 0
+# consumers of stall trips beyond the postmortem dump — the serving
+# Supervisor registers here so a wedged engine tick (no heartbeat inside
+# FLAGS_trace_stall_ms) is observed and the engine restarted once the
+# tick returns control
+_stall_listeners: List = []
+
+
+def add_stall_listener(fn):
+    """Register ``fn(stalled_ms)`` to be called (from the watchdog daemon
+    thread) every time the step-stall watchdog trips. Listener exceptions
+    are swallowed — observability must never add a second failure."""
+    with _wd_lock:
+        if fn not in _stall_listeners:
+            _stall_listeners.append(fn)
+
+
+def remove_stall_listener(fn):
+    with _wd_lock:
+        if fn in _stall_listeners:
+            _stall_listeners.remove(fn)
 
 
 def step_heartbeat():
@@ -353,6 +375,13 @@ def _watchdog_loop():
                  threshold_ms=ms)
             dump_postmortem("stall", stalled_ms=round(stalled_ms, 1),
                             threshold_ms=ms)
+            with _wd_lock:
+                listeners = list(_stall_listeners)
+            for fn in listeners:
+                try:
+                    fn(stalled_ms)
+                except Exception:
+                    pass  # a listener must never take the watchdog down
 
 
 # ---------------------------------------------------------------------------
@@ -362,7 +391,8 @@ def _watchdog_loop():
 # visible on one timeline next to the RecordEvent host spans.
 # ---------------------------------------------------------------------------
 _FLIGHT_TID = 1
-_SERVE_END_PHASES = frozenset(("complete", "error", "reject"))
+_SERVE_END_PHASES = frozenset(("complete", "error", "reject", "shed",
+                               "expire"))
 
 
 def chrome_trace_events(evts: Optional[List[TraceEvent]] = None):
@@ -388,6 +418,15 @@ def chrome_trace_events(evts: Optional[List[TraceEvent]] = None):
             if rids is None:
                 rid = attrs.pop("rid", None)
                 rids = [] if rid is None else [rid]
+            if not rids:
+                # engine-scoped events (health/restart/block_leak) have no
+                # request lane — render as plain flight instants
+                out.append({
+                    "name": f"serve:{phase}", "cat": "serving",
+                    "ph": "i", "s": "t", "ts": ts_us, "pid": pid,
+                    "tid": _FLIGHT_TID, "args": dict(attrs, step=ev.step),
+                })
+                continue
             for rid in rids:
                 args = dict(attrs, phase=phase, step=ev.step)
                 if rid not in admitted:

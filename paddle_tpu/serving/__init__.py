@@ -13,6 +13,15 @@ ladder runs through the serve loop: a transient fault mid-decode demotes
 that bucket's program captured → lazy → per-op and retries the batch
 without dropping requests; SIGTERM drains in-flight sequences before exit.
 
+Overload robustness (ISSUE 11): per-request **deadlines** enforced at
+every stage (queue / prefill / mid-decode, with partial 'timeout'
+responses), an **SLO-aware admission controller** that predicts completion
+from measured cost EMAs and sheds what cannot make its deadline (two
+priority classes — batch sheds first), and a **Supervisor** that restarts
+a wedged engine (bounded, then fails cleanly) while ``Engine.health``
+(warming/ready/degraded/draining/dead) lets the inference PredictorPool
+route traffic around unhealthy replicas.
+
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForPretraining
 
@@ -27,24 +36,31 @@ See SERVING.md for the queue/bucket/paged-cache design and the flags
 """
 from __future__ import annotations
 
+from .admission import AdmissionController  # noqa: F401
 from .cache import BlockPool, PagedCacheView  # noqa: F401
-from .engine import Engine, ServingConfig  # noqa: F401
+from .engine import HEALTH_STATES, Engine, ServingConfig  # noqa: F401
 from .scheduler import (  # noqa: F401
+    PRIORITIES,
     Request,
     RequestQueue,
     Response,
     ServingBuckets,
 )
+from .supervisor import Supervisor  # noqa: F401
 
 __all__ = [
+    "AdmissionController",
     "BlockPool",
     "Engine",
+    "HEALTH_STATES",
+    "PRIORITIES",
     "PagedCacheView",
     "Request",
     "RequestQueue",
     "Response",
     "ServingBuckets",
     "ServingConfig",
+    "Supervisor",
     "create_engine",
 ]
 

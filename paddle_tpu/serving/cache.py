@@ -122,6 +122,18 @@ class BlockPool:
         self.k = [jnp.zeros(shape, dt) for _ in range(self.layers)]
         self.v = [jnp.zeros(shape, dt) for _ in range(self.layers)]
 
+    def reclaim_all(self) -> int:
+        """Rebuild the free-list as if nothing were allocated; returns how
+        many blocks were still outstanding. This is the repair half of the
+        pool-leak tripwire: at engine idle (no active sequences) every
+        block must be free — a nonzero return is an engine bug
+        (``serve_block_leaks``), and reclaiming keeps the pool serviceable
+        instead of slowly starving admission."""
+        leaked = self.used_blocks
+        total = self._num_blocks + self.scratch_slots
+        self._free = list(range(self.scratch_slots, total))
+        return leaked
+
 
 class _BatchState:
     """Per-forward holder threading the pool arrays through the layer stack:

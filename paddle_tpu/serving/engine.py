@@ -24,6 +24,25 @@ program and the batch retries without dropping a request. Injected faults
 reuse the intact pool; a REAL fault on the donated rung conservatively
 resets the pool and re-enqueues every in-flight sequence (greedy decode is
 deterministic, so re-runs reproduce the same tokens).
+
+Overload robustness (ISSUE 11) wraps that loop in three layers:
+
+  deadlines   every request may carry ``deadline_ms``; expiry is enforced
+              in queue (before wasting a prefill), at the admit pop, and
+              mid-decode (partial 'timeout' response per
+              FLAGS_serving_deadline_partial) — expired sequences recycle
+              their blocks and leave the decode group without perturbing
+              other rows;
+  admission   the SLO-aware controller (serving/admission.py) predicts a
+              request's completion from measured prefill/decode cost EMAs
+              and sheds predicted deadline misses, over-cap submits
+              (FLAGS_serving_queue_max), and — batch class first — storm
+              arrivals past the queue-wait p99 trip wire, always with a
+              structured retriable 'overloaded' response;
+  health      the engine exposes warming/ready/degraded/draining/dead
+              (``Engine.health``) so a Supervisor (serving/supervisor.py)
+              and the inference PredictorPool can route traffic around an
+              unhealthy replica, restart a wedged engine, or fail cleanly.
 """
 from __future__ import annotations
 
@@ -39,6 +58,7 @@ import numpy as np
 
 from ..core import flags
 from ..core.dispatch import no_grad
+from .admission import AdmissionController
 from .cache import BlockPool, PagedCacheView, _BatchState, default_num_blocks
 from .scheduler import (
     Request,
@@ -49,9 +69,16 @@ from .scheduler import (
     group_for_decode,
 )
 
-__all__ = ["Engine", "ServingConfig"]
+__all__ = ["Engine", "HEALTH_STATES", "ServingConfig"]
 
 _ENGINE_IDS = itertools.count(1)
+
+# the engine health lifecycle (Engine.health). 'degraded' still serves —
+# it marks a replica the PredictorPool should deprioritize (fresh restart,
+# pool rebuild) until _DEGRADED_COOLDOWN_TICKS clean ticks pass; 'dead'
+# and 'draining' refuse new admissions.
+HEALTH_STATES = ("warming", "ready", "degraded", "draining", "dead")
+_DEGRADED_COOLDOWN_TICKS = 8
 
 
 # -- module-level op helpers (cacheable tokens for the per-op jit cache) ----
@@ -215,6 +242,20 @@ class Engine:
         self._n_completed = 0
         self._n_rejected = 0
         self._n_errors = 0
+        self._n_shed = 0
+        self._n_expired = 0
+        # SLO-aware admission: measured prefill/decode cost EMAs + the
+        # queue-wait trip wire (serving/admission.py)
+        self._admission = AdmissionController(
+            self._uid, bucket_of=self._buckets.prompt_bucket)
+        # health lifecycle: warming until the first successful tick;
+        # degraded after a restart/pool rebuild until a cooldown of clean
+        # ticks; draining/dead refuse new admissions
+        self._health = "warming"
+        self._tick_no = 0
+        self._degraded_until: Optional[int] = None
+        self._restarts = 0
+        self._last_restart_error: Optional[str] = None
 
     # ------------------------------------------------------------------
     # step functions (shared by all three execution tiers)
@@ -304,21 +345,70 @@ class Engine:
         )
 
     # ------------------------------------------------------------------
+    # health lifecycle
+    # ------------------------------------------------------------------
+    @property
+    def health(self) -> str:
+        """One of :data:`HEALTH_STATES` — what a Supervisor / the
+        inference PredictorPool route on."""
+        return self._health
+
+    def serviceable(self) -> bool:
+        """May this engine accept NEW work right now?"""
+        return self._health not in ("draining", "dead")
+
+    def _set_health(self, state: str, why: str):
+        from ..core import dispatch
+
+        if state == self._health:
+            return
+        if state not in HEALTH_STATES:
+            raise ValueError(f"unknown health state {state!r}")
+        prev, self._health = self._health, state
+        dispatch._counters["serve_health_transitions"] += 1
+        dispatch._emit("serve", site="engine", phase="health",
+                       engine=self._uid, prev=prev, state=state,
+                       why=why[:120])
+
+    @staticmethod
+    def _now() -> float:
+        """Deadline clock (wall seconds). A method so tests and the probe
+        can drive expiry with a virtual clock instead of sleeps."""
+        return time.time()
+
+    # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               priority: str = "interactive") -> int:
         """Queue one request; returns its request id. Requests that can
         NEVER be served (context exceeds the budgeted pool or the model's
         positions) are rejected immediately with a Response — admission
-        refusal, not an OOM."""
+        refusal, not an OOM. ``deadline_ms`` (default
+        FLAGS_serving_default_deadline_ms; 0/None = none) and ``priority``
+        ('interactive' > 'batch') feed the SLO-aware admission controller:
+        a submit the engine predicts it cannot serve in time — or one
+        arriving past FLAGS_serving_queue_max / the queue-wait p99 trip
+        wire — is shed with a structured retriable 'overloaded' response
+        instead of queueing toward a timeout."""
         from ..core import dispatch
 
+        if deadline_ms is None:
+            default_dl = float(flags.flag("serving_default_deadline_ms"))
+            deadline_ms = default_dl if default_dl > 0 else None
         req = Request(
             prompt=np.asarray(prompt),
             max_new_tokens=max_new_tokens or self._default_max_new,
             eos_token_id=eos_token_id,
+            deadline_ms=deadline_ms,
+            priority=priority,
         )
+        if self._health == "dead":
+            self._reject(req, "engine is dead (supervisor restarts "
+                              "exhausted)")
+            return req.request_id
         if self._draining:
             self._reject(req, "engine is draining (preemption)")
             return req.request_id
@@ -348,10 +438,16 @@ class Engine:
                 "(planner-budgeted by FLAGS_memory_budget_mb)",
             )
             return req.request_id
+        shed = self._admission.decide(
+            req, queue=self._queue, active=self._active, now=self._now())
+        if shed is not None:
+            self._shed(req, shed)
+            return req.request_id
         self._queue.push(req)
         self._accepted.add(req.request_id)
         dispatch._emit("serve", site="engine", phase="admit",
-                       rid=req.request_id, prompt_len=plen, blocks=n_blk)
+                       rid=req.request_id, prompt_len=plen, blocks=n_blk,
+                       priority=req.priority)
         return req.request_id
 
     def response(self, request_id: int) -> Optional[Response]:
@@ -363,10 +459,13 @@ class Engine:
         return self._responses.pop(request_id, None)
 
     def step(self):
-        """One scheduler tick: admit + prefill what fits, then one decode
-        step for every active group."""
+        """One scheduler tick: expire what already missed its deadline,
+        admit + prefill what fits, then one decode step for every active
+        group."""
         from ..resilience import runtime as _rt
 
+        self._tick_no += 1
+        self._expire_deadlines(stage="queued")
         self._admit()
         groups = group_for_decode(self._active)
         for n_blk in sorted(groups):
@@ -379,9 +478,32 @@ class Engine:
                 # the requeued sequences re-prefill on the next one
                 chunk = [s for s in seqs[i:i + cap] if s in self._active]
                 if chunk and not self._decode_batch(chunk, n_blk):
-                    _rt.on_step_end()
+                    self._end_tick(_rt)
                     return
+        self._end_tick(_rt)
+
+    def _end_tick(self, _rt):
         _rt.on_step_end()
+        if self._health == "warming":
+            self._set_health("ready", "first tick completed")
+        elif (self._health == "degraded"
+              and self._degraded_until is not None
+              and self._tick_no >= self._degraded_until):
+            self._degraded_until = None
+            self._set_health("ready", "degraded cooldown elapsed")
+
+    def _expire_deadlines(self, stage: str):
+        """Answer every queued/active request whose deadline has passed.
+        Queued expiry runs BEFORE admission so a dead-on-arrival request
+        never wastes a prefill; active expiry removes the sequence from
+        its decode group (the group is recomputed each tick, so the other
+        rows are untouched) and recycles its blocks."""
+        now = self._now()
+        for req in self._queue.take_expired(now):
+            self._expire(req, stage=stage)
+        for seq in [s for s in self._active if s.req.expired(now)]:
+            self._release(seq)
+            self._expire(seq.req, stage="decode", seq=seq)
 
     def run_until_idle(self):
         """Drive the loop until every accepted request has a response."""
@@ -391,9 +513,12 @@ class Engine:
 
     def _audit_drops(self):
         """The zero-drop tripwire: at idle, every accepted request must
-        have produced exactly one Response. Anything missing is counted in
-        serve_requests_dropped (the chaos gates fail on it) and answered
-        with an error response so no caller ever hangs on a lost id."""
+        have produced exactly one Response, and — the pool-leak half —
+        every KV block must be back on the free-list. Anything missing is
+        counted (serve_requests_dropped / serve_block_leaks; the chaos
+        gates fail on either), answered with an error response so no
+        caller ever hangs on a lost id, and leaked blocks are reclaimed so
+        the pool doesn't starve admission forever."""
         from ..core import dispatch
 
         missing = self._accepted - set(self._responses)
@@ -405,6 +530,11 @@ class Engine:
                 done_time=time.time(),
             )
         self._accepted.clear()
+        if not self._active and self._pool.used_blocks:
+            leaked = self._pool.reclaim_all()
+            dispatch._counters["serve_block_leaks"] += leaked
+            dispatch._emit("serve", site="engine", phase="block_leak",
+                           engine=self._uid, blocks=leaked)
 
     def serve(self, requests: Seq, **submit_kw) -> List[Response]:
         """Convenience: submit every prompt, run to completion, return (and
@@ -412,6 +542,58 @@ class Engine:
         ids = [self.submit(p, **submit_kw) for p in requests]
         self.run_until_idle()
         return [self.pop_response(i) for i in ids]
+
+    # -- supervision -----------------------------------------------------
+    def restart(self, err: BaseException):
+        """Tear the runtime down to a known-good state after a wedge or a
+        tick exception escaped the resilience ladder: evict this engine's
+        captured programs (a wedged executable must not be replayed),
+        requeue every in-flight sequence through the existing requeue path
+        (greedy decode ⇒ the re-run reproduces bitwise-identical tokens),
+        and rebuild the pool storage. The engine comes back 'degraded'
+        until a cooldown of clean ticks. The Supervisor owns the restart
+        BUDGET (FLAGS_serving_max_engine_restarts) and calls
+        :meth:`fail_clean` past it."""
+        from ..core import dispatch
+        from ..core.lazy import reset_serve_programs
+
+        self._restarts += 1
+        self._last_restart_error = f"{type(err).__name__}: {err}"
+        dispatch._counters["serve_engine_restarts"] += 1
+        dispatch._emit("serve", site="engine", phase="restart",
+                       engine=self._uid, restarts=self._restarts,
+                       error=type(err).__name__)
+        reset_serve_programs(owner=self._uid)
+        for seq in list(self._active):
+            self._requeue_seq(seq, err, count_retry=False)
+        self._pool.reset_storage()
+        self._mark_degraded(f"engine restart: {type(err).__name__}")
+
+    def fail_clean(self, err: BaseException):
+        """The restart budget is exhausted: answer EVERY queued and
+        in-flight request with a terminal error response (zero hangs, zero
+        silent drops), release their blocks, and go 'dead' — submits from
+        here on are rejected."""
+        from ..profiler import trace as _trace
+
+        why = (f"engine dead after {self._restarts} restarts "
+               f"(FLAGS_serving_max_engine_restarts): {err}")
+        for seq in list(self._active):
+            self._release(seq)
+            self._error(seq.req, why, seq)
+        while True:
+            req = self._queue.pop()
+            if req is None:
+                break
+            self._error(req, why)
+        self._set_health("dead", why)
+        _trace.dump_postmortem("engine_dead", exc=err,
+                               engine=self._uid, restarts=self._restarts)
+
+    @property
+    def pending(self) -> int:
+        """Accepted-but-unanswered work (queued + in flight)."""
+        return len(self._queue) + len(self._active)
 
     # -- preemption ------------------------------------------------------
     def begin_drain(self):
@@ -422,6 +604,8 @@ class Engine:
         if not self._draining:
             self._draining = True
             dispatch._counters["serve_preempt_drains"] += 1
+            if self._health != "dead":
+                self._set_health("draining", "preemption drain")
 
     def install_preemption_handler(self, signals=(_signal.SIGTERM,)):
         for s in signals:
@@ -453,6 +637,8 @@ class Engine:
         reset_serve_programs(owner=self._uid)
         _metrics.default_registry().remove(
             "serve_token_lat_ms", labels={"engine": str(self._uid)})
+        self._admission.close()
+        self._health = "dead"  # no transition event from __del__ paths
 
     def __del__(self):
         try:
@@ -478,10 +664,15 @@ class Engine:
         p50 = self._token_lat.quantile(0.5)
         p99 = self._token_lat.quantile(0.99)
         out = {
+            "health": self._health,
             "completed": self._n_completed,
             "rejected": self._n_rejected,
+            "shed": self._n_shed,
+            "expired": self._n_expired,
             "errors": self._n_errors,
-            "pending": len(self._queue) + len(self._active),
+            "restarts": self._restarts,
+            "admission": self._admission.state(),
+            "pending": self.pending,
             "pool_blocks": self._pool.num_blocks,
             "pool_occupancy": round(self._pool.occupancy(), 4),
             "pool_peak_occupancy": round(self._pool.peak_occupancy, 4),
@@ -500,6 +691,17 @@ class Engine:
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
+    def _release(self, seq: Sequence):
+        """The one teardown path every sequence exit goes through: out of
+        the active set, blocks back on the free-list, exactly once — the
+        leak audit in run_until_idle stays at zero because nothing frees
+        by hand anymore."""
+        if seq in self._active:
+            self._active.remove(seq)
+        if seq.blocks:
+            self._pool.free(seq.blocks)
+            seq.blocks = []
+
     def _reject(self, req: Request, why: str):
         from ..core import dispatch
 
@@ -511,6 +713,57 @@ class Engine:
         )
         dispatch._emit("serve", site="engine", phase="reject",
                        rid=req.request_id, why=why[:120])
+
+    def _shed(self, req: Request, decision):
+        """Load shedding: a structured, retriable 'overloaded' response —
+        the admission controller predicted this request cannot be served
+        in time (or the queue is at cap / the trip wire is open), so the
+        honest answer is 'retry elsewhere/later', not a queue slot that
+        ends in a timeout."""
+        from ..core import dispatch
+
+        dispatch._counters["serve_requests_shed"] += 1
+        reasons = dispatch._counters["serve_shed_reasons"]
+        reasons[decision.reason] = reasons.get(decision.reason, 0) + 1
+        self._n_shed += 1
+        self._responses[req.request_id] = Response(
+            request_id=req.request_id, status="overloaded",
+            error=f"overloaded ({decision.reason}): {decision.detail}",
+            retriable=True,
+            prompt_len=int(req.prompt.size), submit_time=req.submit_time,
+            done_time=time.time(),
+        )
+        dispatch._emit("serve", site="engine", phase="shed",
+                       rid=req.request_id, reason=decision.reason,
+                       priority=req.priority)
+
+    def _expire(self, req: Request, stage: str,
+                seq: Optional[Sequence] = None):
+        """Deadline expiry: a terminal 'timeout' response. Mid-decode
+        expiry keeps the partial output when FLAGS_serving_deadline_partial
+        is on (greedy decode makes partials meaningful); the caller has
+        already released the sequence's blocks."""
+        from ..core import dispatch
+
+        dispatch._counters["serve_deadline_expired"] += 1
+        stages = dispatch._counters["serve_expire_stages"]
+        stages[stage] = stages.get(stage, 0) + 1
+        self._n_expired += 1
+        partial = bool(flags.flag("serving_deadline_partial"))
+        tokens = list(seq.tokens) if (seq is not None and partial) else []
+        n_gen = 0 if seq is None else len(seq.tokens)
+        self._responses[req.request_id] = Response(
+            request_id=req.request_id, status="timeout",
+            error=(f"deadline of {req.deadline_ms:.0f} ms exceeded at "
+                   f"stage '{stage}' after {n_gen} tokens"),
+            tokens=tokens,
+            prompt_len=int(req.prompt.size), submit_time=req.submit_time,
+            first_token_time=getattr(req, "_first_token_time", None),
+            done_time=time.time(),
+        )
+        dispatch._emit("serve", site="engine", phase="expire",
+                       rid=req.request_id, stage=stage, tokens=n_gen,
+                       priority=req.priority)
 
     def _error(self, req: Request, why: str, seq: Optional[Sequence] = None):
         from ..core import dispatch
@@ -528,8 +781,7 @@ class Engine:
     def _complete(self, seq: Sequence):
         from ..core import dispatch
 
-        self._active.remove(seq)
-        self._pool.free(seq.blocks)
+        self._release(seq)
         dispatch._counters["serve_requests_completed"] += 1
         dispatch._emit("serve", site="engine", phase="complete",
                        rid=seq.req.request_id, tokens=len(seq.tokens))
@@ -543,21 +795,27 @@ class Engine:
             logits=list(seq.logits) if self._keep_logits else None,
         )
 
-    def _requeue_seq(self, seq: Sequence, err: BaseException):
+    def _requeue_seq(self, seq: Sequence, err: BaseException,
+                     count_retry: bool = True):
         """Tear one sequence down and re-run it from its prompt (greedy
         decode is deterministic — the re-run reproduces the same tokens).
-        Past the retry budget, the request gets an error response."""
+        Past the retry budget, the request gets an error response.
+        ``count_retry=False`` is the supervisor-restart path: the engine
+        wedged, not the request, so innocent in-flight work must not burn
+        its FLAGS_serving_request_retries budget — the restart budget
+        (FLAGS_serving_max_engine_restarts → fail_clean) is the bound
+        there."""
         from ..core import dispatch
 
-        if seq in self._active:
-            self._active.remove(seq)
-        self._pool.free(seq.blocks)
+        self._release(seq)
         req = seq.req
-        req.retries += 1
-        if req.retries > int(flags.flag("serving_request_retries")):
-            self._error(req,
-                        f"failed after {req.retries - 1} retries: {err}", seq)
-            return
+        if count_retry:
+            req.retries += 1
+            if req.retries > int(flags.flag("serving_request_retries")):
+                self._error(
+                    req,
+                    f"failed after {req.retries - 1} retries: {err}", seq)
+                return
         dispatch._counters["serve_request_requeues"] += 1
         dispatch._emit("serve", site="engine", phase="requeue",
                        rid=req.request_id, retries=req.retries,
@@ -571,14 +829,32 @@ class Engine:
         self._pool.reset_storage()
         for seq in list(self._active):
             self._requeue_seq(seq, err.cause)
+        self._mark_degraded(f"pool rebuilt after {type(err.cause).__name__}")
+
+    def _mark_degraded(self, why: str):
+        if self._health in ("draining", "dead"):
+            return  # terminal-ish states outrank degraded
+        self._degraded_until = self._tick_no + _DEGRADED_COOLDOWN_TICKS
+        self._set_health("degraded", why)
 
     def _admit(self):
         from ..models.gpt import CacheOverflow
 
         while True:
-            req = self._queue.peek()
+            # pop-first, not peek-then-pop: a signal-handler submit landing
+            # between the two could change which request pop() returns
+            # (interactive jumps the batch head), so the engine always
+            # operates on the request it actually popped and push_front
+            # restores it on backpressure
+            req = self._queue.pop()
             if req is None:
                 return
+            # last call before the expensive part: a request that expired
+            # between the tick-start queue scan and this pop must not
+            # burn a prefill (or the blocks behind it)
+            if req.expired(self._now()):
+                self._expire(req, stage="prefill")
+                continue
             n_blk = self._buckets.ctx_blocks(
                 int(req.prompt.size), req.max_new_tokens)
             try:
@@ -586,13 +862,15 @@ class Engine:
             except CacheOverflow as e:
                 from ..core import dispatch
 
-                self._queue.pop()
                 dispatch._counters["serve_admission_refusals"] += 1
                 self._reject(req, str(e))
                 continue
             if blocks is None:
-                return  # backpressure: wait for a completion to free blocks
-            self._queue.pop()
+                # backpressure: wait for a completion to free blocks
+                self._queue.push_front(req)
+                return
+            self._admission.note_queue_wait(
+                (self._now() - req.submit_time) * 1000.0)
             seq = Sequence(req, blocks, n_blk)
             try:
                 self._prefill(seq)
@@ -626,6 +904,7 @@ class Engine:
         dispatch._counters["serve_prefills"] += 1
         prefill_ms = (time.perf_counter() - t0) * 1000.0
         self._token_lat.observe(prefill_ms)
+        self._admission.note_prefill(P, prefill_ms)
         dispatch._emit("serve", site="engine", phase="prefill",
                        rid=req.request_id, bucket=P, blocks=seq.n_blk,
                        ms=round(prefill_ms, 3))
@@ -651,8 +930,7 @@ class Engine:
         ready = []
         for s in seqs:
             if s.length + 1 > s.n_blk * self._block_size:
-                self._active.remove(s)
-                self._pool.free(s.blocks)
+                self._release(s)
                 self._error(
                     s.req,
                     str(CacheOverflow(s.length + 1,
@@ -699,6 +977,8 @@ class Engine:
                        rids=tuple(s.req.request_id for s in ready),
                        batch=B, blocks=n_blk, ms=round(step_ms, 3))
         self._decode_rows += len(ready)
+        self._admission.note_decode(step_ms, len(ready))
+        now = self._now()
         for i, s in enumerate(ready):
             tok = int(out[i])
             s.length += 1
@@ -709,6 +989,12 @@ class Engine:
             self._token_lat.observe(step_ms)
             if s.done:
                 self._complete(s)
+            elif s.req.expired(now):
+                # mid-decode expiry: this row leaves the group here (the
+                # group list is rebuilt every tick, so no other row moves)
+                # and answers 'timeout' with its partial output
+                self._release(s)
+                self._expire(s.req, stage="decode", seq=s)
         return True
 
     def _run_tiered(self, kind: str, key, fn, args):

@@ -28,14 +28,19 @@ import numpy as np
 from ..core import flags
 from ..io.bucketing import BucketSpec
 
-__all__ = ["Request", "Response", "RequestQueue", "ServingBuckets"]
+__all__ = ["PRIORITIES", "Request", "Response", "RequestQueue",
+           "ServingBuckets"]
 
 _REQUEST_IDS = itertools.count(1)
 
 
+PRIORITIES = ("interactive", "batch")
+
+
 @dataclass
 class Request:
-    """One generation request: a prompt and its decode limits."""
+    """One generation request: a prompt, its decode limits, and its SLO
+    (deadline + priority class)."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -45,6 +50,12 @@ class Request:
     # times the engine has torn this request down and re-enqueued it after
     # a non-recoverable fault (bounded by FLAGS_serving_request_retries)
     retries: int = 0
+    # SLO: wall-clock deadline in ms from submit (None = inherit
+    # FLAGS_serving_default_deadline_ms at admission; 0/None after that =
+    # no deadline), and the priority class — 'interactive' admits and pops
+    # ahead of 'batch', and 'batch' sheds first under overload
+    deadline_ms: Optional[float] = None
+    priority: str = "interactive"
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int64).reshape(-1)
@@ -53,23 +64,61 @@ class Request:
         self.max_new_tokens = int(self.max_new_tokens)
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got "
+                f"{self.priority!r}")
+        if self.deadline_ms is not None:
+            self.deadline_ms = float(self.deadline_ms)
+            if self.deadline_ms < 0:
+                raise ValueError(
+                    "deadline_ms must be >= 0 (0/None = no deadline)")
+            if self.deadline_ms == 0:
+                # the documented opt-out: an explicit 0 means NO deadline —
+                # it is the only way to override a configured
+                # FLAGS_serving_default_deadline_ms (None inherits it)
+                self.deadline_ms = None
+
+    @property
+    def deadline_time(self) -> Optional[float]:
+        """Absolute wall-clock deadline (seconds since epoch), or None."""
+        if self.deadline_ms is None:
+            return None
+        return self.submit_time + self.deadline_ms / 1000.0
+
+    def expired(self, now: float) -> bool:
+        dl = self.deadline_time
+        return dl is not None and now >= dl
+
+    def remaining_ms(self, now: float) -> Optional[float]:
+        dl = self.deadline_time
+        return None if dl is None else (dl - now) * 1000.0
 
 
 @dataclass
 class Response:
     """The engine's answer. ``status`` is one of:
 
-    - ``"ok"``        every requested token generated (or EOS hit)
-    - ``"rejected"``  refused at admission (budget overflow / draining)
-    - ``"error"``     accepted but failed after the retry budget
+    - ``"ok"``          every requested token generated (or EOS hit)
+    - ``"rejected"``    refused at admission (budget overflow / draining)
+    - ``"overloaded"``  shed by SLO-aware admission (queue cap, queue-wait
+                        p99 trip wire, or a predicted deadline miss) —
+                        structured and ``retriable``: resubmit later
+    - ``"timeout"``     the request's deadline passed; ``tokens`` carries
+                        the partial output when the expiry was mid-decode
+                        and FLAGS_serving_deadline_partial is on
+    - ``"error"``       accepted but failed after the retry budget
 
     A request is NEVER silently dropped: every submitted request gets
-    exactly one Response (the chaos serve gate fails otherwise)."""
+    exactly one terminal Response (the chaos serve gate fails otherwise)."""
 
     request_id: int
     status: str
     tokens: List[int] = field(default_factory=list)
     error: Optional[str] = None
+    # True for load-shedding responses ('overloaded'): the request itself
+    # was fine, the engine was not — resubmitting later can succeed
+    retriable: bool = False
     prompt_len: int = 0
     # wall-clock timing (seconds since epoch): submit → first token → done
     submit_time: float = 0.0
@@ -97,30 +146,81 @@ class Response:
 
 
 class RequestQueue:
-    """FIFO admission queue. Single-threaded engines drive it directly;
-    ``submit`` is safe to call from a signal handler (deque.append is
-    atomic)."""
+    """Two-class admission queue: FIFO within a priority class, and
+    ``interactive`` always pops ahead of ``batch`` — so batch traffic can
+    never starve interactive under a storm (the shed policy is the other
+    half: batch sheds first). Single-threaded engines drive it directly;
+    per-class ``submit`` is safe to call from a signal handler
+    (deque.append is atomic).
+
+    The queue itself is pure mechanism — the CAP (FLAGS_serving_queue_max)
+    is enforced by the engine's admission path, which must answer the
+    over-cap request with a structured 'overloaded' response rather than
+    silently refuse."""
 
     def __init__(self):
-        self._q: deque = deque()
+        self._qs: Dict[str, deque] = {"interactive": deque(),
+                                      "batch": deque()}
 
     def push(self, req: Request):
-        self._q.append(req)
+        self._qs[req.priority].append(req)
 
     def push_front(self, req: Request):
-        self._q.appendleft(req)
+        self._qs[req.priority].appendleft(req)
 
     def peek(self) -> Optional[Request]:
-        return self._q[0] if self._q else None
+        for p in PRIORITIES:
+            if self._qs[p]:
+                return self._qs[p][0]
+        return None
 
     def pop(self) -> Optional[Request]:
-        return self._q.popleft() if self._q else None
+        for p in PRIORITIES:
+            if self._qs[p]:
+                return self._qs[p].popleft()
+        return None
+
+    def iter_priority(self, priority: str):
+        """Queued requests of one class, pop order."""
+        return iter(list(self._qs[priority]))
+
+    def take_expired(self, now: float) -> List[Request]:
+        """Remove and return every queued request whose deadline has
+        passed — expired work must answer 'timeout' instead of wasting a
+        prefill (and the blocks behind it)."""
+        out: List[Request] = []
+        for p in PRIORITIES:
+            q = self._qs[p]
+            # scan a snapshot, delete by IDENTITY: deque.remove would go
+            # through Request's dataclass == (ambiguous ndarray truth
+            # value), and a rotation would scramble FIFO order against a
+            # concurrent signal-handler push. The common case (no
+            # deadlines configured) never mutates the deque at all.
+            for r in list(q):
+                if not r.expired(now):
+                    continue
+                # indexed access, not an iterator: a concurrent
+                # signal-handler append must not raise 'deque mutated
+                # during iteration' out of the engine tick
+                for i in range(len(q)):
+                    try:
+                        if q[i] is r:
+                            del q[i]
+                            out.append(r)
+                            break
+                    except IndexError:
+                        break  # raced with a concurrent pop
+        return out
+
+    def __iter__(self):
+        for p in PRIORITIES:
+            yield from list(self._qs[p])
 
     def __len__(self):
-        return len(self._q)
+        return sum(len(q) for q in self._qs.values())
 
     def __bool__(self):
-        return bool(self._q)
+        return any(self._qs.values())
 
 
 def _validate_buckets(out: List[int], origin) -> List[int]:

@@ -1,0 +1,202 @@
+"""Self-healing engine supervision: restart the engine, not the fleet.
+
+The PR 7 engine recovers from faults *inside* a tick (the resilience
+ladder retries, demotes, requeues), but a tick exception that escapes the
+ladder — a scheduler bug, a poisoned captured program, a device wedge —
+used to propagate to whoever was driving the loop and take every queued
+request with it. The :class:`Supervisor` is the layer above: it drives the
+serve loop, consumes the two wedge signals, and restarts the engine in
+place.
+
+Signals:
+
+- **tick exceptions** — any ``Exception`` escaping ``Engine.step()``
+  (``Preempted``/``KeyboardInterrupt``/``SystemExit`` pass through: those
+  are control flow, not faults);
+- **the PR 9 step-stall watchdog** — ``FLAGS_trace_stall_ms`` > 0 starts
+  the trace-module watchdog; the supervisor registers a stall listener,
+  and a tick that trips it (no heartbeat inside the threshold) is treated
+  as a wedge once control returns.
+
+A restart (``Engine.restart``) evicts the engine's captured programs,
+rebuilds the pool, and re-enqueues in-flight sequences through the
+existing requeue path — greedy decode is deterministic, so the re-run
+reproduces **bitwise-identical tokens**. Restarts are bounded by
+``FLAGS_serving_max_engine_restarts``; past the budget the supervisor
+fails *cleanly* (``Engine.fail_clean``): every queued and in-flight
+request gets a terminal error response, the engine goes ``dead``, and a
+postmortem is dumped — zero hangs, zero silent drops.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence as Seq
+
+from ..core import flags
+
+__all__ = ["Supervisor"]
+
+
+class Supervisor:
+    """Drives one :class:`~paddle_tpu.serving.Engine`'s serve loop with
+    wedge detection and bounded self-healing restarts.
+
+        sup = paddle.serving.Supervisor(engine)
+        rids = [engine.submit(p, deadline_ms=500) for p in prompts]
+        sup.run_until_idle()          # restarts the engine if it wedges
+
+    ``max_restarts=None`` reads FLAGS_serving_max_engine_restarts live.
+
+    The stall watchdog's heartbeat is process-global (every engine tick
+    and training step feeds it), so stall trips are only attributed to
+    this supervisor's engine while one of ITS ticks is in flight, and
+    ``run_until_idle`` disarms the watchdog when it goes idle — run one
+    supervised serve loop at a time per process for stall detection
+    (tick-exception wedge recovery is always per-engine regardless).
+    """
+
+    def __init__(self, engine, max_restarts: Optional[int] = None):
+        import weakref
+
+        from ..profiler import trace as _trace
+
+        self._engine = engine
+        self._max_restarts = max_restarts
+        self._restarts = 0
+        self._stalled_ms: Optional[float] = None
+        self._in_tick = False
+        # the listener holds only a WEAK reference to this supervisor: the
+        # global listener registry must not pin the supervisor (and through
+        # it the engine, the model, and the pool's K/V arrays) alive when a
+        # caller drops the supervisor without close() — the dead closures
+        # leak class the serving engine's own close() exists to prevent.
+        # A trip after collection removes the stale closure itself.
+        ref = weakref.ref(self)
+
+        def _listener(stalled_ms, _ref=ref):
+            sup = _ref()
+            if sup is None:
+                _trace.remove_stall_listener(_listener)
+                return
+            sup._note_stall(stalled_ms)
+
+        self._listener = _listener  # stable identity for remove
+        _trace.add_stall_listener(self._listener)
+
+    # -- stall-watchdog plumbing ----------------------------------------
+    def _note_stall(self, stalled_ms: float):
+        # called from the watchdog daemon thread; consumed at the next
+        # tick boundary on the driving thread. The watchdog heartbeat is
+        # process-global, so only latch trips that fired while OUR engine
+        # was mid-tick — another engine's (or a training loop's) stall
+        # must not restart a healthy engine and burn its requests'
+        # requeue budgets
+        if self._in_tick:
+            self._stalled_ms = stalled_ms
+
+    def _take_stall(self) -> Optional[float]:
+        ms, self._stalled_ms = self._stalled_ms, None
+        return ms
+
+    def close(self):
+        from ..profiler import trace as _trace
+
+        _trace.remove_stall_listener(self._listener)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- supervision ----------------------------------------------------
+    @property
+    def restarts(self) -> int:
+        return self._restarts
+
+    def _budget(self) -> int:
+        if self._max_restarts is not None:
+            return int(self._max_restarts)
+        return int(flags.flag("serving_max_engine_restarts"))
+
+    def _recover(self, err: BaseException):
+        self._restarts += 1
+        if self._restarts > self._budget():
+            self._engine.fail_clean(err)
+            return
+        self._engine.restart(err)
+
+    @staticmethod
+    def _progress_marker() -> float:
+        """Cheap observable-progress sum: a tick that prefilled, decoded,
+        completed, or expired anything was slow, not wedged."""
+        from ..core import dispatch
+
+        c = dispatch._counters
+        return (c["serve_prefills"] + c["serve_decode_steps"]
+                + c["serve_requests_completed"]
+                + c["serve_deadline_expired"])
+
+    def step(self):
+        """One supervised tick: run ``Engine.step()``, convert a wedge
+        into an engine restart. A wedge is an exception escaping the tick,
+        or a stall-watchdog trip during a tick that made NO observable
+        progress — a slow-but-productive tick (first-serve XLA compiles
+        routinely exceed FLAGS_trace_stall_ms) must not trigger a restart
+        that evicts the very programs it just built."""
+        from ..core import dispatch
+
+        self._take_stall()  # stalls from BEFORE this tick aren't its fault
+        before = self._progress_marker()
+        self._in_tick = True
+        try:
+            self._engine.step()
+        except Exception as e:
+            # Preempted (a SystemExit subclass) propagates past this
+            # handler on its own — a preemption drain is control flow,
+            # not a wedge, and must not burn the restart budget
+            self._recover(e)
+            return
+        finally:
+            self._in_tick = False
+        stalled = self._take_stall()
+        if stalled is not None:
+            if self._progress_marker() > before:
+                dispatch._emit("serve", site="supervisor",
+                               phase="stall_benign",
+                               stalled_ms=round(stalled, 1))
+                return  # slow tick, real work done — not a wedge
+            self._recover(TimeoutError(
+                f"step-stall watchdog fired mid-tick with no progress "
+                f"({stalled:.0f} ms > FLAGS_trace_stall_ms)"))
+
+    def run_until_idle(self):
+        """Drive the supervised loop until every accepted request has a
+        terminal response — including through restarts, and including the
+        fail-clean path (a dead engine has already answered everything)."""
+        from ..profiler import trace as _trace
+
+        eng = self._engine
+        try:
+            while eng.pending and eng.health != "dead":
+                self.step()
+            eng._audit_drops()
+        finally:
+            # an idle serving loop looks exactly like a stalled one to the
+            # watchdog — stand it down (the train_step_range discipline)
+            _trace.watchdog_disarm()
+
+    def serve(self, requests: Seq, **submit_kw) -> List:
+        """Submit every prompt, run supervised to completion, return (and
+        evict) the responses in submit order."""
+        ids = [self._engine.submit(p, **submit_kw) for p in requests]
+        self.run_until_idle()
+        return [self._engine.pop_response(i) for i in ids]
+
+    def state(self) -> dict:
+        return {
+            "restarts": self._restarts,
+            "budget": self._budget(),
+            "engine_health": self._engine.health,
+            "last_restart_error": self._engine._last_restart_error,
+        }

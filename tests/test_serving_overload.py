@@ -1,0 +1,652 @@
+"""paddle.serving under overload — ISSUE 11 acceptance.
+
+  - per-request deadlines enforced at every stage (queued / prefill /
+    mid-decode) with terminal 'timeout' responses, partial output per
+    FLAGS_serving_deadline_partial, and blocks recycled;
+  - SLO-aware admission: queue cap (FLAGS_serving_queue_max), queue-wait
+    p99 trip wire (batch sheds first, interactive rides through), and
+    predicted-deadline-miss shedding from measured cost EMAs — always a
+    structured retriable 'overloaded' response, never a hang;
+  - Supervisor self-healing: tick exceptions and stall-watchdog trips
+    restart the engine (fresh pool, evicted captured programs, in-flight
+    sequences requeued — bitwise-identical tokens under greedy decode),
+    bounded by FLAGS_serving_max_engine_restarts before failing cleanly;
+  - health states (warming/ready/degraded/draining/dead) exposed on the
+    engine and honored by inference.PredictorPool.acquire;
+  - the pool-leak tripwire: run_until_idle's audit keeps serve_block_leaks
+    at 0 on every exit path and repairs (and counts) anything that leaks.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+from paddle_tpu import serving
+from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+VOCAB = 64
+
+
+def tiny_model(seed=7, max_seq_len=32):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=max_seq_len, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_model()
+
+
+def make_engine(model, **kw):
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prompt_buckets", [8, 16])
+    kw.setdefault("num_blocks", 24)
+    return serving.Engine(model, serving.ServingConfig(**kw))
+
+
+@pytest.fixture(autouse=True)
+def _overload_isolation():
+    from paddle_tpu.core.lazy import reset_serve_programs
+
+    res.reset()
+    prof.reset_dispatch_counters()
+    yield
+    paddle.set_flags({
+        "FLAGS_fault_inject": "",
+        "FLAGS_serving_default_deadline_ms": 0.0,
+        "FLAGS_serving_deadline_partial": True,
+        "FLAGS_serving_queue_max": 256,
+        "FLAGS_serving_queue_wait_p99_ms": 0.0,
+        "FLAGS_serving_max_engine_restarts": 3,
+        "FLAGS_trace_stall_ms": 0.0,
+    })
+    res.reset()
+    reset_serve_programs()
+
+
+def _prompt(rng, n=8):
+    return rng.integers(1, VOCAB, n)
+
+
+def _clean_tokens(model, prompts, max_new):
+    out = []
+    for p in prompts:
+        ref = model.generate(
+            paddle.to_tensor(np.asarray(p, np.int64)[None, :]),
+            max_new_tokens=max_new,
+        ).numpy()[0, len(p):]
+        out.append([int(t) for t in ref])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# deadlines: expiry at each stage
+# ---------------------------------------------------------------------------
+def test_deadline_expiry_in_queue(model):
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    rid = eng.submit(_prompt(rng), max_new_tokens=4, deadline_ms=5.0)
+    eng._now = lambda: time.time() + 10.0  # virtual clock: deadline passed
+    eng.run_until_idle()
+    r = eng.pop_response(rid)
+    assert r.status == "timeout" and not r.ok
+    assert r.tokens == []  # expired before any work
+    assert "queued" in r.error
+    c = prof.dispatch_counters()
+    assert c["serve_deadline_expired"] == 1
+    assert c["serve_expire_stages"]["queued"] == 1
+    assert c["serve_prefills"] == 0  # no prefill was wasted
+    assert eng._pool.free_blocks == eng._pool.num_blocks
+    assert c["serve_block_leaks"] == 0
+
+
+def test_deadline_expiry_at_prefill_pop(model):
+    # not expired at the tick-start queue scan, expired by the admit pop:
+    # the request must answer 'timeout' at stage 'prefill' WITHOUT running
+    # the prefill program
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    rid = eng.submit(_prompt(rng), max_new_tokens=4, deadline_ms=50.0)
+    req = eng._queue.peek()
+    base = req.submit_time
+    clock = iter([base + 0.001,   # tick-start expiry scan: still alive
+                  base + 10.0])   # admit pop: expired
+    eng._now = lambda: next(clock, base + 10.0)
+    eng.run_until_idle()
+    r = eng.pop_response(rid)
+    assert r.status == "timeout"
+    c = prof.dispatch_counters()
+    assert c["serve_expire_stages"] == {"prefill": 1}
+    assert c["serve_prefills"] == 0
+    assert eng._pool.free_blocks == eng._pool.num_blocks
+
+
+def test_deadline_expiry_mid_decode_partial_tokens(model):
+    rng = np.random.default_rng(3)
+    p = _prompt(rng)
+    (clean,) = _clean_tokens(model, [p], 8)
+    eng = make_engine(model)
+    rid = eng.submit(p, max_new_tokens=8, deadline_ms=60_000.0)
+    eng.step()  # prefill + first decode
+    eng.step()  # another decode
+    (seq,) = eng._active
+    assert 2 <= len(seq.tokens) < 8
+    eng._now = lambda: time.time() + 120.0  # deadline passes mid-decode
+    eng.run_until_idle()
+    r = eng.pop_response(rid)
+    assert r.status == "timeout"
+    # the partial output is the bitwise PREFIX of the fault-free run
+    assert len(r.tokens) >= 2
+    assert r.tokens == clean[:len(r.tokens)]
+    c = prof.dispatch_counters()
+    assert c["serve_expire_stages"]["decode"] == 1
+    # the expired row left the group without touching pool accounting
+    assert eng._pool.free_blocks == eng._pool.num_blocks
+    assert c["serve_block_leaks"] == 0
+
+
+def test_deadline_partial_flag_off_drops_tokens(model):
+    rng = np.random.default_rng(3)
+    eng = make_engine(model)
+    paddle.set_flags({"FLAGS_serving_deadline_partial": False})
+    rid = eng.submit(_prompt(rng), max_new_tokens=8, deadline_ms=60_000.0)
+    eng.step()
+    eng.step()
+    eng._now = lambda: time.time() + 120.0
+    eng.run_until_idle()
+    r = eng.pop_response(rid)
+    assert r.status == "timeout" and r.tokens == []
+
+
+def test_default_deadline_flag_applies(model):
+    paddle.set_flags({"FLAGS_serving_default_deadline_ms": 7.5})
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    rid = eng.submit(_prompt(rng), max_new_tokens=4)  # no explicit deadline
+    req = eng._queue.peek()
+    assert req.deadline_ms == 7.5
+    # an explicit deadline still wins
+    rid2 = eng.submit(_prompt(rng), max_new_tokens=4, deadline_ms=9999.0)
+    assert any(r.deadline_ms == 9999.0 for r in eng._queue)
+    # and an explicit 0 is the documented opt-out: NO deadline even with
+    # the default flag configured
+    rid3 = eng.submit(_prompt(rng), max_new_tokens=4, deadline_ms=0)
+    assert any(r.request_id == rid3 and r.deadline_ms is None
+               for r in eng._queue)
+    with pytest.raises(ValueError, match="deadline_ms"):
+        eng.submit(_prompt(rng), max_new_tokens=4, deadline_ms=-1)
+    eng.run_until_idle()
+    assert eng.response(rid) is not None and eng.response(rid2) is not None
+    assert eng.response(rid3).ok
+
+
+def test_expired_decode_row_does_not_perturb_neighbors(model):
+    # two sequences decode in one group; one expires mid-decode — the
+    # survivor must finish with tokens bitwise-identical to a run where it
+    # was alone
+    rng = np.random.default_rng(5)
+    p_live, p_dead = _prompt(rng), _prompt(rng)
+    (clean_live,) = _clean_tokens(model, [p_live], 8)
+    eng = make_engine(model)
+    rid_live = eng.submit(p_live, max_new_tokens=8)
+    rid_dead = eng.submit(p_dead, max_new_tokens=8, deadline_ms=60_000.0)
+    eng.step()  # prefill both
+    eng.step()  # decode both
+    base = time.time()
+    eng._now = lambda: base + 120.0  # only p_dead has a deadline
+    eng.run_until_idle()
+    assert eng.pop_response(rid_dead).status == "timeout"
+    r = eng.pop_response(rid_live)
+    assert r.ok and r.tokens == clean_live
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware admission: cap, trip wire, predicted misses, priorities
+# ---------------------------------------------------------------------------
+def test_queue_cap_sheds_with_structured_overloaded(model):
+    paddle.set_flags({"FLAGS_serving_queue_max": 2})
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    ids = [eng.submit(_prompt(rng), max_new_tokens=2) for _ in range(4)]
+    shed = [eng.response(i) for i in ids if eng.response(i) is not None]
+    assert len(shed) == 2  # two over the cap
+    for r in shed:
+        assert r.status == "overloaded" and r.retriable
+        assert "queue" in r.error
+    c = prof.dispatch_counters()
+    assert c["serve_requests_shed"] == 2
+    assert c["serve_shed_reasons"]["queue_full"] == 2
+    eng.run_until_idle()  # the two under the cap still complete
+    done = [eng.response(i) for i in ids]
+    assert sum(1 for r in done if r.ok) == 2
+    assert all(r is not None for r in done)  # zero hangs
+
+
+def test_predicted_deadline_miss_sheds_at_submit(model):
+    eng = make_engine(model)
+    # seed the measured-cost EMAs: 100 ms prefill, 100 ms per token
+    eng._admission.note_prefill(8, 100.0)
+    eng._admission.note_decode(100.0, 1)
+    rng = np.random.default_rng(0)
+    rid = eng.submit(_prompt(rng), max_new_tokens=8, deadline_ms=50.0)
+    r = eng.response(rid)
+    assert r is not None and r.status == "overloaded" and r.retriable
+    assert "predicted" in r.error
+    assert prof.dispatch_counters()["serve_shed_reasons"][
+        "predicted_deadline_miss"] == 1
+    # a generous deadline admits and completes
+    rid2 = eng.submit(_prompt(rng), max_new_tokens=2, deadline_ms=1e9)
+    eng.run_until_idle()
+    assert eng.response(rid2).ok
+
+
+def test_queue_wait_trip_wire_sheds_batch_first(model):
+    paddle.set_flags({"FLAGS_serving_queue_wait_p99_ms": 5.0})
+    eng = make_engine(model)
+    for _ in range(10):  # past the minimum-sample gate, p99 >> trip wire
+        eng._admission.note_queue_wait(500.0)
+    rng = np.random.default_rng(0)
+    b = eng.submit(_prompt(rng), max_new_tokens=2, priority="batch")
+    rb = eng.response(b)
+    assert rb is not None and rb.status == "overloaded"
+    assert "batch sheds first" in rb.error
+    # interactive rides through the same storm
+    i = eng.submit(_prompt(rng), max_new_tokens=2, priority="interactive")
+    assert eng.response(i) is None  # queued, not shed
+    eng.run_until_idle()
+    assert eng.response(i).ok
+    c = prof.dispatch_counters()
+    assert c["serve_shed_reasons"]["queue_p99"] == 1
+
+
+def test_non_head_queued_request_expires(model):
+    # regression: take_expired must expire a request BEHIND a live head —
+    # deque.remove on a dataclass with an ndarray field raises an
+    # ambiguous-truth ValueError, which an earlier draft swallowed,
+    # silently leaving non-head expired work queued
+    eng = make_engine(model, num_blocks=4)  # 1 admitted seq at a time
+    rng = np.random.default_rng(0)
+    head = eng.submit(_prompt(rng), max_new_tokens=8)  # no deadline
+    dead = eng.submit(_prompt(rng), max_new_tokens=8, deadline_ms=60_000.0)
+    base = time.time()
+    eng._now = lambda: base + 120.0  # only `dead` has a deadline
+    eng.step()
+    r = eng.response(dead)
+    assert r is not None and r.status == "timeout"
+    assert prof.dispatch_counters()["serve_expire_stages"]["queued"] == 1
+    eng.run_until_idle()
+    assert eng.response(head).ok
+
+
+def test_trip_wire_recovers_after_storm(model):
+    # the trip-wire p99 is a recent-window signal: once admitted traffic
+    # waits normally again, the storm ages out and batch admits again
+    paddle.set_flags({"FLAGS_serving_queue_wait_p99_ms": 50.0})
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        eng._admission.note_queue_wait(500.0)  # the storm
+    b1 = eng.submit(_prompt(rng), max_new_tokens=2, priority="batch")
+    assert eng.response(b1).status == "overloaded"
+    for _ in range(130):  # normal waits displace the storm window
+        eng._admission.note_queue_wait(1.0)
+    b2 = eng.submit(_prompt(rng), max_new_tokens=2, priority="batch")
+    assert eng.response(b2) is None  # admitted again
+    eng.run_until_idle()
+    assert eng.response(b2).ok
+
+
+def test_interactive_pops_ahead_of_batch():
+    q = serving.RequestQueue()
+    rb = serving.Request(prompt=np.ones(4), max_new_tokens=1,
+                         priority="batch")
+    ri = serving.Request(prompt=np.ones(4), max_new_tokens=1,
+                         priority="interactive")
+    q.push(rb)
+    q.push(ri)
+    assert q.peek() is ri and q.pop() is ri
+    assert q.pop() is rb and q.pop() is None
+    with pytest.raises(ValueError, match="priority"):
+        serving.Request(prompt=np.ones(4), max_new_tokens=1, priority="bulk")
+
+
+def test_batch_backlog_includes_interactive_but_not_vice_versa(model):
+    # the prediction asymmetry that makes batch shed first: identical
+    # deadline/cost, but a batch request counts ALL queued work ahead of
+    # it and sheds, while an interactive request — which pops ahead of the
+    # batch backlog — counts only interactive work and admits
+    eng = make_engine(model, num_blocks=4)  # small pool: work stays queued
+    eng._admission.note_prefill(8, 10.0)
+    eng._admission.note_decode(10.0, 1)
+    rng = np.random.default_rng(0)
+    # a pile of queued batch work (deadlines generous enough to admit)
+    for _ in range(4):
+        eng.submit(_prompt(rng), max_new_tokens=8, deadline_ms=1e9,
+                   priority="batch")
+    deadline = 200.0  # covers own cost (~90 ms) but not the backlog's
+    b = eng.submit(_prompt(rng), max_new_tokens=8, deadline_ms=deadline,
+                   priority="batch")
+    i = eng.submit(_prompt(rng), max_new_tokens=8, deadline_ms=deadline,
+                   priority="interactive")
+    rb, ri = eng.response(b), eng.response(i)
+    assert rb is not None and rb.status == "overloaded"
+    assert ri is None  # admitted: it jumps the batch queue, so only
+    #                    interactive work counted against its deadline
+    eng._now = lambda: time.time() + 1e4  # expire whatever remains
+    eng.run_until_idle()
+    assert eng.response(i) is not None
+
+
+# ---------------------------------------------------------------------------
+# supervisor: restart on wedge, bitwise tokens, bounded fail-clean
+# ---------------------------------------------------------------------------
+def test_supervisor_restarts_on_tick_exception_bitwise(model):
+    rng = np.random.default_rng(3)
+    prompts = [_prompt(rng) for _ in range(3)]
+    clean = _clean_tokens(model, prompts, 6)
+    eng = make_engine(model)
+    sup = serving.Supervisor(eng)
+    try:
+        ids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        orig = eng._decode_batch
+        state = {"armed": True}
+
+        def wedge(chunk, n_blk):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("tick bug escaped the ladder")
+            return orig(chunk, n_blk)
+
+        eng._decode_batch = wedge
+        sup.run_until_idle()
+        resps = [eng.pop_response(i) for i in ids]
+    finally:
+        sup.close()
+    assert sup.restarts == 1
+    assert [r.tokens for r in resps] == clean  # greedy ⇒ bitwise re-run
+    assert all(r.ok for r in resps)
+    c = prof.dispatch_counters()
+    assert c["serve_engine_restarts"] == 1
+    assert c["serve_requests_dropped"] == 0
+    assert c["serve_block_leaks"] == 0
+    assert eng._pool.free_blocks == eng._pool.num_blocks
+
+
+def test_supervisor_restart_budget_fails_clean(model):
+    rng = np.random.default_rng(0)
+    eng = make_engine(model)
+    sup = serving.Supervisor(eng, max_restarts=2)
+    try:
+        ids = [eng.submit(_prompt(rng), max_new_tokens=4) for _ in range(3)]
+
+        def always_wedged(chunk, n_blk):
+            raise RuntimeError("permanently wedged")
+
+        eng._decode_batch = always_wedged
+        sup.run_until_idle()  # must RETURN — fail clean, never hang
+    finally:
+        sup.close()
+    assert sup.restarts == 3  # 2 restarts + the final over-budget attempt
+    assert eng.health == "dead"
+    for i in ids:
+        r = eng.response(i)
+        assert r is not None and r.status == "error"
+        assert "restarts" in r.error
+    # dead engines refuse new work with a response, not an exception
+    late = eng.submit(_prompt(rng), max_new_tokens=2)
+    assert eng.response(late).status == "rejected"
+    c = prof.dispatch_counters()
+    assert c["serve_engine_restarts"] == 2  # the budgeted ones
+    assert c["serve_requests_dropped"] == 0
+    assert c["serve_block_leaks"] == 0
+
+
+def test_supervisor_consumes_stall_watchdog(model):
+    # a tick that trips the stall watchdog AND makes no observable
+    # progress is a wedge: the supervisor restarts the engine
+    rng = np.random.default_rng(3)
+    prompts = [_prompt(rng) for _ in range(2)]
+    clean = _clean_tokens(model, prompts, 4)
+    paddle.set_flags({"FLAGS_trace_stall_ms": 40.0})
+    eng = make_engine(model)
+    sup = serving.Supervisor(eng)
+    try:
+        ids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.step()  # a healthy tick arms the watchdog heartbeat
+        orig = eng._decode_batch
+        state = {"armed": True}
+
+        def wedged_tick(chunk, n_blk):
+            if state["armed"]:
+                state["armed"] = False
+                time.sleep(0.25)  # way past FLAGS_trace_stall_ms...
+                return True       # ...and NOTHING decoded: a true wedge
+            return orig(chunk, n_blk)
+
+        eng._decode_batch = wedged_tick
+        sup.run_until_idle()
+        resps = [eng.pop_response(i) for i in ids]
+    finally:
+        sup.close()
+        paddle.set_flags({"FLAGS_trace_stall_ms": 0.0})
+    assert sup.restarts >= 1  # the stall was observed and acted on
+    assert all(r.ok for r in resps)
+    assert [r.tokens for r in resps] == clean
+    assert prof.dispatch_counters()["serve_requests_dropped"] == 0
+
+
+def test_slow_but_productive_tick_is_not_a_wedge(model):
+    # first-serve compiles routinely exceed the stall threshold: a tick
+    # that trips the watchdog but DID real work must not trigger the
+    # restart (which would evict the programs it just built)
+    rng = np.random.default_rng(3)
+    paddle.set_flags({"FLAGS_trace_stall_ms": 40.0})
+    eng = make_engine(model)
+    sup = serving.Supervisor(eng)
+    try:
+        ids = [eng.submit(_prompt(rng), max_new_tokens=4)
+               for _ in range(2)]
+        eng.step()  # arm the heartbeat
+        orig = eng._decode_batch
+        state = {"armed": True}
+
+        def slow_tick(chunk, n_blk):
+            if state["armed"]:
+                state["armed"] = False
+                time.sleep(0.25)  # trips the watchdog...
+            return orig(chunk, n_blk)  # ...but the decode happens
+
+        eng._decode_batch = slow_tick
+        sup.run_until_idle()
+        resps = [eng.pop_response(i) for i in ids]
+    finally:
+        sup.close()
+        paddle.set_flags({"FLAGS_trace_stall_ms": 0.0})
+    assert sup.restarts == 0
+    assert all(r.ok for r in resps)
+
+
+def test_restart_requeues_do_not_burn_request_retries(model):
+    # the engine wedged, not the request: with default budgets
+    # (request_retries=2 < max_engine_restarts=3) an in-flight request
+    # must survive all three in-budget restarts and finish bitwise
+    rng = np.random.default_rng(3)
+    prompts = [_prompt(rng) for _ in range(2)]
+    clean = _clean_tokens(model, prompts, 4)
+    eng = make_engine(model)
+    sup = serving.Supervisor(eng)  # default budget: 3 restarts
+    try:
+        ids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        orig = eng._decode_batch
+        state = {"wedges": 3}
+
+        def wedge(chunk, n_blk):
+            if state["wedges"]:
+                state["wedges"] -= 1
+                raise RuntimeError("wedge")
+            return orig(chunk, n_blk)
+
+        eng._decode_batch = wedge
+        sup.run_until_idle()
+        resps = [eng.pop_response(i) for i in ids]
+    finally:
+        sup.close()
+    assert sup.restarts == 3
+    assert all(r.ok for r in resps)
+    assert [r.tokens for r in resps] == clean
+
+
+# ---------------------------------------------------------------------------
+# health states + PredictorPool routing
+# ---------------------------------------------------------------------------
+def test_health_transitions(model):
+    eng = make_engine(model)
+    assert eng.health == "warming"
+    rng = np.random.default_rng(0)
+    eng.serve([_prompt(rng)], max_new_tokens=2)
+    assert eng.health == "ready"
+    eng.restart(RuntimeError("forced"))
+    assert eng.health == "degraded"
+    for _ in range(10):  # cooldown of clean ticks re-promotes
+        eng.step()
+    assert eng.health == "ready"
+    eng.begin_drain()
+    assert eng.health == "draining" and not eng.serviceable()
+    eng.fail_clean(RuntimeError("done"))
+    assert eng.health == "dead"
+    assert prof.dispatch_counters()["serve_health_transitions"] >= 5
+
+
+def test_health_events_explain_transitions(model):
+    from paddle_tpu.profiler import trace
+
+    trace.clear()
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    eng.serve([_prompt(rng)], max_new_tokens=2)
+    eng.restart(RuntimeError("forced"))
+    phases = [(e.attrs or {}).get("state")
+              for e in trace.events() if e.kind == "serve"
+              and (e.attrs or {}).get("phase") == "health"]
+    assert phases[:2] == ["ready", "degraded"]
+
+
+def test_predictor_pool_routes_around_unhealthy(model):
+    from paddle_tpu import inference
+
+    config = inference.Config()
+    config.enable_generative_serving(
+        model, block_size=8, prompt_buckets=[8], num_blocks=16,
+        max_new_tokens=3,
+    )
+    pool = inference.PredictorPool(config, size=2, clone=False)
+    a, b = pool.retrieve(0), pool.retrieve(1)
+    assert a.engine is not b.engine  # independent replicas
+    assert pool.acquire() in (a, b)
+    a.engine.begin_drain()  # replica a goes unhealthy
+    for _ in range(4):
+        assert pool.acquire() is b  # traffic routes around it
+    assert pool.healths() == ["draining", "warming"]
+    b.engine.fail_clean(RuntimeError("dead too"))
+    with pytest.raises(RuntimeError, match="no serviceable"):
+        pool.acquire()
+    # degraded replicas are last-resort but still serve
+    a.engine._draining = False
+    a.engine._health = "degraded"
+    assert pool.acquire() is a
+
+
+def test_predictor_pool_round_robins_degraded_fleet(model):
+    # an all-degraded fleet must still spread load, not pin every
+    # acquire to the first degraded replica in rotation order
+    from paddle_tpu import inference
+
+    config = inference.Config()
+    config.enable_generative_serving(
+        model, block_size=8, prompt_buckets=[8], num_blocks=16,
+        max_new_tokens=3,
+    )
+    pool = inference.PredictorPool(config, size=3, clone=False)
+    for i in range(3):
+        pool.retrieve(i).engine._health = "degraded"
+    picks = [pool.acquire() for _ in range(6)]
+    assert {id(p) for p in picks} == {id(pool.retrieve(i))
+                                      for i in range(3)}
+
+
+def test_predictor_pool_clone_contract_unchanged(model):
+    from paddle_tpu import inference
+
+    config = inference.Config()
+    config.enable_generative_serving(
+        model, block_size=8, prompt_buckets=[8], num_blocks=16,
+        max_new_tokens=3,
+    )
+    pool = inference.PredictorPool(config, size=2)  # default: clones
+    assert pool.retrieve(0).engine is pool.retrieve(1).engine
+
+
+# ---------------------------------------------------------------------------
+# block-leak tripwire
+# ---------------------------------------------------------------------------
+def test_block_leak_audit_counts_and_repairs(model):
+    eng = make_engine(model)
+    rng = np.random.default_rng(0)
+    eng.serve([_prompt(rng)], max_new_tokens=2)
+    assert prof.dispatch_counters()["serve_block_leaks"] == 0
+    # simulate a buggy exit path that forgot to recycle its blocks
+    leaked = eng._pool.alloc(3)
+    assert leaked is not None
+    eng.run_until_idle()  # idle audit: counted AND repaired
+    c = prof.dispatch_counters()
+    assert c["serve_block_leaks"] == 3
+    assert eng._pool.free_blocks == eng._pool.num_blocks
+
+
+def test_no_leaks_under_mixed_storm(model):
+    # sheds + expiries + faults + requeues all in one run: every exit path
+    # recycles its blocks and every request ends terminal
+    paddle.set_flags({"FLAGS_fault_inject": "execute:p=0.2",
+                      "FLAGS_retry_backoff_ms": 0.5,
+                      "FLAGS_serving_queue_max": 4})
+    try:
+        eng = make_engine(model, num_blocks=8)
+        rng = np.random.default_rng(1)
+        ids = []
+        for k in range(10):
+            ids.append(eng.submit(
+                _prompt(rng), max_new_tokens=4,
+                deadline_ms=5.0 if k % 3 == 0 else None,
+                priority="batch" if k % 2 else "interactive"))
+        eng.run_until_idle()
+    finally:
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+    statuses = [eng.response(i).status for i in ids]  # no Nones: terminal
+    assert set(statuses) <= {"ok", "timeout", "overloaded", "error",
+                             "rejected"}
+    c = prof.dispatch_counters()
+    assert c["serve_requests_dropped"] == 0
+    assert c["serve_block_leaks"] == 0
+    assert eng._pool.free_blocks == eng._pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# flags surface
+# ---------------------------------------------------------------------------
+def test_overload_flags_documented():
+    docs = paddle.core.flags.describe_flags("serving")
+    names = {d["name"] for d in docs}
+    assert {"FLAGS_serving_default_deadline_ms",
+            "FLAGS_serving_deadline_partial",
+            "FLAGS_serving_queue_max",
+            "FLAGS_serving_queue_wait_p99_ms",
+            "FLAGS_serving_max_engine_restarts"} <= names
+    assert all(d["doc"] for d in docs)

@@ -12,6 +12,15 @@ token output identical to the fault-free fixed-shape reference — under
                 and every request still completes with the same tokens
   sigterm       SIGTERM mid-serve → drain: everything already submitted
                 completes, new submissions are rejected, nothing drops
+  overload      2× sustained oversubmit (ISSUE 11): with the queue-wait
+                p99 trip wire open, every batch-class submission sheds
+                with a structured retriable 'overloaded' response while
+                every interactive request completes inside its deadline —
+                zero hangs, zero drops, zero leaked KV blocks
+  wedge         a forced engine wedge (a tick exception escaping the
+                resilience ladder): the Supervisor restarts the engine
+                (fresh pool, evicted captured programs) and the requeued
+                sequences finish with bitwise-identical tokens
 
 Usage:
     JAX_PLATFORMS=cpu python tools/serve_probe.py [--requests 6] [--max-new 8]
@@ -165,6 +174,104 @@ def scenario_sigterm(model, prompts, max_new, clean, results):
     })
 
 
+def scenario_overload(model, max_new, results):
+    """2× sustained oversubmit: interactive requests carry a generous
+    deadline and must ALL complete inside it; batch requests arrive into
+    an open queue-wait p99 trip wire and must ALL shed with a structured
+    retriable response. The hard gates: every submitted request gets a
+    terminal response (zero hangs, zero drops) and the pool leaks zero
+    blocks."""
+    _fresh()
+    paddle.set_flags({"FLAGS_serving_queue_wait_p99_ms": 1.0,
+                      "FLAGS_serving_queue_max": 64})
+    try:
+        eng = _engine(model)
+        rng = np.random.default_rng(5)
+        warm = [rng.integers(1, VOCAB, 8) for _ in range(10)]
+        # warm window: compiles the programs AND seeds the measured cost
+        # EMAs + enough queue-wait samples (>= 8) to arm the trip wire
+        eng.serve(warm, max_new_tokens=max_new)
+        deadline_ms = 120_000.0  # generous: interactive must make it
+        n = 12  # ~2x what the 24-block pool can hold concurrently
+        subs = []  # (rid, priority)
+        for k in range(n):
+            for prio in ("interactive", "batch"):
+                rid = eng.submit(rng.integers(1, VOCAB, 8),
+                                 max_new_tokens=max_new,
+                                 deadline_ms=deadline_ms, priority=prio)
+                subs.append((rid, prio))
+        eng.run_until_idle()
+        resps = {rid: eng.pop_response(rid) for rid, _ in subs}
+        c = prof.dispatch_counters()
+    finally:
+        paddle.set_flags({"FLAGS_serving_queue_wait_p99_ms": 0.0,
+                          "FLAGS_serving_queue_max": 256})
+    inter = [resps[r] for r, p in subs if p == "interactive"]
+    batch = [resps[r] for r, p in subs if p == "batch"]
+    inter_lat = [r.latency_ms for r in inter if r is not None and r.ok]
+    inter_p99 = (float(np.percentile(inter_lat, 99)) if inter_lat else None)
+    ok = (
+        all(r is not None for r in resps.values())          # zero hangs
+        and all(r.ok for r in inter)                        # goodput kept
+        and inter_p99 is not None and inter_p99 < deadline_ms
+        and all(r.status == "overloaded" and r.retriable for r in batch)
+        and c["serve_requests_dropped"] == 0
+        and c["serve_block_leaks"] == 0
+        and eng._pool.free_blocks == eng._pool.num_blocks
+    )
+    results.append({
+        "scenario": "overload/2x", "ok": ok,
+        "interactive_completed": sum(r.ok for r in inter),
+        "interactive_p99_ms": inter_p99,
+        "deadline_ms": deadline_ms,
+        "batch_shed": sum(r.status == "overloaded" for r in batch),
+        "shed_reasons": dict(c["serve_shed_reasons"]),
+        "dropped": c["serve_requests_dropped"],
+        "block_leaks": c["serve_block_leaks"],
+    })
+
+
+def scenario_wedge(model, prompts, max_new, clean, results):
+    """A forced mid-run engine wedge — a tick exception escaping the
+    resilience ladder — detected by the Supervisor, which restarts the
+    engine and finishes every request with bitwise-identical tokens."""
+    _fresh()
+    eng = _engine(model)
+    sup = serving.Supervisor(eng)
+    try:
+        ids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        orig = eng._decode_batch
+        state = {"armed": True}
+
+        def wedged(chunk, n_blk):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("forced wedge: tick bug")
+            return orig(chunk, n_blk)
+
+        eng._decode_batch = wedged
+        sup.run_until_idle()
+        resps = [eng.pop_response(i) for i in ids]
+    finally:
+        sup.close()
+    c = prof.dispatch_counters()
+    ok = (all(r is not None and r.ok for r in resps)
+          and _tokens(resps) == clean
+          and sup.restarts >= 1
+          and c["serve_engine_restarts"] >= 1
+          and c["serve_requests_dropped"] == 0
+          and c["serve_block_leaks"] == 0
+          and eng.health in ("ready", "degraded"))
+    results.append({
+        "scenario": "wedge/supervisor", "ok": ok,
+        "restarts": sup.restarts,
+        "health": eng.health,
+        "requeues": c["serve_request_requeues"],
+        "dropped": c["serve_requests_dropped"],
+        "block_leaks": c["serve_block_leaks"],
+    })
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=6)
@@ -178,6 +285,8 @@ def main():
     scenario_faults(model, prompts, args.max_new, clean, results)
     scenario_storm(model, prompts, args.max_new, clean, results)
     scenario_sigterm(model, prompts, args.max_new, clean, results)
+    scenario_overload(model, args.max_new, results)
+    scenario_wedge(model, prompts, args.max_new, clean, results)
     _fresh()
 
     for r in results:
