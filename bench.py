@@ -27,6 +27,16 @@ import time
 import numpy as np
 
 
+def _tb_tail(e, n=4):
+    """Last `n` traceback lines of an exception, one stderr-friendly line —
+    a failed bench block must say WHERE it died, not just the repr."""
+    import traceback
+
+    lines = traceback.format_exception(type(e), e, e.__traceback__)
+    tail = [ln.strip().replace("\n", " | ") for ln in lines[-n:]]
+    return f"{type(e).__name__}: {e} [tb: " + " | ".join(tail) + "]"
+
+
 def _best_window(run_window, reps=None):
     """Run a self-syncing timed window `reps` times, return the best (min)
     duration. The axon relay's per-program turnaround fluctuates ~0.5-8 ms
@@ -758,27 +768,67 @@ def _checkpoint_block(steps=120, bsz=16):
 
 
 def _observability_block(steps=6, bsz=8):
-    """Observability probe for the BENCH_* trajectory (ISSUE 9): tracing-on
-    overhead of the flight recorder at its default ring size (gated <1% by
-    tools/obs_probe.py; recorded here per round), events/step at the
-    captured steady state, and the per-emit cost split (on-mode vs the
-    off-mode fast path). Delegates to the one measurement definition in
-    tools/obs_probe.py."""
+    """Observability probe for the BENCH_* trajectory (ISSUE 9 + 13):
+    tracing-on overhead of the flight recorder at its default ring size
+    (gated <1% by tools/obs_probe.py; recorded here per round), events/step
+    at the captured steady state, the per-emit cost split (on-mode vs the
+    off-mode fast path), the diagnostics server's /metrics scrape latency
+    (client p50/p99 + server-side exposition build p50), and the
+    perf-regression sentinel's false-positive count over the benched
+    steady window (must be 0 — a clean run never pages). Delegates to the
+    one measurement definition in tools/obs_probe.py."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "tools"))
     import paddle_tpu as paddle
+    import paddle_tpu.profiler as prof
     import paddle_tpu.resilience as res
     from obs_probe import _batches as _obs_batches
-    from obs_probe import measure_trace_overhead
+    from obs_probe import _build, _one_step, measure_trace_overhead
 
     try:
-        return measure_trace_overhead(_obs_batches(steps, bsz))
+        batches = _obs_batches(steps, bsz)
+        out = measure_trace_overhead(batches)
+
+        # -- /metrics scrape latency (ISSUE 13 ops plane; the one
+        # measurement definition lives in obs_probe) ------------------------
+        from obs_probe import measure_scrape_latency
+        from paddle_tpu.profiler import diag
+
+        addr = diag.start(port=0)
+        try:
+            out.update(measure_scrape_latency(addr, n=30))
+        finally:
+            diag.stop()
+
+        # -- sentinel false positives over a clean steady window ------------
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                          "FLAGS_eager_step_capture": True})
+        net, opt, loss_fn = _build()
+        for xy in batches * 3:  # settle into the captured steady state
+            _one_step(net, opt, loss_fn, xy)
+        from paddle_tpu.core import lazy as _lazy
+
+        _lazy.drain_async()
+        paddle.set_flags({"FLAGS_sentinel_pct": 20.0,
+                          "FLAGS_sentinel_warmup_steps": 5,
+                          "FLAGS_sentinel_sustain_steps": 3})
+        prof.sentinel.reset()
+        before = prof.dispatch_counters()["perf_regressions"]
+        n_window = 40
+        for i in range(n_window):
+            _one_step(net, opt, loss_fn, batches[i % len(batches)])
+        out["sentinel_false_positives"] = int(
+            prof.dispatch_counters()["perf_regressions"] - before)
+        out["sentinel_window_steps"] = n_window
+        return out
     finally:
         paddle.set_flags({"FLAGS_fault_inject": "",
                           "FLAGS_trace_ring_size": 4096,
+                          "FLAGS_sentinel_pct": 0.0,
                           "FLAGS_eager_lazy_dispatch": False,
                           "FLAGS_eager_step_capture": True,
                           "FLAGS_retry_backoff_ms": 5.0})
+        prof.sentinel.reset()
         res.reset()
 
 
@@ -902,7 +952,7 @@ def main():
         try:
             result["resilience"] = _resilience_block()
         except Exception as e:
-            print(f"# resilience block FAILED: {type(e).__name__}: {e}",
+            print(f"# resilience block FAILED: {_tb_tail(e)}",
                   file=sys.stderr)
     # checkpoint-overhead trajectory block (auto cadence vs off, overhead %
     # vs budget, snapshot/commit split) — BENCH_CHECKPOINT=0 skips it
@@ -910,7 +960,7 @@ def main():
         try:
             result["checkpoint"] = _checkpoint_block()
         except Exception as e:
-            print(f"# checkpoint block FAILED: {type(e).__name__}: {e}",
+            print(f"# checkpoint block FAILED: {_tb_tail(e)}",
                   file=sys.stderr)
     # observability trajectory block (flight-recorder overhead %, events/
     # step, per-emit cost) — BENCH_OBSERVABILITY=0 skips it
@@ -918,7 +968,7 @@ def main():
         try:
             result["observability"] = _observability_block()
         except Exception as e:
-            print(f"# observability block FAILED: {type(e).__name__}: {e}",
+            print(f"# observability block FAILED: {_tb_tail(e)}",
                   file=sys.stderr)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)
@@ -940,7 +990,8 @@ def main():
                 extra = fn()
                 print(f"# config {name}: {json.dumps(extra)}", file=sys.stderr)
             except Exception as e:
-                print(f"# config {name} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+                print(f"# config {name} FAILED: {_tb_tail(e)}",
+                      file=sys.stderr)
 
     print(
         f"# {which}: {steps} steps x {tokens_per_step} tok in {dt:.2f}s "

@@ -113,6 +113,17 @@ def _counter_set(key: str, v):
         _counters[key] = v
 
 
+def _counter_add_labeled(family: str, key: str, n: int = 1):
+    """Race-free update of one nested reason/site family entry — for
+    writers that may run off the main thread (the perf-regression sentinel
+    observes from the serving loop and the training thread alike)."""
+    with _counters_lock:
+        fam = _counters.get(family)
+        if fam is None:
+            fam = _counters[family] = {}
+        fam[key] = fam.get(key, 0) + n
+
+
 def reset_dispatch_counters():
     with _counters_lock:
         _reset_counters_locked()
@@ -219,11 +230,17 @@ def _reset_counters_locked():
         serve_engine_restarts=0,
         serve_health_transitions=0,
         serve_block_leaks=0,
+        # ops plane (ISSUE 13): perf-regression sentinel trips (the
+        # labeled family records WHICH step-signature / serving key
+        # regressed) and clears (a tripped key recovering re-baselines)
+        perf_regressions=0,
+        perf_regression_clears=0,
         serve_shed_reasons={},
         serve_expire_stages={},
         flush_reasons={},
         capture_fallback_reasons={},
         fault_sites={},
+        perf_regression_sites={},
     )
 
 
@@ -247,10 +264,41 @@ def dispatch_counters() -> Dict[str, Any]:
     ``{k: dict(v) if isinstance(v, Mapping) else v for k, v in c.items()}``
     (what ``measure_programs`` does); the live store is internal
     (``_counters``)."""
-    out = dict(_counters)
-    for k, v in out.items():
-        if isinstance(v, dict):  # reason/site/stage families
-            out[k] = MappingProxyType(dict(v))
+    # the copy takes _counters_lock so a concurrent reset (clear+update)
+    # can never be observed half-rebuilt — a /metrics scrape racing
+    # reset_dispatch_counters must see either the old families or the
+    # fresh zeros, never a torn partial dict. Main-thread writers bump
+    # entries WITHOUT the lock (that is the hot-path budget), so the
+    # nested-dict copies retry the rare resize-during-copy race.
+    for _ in range(8):
+        try:
+            with _counters_lock:
+                out = dict(_counters)
+                for k, v in out.items():
+                    if isinstance(v, dict):  # reason/site/stage families
+                        out[k] = MappingProxyType(dict(v))
+            return MappingProxyType(out)
+        except RuntimeError:
+            continue
+    with _counters_lock:  # sustained churn: per-family fallback. Main-
+        # thread writers can still insert new family keys mid-copy, so
+        # each nested copy retries on its own; a family that never copies
+        # clean degrades to its last good attempt (or empty) — this
+        # function's contract is a snapshot that NEVER raises, a /metrics
+        # scrape must not 500 on counter churn
+        out = {}
+        for k in list(_counters):
+            v = _counters.get(k)
+            if isinstance(v, dict):
+                fam = {}
+                for _ in range(64):
+                    try:
+                        fam = dict(v)
+                        break
+                    except RuntimeError:
+                        continue
+                v = MappingProxyType(fam)
+            out[k] = v
     return MappingProxyType(out)
 
 

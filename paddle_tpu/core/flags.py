@@ -356,6 +356,58 @@ define_flag(
     "dump (the event tail that explains what led up to the crash)",
 )
 # ---------------------------------------------------------------------------
+# Ops plane (paddle.profiler.diag / paddle.profiler.sentinel — see
+# OBSERVABILITY.md "Ops plane")
+# ---------------------------------------------------------------------------
+define_flag(
+    "diag_port", -1,
+    "per-process diagnostics HTTP server (paddle.profiler.diag): the port "
+    "diag.start() binds its stdlib ThreadingHTTPServer daemon to, serving "
+    "GET /metrics (Prometheus exposition incl. the adopted dispatch "
+    "counters), /healthz + /readyz (JSON liveness/readiness with HTTP "
+    "200/503 so a plain LB health check works), /flight?kind=&site=&last=N "
+    "(flight-recorder tail), /postmortems (list + fetch the "
+    "FLAGS_postmortem_dir dumps), /statusz (human-readable runtime state), "
+    "and /clockz (the fleet aggregator's clock-offset handshake). -1 "
+    "(default) = off; 0 = ephemeral port (tests / chaos fleet workers); "
+    "> 0 = fixed port. All read paths are built on detached snapshots, so "
+    "a scrape can never block or tear a training step",
+)
+define_flag(
+    "diag_host", "127.0.0.1",
+    "bind address of the diagnostics server (FLAGS_diag_port); set to "
+    "0.0.0.0 to expose /metrics and the fleet flight-ring pull across "
+    "hosts (the FleetAggregator reaches workers at the address they "
+    "publish under obs/<job>/<node>)",
+)
+define_flag(
+    "sentinel_pct", 0.0,
+    "perf-regression sentinel threshold (paddle.profiler.sentinel): when "
+    "> 0, per-(step-signature) step-time EMAs (and serving decode / "
+    "queue-wait latencies) are baselined after "
+    "FLAGS_sentinel_warmup_steps observations; sustained drift past this "
+    "percent (FLAGS_sentinel_sustain_steps consecutive breaches, with "
+    "hysteresis — a tripped key re-arms only after drifting back under "
+    "half the threshold) emits a 'perf_regression' flight event, "
+    "increments perf_regressions, dumps a postmortem whose event tail "
+    "shows what changed, and flips /healthz to 503 'degraded'. Breaches "
+    "are suppressed while the degradation ladder is demoted or a "
+    "checkpoint persist / background compile is in flight (those are "
+    "legitimate slowdowns, not regressions). 0 = off",
+)
+define_flag(
+    "sentinel_warmup_steps", 10,
+    "observations of a (step-signature) key before the perf-regression "
+    "sentinel freezes its baseline EMA and starts drift detection",
+)
+define_flag(
+    "sentinel_sustain_steps", 3,
+    "consecutive over-threshold observations before the perf-regression "
+    "sentinel trips (and, symmetrically, consecutive recovered "
+    "observations before a tripped key clears and re-baselines) — "
+    "one-step blips never page",
+)
+# ---------------------------------------------------------------------------
 # Serving runtime (paddle.serving — see SERVING.md)
 # ---------------------------------------------------------------------------
 define_flag(

@@ -54,6 +54,7 @@ __all__ = [
     "serve_capture_state",
     "serve_program",
     "step_capture_state",
+    "step_signature_id",
 ]
 
 
@@ -2031,6 +2032,21 @@ def serve_capture_state() -> Dict[str, Any]:
             if p._built_donate or p._built_plain
         ),
     }
+
+
+def step_signature_id() -> Optional[int]:
+    """Small stable id of the ARMED whole-step capture signature on this
+    thread, or None when no signature is armed. The perf-regression
+    sentinel keys its train-step baseline on this, so a workload change
+    that re-arms capture starts a fresh baseline instead of tripping
+    against the old step's timing."""
+    obs = getattr(_tls, "observer", None)
+    if obs is None or obs.armed is None:
+        return None
+    try:
+        return hash(obs.armed) & 0xFFFF
+    except TypeError:
+        return None
 
 
 def step_capture_state() -> Dict[str, Any]:

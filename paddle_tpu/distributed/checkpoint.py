@@ -35,6 +35,7 @@ except Exception:  # pragma: no cover
 __all__ = [
     "save_state_dict",
     "load_state_dict",
+    "persists_in_flight",
     "AsyncCheckpointer",
     "CadenceTuner",
     "CheckpointCadence",
@@ -46,6 +47,18 @@ __all__ = [
 ]
 
 _LATEST = "LATEST"
+
+# process-wide count of persist phases currently running (sync or on a
+# background thread). The perf-regression sentinel reads this: a step slowed
+# by an overlapping checkpoint persist is CheckFreq working, not a
+# regression, so breaches during a persist are suppressed.
+_persists_active = 0
+_persists_lock = threading.Lock()
+
+
+def persists_in_flight() -> int:
+    """Number of checkpoint persist phases currently running."""
+    return _persists_active
 
 
 def _ckpt_io(thunk):
@@ -264,6 +277,16 @@ class AsyncCheckpointer:
 
     # -- persist phase (CheckFreq phase 2: transfer + serialize + commit) ---
     def _persist(self, job: _SaveJob):
+        global _persists_active
+        with _persists_lock:
+            _persists_active += 1
+        try:
+            self._persist_inner(job)
+        finally:
+            with _persists_lock:
+                _persists_active -= 1
+
+    def _persist_inner(self, job: _SaveJob):
         try:
             t0 = time.perf_counter()
             if self._mgr is not None:
@@ -707,12 +730,13 @@ def _train_range(count: int, checkpointer, state_dict, save_freq,
         if guard is not None:
             guard.uninstall()
         # the loop is over — no more step heartbeats will arrive, which is
-        # indistinguishable from a stall; stand the watchdog down so a
-        # cleanly finished run never dumps a spurious stall postmortem
+        # indistinguishable from a stall; stand the TRAIN source down so a
+        # cleanly finished run never dumps a spurious stall postmortem (a
+        # co-resident serving engine's heartbeat stays armed)
         try:
             from ..profiler import trace as _trace
 
-            _trace.watchdog_disarm()
+            _trace.watchdog_disarm("train")
         except Exception:
             pass
         if checkpointer is not None:

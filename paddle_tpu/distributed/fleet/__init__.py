@@ -35,6 +35,8 @@ from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DataGenerator, InMemoryDataset, QueueDataset  # noqa: F401
 from . import elastic  # noqa: F401
+from . import obs  # noqa: F401
+from .obs import FleetAggregator, ObsPublisher  # noqa: F401
 from .localsgd import LocalSGDOptimizer  # noqa: F401
 from .dgc import DGCMomentumOptimizer  # noqa: F401
 

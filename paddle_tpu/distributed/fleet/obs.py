@@ -1,0 +1,402 @@
+"""Fleet-wide observability: per-worker snapshots through the elastic KV
+master, merged into one operator view.
+
+The multi-host chaos fleet (``tools/chaos_fleet_probe.py``) was N blind
+processes: each worker has a flight recorder, a metrics registry, and (now)
+a diagnostics server — but nothing merges them. This module closes that
+gap using the SAME elastic TCP lease/KV master the fleet already heartbeats
+through (``distributed/fleet/elastic.py`` over the PS wire):
+
+- :class:`ObsPublisher` — each worker publishes a compact JSON snapshot
+  (health, key metrics, its diagnostics-server address, wall clock) as a
+  TTL lease under ``obs/<job>/<node>`` on its heartbeat cadence. A dead or
+  wedged worker's lease expires, so it drops out of the merged view with
+  no coordinator — exactly the elastic-membership semantics.
+
+- :class:`FleetAggregator` — merges the live snapshots into:
+  * ``merged_prometheus_text()`` — ONE exposition where every family
+    carries a ``host`` label per worker (scrape a whole fleet from any
+    box that can reach the KV master);
+  * ``fleet_health()`` — one table: node, health status/reasons, step,
+    snapshot age, engines;
+  * ``merged_chrome_trace()`` — pulls each live host's flight ring over
+    its diagnostics server (``/flight``) and emits one chrome trace with
+    a process lane per host, timestamps aligned by a per-host
+    clock-offset handshake (``/clockz``, NTP-style: offset from the
+    minimum-RTT sample) — a chaos SIGKILL/partition scenario becomes one
+    readable timeline instead of N logs.
+
+Publishers fail SOFT on master outages (the partition chaos scenario:
+training must continue while the master is down; snapshots resume on
+heal), mirroring ``ElasticManager.heartbeat``.
+
+:class:`MemoryKv` is a process-local stand-in for the TCP master with the
+same lease semantics — what the fast tests (and single-process demos) use;
+the real wire path is exercised by the slow fleet probe.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FleetAggregator", "MemoryKv", "ObsPublisher", "obs_key",
+           "obs_prefix"]
+
+
+def obs_prefix(job_id: str = "default") -> str:
+    return f"obs/{job_id}/"
+
+
+def obs_key(job_id: str, node_id: str) -> str:
+    """The fleet KV key schema: ``obs/<job>/<node>``."""
+    return obs_prefix(job_id) + node_id
+
+
+class MemoryKv:
+    """In-memory lease/KV with the master's semantics (kv_lease refreshes
+    a TTL; expired keys drop out of kv_alive) — test double for the TCP
+    master, NOT a distributed store."""
+
+    def __init__(self):
+        self._data: Dict[str, tuple] = {}  # key -> (value, deadline|None)
+
+    def kv_put(self, key: str, value: str):
+        self._data[key] = (value, None)
+
+    def kv_lease(self, key: str, value: str, ttl_s: float):
+        self._data[key] = (value, time.time() + float(ttl_s))
+
+    def kv_get(self, key: str) -> Optional[str]:
+        row = self._data.get(key)
+        if row is None:
+            return None
+        value, deadline = row
+        if deadline is not None and time.time() > deadline:
+            del self._data[key]
+            return None
+        return value
+
+    def kv_del(self, key: str):
+        self._data.pop(key, None)
+
+    def kv_alive(self, prefix: str) -> Dict[str, str]:
+        now = time.time()
+        out = {}
+        for k in list(self._data):
+            if not k.startswith(prefix):
+                continue
+            value, deadline = self._data[k]
+            if deadline is not None and now > deadline:
+                del self._data[k]
+                continue
+            out[k] = value
+        return out
+
+
+def _kv_from_master(master: str):
+    from ..ps import PsClient
+
+    return PsClient([master])
+
+
+class ObsPublisher:
+    """Publishes this process's observability snapshot under
+    ``obs/<job>/<node>`` with a TTL lease; call :meth:`publish` on the
+    heartbeat cadence (next to ``ElasticManager.heartbeat``)."""
+
+    def __init__(self, master: Optional[str] = None, kv=None,
+                 job_id: str = "default", node_id: Optional[str] = None,
+                 ttl: float = 10.0, diag_addr: Optional[str] = None):
+        if kv is None and not master:
+            raise ValueError("ObsPublisher needs master= or kv=")
+        self._master = master
+        self._kv = kv
+        self.job_id = job_id
+        self.node_id = node_id or os.getenv(
+            "PADDLE_CURRENT_ENDPOINT", f"node-{os.getpid()}")
+        self.ttl = float(ttl)
+        self._diag_addr = diag_addr
+        self.publishes = 0
+        self.failures = 0
+
+    @classmethod
+    def from_elastic(cls, manager, diag_addr: Optional[str] = None,
+                     ttl: Optional[float] = None) -> "ObsPublisher":
+        """Build from an :class:`ElasticManager` — same master, job id,
+        node id, and TTL, so obs membership expires exactly when the
+        elastic lease would."""
+        return cls(master=manager.master, job_id=manager.job_id,
+                   node_id=manager._node_id,
+                   ttl=ttl if ttl is not None else manager.heartbeat_ttl,
+                   diag_addr=diag_addr)
+
+    def _client(self):
+        if self._kv is None:
+            self._kv = _kv_from_master(self._master)
+        return self._kv
+
+    def key(self) -> str:
+        return obs_key(self.job_id, self.node_id)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The compact per-worker doc: identity + diag address + health +
+        flat metrics (histograms reduced to count/sum — the aggregator's
+        exposition carries them as counters)."""
+        from ...profiler import diag as _diag
+        from ...profiler import metrics as _metrics
+
+        _, health = _diag.health_doc()
+        try:
+            snap = _metrics.snapshot(include_dispatch=True)
+            hists = {
+                name: {"count": (h or {}).get("count", 0),
+                       "sum": (h or {}).get("sum", 0.0)}
+                for name, h in snap.get("histograms", {}).items()
+            }
+            metrics_doc = {"counters": snap.get("counters", {}),
+                           "gauges": snap.get("gauges", {}),
+                           "histograms": hists}
+        except Exception:
+            metrics_doc = None
+        return {
+            "node": self.node_id,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "diag": self._diag_addr or _diag.address(),
+            "wall": time.time(),
+            "step": health.get("step"),
+            "health": {
+                "status": health.get("status"),
+                "reasons": health.get("reasons"),
+                "heartbeat_age_ms": health.get("heartbeat_age_ms"),
+                "sentinel_tripped": health.get("sentinel_tripped"),
+                "engines": health.get("engines"),
+            },
+            "metrics": metrics_doc,
+        }
+
+    def publish(self, raise_errors: bool = False) -> bool:
+        """One lease refresh with a fresh snapshot. Master outages fail
+        SOFT by default (False returned, failure counted): the partition
+        chaos scenario trains through the outage and snapshots resume on
+        heal — observability must never take a worker down."""
+        try:
+            doc = json.dumps(self.snapshot(), default=str)
+            self._client().kv_lease(self.key(), doc, self.ttl)
+            self.publishes += 1
+            return True
+        except Exception:
+            self.failures += 1
+            if raise_errors:
+                raise
+            return False
+
+    def withdraw(self):
+        """Best-effort delete (clean shutdown; expiry handles crashes)."""
+        try:
+            self._client().kv_del(self.key())
+        except Exception:
+            pass
+
+
+def _split_labels(fullname: str):
+    """'name{a="b"}' -> ('name', 'a="b"'); 'name' -> ('name', '')."""
+    if "{" in fullname and fullname.endswith("}"):
+        base, rest = fullname.split("{", 1)
+        return base, rest[:-1]
+    return fullname, ""
+
+
+def _http_json(addr: str, path: str, timeout: float) -> Dict[str, Any]:
+    with urllib.request.urlopen(f"http://{addr}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+class FleetAggregator:
+    """Merges the live ``obs/<job>/*`` snapshots into one operator view."""
+
+    def __init__(self, master: Optional[str] = None, kv=None,
+                 job_id: str = "default", http_timeout: float = 2.0):
+        if kv is None and not master:
+            raise ValueError("FleetAggregator needs master= or kv=")
+        self._master = master
+        self._kv = kv
+        self.job_id = job_id
+        self.http_timeout = float(http_timeout)
+
+    def _client(self):
+        if self._kv is None:
+            self._kv = _kv_from_master(self._master)
+        return self._kv
+
+    def snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """{node_id: snapshot doc} for every UNEXPIRED obs lease — a dead
+        host's lease lapses, so it simply isn't here (no stale metrics)."""
+        prefix = obs_prefix(self.job_id)
+        alive = self._client().kv_alive(prefix)
+        out = {}
+        for key, value in alive.items():
+            node = key[len(prefix):]
+            try:
+                out[node] = json.loads(value)
+            except (ValueError, TypeError):
+                continue  # torn/corrupt doc: skip this cycle, not crash
+        return out
+
+    # -- merged exposition ----------------------------------------------
+    def merged_prometheus_text(self, prefix: str = "paddle_") -> str:
+        """One Prometheus exposition for the whole fleet: every family
+        from every live host, each sample labeled ``host="<node>"``
+        (prepended, so per-host label sets — engine uids etc. — survive
+        untouched)."""
+        from ...profiler.metrics import _fmt, escape_label_value
+
+        snaps = self.snapshots()
+        kinds: Dict[str, str] = {}
+        samples: Dict[str, List[str]] = {}
+
+        def add(node, fullname, kind, value):
+            base, labels = _split_labels(fullname)
+            fam = prefix + base
+            inner = f'host="{escape_label_value(node)}"'
+            if labels:
+                inner += "," + labels
+            kinds.setdefault(fam, kind)
+            samples.setdefault(fam, []).append(
+                f"{fam}{{{inner}}} {_fmt(value)}")
+
+        for node in sorted(snaps):
+            m = snaps[node].get("metrics") or {}
+            for fullname, v in sorted((m.get("counters") or {}).items()):
+                add(node, fullname, "counter", v)
+            for fullname, v in sorted((m.get("gauges") or {}).items()):
+                add(node, fullname, "gauge", v)
+            for fullname, h in sorted((m.get("histograms") or {}).items()):
+                base, labels = _split_labels(fullname)
+                lbl = "{" + labels + "}" if labels else ""
+                add(node, f"{base}_count{lbl}", "counter",
+                    (h or {}).get("count", 0))
+                add(node, f"{base}_sum{lbl}", "counter",
+                    (h or {}).get("sum", 0.0))
+        lines: List[str] = []
+        for fam in sorted(kinds):
+            lines.append(f"# TYPE {fam} {kinds[fam]}")
+            lines.extend(samples[fam])
+        return "\n".join(lines) + "\n"
+
+    # -- fleet health ----------------------------------------------------
+    def fleet_health(self) -> List[Dict[str, Any]]:
+        """One row per live node: status, step, snapshot age, engines."""
+        now = time.time()
+        rows = []
+        for node, doc in sorted(self.snapshots().items()):
+            h = doc.get("health") or {}
+            rows.append({
+                "node": node,
+                "host": doc.get("host"),
+                "pid": doc.get("pid"),
+                "status": h.get("status"),
+                "reasons": h.get("reasons") or [],
+                "step": doc.get("step"),
+                "age_s": round(now - float(doc.get("wall") or now), 2),
+                "diag": doc.get("diag"),
+                "engines": h.get("engines") or {},
+            })
+        return rows
+
+    # -- merged chrome trace ---------------------------------------------
+    def clock_offset_s(self, addr: str, samples: int = 3) -> float:
+        """NTP-style offset of a host's wall clock vs OURS, measured
+        against its /clockz endpoint: offset = remote_wall - local_mid,
+        taken from the minimum-RTT sample (the KV master hands us the
+        address; the handshake runs point-to-point)."""
+        best_rtt, best_off = None, 0.0
+        for _ in range(max(1, samples)):
+            t0 = time.time()
+            doc = _http_json(addr, "/clockz", self.http_timeout)
+            t1 = time.time()
+            rtt = t1 - t0
+            off = float(doc["wall"]) - (t0 + t1) / 2.0
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt, best_off = rtt, off
+        return best_off
+
+    def merged_chrome_trace(self, kind: Optional[str] = None,
+                            site: Optional[str] = None,
+                            last: Optional[int] = None) -> Dict[str, Any]:
+        """Pull each live host's flight ring over its diagnostics server
+        and merge into ONE chrome trace: a process lane per host (chrome
+        ``process_name`` metadata = ``host:<node>``), flight events as
+        instants, timestamps mapped into the aggregator's wall clock via
+        the per-host offset. Unreachable hosts (no diag server, mid-crash)
+        are recorded in the metadata, never fatal."""
+        events: List[Dict[str, Any]] = []
+        pulled: List[str] = []
+        unreachable: List[str] = []
+        query = []
+        if kind:
+            query.append(f"kind={kind}")
+        if site:
+            query.append(f"site={site}")
+        if last is not None:
+            query.append(f"last={int(last)}")
+        qs = ("?" + "&".join(query)) if query else ""
+        snaps = self.snapshots()
+
+        # the per-host pulls (3-sample /clockz handshake + /flight) are
+        # independent — run them concurrently, or every dead/partitioned
+        # host with a still-published diag address stalls the whole merge
+        # by a full connect timeout (the exact chaos window this feeds)
+        def pull(addr):
+            off = self.clock_offset_s(addr)
+            return off, _http_json(addr, f"/flight{qs}", self.http_timeout)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        nodes = sorted(snaps)
+        futures = {}
+        with ThreadPoolExecutor(max_workers=min(8, max(1, len(nodes)))) as ex:
+            for node in nodes:
+                addr = snaps[node].get("diag")
+                if addr:
+                    futures[node] = ex.submit(pull, addr)
+        for lane, node in enumerate(nodes, start=1):
+            events.append({"name": "process_name", "ph": "M", "pid": lane,
+                           "args": {"name": f"host:{node}"}})
+            fut = futures.get(node)
+            if fut is None:
+                unreachable.append(node)
+                continue
+            try:
+                off, flight = fut.result()
+            except Exception:
+                unreachable.append(node)
+                continue
+            pulled.append(node)
+            for ev in flight.get("events", []):
+                name = ev.get("kind", "?")
+                if ev.get("site"):
+                    name += ":" + ev["site"]
+                events.append({
+                    "name": name, "cat": "fleet", "ph": "i", "s": "t",
+                    "ts": (float(ev["ts"]) - off) * 1e6,
+                    "pid": lane, "tid": 1,
+                    "args": dict(ev.get("attrs") or {}, step=ev.get("step"),
+                                 node=node),
+                })
+        return {
+            "traceEvents": events,
+            "metadata": {
+                "merged_by": "paddle_tpu.distributed.fleet.obs",
+                "job_id": self.job_id,
+                "hosts": sorted(snaps),
+                "hosts_pulled": pulled,
+                "hosts_unreachable": unreachable,
+                "merged_at": time.time(),
+            },
+        }

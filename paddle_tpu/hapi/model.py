@@ -166,7 +166,7 @@ class Model:
         try:
             from ..profiler import trace as _trace
 
-            _trace.watchdog_disarm()
+            _trace.watchdog_disarm("train")
         except Exception:
             pass
         return self

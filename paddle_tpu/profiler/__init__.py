@@ -38,6 +38,8 @@ from . import metrics  # noqa: F401
 from . import trace  # noqa: F401
 
 __all__ = [
+    "diag",
+    "sentinel",
     "Profiler",
     "ProfilerState",
     "ProfilerTarget",
@@ -452,6 +454,13 @@ def _memory_snapshot(counters):
         except Exception:
             pass  # measurement must never break the profiled step
     return snap
+
+
+# ops plane (ISSUE 13): the per-process diagnostics HTTP server and the
+# perf-regression sentinel. Imported LAST — both reach back into this
+# package (StepTimer, metrics, trace), so they must see it initialized.
+from . import sentinel  # noqa: E402,F401
+from . import diag  # noqa: E402,F401
 
 
 def export_protobuf(dir_name: str, worker_name=None):

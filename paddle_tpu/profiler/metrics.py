@@ -31,15 +31,45 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "default_registry",
+    "escape_label_value",
+    "parse_prometheus_text",
     "prometheus_text",
     "snapshot",
+    "unescape_label_value",
 ]
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus exposition-format (v0.0.4) label-value escaping:
+    backslash, double-quote, and newline. Without this, an error-string or
+    request-id label value containing any of the three corrupts the whole
+    exposition — a raw newline even splits one sample into two junk lines."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def unescape_label_value(v: str) -> str:
+    """Inverse of :func:`escape_label_value` (consumer-side helper)."""
+    out, i, n = [], 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def _label_str(labels: Dict[str, str]) -> str:
+    # escaped in snapshot keys AND the exposition (one serialization, so
+    # parse_prometheus_text round-trips against snapshot() verbatim)
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -268,6 +298,7 @@ _DISPATCH_LABEL_KEYS = {
     "fault_sites": "site",
     "serve_shed_reasons": "reason",
     "serve_expire_stages": "stage",
+    "perf_regression_sites": "site",
 }
 
 

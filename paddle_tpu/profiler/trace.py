@@ -50,6 +50,7 @@ __all__ = [
     "emit",
     "enabled",
     "events",
+    "heartbeat_age_ms",
     "last_postmortem_path",
     "remove_stall_listener",
     "step_heartbeat",
@@ -157,22 +158,34 @@ def emit(kind: str, site: str = "", step: Optional[int] = None, **attrs):
     return ev
 
 
-def events(last: Optional[int] = None) -> List[TraceEvent]:
+def events(last: Optional[int] = None, kind: Optional[str] = None,
+           site: Optional[str] = None) -> List[TraceEvent]:
     """Snapshot of the ring, oldest first (optionally only the trailing
-    ``last`` events). Safe against concurrent emits: the copy retries the
-    rare 'deque mutated during iteration' race instead of locking the emit
-    path."""
+    ``last`` events). ``kind=`` / ``site=`` filter during the copy, so a
+    ``/flight?kind=ladder`` query or a postmortem builder materializes only
+    the matching events instead of the whole ring; ``last`` applies AFTER
+    the filters (the trailing N *matching* events). Safe against concurrent
+    emits: the copy retries the rare 'deque mutated during iteration' race
+    instead of locking the emit path."""
     ring = _ring
     if ring is None:
         return []
+    if kind is None and site is None:
+        keep = None
+    else:
+        def keep(e):
+            return ((kind is None or e.kind == kind)
+                    and (site is None or e.site == site))
     for _ in range(8):
         try:
-            out = list(ring)
+            out = list(ring) if keep is None else [e for e in ring if keep(e)]
             break
         except RuntimeError:
             continue
     else:  # sustained concurrent churn: drain via indexed access
         out = [ring[i] for i in range(len(ring))]
+        if keep is not None:
+            out = [e for e in out if keep(e)]
     if last is not None and last >= 0:
         out = out[-last:] if last else []
     return out
@@ -294,8 +307,11 @@ def read_postmortem(path: str) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 _wd_lock = threading.Lock()
 _wd_thread: Optional[threading.Thread] = None
-_wd_last_hb: Optional[int] = None
-_wd_fired = False
+# heartbeats are PER SOURCE ('train' from optimizer.step, 'serve' from the
+# engine tick): a combined train+serve process must not lose the training
+# loop's liveness signal because an idle engine stood ITS heartbeat down
+_wd_hb: Dict[str, int] = {}
+_wd_fired: Dict[str, bool] = {}
 _wd_stalls = 0
 # consumers of stall trips beyond the postmortem dump — the serving
 # Supervisor registers here so a wedged engine tick (no heartbeat inside
@@ -319,30 +335,50 @@ def remove_stall_listener(fn):
             _stall_listeners.remove(fn)
 
 
-def step_heartbeat():
+def step_heartbeat(source: str = "train"):
     """Step-boundary tick (called from resilience.runtime.on_step_end).
-    Re-arms the watchdog and starts it on first use when
+    Re-arms the watchdog for ``source`` and starts it on first use when
     FLAGS_trace_stall_ms > 0."""
-    global _wd_last_hb, _wd_fired
-    _wd_last_hb = time.perf_counter_ns()
-    _wd_fired = False
+    _wd_hb[source] = time.perf_counter_ns()
+    _wd_fired[source] = False
     if float(_flags.flag("trace_stall_ms")) > 0 and _wd_thread is None:
         _start_watchdog()
 
 
-def watchdog_disarm():
-    """Stand down the stall watchdog until the next heartbeat. A training
-    loop that ENDS looks exactly like a stalled one — no more step
-    boundaries — so clean completion must disarm (train_step_range /
-    train_epoch_range do this in their finally) or every finished run
-    would dump a spurious stall postmortem."""
-    global _wd_last_hb, _wd_fired
-    _wd_last_hb = None
-    _wd_fired = False
+def watchdog_disarm(source: Optional[str] = None):
+    """Stand down the stall watchdog for ``source`` (every source when
+    None) until the next heartbeat. A loop that ENDS looks exactly like a
+    stalled one — no more step boundaries — so clean completion must
+    disarm (train_step_range / train_epoch_range / Engine.run_until_idle
+    do this in their finally) or every finished run would dump a spurious
+    stall postmortem. Sources disarm independently: an idle serving
+    engine standing down must not erase the training loop's liveness
+    signal in a combined train+serve process."""
+    if source is None:
+        _wd_hb.clear()
+        _wd_fired.clear()
+    else:
+        _wd_hb.pop(source, None)
+        _wd_fired.pop(source, None)
 
 
 def stall_count() -> int:
     return _wd_stalls
+
+
+def heartbeat_age_ms(source: Optional[str] = None) -> Optional[float]:
+    """Milliseconds since the last step heartbeat of ``source`` — or, when
+    None, of the STALEST armed source — or None when no loop is running
+    (never beat, or every finished loop disarmed its source). The
+    diagnostics server's /healthz liveness check reads this — a heartbeat
+    older than FLAGS_trace_stall_ms means that step loop is wedged."""
+    if source is not None:
+        hb = _wd_hb.get(source)
+        return None if hb is None else (time.perf_counter_ns() - hb) / 1e6
+    beats = list(_wd_hb.values())
+    if not beats:
+        return None
+    return (time.perf_counter_ns() - min(beats)) / 1e6
 
 
 def _start_watchdog():
@@ -357,23 +393,26 @@ def _start_watchdog():
 
 
 def _watchdog_loop():
-    global _wd_fired, _wd_stalls
+    global _wd_stalls
     while True:
         ms = float(_flags.flag("trace_stall_ms"))
         if ms <= 0:
             time.sleep(0.25)
             continue
         time.sleep(min(max(ms / 2000.0, 0.005), 0.5))
-        hb = _wd_last_hb
-        if hb is None or _wd_fired:
-            continue
-        stalled_ms = (time.perf_counter_ns() - hb) / 1e6
-        if stalled_ms >= ms:
-            _wd_fired = True
+        now = time.perf_counter_ns()
+        for source, hb in list(_wd_hb.items()):
+            if _wd_fired.get(source):
+                continue
+            stalled_ms = (now - hb) / 1e6
+            if stalled_ms < ms:
+                continue
+            _wd_fired[source] = True
             _wd_stalls += 1
-            emit("stall", site="watchdog", stalled_ms=round(stalled_ms, 1),
-                 threshold_ms=ms)
-            dump_postmortem("stall", stalled_ms=round(stalled_ms, 1),
+            emit("stall", site="watchdog", source=source,
+                 stalled_ms=round(stalled_ms, 1), threshold_ms=ms)
+            dump_postmortem("stall", source=source,
+                            stalled_ms=round(stalled_ms, 1),
                             threshold_ms=ms)
             with _wd_lock:
                 listeners = list(_stall_listeners)

@@ -128,6 +128,11 @@ class DegradationLadder:
                         )
             st.fault_this_step = False
 
+    def any_demoted(self) -> bool:
+        """Cheap gate for the perf-regression sentinel: a demoted tier IS
+        slower — that slowdown is resilience working, not a regression."""
+        return any(st.demoted for st in self._states.values())
+
     def state(self) -> Dict[str, Any]:
         """Snapshot for profiler/bench introspection."""
         demoted = sorted(

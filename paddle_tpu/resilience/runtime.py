@@ -176,16 +176,35 @@ def captured_tier_ok(key: Hashable = None) -> bool:
     return _ladder.degradation_ladder().allows("captured", key)
 
 
-def on_step_end():
+def on_step_end(source: str = "train"):
     """Optimizer.step boundary tick: advances the fault-injection step
-    counter, the ladder's cooldown clocks, and the stall watchdog's
-    heartbeat (paddle.profiler.trace / FLAGS_trace_stall_ms)."""
+    counter, the ladder's cooldown clocks, the stall watchdog's heartbeat
+    (paddle.profiler.trace / FLAGS_trace_stall_ms), and — when
+    FLAGS_sentinel_pct > 0 — the perf-regression sentinel's step-time
+    baseline for `source` ('train' from optimizer.step, 'serve[<uid>]'
+    from each serving engine's tick; training steps running under an
+    armed whole-step capture key by its signature so a re-capture
+    re-baselines)."""
     faults.advance_step()
     _ladder.degradation_ladder().step_end()
     try:
-        _disp()._trace_module().step_heartbeat()
+        _disp()._trace_module().step_heartbeat(source)
     except Exception:
         pass  # observability must never break the step boundary
+    try:
+        from ..profiler import sentinel as _sentinel
+
+        if _sentinel.PerfSentinel.enabled():
+            key = source
+            if source == "train":
+                from ..core import lazy as _lazy
+
+                sig = _lazy.step_signature_id()
+                if sig is not None:
+                    key = f"train[{sig}]"
+            _sentinel.default_sentinel().lap(key)
+    except Exception:
+        pass  # the sentinel must never break the step boundary
 
 
 def state() -> dict:

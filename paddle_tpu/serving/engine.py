@@ -256,6 +256,12 @@ class Engine:
         self._degraded_until: Optional[int] = None
         self._restarts = 0
         self._last_restart_error: Optional[str] = None
+        # ops plane (ISSUE 13): the diagnostics server aggregates every
+        # live engine's health into /healthz + /readyz (weakly referenced;
+        # close() unregisters eagerly)
+        from ..profiler import diag as _diag
+
+        _diag.register_engine(self)
 
     # ------------------------------------------------------------------
     # step functions (shared by all three execution tiers)
@@ -483,7 +489,12 @@ class Engine:
         self._end_tick(_rt)
 
     def _end_tick(self, _rt):
-        _rt.on_step_end()
+        # per-ENGINE source/key: a process-global 'serve' would interleave
+        # every engine's tick cadence into one baseline (and one liveness
+        # signal) — closing one engine would halve the other's measured
+        # rate into a false perf_regression, and one engine draining would
+        # erase a still-wedged sibling's stall signal
+        _rt.on_step_end(source=f"serve[{self._uid}]")
         if self._health == "warming":
             self._set_health("ready", "first tick completed")
         elif (self._health == "degraded"
@@ -507,9 +518,18 @@ class Engine:
 
     def run_until_idle(self):
         """Drive the loop until every accepted request has a response."""
+        from ..profiler import trace as _trace
+
         while self._queue or self._active:
             self.step()
         self._audit_drops()
+        # an IDLE request-driven engine looks exactly like a stalled one
+        # to the heartbeat-age liveness read (/healthz) and the stall
+        # watchdog: stand THIS ENGINE's heartbeat down (the Supervisor /
+        # train_step_range discipline) — the next tick re-arms it; the
+        # training loop and any sibling engine are separate sources and
+        # stay armed
+        _trace.watchdog_disarm(f"serve[{self._uid}]")
 
     def _audit_drops(self):
         """The zero-drop tripwire: at idle, every accepted request must
@@ -631,12 +651,29 @@ class Engine:
         latency histogram, and restore any signal handlers. Safe to call
         twice."""
         from ..core.lazy import reset_serve_programs
+        from ..profiler import diag as _diag
         from ..profiler import metrics as _metrics
+        from ..profiler import sentinel as _sentinel
 
         self.uninstall_preemption_handler()
+        _diag.unregister_engine(self)
         reset_serve_programs(owner=self._uid)
         _metrics.default_registry().remove(
             "serve_token_lat_ms", labels={"engine": str(self._uid)})
+        # retire this engine's sentinel baselines: a closed engine's keys
+        # get no further observations, so a tripped one could never clear
+        # and would degrade /healthz for a replica that no longer exists
+        _sentinel.retire(f"serve[{self._uid}]")
+        _sentinel.retire(f"serve_decode[{self._uid}:")
+        _sentinel.retire(f"serve_queue_wait[{self._uid}]")
+        # ... and its heartbeat source: a closed-without-drain engine must
+        # not leave a stale armed source pinning /healthz at 'stalled'
+        try:
+            from ..profiler import trace as _trace
+
+            _trace.watchdog_disarm(f"serve[{self._uid}]")
+        except Exception:
+            pass
         self._admission.close()
         self._health = "dead"  # no transition event from __del__ paths
 
@@ -869,8 +906,11 @@ class Engine:
                 # backpressure: wait for a completion to free blocks
                 self._queue.push_front(req)
                 return
-            self._admission.note_queue_wait(
-                (self._now() - req.submit_time) * 1000.0)
+            wait_ms = (self._now() - req.submit_time) * 1000.0
+            self._admission.note_queue_wait(wait_ms)
+            from ..profiler import sentinel as _sentinel
+
+            _sentinel.observe(f"serve_queue_wait[{self._uid}]", wait_ms)
             seq = Sequence(req, blocks, n_blk)
             try:
                 self._prefill(seq)
@@ -978,6 +1018,11 @@ class Engine:
                        batch=B, blocks=n_blk, ms=round(step_ms, 3))
         self._decode_rows += len(ready)
         self._admission.note_decode(step_ms, len(ready))
+        # per-(decode-signature) regression baseline: one key per captured
+        # bucket program, so only a genuinely slower replay drifts
+        from ..profiler import sentinel as _sentinel
+
+        _sentinel.observe(f"serve_decode[{self._uid}:{B}x{n_blk}]", step_ms)
         now = self._now()
         for i, s in enumerate(ready):
             tok = int(out[i])

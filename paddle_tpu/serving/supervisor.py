@@ -183,8 +183,10 @@ class Supervisor:
             eng._audit_drops()
         finally:
             # an idle serving loop looks exactly like a stalled one to the
-            # watchdog — stand it down (the train_step_range discipline)
-            _trace.watchdog_disarm()
+            # watchdog — stand THIS engine's source down (the
+            # train_step_range discipline); a co-resident training loop or
+            # sibling engine stays armed
+            _trace.watchdog_disarm(f"serve[{eng._uid}]")
 
     def serve(self, requests: Seq, **submit_kw) -> List:
         """Submit every prompt, run supervised to completion, return (and

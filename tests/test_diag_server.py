@@ -1,0 +1,247 @@
+"""ISSUE 13 ops plane: the per-process diagnostics server.
+
+Covers: off-by-default (FLAGS_diag_port = -1), every endpoint against a
+live process (metrics exposition parses, healthz/readyz status codes,
+flight filters, postmortem list/fetch with path-traversal safety,
+statusz render, clockz), the healthz 200→503 flip on a stale step
+heartbeat, engine-health aggregation, and that scrapes are detached
+reads (a scrape storm never errors against concurrent counter churn).
+"""
+import gc
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+from paddle_tpu.profiler import diag, metrics, sentinel, trace
+
+
+def _get(addr, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture()
+def server():
+    addr = diag.start(port=0)
+    assert addr is not None
+    yield addr
+    diag.stop()
+
+
+@pytest.fixture(autouse=True)
+def _diag_isolation():
+    # unclosed engines from OTHER test files linger in diag's weak
+    # registry until their reference cycles are collected — drop them so
+    # the health-aggregation assertions see only this file's engines
+    gc.collect()
+    res.reset()
+    prof.reset_dispatch_counters()
+    trace.clear()
+    sentinel.reset()
+    yield
+    diag.stop()
+    sentinel.reset()
+    paddle.set_flags({
+        "FLAGS_trace_stall_ms": 0.0,
+        "FLAGS_postmortem_dir": "",
+        "FLAGS_sentinel_pct": 0.0,
+    })
+    trace.watchdog_disarm()
+    res.reset()
+
+
+def test_off_by_default_and_idempotent_start_stop():
+    assert paddle.get_flags("FLAGS_diag_port")["FLAGS_diag_port"] == -1
+    assert diag.start() is None  # flag default: off
+    assert not diag.started() and diag.address() is None
+    a1 = diag.start(port=0)
+    a2 = diag.start(port=0)  # idempotent: same server, same address
+    assert a1 == a2 and diag.started()
+    diag.stop()
+    diag.stop()  # idempotent
+    assert not diag.started()
+
+
+def test_metrics_endpoint_serves_exposition(server):
+    _ = paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0
+    st, body = _get(server, "/metrics")
+    assert st == 200
+    parsed = metrics.parse_prometheus_text(body.decode())
+    assert parsed["paddle_programs"] >= 1
+    # scrapes are themselves metered (bench reads the build-cost histogram)
+    st, body = _get(server, "/metrics")
+    parsed = metrics.parse_prometheus_text(body.decode())
+    assert parsed["paddle_diag_scrapes"] >= 1
+    assert parsed["paddle_diag_scrape_ms_count"] >= 1
+
+
+def test_healthz_flips_on_stale_heartbeat(server):
+    paddle.set_flags({"FLAGS_trace_stall_ms": 80.0})
+    trace.step_heartbeat()
+    st, body = _get(server, "/healthz")
+    doc = json.loads(body)
+    assert st == 200 and doc["status"] == "ok"
+    assert doc["heartbeat_age_ms"] is not None
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        st, body = _get(server, "/healthz")
+        if st == 503:
+            break
+        time.sleep(0.02)
+    doc = json.loads(body)
+    assert st == 503 and "stalled" in doc["reasons"]
+    # a fresh heartbeat greens it again within the same period
+    trace.step_heartbeat()
+    st, _ = _get(server, "/healthz")
+    assert st == 200
+    # ... and a DISARMED watchdog (finished loop) is healthy, not stalled
+    trace.watchdog_disarm()
+    st, body = _get(server, "/healthz")
+    assert st == 200 and json.loads(body)["heartbeat_age_ms"] is None
+
+
+def test_healthz_degraded_on_sentinel_trip(server):
+    paddle.set_flags({"FLAGS_sentinel_pct": 25.0,
+                      "FLAGS_sentinel_warmup_steps": 3,
+                      "FLAGS_sentinel_sustain_steps": 2})
+    s = sentinel.default_sentinel()
+    for _ in range(5):
+        s.observe("train", 10.0)
+    for _ in range(4):
+        s.observe("train", 30.0)
+    assert s.tripped() == ["train"]
+    st, body = _get(server, "/healthz")
+    doc = json.loads(body)
+    assert st == 503
+    assert doc["status"] == "degraded"
+    assert doc["reasons"] == ["perf_regression"]
+    assert doc["sentinel_tripped"] == ["train"]
+
+
+def test_flight_endpoint_filters(server):
+    paddle.set_flags({"FLAGS_trace_ring_size": 256})
+    trace.clear()
+    for i in range(6):
+        trace.emit("alpha", site="s1", i=i)
+        trace.emit("beta", site="s2", i=i)
+    st, body = _get(server, "/flight?kind=alpha&last=4")
+    doc = json.loads(body)
+    assert st == 200 and doc["count"] == 4
+    assert all(e["kind"] == "alpha" for e in doc["events"])
+    assert [e["attrs"]["i"] for e in doc["events"]] == [2, 3, 4, 5]
+    st, body = _get(server, "/flight?site=s2")
+    doc = json.loads(body)
+    assert doc["count"] == 6
+
+
+def test_postmortems_list_fetch_and_traversal_safety(server):
+    with tempfile.TemporaryDirectory() as d:
+        paddle.set_flags({"FLAGS_postmortem_dir": d})
+        path = trace.dump_postmortem("probe", extra=1)
+        assert path
+        name = os.path.basename(path)
+        st, body = _get(server, "/postmortems")
+        doc = json.loads(body)
+        assert st == 200 and [p["name"] for p in doc["postmortems"]] == [name]
+        st, body = _get(server, f"/postmortems/{name}")
+        assert st == 200 and json.loads(body)["reason"] == "probe"
+        # never a file server: traversal and non-postmortem names 404
+        st, _ = _get(server, "/postmortems/..%2f..%2fetc%2fpasswd")
+        assert st == 404
+        st, _ = _get(server, "/postmortems/notpostmortem.json")
+        assert st == 404
+        paddle.set_flags({"FLAGS_postmortem_dir": ""})
+
+
+def test_statusz_and_clockz_and_404(server):
+    _ = paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0
+    st, body = _get(server, "/statusz")
+    text = body.decode()
+    assert st == 200
+    for section in ("whole-step capture", "resilience ladder",
+                    "checkpoint cadence", "perf-regression sentinel",
+                    "serving engines", "flight recorder"):
+        assert section in text, section
+    t0 = time.time()
+    st, body = _get(server, "/clockz")
+    t1 = time.time()
+    doc = json.loads(body)
+    assert st == 200 and t0 <= doc["wall"] <= t1 + 1.0
+    st, _ = _get(server, "/bogus")
+    assert st == 404
+    st, _ = _get(server, "/")
+    assert st == 200
+
+
+def test_engine_health_aggregation(server):
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    eng = serving.Engine(m, serving.ServingConfig(
+        block_size=8, prompt_buckets=[8], num_blocks=24))
+    try:
+        # registered at construction; warming engine: alive but NOT ready
+        st, body = _get(server, "/healthz")
+        doc = json.loads(body)
+        assert st == 200 and doc["engines"] == {str(eng._uid): "warming"}
+        st, body = _get(server, "/readyz")
+        doc = json.loads(body)
+        assert st == 503 and "no_serviceable_engine" in doc["reasons"]
+        eng.serve([[1, 2, 3]], max_new_tokens=2)  # first tick → ready
+        st, body = _get(server, "/healthz")
+        assert json.loads(body)["engines"] == {str(eng._uid): "ready"}
+        st, _ = _get(server, "/readyz")
+        assert st == 200
+        # /statusz shows the live engine row
+        _, body = _get(server, "/statusz")
+        assert f"engine {eng._uid}: health=ready" in body.decode()
+    finally:
+        eng.close()
+    # close() unregisters: no stale engines in the health view
+    st, body = _get(server, "/healthz")
+    doc = json.loads(body)
+    assert st == 200 and doc["engines"] == {}
+
+
+def test_scrape_storm_against_counter_churn(server):
+    """Scrapes are detached snapshot reads: a storm of them against a
+    thread writing counters must produce only valid expositions."""
+    from paddle_tpu.core import dispatch
+
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            dispatch._counters["programs"] += 1
+            dispatch._counter_add_labeled("flush_reasons", "storm")
+
+    th = threading.Thread(target=writer, daemon=True)
+    th.start()
+    try:
+        for _ in range(30):
+            st, body = _get(server, "/metrics")
+            assert st == 200
+            metrics.parse_prometheus_text(body.decode())  # parses clean
+    finally:
+        stop.set()
+        th.join(timeout=5)

@@ -1,0 +1,165 @@
+"""ISSUE 13 ops plane: fleet-wide metrics & trace aggregation.
+
+Fast-path coverage over MemoryKv (same lease semantics as the TCP
+master): snapshot publishing under obs/<job>/<node>, host-labeled merged
+exposition (label sets preserved, hostile node names escaped), the fleet
+health table, lease expiry dropping dead hosts (no stale metrics), and
+the merged chrome trace with per-host lanes + clock-offset alignment
+against a live diagnostics server. The real TCP-wire path rides the slow
+chaos fleet probe (tools/chaos_fleet_probe.py sigkill scenario).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+from paddle_tpu.distributed.fleet.obs import (
+    FleetAggregator,
+    MemoryKv,
+    ObsPublisher,
+    obs_key,
+)
+from paddle_tpu.profiler import diag, metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def _fleet_isolation():
+    res.reset()
+    prof.reset_dispatch_counters()
+    trace.clear()
+    yield
+    diag.stop()
+    res.reset()
+
+
+def test_publisher_snapshot_and_key_schema():
+    kv = MemoryKv()
+    pub = ObsPublisher(kv=kv, job_id="j1", node_id="w0", ttl=5.0)
+    assert pub.key() == obs_key("j1", "w0") == "obs/j1/w0"
+    doc = pub.snapshot()
+    assert doc["node"] == "w0"
+    assert "counters" in doc["metrics"]
+    assert doc["health"]["status"] in ("ok", "degraded", "unhealthy")
+    assert pub.publish(raise_errors=True)
+    agg = FleetAggregator(kv=kv, job_id="j1")
+    assert sorted(agg.snapshots()) == ["w0"]
+    # a different job's aggregator sees nothing
+    assert FleetAggregator(kv=kv, job_id="other").snapshots() == {}
+
+
+def test_merged_exposition_host_labels_and_expiry():
+    _ = paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0
+    kv = MemoryKv()
+    ObsPublisher(kv=kv, job_id="j", node_id="w0",
+                 ttl=30.0).publish(raise_errors=True)
+    ObsPublisher(kv=kv, job_id="j", node_id="w1",
+                 ttl=0.2).publish(raise_errors=True)
+    agg = FleetAggregator(kv=kv, job_id="j")
+    text = agg.merged_prometheus_text()
+    # every family carries a host label for every live worker
+    assert 'paddle_programs{host="w0"}' in text
+    assert 'paddle_programs{host="w1"}' in text
+    # existing label sets survive with host PREPENDED (dispatch families)
+    assert "# TYPE paddle_programs counter" in text
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name, _, value = line.rpartition(" ")
+        float(value)  # well-formed exposition
+        assert 'host="' in name
+    # w1's lease expires → dead host drops from the merged view entirely
+    time.sleep(0.3)
+    text2 = agg.merged_prometheus_text()
+    assert 'host="w1"' not in text2 and 'host="w0"' in text2
+    assert sorted(agg.snapshots()) == ["w0"]
+
+
+def test_merged_exposition_escapes_hostile_node_names():
+    kv = MemoryKv()
+    evil = 'w"0\\x'
+    ObsPublisher(kv=kv, job_id="j", node_id=evil,
+                 ttl=30.0).publish(raise_errors=True)
+    text = FleetAggregator(kv=kv, job_id="j").merged_prometheus_text()
+    parsed = metrics.parse_prometheus_text(text)
+    assert parsed  # parses clean despite the hostile label value
+    esc = metrics.escape_label_value(evil)
+    assert f'host="{esc}"' in text
+
+
+def test_fleet_health_table():
+    kv = MemoryKv()
+    ObsPublisher(kv=kv, job_id="j", node_id="w0",
+                 ttl=30.0).publish(raise_errors=True)
+    rows = FleetAggregator(kv=kv, job_id="j").fleet_health()
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["node"] == "w0"
+    assert row["status"] in ("ok", "degraded", "unhealthy")
+    assert row["age_s"] >= 0 and isinstance(row["engines"], dict)
+
+
+def test_publish_fails_soft_on_master_outage():
+    class DeadKv:
+        def kv_lease(self, *a):
+            raise ConnectionError("partition")
+
+        def kv_del(self, *a):
+            raise ConnectionError("partition")
+
+    pub = ObsPublisher(kv=DeadKv(), job_id="j", node_id="w0")
+    assert pub.publish() is False  # soft: the worker trains on
+    assert pub.failures == 1
+    with pytest.raises(ConnectionError):
+        pub.publish(raise_errors=True)
+    pub.withdraw()  # also soft
+
+
+def test_merged_chrome_trace_per_host_lanes_and_clock_alignment():
+    _ = paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0
+    trace.emit("probe", site="fleet", i=1)
+    addr = diag.start(port=0)
+    kv = MemoryKv()
+    # two logical nodes; only w0 carries a reachable diag server
+    ObsPublisher(kv=kv, job_id="j", node_id="w0", ttl=30.0,
+                 diag_addr=addr).publish(raise_errors=True)
+    pub_dark = ObsPublisher(kv=kv, job_id="j", node_id="w1", ttl=30.0)
+    doc_dark = pub_dark.snapshot()
+    doc_dark["diag"] = None
+    kv.kv_lease(pub_dark.key(), __import__("json").dumps(doc_dark), 30.0)
+    agg = FleetAggregator(kv=kv, job_id="j")
+    off = agg.clock_offset_s(addr)
+    assert abs(off) < 1.0  # same host, same clock: near-zero offset
+    doc = agg.merged_chrome_trace(last=128)
+    lanes = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+             if e.get("ph") == "M"}
+    assert set(lanes) == {"host:w0", "host:w1"}  # one process lane each
+    assert len(set(lanes.values())) == 2
+    fleet_evs = [e for e in doc["traceEvents"] if e.get("cat") == "fleet"]
+    assert fleet_evs and all(e["args"]["node"] == "w0" for e in fleet_evs)
+    assert any(e["name"] == "probe:fleet" for e in fleet_evs)
+    # aligned into the aggregator's wall clock: recent, ordered, finite
+    now_us = time.time() * 1e6
+    for e in fleet_evs:
+        assert 0 < e["ts"] <= now_us + 5e6
+    assert doc["metadata"]["hosts_pulled"] == ["w0"]
+    assert doc["metadata"]["hosts_unreachable"] == ["w1"]
+    # kind filter pushes down to each host's /flight query
+    filtered = agg.merged_chrome_trace(kind="probe")
+    kinds = {e["name"] for e in filtered["traceEvents"]
+             if e.get("cat") == "fleet"}
+    assert kinds == {"probe:fleet"}
+
+
+def test_from_elastic_reuses_manager_identity():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    mgr = ElasticManager(lambda: None, job_id="jx", master="127.0.0.1:1",
+                         heartbeat_ttl=7.5)
+    pub = ObsPublisher.from_elastic(mgr, diag_addr="127.0.0.1:99")
+    assert pub.job_id == "jx"
+    assert pub.node_id == mgr._node_id
+    assert pub.ttl == 7.5
+    assert pub.key() == f"obs/jx/{mgr._node_id}"

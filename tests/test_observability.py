@@ -266,6 +266,114 @@ def test_prometheus_text_round_trip():
     assert "# TYPE paddle_requests counter" in text
 
 
+def test_prometheus_label_escaping_round_trip():
+    """Hostile label values (backslash, double-quote, newline — exactly
+    what an error-string or request-id label carries) must neither corrupt
+    the exposition nor break the parse round-trip (exposition format
+    v0.0.4 escaping)."""
+    reg = metrics.MetricsRegistry()
+    hostile = {
+        "err": 'Bad "quote" \\ backslash\nand a newline',
+        "path": "C:\\tmp\\x",
+    }
+    reg.counter("errors", labels=hostile).inc(3)
+    reg.gauge("plain").set(1)
+    text = reg.prometheus_text(include_dispatch=False)
+    # escaped single-line samples: no raw newline inside any sample line,
+    # every non-comment line still parses as "<name{labels}> <value>"
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name, _, value = line.rpartition(" ")
+        float(value)  # would raise on a split/corrupted line
+    assert '\\"quote\\"' in text and "\\\\ backslash" in text
+    assert "\\n" in text and "backslash\nand" not in text
+    parsed = metrics.parse_prometheus_text(text)
+    snap = reg.snapshot(include_dispatch=False)
+    (full_name,) = snap["counters"]
+    assert parsed["paddle_" + full_name] == 3
+    # the escaping is reversible
+    assert metrics.unescape_label_value(
+        metrics.escape_label_value(hostile["err"])) == hostile["err"]
+
+
+def test_trace_events_kind_site_filters():
+    paddle.set_flags({"FLAGS_trace_ring_size": 256})
+    trace.clear()
+    for i in range(10):
+        trace.emit("alpha", site="s1", i=i)
+        trace.emit("alpha", site="s2", i=i)
+        trace.emit("beta", site="s1", i=i)
+    assert len(trace.events(kind="alpha")) == 20
+    assert len(trace.events(kind="beta")) == 10
+    assert len(trace.events(site="s1")) == 20
+    assert len(trace.events(kind="alpha", site="s2")) == 10
+    assert trace.events(kind="nope") == []
+    # `last` applies AFTER the filter (trailing N matching), oldest first
+    tail = trace.events(kind="alpha", site="s1", last=3)
+    assert [e.attrs["i"] for e in tail] == [7, 8, 9]
+    ts = [e.ts for e in trace.events(kind="alpha")]
+    assert ts == sorted(ts)
+
+
+def test_concurrent_scrape_vs_reset_exposition():
+    """Satellite of ISSUE 13: snapshot()/prometheus_text() hammered from a
+    scraper thread while an off-thread writer bumps counters (incl. nested
+    families) and reset_dispatch_counters() fires must never raise and
+    never emit a torn/partial exposition."""
+    import threading
+
+    from paddle_tpu.core import dispatch
+
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        # the LEGITIMATE off-thread writer paths (the async-compile worker
+        # and persist threads use these); an early writer death would
+        # silently hollow the stress out, so its errors are recorded too
+        try:
+            i = 0
+            while not stop.is_set():
+                dispatch._counter_add("async_compile_ms", 0.5)
+                dispatch._counter_add_labeled("flush_reasons", f"r{i % 7}")
+                dispatch._counter_add("programs", 1)
+                i += 1
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    def resetter():
+        try:
+            while not stop.is_set():
+                prof.reset_dispatch_counters()
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                snap = metrics.snapshot(include_dispatch=True)
+                assert "programs" in snap["counters"]
+                text = metrics.prometheus_text(include_dispatch=True)
+                parsed = metrics.parse_prometheus_text(text)
+                # a torn family would show up as an unparseable line
+                # (parse floats every value) or a missing core counter
+                assert "paddle_programs" in parsed
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=f)
+               for f in (writer, resetter, scraper, scraper)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # plenty of interleavings; the race is per-call
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors[:1]
+
+
 def test_dispatch_counters_adopted_by_registry():
     prof.reset_dispatch_counters()
     _ = paddle.to_tensor(np.ones((2, 2), np.float32)) + 1.0
@@ -533,6 +641,31 @@ def test_stall_watchdog_trips_once_per_episode():
         assert len([f for f in os.listdir(d) if "stall" in f]) == 1
         paddle.set_flags({"FLAGS_trace_stall_ms": 0.0,
                           "FLAGS_postmortem_dir": ""})
+
+
+def test_heartbeat_sources_disarm_independently():
+    # train and serve heartbeats are separate sources: an idle serving
+    # engine standing down (Engine.run_until_idle / Supervisor) must not
+    # erase the training loop's liveness signal in a combined process
+    trace.watchdog_disarm()
+    trace.step_heartbeat("train")
+    trace.step_heartbeat("serve")
+    assert trace.heartbeat_age_ms("train") is not None
+    assert trace.heartbeat_age_ms("serve") is not None
+    trace.watchdog_disarm("serve")
+    assert trace.heartbeat_age_ms("serve") is None
+    assert trace.heartbeat_age_ms("train") is not None
+    assert trace.heartbeat_age_ms() is not None  # /healthz still sees train
+    # the source-less age is the STALEST armed source (any wedged loop
+    # must flip /healthz, not just the most recently beating one)
+    time.sleep(0.02)
+    trace.step_heartbeat("serve")
+    assert (trace.heartbeat_age_ms()
+            >= trace.heartbeat_age_ms("serve"))
+    assert trace.heartbeat_age_ms() == pytest.approx(
+        trace.heartbeat_age_ms("train"), rel=0.5)
+    trace.watchdog_disarm()  # argless: every source stands down
+    assert trace.heartbeat_age_ms() is None
 
 
 # ---------------------------------------------------------------------------

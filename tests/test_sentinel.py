@@ -1,0 +1,318 @@
+"""ISSUE 13 ops plane: the perf-regression sentinel.
+
+Covers: off-by-default, warmup/arming, sustained-drift trip (exactly one
+— hysteresis holds while slow, clears + re-baselines on recovery),
+speedups never trip (signed drift), suppression (ladder demotion /
+in-flight checkpoint persist / on-path snapshot), the trip's side
+effects (labeled counter family, perf_regression flight event,
+postmortem), per-signature lap keys, and the serving decode feed.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+import paddle_tpu.resilience as res
+from paddle_tpu.profiler import sentinel, trace
+
+
+@pytest.fixture(autouse=True)
+def _sentinel_isolation():
+    res.reset()
+    prof.reset_dispatch_counters()
+    trace.clear()
+    sentinel.reset()
+    paddle.set_flags({"FLAGS_sentinel_pct": 25.0,
+                      "FLAGS_sentinel_warmup_steps": 4,
+                      "FLAGS_sentinel_sustain_steps": 3})
+    yield
+    paddle.set_flags({"FLAGS_sentinel_pct": 0.0,
+                      "FLAGS_sentinel_warmup_steps": 10,
+                      "FLAGS_sentinel_sustain_steps": 3,
+                      "FLAGS_postmortem_dir": ""})
+    sentinel.reset()
+    res.reset()
+
+
+def _steady(s, key="train", ms=10.0, n=8):
+    for _ in range(n):
+        s.observe(key, ms)
+
+
+def test_disabled_by_default_is_inert():
+    paddle.set_flags({"FLAGS_sentinel_pct": 0.0})
+    s = sentinel.PerfSentinel()
+    for _ in range(100):
+        s.observe("train", 1.0)
+        s.lap("train")
+    assert s.state()["keys"] == {} and not s.tripped()
+
+
+def test_warmup_arms_baseline_then_trips_once_with_hysteresis():
+    s = sentinel.PerfSentinel()
+    _steady(s, n=3)
+    assert not s.state()["keys"]["train"]["armed"]  # still warming
+    _steady(s, n=3)
+    st = s.state()["keys"]["train"]
+    assert st["armed"] and st["baseline_ms"] == pytest.approx(10.0)
+    # sustained 2x slowdown: exactly ONE trip no matter how long it lasts
+    for _ in range(30):
+        s.observe("train", 20.0)
+    assert s.tripped() == ["train"]
+    c = prof.dispatch_counters()
+    assert c["perf_regressions"] == 1
+    assert dict(c["perf_regression_sites"]) == {"train": 1}
+    # recovery: drops under half the threshold for `sustain` obs → clears
+    # and RE-BASELINES to the new steady state
+    for _ in range(30):
+        s.observe("train", 10.0)
+        if not s.tripped():
+            break
+    assert not s.tripped()
+    assert prof.dispatch_counters()["perf_regression_clears"] == 1
+    st = s.state()["keys"]["train"]
+    assert st["baseline_ms"] < 20.0  # re-marked near the recovered EMA
+    phases = [e.attrs["phase"] for e in trace.events(kind="perf_regression")]
+    assert phases == ["trip", "clear"]
+
+
+def test_single_breach_never_trips():
+    s = sentinel.PerfSentinel()
+    _steady(s)
+    s.observe("train", 50.0)  # one spike < sustain_steps
+    _steady(s, n=2)
+    assert not s.tripped()
+    assert prof.dispatch_counters()["perf_regressions"] == 0
+
+
+def test_speedup_never_trips():
+    s = sentinel.PerfSentinel()
+    _steady(s)
+    for _ in range(20):
+        s.observe("train", 1.0)  # 10x FASTER — drift is signed
+    assert not s.tripped()
+
+
+def test_ladder_demotion_suppresses_breaches():
+    from paddle_tpu.resilience import ladder as _ladder
+
+    paddle.set_flags({"FLAGS_ladder_demote_after": 1})
+    s = sentinel.PerfSentinel()
+    _steady(s)
+    _ladder.degradation_ladder().record_fault("captured", key="k")
+    assert _ladder.degradation_ladder().any_demoted()
+    for _ in range(20):
+        s.observe("train", 40.0)  # 4x slower — but legitimately demoted
+    assert not s.tripped()
+    st = s.state()["keys"]["train"]
+    assert st["suppressed"] >= 20
+    assert st["last_suppressed"] == "ladder_demoted"
+    paddle.set_flags({"FLAGS_ladder_demote_after": 2})
+    res.reset()
+    # ladder healthy again: breaches count and the trip lands
+    for _ in range(5):
+        s.observe("train", 40.0)
+    assert s.tripped() == ["train"]
+
+
+def test_checkpoint_persist_suppresses_breaches():
+    from paddle_tpu.distributed import checkpoint as ck
+
+    s = sentinel.PerfSentinel()
+    _steady(s)
+    ck._persists_active += 1
+    try:
+        for _ in range(10):
+            s.observe("train", 40.0)
+        assert not s.tripped()
+        assert (s.state()["keys"]["train"]["last_suppressed"]
+                == "checkpoint_in_flight")
+    finally:
+        ck._persists_active -= 1
+
+
+def test_on_path_snapshot_suppresses_one_interval():
+    from paddle_tpu.core import dispatch
+
+    s = sentinel.PerfSentinel()
+    _steady(s)
+    dispatch._counters["ckpt_snapshots"] += 1  # a save landed this step
+    s.observe("train", 40.0)
+    assert s.state()["keys"]["train"]["last_suppressed"] \
+        == "checkpoint_snapshot"
+    assert s.state()["keys"]["train"]["breach_streak"] == 0
+
+
+def test_trip_dumps_postmortem_with_event_tail():
+    with tempfile.TemporaryDirectory() as d:
+        paddle.set_flags({"FLAGS_postmortem_dir": d})
+        s = sentinel.PerfSentinel()
+        _steady(s)
+        for _ in range(4):
+            s.observe("train", 30.0)
+        assert s.tripped()
+        pms = [f for f in os.listdir(d)
+               if f.startswith("postmortem_perf_regression")]
+        assert len(pms) == 1
+        doc = json.load(open(os.path.join(d, pms[0])))
+        assert doc["reason"] == "perf_regression"
+        assert doc["attrs"]["site"] == "train"
+        assert doc["attrs"]["drift_pct"] > 25.0
+        assert doc["attrs"]["baseline_ms"] == pytest.approx(10.0)
+        # metrics snapshot rode along with the labeled family adopted
+        assert doc["metrics"]["counters"][
+            'perf_regression_sites{site="train"}'] == 1
+
+
+def test_lap_keys_do_not_cross_signatures():
+    """Consecutive laps of DIFFERENT keys must not synthesize an interval
+    from the stale clock — a signature switch is a fresh baseline, not a
+    wall-time spike."""
+    import time as _time
+
+    s = sentinel.PerfSentinel()
+    s.lap("a")
+    _time.sleep(0.01)
+    s.lap("b")  # switch: must NOT observe 10ms on "b"
+    assert s.state()["keys"]["b"]["seen"] == 0
+    s.lap("b")
+    assert s.state()["keys"]["b"]["seen"] == 1
+
+
+def test_concurrent_loops_both_arm():
+    """A training thread and a serving thread lap DIFFERENT keys
+    concurrently; per-thread lap tracking must let both baselines arm
+    (one global last-key would see the alternation and starve both)."""
+    import threading
+
+    s = sentinel.PerfSentinel()
+
+    def loop(key):
+        for _ in range(8):
+            s.lap(key)
+
+    threads = [threading.Thread(target=loop, args=("train",)),
+               threading.Thread(target=loop, args=("serve",))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    keys = s.state()["keys"]
+    assert keys["train"]["seen"] == 7 and keys["serve"]["seen"] == 7
+    assert keys["train"]["armed"] and keys["serve"]["armed"]
+
+
+def test_training_loop_feeds_train_key():
+    paddle.set_flags({"FLAGS_sentinel_warmup_steps": 2})
+    w = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32),
+                         stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for _ in range(5):
+        loss = (x @ w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    keys = sentinel.state()["keys"]
+    assert any(k.startswith("train") for k in keys), keys
+    assert not sentinel.tripped()
+
+
+def test_serving_decode_and_queue_wait_keys():
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    eng = serving.Engine(m, serving.ServingConfig(
+        block_size=8, prompt_buckets=[8], num_blocks=24))
+    try:
+        eng.serve([[1, 2, 3], [4, 5]], max_new_tokens=4)
+        keys = sentinel.state()["keys"]
+        assert any(k.startswith(f"serve_decode[{eng._uid}:")
+                   for k in keys), keys
+        assert any(k == f"serve_queue_wait[{eng._uid}]" for k in keys), keys
+        assert not sentinel.tripped()
+    finally:
+        eng.close()
+    # close() retires the engine's baselines: a dead replica's keys get no
+    # further observations, so a latched trip could never clear, and key
+    # state would grow with engine churn
+    keys = sentinel.state()["keys"]
+    assert not any(str(eng._uid) in k for k in keys), keys
+
+
+def test_sibling_engines_have_independent_sources():
+    # serve sources/keys are per ENGINE: one engine draining (or closing)
+    # must not erase a sibling's liveness signal or sentinel baseline —
+    # a process-global 'serve' key would interleave both cadences and a
+    # close would halve the survivor's rate into a false trip
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    scfg = serving.ServingConfig(block_size=8, prompt_buckets=[8],
+                                 num_blocks=24)
+    m1, m2 = GPTForPretraining(cfg), GPTForPretraining(cfg)
+    m1.eval(); m2.eval()
+    e1, e2 = serving.Engine(m1, scfg), serving.Engine(m2, scfg)
+    try:
+        e2.submit([1, 2, 3], max_new_tokens=2)
+        e2.step()  # arms serve[e2] without draining
+        e1.serve([[1, 2]], max_new_tokens=2)  # run_until_idle disarms e1
+        assert trace.heartbeat_age_ms(f"serve[{e1._uid}]") is None
+        assert trace.heartbeat_age_ms(f"serve[{e2._uid}]") is not None
+        keys = sentinel.state()["keys"]
+        assert f"serve[{e1._uid}]" in keys and f"serve[{e2._uid}]" in keys
+        e1.close()  # retires e1's keys and source only
+        assert trace.heartbeat_age_ms(f"serve[{e2._uid}]") is not None
+        keys = sentinel.state()["keys"]
+        assert f"serve[{e1._uid}]" not in keys
+        assert f"serve[{e2._uid}]" in keys
+        e2.run_until_idle()
+    finally:
+        e1.close(); e2.close()
+
+
+def test_retire_unlatches_and_reports_clear():
+    s = sentinel.PerfSentinel()
+    _steady(s, key="serve_decode[9:2x8]", ms=10.0)
+    for _ in range(10):
+        s.observe("serve_decode[9:2x8]", 30.0)
+    assert s.tripped() == ["serve_decode[9:2x8]"]
+    s.retire("serve_decode[9:")
+    assert not s.tripped() and s.state()["keys"] == {}
+    # the way out is a CLEAR, not silence: /healthz consumers and the
+    # trip/clear counters must balance
+    assert prof.dispatch_counters()["perf_regression_clears"] == 1
+    phases = [e.attrs["phase"] for e in trace.events(kind="perf_regression")]
+    assert phases == ["trip", "clear"]
+
+
+def test_lap_key_switch_unlatches_orphaned_trip():
+    # a capture re-arm moves the training thread from train[old] to
+    # train[new]; the old key gets no further observations, so a tripped
+    # latch would hold /healthz at 503 forever — the switch must unlatch
+    s = sentinel.PerfSentinel()
+    s.lap("train[1]")
+    _steady(s, key="train[1]", ms=10.0)
+    for _ in range(10):
+        s.observe("train[1]", 30.0)
+    assert s.tripped() == ["train[1]"]
+    s.lap("train[2]")
+    assert not s.tripped()
+    # baseline survives the unlatch: consecutive laps may resume later
+    assert s.state()["keys"]["train[1]"]["baseline_ms"] is not None
+    phases = [e.attrs["phase"] for e in trace.events(kind="perf_regression")]
+    assert phases == ["trip", "clear"]

@@ -87,6 +87,19 @@ def worker_main(args):
         heartbeat_ttl=args.ttl,
     )
     mgr.register()
+
+    # ops plane (ISSUE 13): every worker runs an ephemeral diagnostics
+    # server and publishes health/metrics/diag-address snapshots under
+    # obs/<job>/<node> on the heartbeat cadence — the supervisor's
+    # FleetAggregator gates the merged view (host labels, per-host trace
+    # lanes, dead-host lease expiry)
+    from paddle_tpu.distributed.fleet.obs import ObsPublisher
+    from paddle_tpu.profiler import diag
+
+    diag_addr = diag.start(port=0)
+    log(f"diag {diag_addr}")
+    obs_pub = ObsPublisher.from_elastic(mgr, diag_addr=diag_addr)
+    obs_pub.publish()  # soft-fail, like heartbeats
     if args.barrier:
         t0 = time.time()
         while time.time() - t0 < 30:
@@ -131,6 +144,7 @@ def worker_main(args):
         lv = float(loss)
         time.sleep(STEP_SLEEP)
         mgr.heartbeat()
+        obs_pub.publish()
         if args.stall_at is not None and step == args.stall_at:
             # wedged host: no heartbeats for > TTL (lease must expire)
             log(f"stall {step}")
@@ -140,6 +154,7 @@ def worker_main(args):
     np.savez(os.path.join(wdir, "final.npz"),
              **{k: np.asarray(v._value) for k, v in state.items()})
     log("final")
+    obs_pub.withdraw()
     mgr.deregister()
     return 0
 
@@ -257,15 +272,65 @@ def _baseline(root, master, np_, steps):
     return [_load_final(d) for d in dirs]
 
 
+def _obs_aggregator(master):
+    from paddle_tpu.distributed.fleet.obs import FleetAggregator
+
+    return FleetAggregator(master=master, job_id=JOB_ID)
+
+
+def _obs_gate_all_live(agg, np_):
+    """Merged exposition carries a host label for EVERY live worker, and
+    the merged chrome trace has one process lane per host with events
+    actually pulled over each worker's ephemeral diag server."""
+    try:
+        text = agg.merged_prometheus_text()
+        hosts_ok = all(f'host="w{i}"' in text for i in range(np_))
+        fams_ok = all(f'paddle_programs{{host="w{i}"}}' in text
+                      for i in range(np_))
+        doc = agg.merged_chrome_trace(last=256)
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"}
+        lanes_ok = all(f"host:w{i}" in lanes for i in range(np_))
+        pulled_ok = len(doc["metadata"]["hosts_pulled"]) >= np_
+        events_ok = sum(1 for e in doc["traceEvents"]
+                        if e.get("cat") == "fleet") > 0
+        return (hosts_ok and fams_ok and lanes_ok and pulled_ok
+                and events_ok)
+    except Exception:
+        return False
+
+
+def _obs_gate_host_dropped(agg, victim, ttl, timeout=15.0):
+    """After a SIGKILL, the dead host's obs lease must EXPIRE out of the
+    merged view (no coordinator, no stale metrics) within a few TTLs."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            if f"w{victim}" not in agg.snapshots():
+                return f'host="w{victim}"' not in agg.merged_prometheus_text()
+        except Exception:
+            pass
+        time.sleep(ttl / 4)
+    return False
+
+
 def scenario_sigkill(root, master, np_, steps, baseline, results):
     ttl = 1.5
     dirs = [os.path.join(root, "sigkill", f"w{i}") for i in range(np_)]
     procs = [_spawn(i, master, dirs[i], steps, np_, ttl) for i in range(np_)]
     victim = np_ - 1
+    obs_live = obs_dropped = False
     try:
         _wait_done_at_least(dirs[victim], steps // 3)
+        agg = _obs_aggregator(master)
+        for _ in range(3):  # all workers have published by now; retry the
+            obs_live = _obs_gate_all_live(agg, np_)  # rare torn read only
+            if obs_live:
+                break
+            time.sleep(0.1)
         procs[victim].send_signal(signal.SIGKILL)  # host dies mid-step
         procs[victim].wait()
+        obs_dropped = _obs_gate_host_dropped(agg, victim, ttl)
         # elastic semantics: the supervisor relaunches the dead host; the
         # relaunch resumes from its own checkpoint (no barrier — survivors
         # may already be done)
@@ -280,10 +345,13 @@ def scenario_sigkill(root, master, np_, steps, baseline, results):
     lost = _steps_lost(_log_lines(dirs[victim]))
     bitwise = all(_finals_bitwise_equal(f, b)
                   for f, b in zip(finals, baseline))
-    ok = all(rc == 0 for rc in rcs) and lost <= 1 and bitwise
+    ok = (all(rc == 0 for rc in rcs) and lost <= 1 and bitwise
+          and obs_live and obs_dropped)
     results.append({
         "scenario": "sigkill", "ok": ok, "rcs": rcs,
         "steps_lost": lost, "bitwise_identical": bitwise,
+        "obs_all_hosts_in_merged_view": obs_live,
+        "obs_dead_host_dropped": obs_dropped,
     })
     return ok
 

@@ -1,0 +1,107 @@
+"""fleet_top — the fleet-wide observability CLI (ISSUE 13 ops plane).
+
+Point it at the elastic TCP lease/KV master any fleet job already runs
+(``distributed/fleet/elastic.py start_master``; workers publish snapshots
+under ``obs/<job>/<node>`` via ``ObsPublisher``) and get one merged view:
+
+  default         one health row per live worker (node, status, step,
+                  snapshot age, diag address, engine healths)
+  --metrics       one merged Prometheus exposition, every family labeled
+                  host="<node>" — pipe to a file and point promtool at it
+  --trace OUT     one merged chrome trace with a process lane per host
+                  (clock-offset-aligned flight rings pulled over each
+                  worker's diagnostics server) — load in Perfetto
+  --watch SECS    re-render the health table on an interval (top(1) mode)
+
+Usage:
+    python tools/fleet_top.py --master 127.0.0.1:4217 [--job default]
+        [--metrics] [--trace fleet_trace.json] [--watch 2]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _render_health(rows) -> str:
+    if not rows:
+        return "(no live obs/<job>/* leases — is the fleet publishing?)"
+    cols = ["node", "status", "step", "age_s", "pid", "diag", "reasons",
+            "engines"]
+    table = [cols]
+    for r in rows:
+        table.append([
+            str(r["node"]), str(r["status"]), str(r["step"]),
+            str(r["age_s"]), str(r["pid"]), str(r["diag"]),
+            ",".join(r["reasons"]) or "-",
+            ",".join(f"{k}:{v}" for k, v in sorted(r["engines"].items()))
+            or "-",
+        ])
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = []
+    for j, row in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--master", required=True,
+                    help="host:port of the elastic TCP lease/KV master")
+    ap.add_argument("--job", default="default", help="fleet job id")
+    ap.add_argument("--metrics", action="store_true",
+                    help="print the merged Prometheus exposition and exit")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="write the merged chrome trace JSON to OUT")
+    ap.add_argument("--flight-kind", default=None,
+                    help="filter the merged trace to one event kind")
+    ap.add_argument("--last", type=int, default=None,
+                    help="trailing events per host in the merged trace")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECS",
+                    help="re-render the health table every SECS seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the health table as JSON lines")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.distributed.fleet.obs import FleetAggregator
+
+    agg = FleetAggregator(master=args.master, job_id=args.job)
+
+    if args.metrics:
+        sys.stdout.write(agg.merged_prometheus_text())
+        return 0
+    if args.trace:
+        doc = agg.merged_chrome_trace(kind=args.flight_kind, last=args.last)
+        with open(args.trace, "w") as f:
+            json.dump(doc, f)
+        meta = doc["metadata"]
+        print(f"wrote {args.trace}: "
+              f"{len(doc['traceEvents'])} events, "
+              f"hosts={meta['hosts']}, pulled={meta['hosts_pulled']}, "
+              f"unreachable={meta['hosts_unreachable']}")
+        return 0
+
+    while True:
+        rows = agg.fleet_health()
+        if args.json:
+            for r in rows:
+                print(json.dumps(r))
+        else:
+            print(f"fleet_top  job={args.job}  master={args.master}  "
+                  f"{time.strftime('%H:%M:%S')}  live={len(rows)}")
+            print(_render_health(rows))
+        if args.watch is None:
+            return 0
+        time.sleep(args.watch)
+        print()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

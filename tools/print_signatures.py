@@ -46,6 +46,9 @@ SUBMODULES = [
     "profiler",
     "profiler.metrics",
     "profiler.trace",
+    "profiler.diag",
+    "profiler.sentinel",
+    "distributed.fleet.obs",
     "resilience",
     "quantization",
     "incubate",
