@@ -767,6 +767,127 @@ def _checkpoint_block(steps=120, bsz=16):
     }
 
 
+def _elastic_block(train_steps=24):
+    """Elastic-rescale probe for the BENCH_* trajectory (ISSUE 14):
+    in-place rescale downtime (lease death -> survivors' new WorldView
+    installed, the epoch-bump + barrier cost), grow rebind latency, the
+    steps/s cost of accumulation compensation (the same global batch run
+    at world-2 share vs the doubled post-shrink factor), and straggler
+    detection latency (slowdown start -> fleet-median detector trip).
+    All in-process over the MemoryKv lease double — the real TCP wire +
+    bitwise guarantees are gated by chaos_fleet_probe --scenario elastic."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.elastic import (
+        RescaleCoordinator,
+        deterministic_tree_sum,
+    )
+    from paddle_tpu.distributed.fleet.obs import (
+        MemoryKv,
+        ObsPublisher,
+        StragglerDetector,
+    )
+    from paddle_tpu.io import GlobalStepSampler
+
+    out = {}
+    kv = MemoryKv()
+    mk = lambda n: RescaleCoordinator(
+        kv=kv, job_id="bench", node_id=n, np_min=1, np_max=4,
+        poll_interval=0.002, barrier_timeout_s=10.0, debounce=1)
+    a, b = mk("A"), mk("B")
+    a.register(), b.register()
+    got = {}
+    t = threading.Thread(target=lambda: got.update(v=a.form(expected=2)))
+    t.start()
+    b.form(expected=2)
+    t.join()
+
+    # shrink downtime: lease death -> survivor's installed WorldView
+    t0 = time.perf_counter()
+    kv.kv_del("elastic/bench/B")
+    ev = None
+    while ev is None:
+        ev = a.poll()
+    out["rescale_downtime_ms"] = round(
+        (time.perf_counter() - t0) * 1000.0, 3)
+
+    # grow rebind: join proposal -> survivor installs the grown world
+    b2 = mk("B")
+    t0 = time.perf_counter()
+    t = threading.Thread(target=lambda: b2.join(timeout=10))
+    t.start()
+    ev = None
+    while ev is None:
+        ev = a.poll()
+    t.join()
+    out["grow_rebind_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+
+    # accumulation compensation: steps/s at the world-2 share (k=2
+    # microbatches/step) vs the post-shrink doubled factor (k=4) — the
+    # honest cost of holding global batch constant with half the fleet
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 8)
+    params = list(net.parameters())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=params)
+    X = np.random.default_rng(0).standard_normal((256, 16)).astype(np.float32)
+    sampler = GlobalStepSampler(256, 32, microbatch_size=8, seed=1,
+                                rank=0, world=2)
+
+    def run(world, steps):
+        sampler.set_world(0, world)
+        t0 = time.perf_counter()
+        for s in range(steps):
+            mbg = []
+            for ids in sampler.microbatches(s):
+                opt.clear_grad()
+                loss = (net(paddle.to_tensor(X[ids])) ** 2).mean()
+                loss.backward()
+                mbg.append([np.asarray(p.grad.numpy()) for p in params])
+            total = [deterministic_tree_sum([g[i] for g in mbg])
+                     for i in range(len(params))]
+            for p, g in zip(params, total):
+                p.grad = paddle.to_tensor(
+                    g / np.float32(sampler.num_microbatches))
+            opt.step()
+            opt.clear_grad()
+        return steps / (time.perf_counter() - t0)
+
+    run(2, 4)  # warm the jit caches
+    out["steps_per_s_world2_share"] = round(run(2, train_steps), 2)
+    out["steps_per_s_post_shrink"] = round(run(1, train_steps), 2)
+
+    # straggler detection latency: slowdown start -> detector trip
+    pf = ObsPublisher(kv=kv, job_id="bench", node_id="F")
+    ps = ObsPublisher(kv=kv, job_id="bench", node_id="S")
+    for i in range(6):
+        pf.note_step(i, 10.0), ps.note_step(i, 10.0)
+        pf.publish(), ps.publish()
+    det = StragglerDetector(ps, pct=50.0, sustain=3, evict=False)
+    t0 = time.perf_counter()
+    checks = 0
+    trip = None
+    while trip is None and checks < 50:
+        ps.note_step(6 + checks, 100.0)  # the sustained slowdown
+        pf.note_step(6 + checks, 10.0)
+        ps.publish(), pf.publish()
+        trip = det.check()
+        checks += 1
+    out["straggler_detection_ms"] = round(
+        (time.perf_counter() - t0) * 1000.0, 3)
+    out["straggler_detection_checks"] = checks
+    out["straggler_tripped"] = trip is not None
+    try:
+        from paddle_tpu.profiler import sentinel as _sent
+
+        _sent.clear_external("straggler[S]")
+    except Exception:
+        pass
+    return out
+
+
 def _observability_block(steps=6, bsz=8):
     """Observability probe for the BENCH_* trajectory (ISSUE 9 + 13):
     tracing-on overhead of the flight recorder at its default ring size
@@ -969,6 +1090,14 @@ def main():
             result["observability"] = _observability_block()
         except Exception as e:
             print(f"# observability block FAILED: {_tb_tail(e)}",
+                  file=sys.stderr)
+    # elastic-rescale trajectory block (rescale downtime, steps/s before/
+    # after shrink, straggler detection latency) — BENCH_ELASTIC=0 skips it
+    if os.environ.get("BENCH_ELASTIC", "1") == "1":
+        try:
+            result["elastic"] = _elastic_block()
+        except Exception as e:
+            print(f"# elastic block FAILED: {_tb_tail(e)}",
                   file=sys.stderr)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)

@@ -408,6 +408,49 @@ define_flag(
     "one-step blips never page",
 )
 # ---------------------------------------------------------------------------
+# Elastic rescale (distributed.fleet.elastic RescaleCoordinator — see
+# RESILIENCE.md "Elastic rescale")
+# ---------------------------------------------------------------------------
+define_flag(
+    "elastic_barrier_timeout_s", 20.0,
+    "deadline for the membership-epoch barrier (RescaleCoordinator): on a "
+    "lease expiry or a new node's register, survivors propose a bumped "
+    "epoch and barrier on it; a barrier that cannot complete within this "
+    "many seconds (partitioned master, peers wedged) raises "
+    "RescaleFallback so the caller escalates to the whole-pod restart "
+    "path instead of hanging",
+)
+define_flag(
+    "elastic_rescale_debounce", 2,
+    "consecutive membership polls that must observe the SAME changed "
+    "member set before a survivor proposes an epoch bump — one flapping "
+    "heartbeat (a lease expiring a poll before its refresh lands) must "
+    "not tear the fleet through a barrier",
+)
+define_flag(
+    "elastic_straggler_pct", 0.0,
+    "fleet straggler threshold: when > 0, each worker compares its own "
+    "published step time against the fleet median (per-worker "
+    "step-progress heartbeats ride the obs/<job>/<node> KV leases); a "
+    "worker sustained past this percent slower than the median for "
+    "FLAGS_elastic_straggler_sustain consecutive checks trips a "
+    "sentinel-style 'straggler' event, degrades its /healthz, and — with "
+    "FLAGS_elastic_straggler_evict — evicts itself through the elastic "
+    "shrink path. 0 = off",
+)
+define_flag(
+    "elastic_straggler_sustain", 5,
+    "consecutive over-threshold straggler checks before the detector "
+    "trips — one GC pause or checkpoint stall never evicts a worker",
+)
+define_flag(
+    "elastic_straggler_evict", False,
+    "when the straggler detector trips on THIS worker, deregister its "
+    "elastic lease and stop training so survivors rescale in place "
+    "(the same shrink path a SIGKILL takes); off = detect and degrade "
+    "/healthz only",
+)
+# ---------------------------------------------------------------------------
 # Serving runtime (paddle.serving — see SERVING.md)
 # ---------------------------------------------------------------------------
 define_flag(

@@ -153,6 +153,26 @@ class _SaveJob:
         self.thread: Optional[threading.Thread] = None
 
 
+def _restore_with_template(restore, template):
+    """Run an orbax restore against `template`. Orbax demands an exact
+    structure match with the on-disk tree; TrainingState keeps the
+    structure stable by always carrying the fixed-shape __data__.blob, so
+    the one tolerated mismatch is a PRE-data-state snapshot with no blob —
+    retry without it (params/moments restore, iterator state starts
+    fresh). Any other failure re-raises the ORIGINAL error; the retry
+    never masks a real corruption."""
+    try:
+        return restore(template)
+    except Exception as e:
+        if _DATA_KEY not in template or "mismatch" not in str(e).lower():
+            raise
+        try:
+            return restore({k: v for k, v in template.items()
+                            if k != _DATA_KEY})
+        except Exception:
+            raise e
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = False):
     """Sharded save: each host writes only its local shards (orbax).
 
@@ -183,9 +203,12 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, mesh=None):
         from ..framework.io_utils import load as _load
 
         loaded = _load(path)
-        for k, t in state_dict.items():
+        for k, t in list(state_dict.items()):
             if k in loaded:
-                t.set_value(loaded[k])
+                if isinstance(t, Tensor):
+                    t.set_value(loaded[k])
+                else:  # host-side entry (e.g. the __data__ iterator blob)
+                    state_dict[k] = loaded[k]
         return state_dict
     ckptr = ocp.StandardCheckpointer()
     template = {}
@@ -193,7 +216,8 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, mesh=None):
         val = v._value if isinstance(v, Tensor) else v
         sharding = getattr(val, "sharding", None)
         template[k] = jax.ShapeDtypeStruct(val.shape, val.dtype, sharding=sharding)
-    restored = ckptr.restore(os.path.abspath(path), template)
+    restored = _restore_with_template(
+        lambda t: ckptr.restore(os.path.abspath(path), t), template)
     for k, v in state_dict.items():
         if k in restored:
             if isinstance(v, Tensor):
@@ -454,11 +478,17 @@ class AsyncCheckpointer:
                 )
                 for k, v in state_dict.items()
             }
-            restored = self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+            restored = _restore_with_template(
+                lambda t: self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(t)), template)
             with no_grad():
-                for k, v in state_dict.items():
-                    if k in restored and isinstance(v, Tensor):
+                for k, v in list(state_dict.items()):
+                    if k not in restored:
+                        continue
+                    if isinstance(v, Tensor):
                         v._value = restored[k]
+                    else:  # host-side entry (the __data__ iterator blob)
+                        state_dict[k] = restored[k]
             return step
         steps = sorted(int(d) for d in os.listdir(self.directory) if d.isdigit())
         if not steps:
@@ -478,9 +508,13 @@ class AsyncCheckpointer:
             except Exception:
                 continue  # partial/corrupt snapshot — try the previous one
             with no_grad():
-                for k, v in state_dict.items():
-                    if k in loaded and isinstance(v, Tensor):
+                for k, v in list(state_dict.items()):
+                    if k not in loaded:
+                        continue
+                    if isinstance(v, Tensor):
                         v.set_value(loaded[k])
+                    else:  # host-side entry (the __data__ iterator blob)
+                        state_dict[k] = loaded[k]
             return step
         return None
 
@@ -702,16 +736,23 @@ class CheckpointCadence:
 
 
 def _train_range(count: int, checkpointer, state_dict, save_freq,
-                 guard, optimizer):
+                 guard, optimizer, data=None):
     """Shared restore → yield → boundary-check → cadenced-save protocol
     behind train_epoch_range / train_step_range (they differ only in the
     granularity of `count` and the save_freq default)."""
+    if (data is not None and hasattr(state_dict, "refresh")
+            and getattr(state_dict, "_data", None) is None):
+        # late-attach the data iterator so its epoch/cursor/RNG ride every
+        # snapshot (and the restore below pushes them back)
+        state_dict._data = data
+        state_dict.refresh()
     cadence = CheckpointCadence(checkpointer, state_dict, save_freq)
     start = 0
     if checkpointer is not None and state_dict is not None:
         restored = checkpointer.restore_latest(state_dict)
         if restored is not None:
-            restore_training_state(state_dict, optimizer=optimizer)
+            restore_training_state(state_dict, optimizer=optimizer,
+                                   data=data)
             start = restored + 1
     if guard is not None:
         guard.bind(checkpointer, state_dict)
@@ -753,7 +794,7 @@ def _train_range(count: int, checkpointer, state_dict, save_freq,
 def train_epoch_range(max_epoch_num: int, checkpointer: Optional[AsyncCheckpointer] = None,
                       state_dict: Optional[Dict] = None,
                       save_freq: Union[int, str] = 1,
-                      guard=None, optimizer=None):
+                      guard=None, optimizer=None, data=None):
     """reference: auto_checkpoint.py:598 train_epoch_range — a generator
     wrapping the epoch loop that restores the last epoch on (re)start and
     snapshots at each epoch end; pairs with elastic relaunch for resume.
@@ -763,16 +804,19 @@ def train_epoch_range(max_epoch_num: int, checkpointer: Optional[AsyncCheckpoint
     emergency-saves it, and raises `Preempted` — relaunching resumes at the
     next epoch. When `state_dict` is a `training_state` view (or `optimizer`
     is passed), the optimizer's accumulators are restored too — Adam resumes
-    with its real moments, not fresh zeros. For step-granular (≤1 step lost)
-    resume use train_step_range."""
+    with its real moments, not fresh zeros. Pass `data=` (a sampler or
+    DataLoader with state_dict/load_state_dict) to checkpoint the data
+    iterator alongside: a resumed run continues the sample stream where
+    the last commit cut it instead of re-reading the epoch from the top.
+    For step-granular (≤1 step lost) resume use train_step_range."""
     return _train_range(max_epoch_num, checkpointer, state_dict, save_freq,
-                        guard, optimizer)
+                        guard, optimizer, data=data)
 
 
 def train_step_range(max_steps: int, checkpointer: Optional[AsyncCheckpointer] = None,
                      state_dict: Optional[Dict] = None,
                      save_freq: Union[int, str] = 0,
-                     guard=None, optimizer=None):
+                     guard=None, optimizer=None, data=None):
     """Step-granular, preemption-safe resume loop (paddle.resilience).
 
     Restores the latest snapshot on (re)start and yields the remaining step
@@ -786,12 +830,46 @@ def train_step_range(max_steps: int, checkpointer: Optional[AsyncCheckpointer] =
     snapshot/persist cost, then picks the frequency that keeps measured
     checkpoint overhead under FLAGS_ckpt_overhead_pct, re-tuning when step
     time drifts. Pass `optimizer` to restore its accumulators from the
-    snapshot (see `training_state`)."""
+    snapshot (see `training_state`), and `data=` (sampler / DataLoader
+    with state_dict) to checkpoint the data-iterator state with them —
+    resume then consumes each sample exactly once."""
     return _train_range(max_steps, checkpointer, state_dict, save_freq,
-                        guard, optimizer)
+                        guard, optimizer, data=data)
 
 
 _OPT_PREFIX = "__opt__."
+_DATA_KEY = "__data__.blob"
+# fixed-size blob: orbax restore templates are built from the CURRENT
+# entry shapes, so the serialized iterator state must have a stable shape
+# across save and restore — length-prefixed pickle in a zero-padded buffer
+_DATA_BLOB_BYTES = 8192
+
+
+def _pack_data_state(doc: Dict[str, Any]) -> np.ndarray:
+    import pickle
+    import struct
+
+    payload = pickle.dumps(doc, protocol=2)
+    if len(payload) + 8 > _DATA_BLOB_BYTES:
+        raise ValueError(
+            f"data-iterator state is {len(payload)} bytes — does not fit "
+            f"the {_DATA_BLOB_BYTES}-byte checkpoint blob (keep sampler "
+            "state to epoch/cursor/RNG scalars, not data)")
+    buf = np.zeros(_DATA_BLOB_BYTES, dtype=np.uint8)
+    buf[:8] = np.frombuffer(struct.pack("<q", len(payload)), dtype=np.uint8)
+    buf[8:8 + len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    return buf
+
+
+def _unpack_data_state(arr) -> Optional[Dict[str, Any]]:
+    import pickle
+    import struct
+
+    raw = np.asarray(arr, dtype=np.uint8).tobytes()
+    (n,) = struct.unpack("<q", raw[:8])
+    if n <= 0:
+        return None  # empty blob: the snapshot came from a data-less run
+    return pickle.loads(raw[8:8 + n])
 
 
 class TrainingState(dict):
@@ -803,12 +881,21 @@ class TrainingState(dict):
     automatically (save: fresh moments are packed; restore: `create=True`
     materializes missing accumulators so the snapshot has tensors to land
     in). After a restore, `restore_training_state` pushes the restored
-    moment values back into the optimizer."""
+    moment values back into the optimizer.
 
-    def __init__(self, model, optimizer=None):
+    `data` adds the DATA-ITERATOR state to the same two-phase commit: any
+    object with `state_dict()`/`load_state_dict()` (GlobalStepSampler,
+    DistributedBatchSampler, DataLoader) has its epoch/cursor/RNG packed
+    as a fixed-size `__data__.blob` entry at every refresh, so a resumed
+    `train_step_range` continues the sample stream exactly where the
+    committed boundary cut it — each sample consumed exactly once, no
+    replay from the top of the epoch."""
+
+    def __init__(self, model, optimizer=None, data=None):
         super().__init__()
         self._model = model
         self._optimizer = optimizer
+        self._data = data
         self.refresh()
 
     def refresh(self, create: bool = False):
@@ -828,30 +915,47 @@ class TrainingState(dict):
                     self[f"{_OPT_PREFIX}{i}.{k}"] = (
                         v if isinstance(v, Tensor) else Tensor(v)
                     )
+        if self._data is not None and hasattr(self._data, "state_dict"):
+            self[_DATA_KEY] = _pack_data_state(self._data.state_dict())
+        else:
+            # stable snapshot structure: data-less states carry an EMPTY
+            # (all-zeros) blob so orbax's exact-structure restore matches
+            # between data= and data-less runs in both directions
+            self[_DATA_KEY] = np.zeros(_DATA_BLOB_BYTES, dtype=np.uint8)
         return self
 
 
-def training_state(model, optimizer=None) -> TrainingState:
-    """Checkpointable state covering model params AND optimizer
-    accumulators, for AsyncCheckpointer / save_state_dict / the
-    train_step_range resume loop."""
-    return TrainingState(model, optimizer)
+def training_state(model, optimizer=None, data=None) -> TrainingState:
+    """Checkpointable state covering model params, optimizer accumulators
+    AND (with `data=`) the data-iterator state, for AsyncCheckpointer /
+    save_state_dict / the train_step_range resume loop."""
+    return TrainingState(model, optimizer, data=data)
 
 
-def restore_training_state(state: Dict[str, Any], optimizer=None):
+def restore_training_state(state: Dict[str, Any], optimizer=None,
+                           data=None):
     """Push the optimizer slice of a restored `training_state` back into
-    the optimizer's accumulators (model params restored in place)."""
+    the optimizer's accumulators (model params restored in place), and the
+    `__data__.blob` iterator state back into the sampler/loader."""
     if optimizer is None:
         optimizer = getattr(state, "_optimizer", None)
-    if optimizer is None:
-        return
-    for i, p in enumerate(optimizer._param_list()):
-        prefix = f"{_OPT_PREFIX}{i}."
-        st = {
-            k[len(prefix):]: (v._value if isinstance(v, Tensor) else jax.numpy.asarray(np.asarray(v)))
-            for k, v in state.items() if k.startswith(prefix)
-        }
-        if st:
-            cur = optimizer._accumulators.get(id(p)) or optimizer._create_state(p)
-            cur.update(st)
-            optimizer._accumulators[id(p)] = cur
+    if optimizer is not None:
+        for i, p in enumerate(optimizer._param_list()):
+            prefix = f"{_OPT_PREFIX}{i}."
+            st = {
+                k[len(prefix):]: (v._value if isinstance(v, Tensor) else jax.numpy.asarray(np.asarray(v)))
+                for k, v in state.items() if k.startswith(prefix)
+            }
+            if st:
+                cur = optimizer._accumulators.get(id(p)) or optimizer._create_state(p)
+                cur.update(st)
+                optimizer._accumulators[id(p)] = cur
+    if data is None:
+        data = getattr(state, "_data", None)
+    if data is not None and _DATA_KEY in state and hasattr(
+            data, "load_state_dict"):
+        blob = state[_DATA_KEY]
+        blob = blob._value if isinstance(blob, Tensor) else blob
+        doc = _unpack_data_state(blob)
+        if doc is not None:
+            data.load_state_dict(doc)

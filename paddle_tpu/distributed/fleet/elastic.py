@@ -19,11 +19,24 @@ changed), and redeploys — the reference does the same for collective mode.
 """
 from __future__ import annotations
 
+import json
 import os
 import time
-from typing import Callable, Optional
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
-__all__ = ["ElasticManager", "ElasticStatus", "start_master"]
+__all__ = [
+    "ElasticManager",
+    "ElasticStatus",
+    "LateJoiner",
+    "RescaleCoordinator",
+    "RescaleEvent",
+    "RescaleFallback",
+    "WorldView",
+    "deterministic_tree_sum",
+    "start_master",
+    "state",
+]
 
 
 def start_master(port: int = 0):
@@ -62,6 +75,7 @@ class ElasticManager:
         heartbeat_ttl: float = 10.0,
         fault_tolerance_level: Optional[int] = None,
         master: Optional[str] = None,
+        on_rescale: Optional[Callable] = None,
     ):
         self.pod_builder = pod_builder
         self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID", "default")
@@ -81,7 +95,15 @@ class ElasticManager:
         self.master = master or os.getenv("PADDLE_ELASTIC_MASTER") or None
         self._kv = None
         self.restarts = 0
+        self.inplace_rescales = 0
         self.pod = None
+        # on_rescale(members) -> bool: called INSTEAD of the whole-pod
+        # rebuild when membership changes within [np_min, np_max]; return
+        # True when the running pod rebound in place (endpoint lists
+        # rebuilt, collectives re-formed). False / an exception falls back
+        # to the whole-pod restart — the reference semantics stay the
+        # safety net for unbarrierable states.
+        self.on_rescale = on_rescale
         self._node_id = os.getenv("PADDLE_CURRENT_ENDPOINT", f"node-{os.getpid()}")
 
     def _kv_client(self):
@@ -230,6 +252,23 @@ class ElasticManager:
                 and now_members != membership and (
                     self.np_min <= max(len(now_members), 1) <= self.np_max
                 )
+            if rescale and not failed and self.on_rescale is not None:
+                # in-place rescale: survivors barrier on the membership
+                # epoch bump and rebind without killing the pod (the
+                # RescaleCoordinator path); any failure falls through to
+                # the whole-pod restart below on the next loop turn
+                try:
+                    if self.on_rescale(now_members):
+                        membership = now_members
+                        self.inplace_rescales += 1
+                        time.sleep(self.watch_interval)
+                        continue
+                except Exception as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"elastic: in-place rescale failed ({e}); falling "
+                        "back to whole-pod restart")
             if failed or rescale:
                 if self.level == 0 and failed:
                     self.pod.stop()
@@ -253,3 +292,550 @@ class ElasticManager:
                     warnings.warn(f"elastic: pod rebuild failed ({e}); "
                                   f"retry {self.restarts}/{self.max_restarts}")
             time.sleep(self.watch_interval)
+
+
+# ---------------------------------------------------------------------------
+# Elastic rescale as a first-class training mode (RESILIENCE.md "Elastic
+# rescale"): membership epochs + an in-place shrink/grow barrier protocol.
+#
+# The manager above recovers faults the reference way — kill the pod,
+# rebuild, redeploy. The RescaleCoordinator below is the worker-side
+# alternative for world-size changes within [np_min, np_max]: leases gain a
+# monotonically increasing MEMBERSHIP EPOCH (one kv_put document per job,
+# outside the lease namespace so it never reads as a member); on a lease
+# expiry or a new node's register, survivors propose a bumped epoch, then
+# barrier on it — every member of the proposed world writes an
+# epoch-scoped barrier lease and waits until all are present — and install
+# the new WorldView (members, rank, world) without a restart. Everything
+# is deadline-bounded: a barrier that cannot complete (partitioned master,
+# wedged peers, world outside the np bounds) raises RescaleFallback so the
+# caller escalates to the whole-pod path; it can never hang.
+# ---------------------------------------------------------------------------
+def _epoch_key(job_id: str) -> str:
+    # deliberately OUTSIDE the elastic/<job>/ lease prefix: kv_alive over
+    # the member prefix must never list the epoch document as a node
+    return f"elastic-epoch/{job_id}"
+
+
+def _barrier_prefix(job_id: str, epoch: int) -> str:
+    return f"elastic-barrier/{job_id}/{int(epoch)}/"
+
+
+class RescaleFallback(RuntimeError):
+    """In-place rescale is impossible (barrier timeout, master outage
+    mid-rescale, world outside [np_min, np_max]): the caller must fall
+    back to the whole-pod restart path."""
+
+
+class LateJoiner(RuntimeError):
+    """This node is not in the epoch's membership snapshot (it registered
+    mid-barrier, or was evicted): it must not join this barrier — rejoin
+    via join(), which proposes a follow-up epoch that includes it."""
+
+    def __init__(self, epoch: int, members: Sequence[str], node_id: str):
+        super().__init__(
+            f"node {node_id!r} is not a member of epoch {epoch} "
+            f"({list(members)}); rejoin for the next epoch")
+        self.epoch = int(epoch)
+        self.members = tuple(members)
+
+
+class WorldView:
+    """One membership epoch's world: sorted members, my rank, world size."""
+
+    __slots__ = ("epoch", "members", "rank", "world")
+
+    def __init__(self, epoch: int, members: Sequence[str], node_id: str):
+        self.epoch = int(epoch)
+        self.members = tuple(sorted(members))
+        self.world = len(self.members)
+        self.rank = (self.members.index(node_id)
+                     if node_id in self.members else -1)
+
+    def __repr__(self):
+        return (f"WorldView(epoch={self.epoch}, world={self.world}, "
+                f"rank={self.rank}, members={list(self.members)})")
+
+
+class RescaleEvent:
+    """One installed epoch bump. `kind` is 'form' (first view), 'shrink',
+    'grow', or 'reshape' (same size, different members). `peer_steps` maps
+    each member to the last training step it reported committed at barrier
+    time — joiners use it to find the most-advanced peer to catch up
+    from; survivors roll back to their own last committed boundary."""
+
+    __slots__ = ("kind", "old", "new", "peer_steps")
+
+    def __init__(self, old: Optional[WorldView], new: WorldView,
+                 peer_steps: Dict[str, Optional[int]]):
+        if old is None:
+            self.kind = "form"
+        elif new.world < old.world:
+            self.kind = "shrink"
+        elif new.world > old.world:
+            self.kind = "grow"
+        else:
+            self.kind = "reshape"
+        self.old = old
+        self.new = new
+        self.peer_steps = dict(peer_steps)
+
+    def __repr__(self):
+        return (f"RescaleEvent({self.kind}: "
+                f"{self.old.world if self.old else 0}->{self.new.world} "
+                f"@epoch {self.new.epoch})")
+
+
+def deterministic_tree_sum(parts: List[Any]):
+    """Pairwise (balanced-binary-tree) sum with a FIXED association shape.
+
+    The accumulation-compensation contract needs gradient reduction whose
+    floating-point association does not depend on the world size: rank r
+    of world W owns a contiguous aligned block of the global microbatch
+    list, tree-sums its block locally, and the cross-rank combine
+    tree-sums the rank partials — producing bitwise the same result as one
+    rank tree-summing all microbatches, PROVIDED the microbatch count and
+    every world size are powers of two (aligned blocks are then exact
+    subtrees of the global tree). GlobalStepSampler.set_world validates
+    that invariant."""
+    parts = list(parts)
+    if not parts:
+        raise ValueError("deterministic_tree_sum of no parts")
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            nxt.append(parts[i] + parts[i + 1])
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+_coordinators: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def state() -> List[Dict[str, Any]]:
+    """Detached snapshots of every live RescaleCoordinator in this process
+    (what /statusz's elastic section and the obs lease payload render)."""
+    return [c.state() for c in
+            sorted(_coordinators, key=lambda c: c.node_id)]
+
+
+class RescaleCoordinator:
+    """Worker-side membership-epoch protocol over the TCP lease/KV master
+    (or any kv_* duck — MemoryKv in tests).
+
+    Lifecycle::
+
+        coord = RescaleCoordinator(manager)        # or kv=/master=+job_id
+        view = coord.form(expected=np)             # initial barrier
+        for step in ...:
+            train_one_step()
+            coord.note_commit(step)                # checkpoint boundary
+            event = coord.poll()                   # heartbeat + detect
+            if event is not None:
+                rollback_to_last_committed_boundary()
+                # sampler.set_world already applied if attached
+
+    `poll()` returns a RescaleEvent when an epoch bump installed (in-place
+    shrink/grow), None otherwise. RescaleFallback means the caller must
+    escalate to whole-pod restart; LateJoiner means this node was left
+    out of the new world (evicted, or raced a barrier) and should rejoin.
+    """
+
+    def __init__(self, manager: Optional[ElasticManager] = None, *,
+                 kv=None, master: Optional[str] = None,
+                 job_id: Optional[str] = None,
+                 node_id: Optional[str] = None,
+                 np_min: Optional[int] = None, np_max: Optional[int] = None,
+                 heartbeat_ttl: Optional[float] = None,
+                 barrier_timeout_s: Optional[float] = None,
+                 debounce: Optional[int] = None,
+                 poll_interval: float = 0.05):
+        from ...core import flags as _flags
+
+        if manager is not None:
+            master = master or manager.master
+            job_id = job_id or manager.job_id
+            node_id = node_id or manager._node_id
+            np_min = np_min if np_min is not None else manager.np_min
+            np_max = np_max if np_max is not None else manager.np_max
+            heartbeat_ttl = (heartbeat_ttl if heartbeat_ttl is not None
+                             else manager.heartbeat_ttl)
+        if kv is None and not master:
+            raise ValueError("RescaleCoordinator needs manager=, kv= or "
+                             "master=")
+        self._kv = kv
+        self._master = master
+        self.job_id = job_id or "default"
+        self.node_id = node_id or os.getenv(
+            "PADDLE_CURRENT_ENDPOINT", f"node-{os.getpid()}")
+        self.np_min = int(np_min if np_min is not None else 1)
+        self.np_max = int(np_max) if np_max else 1 << 30
+        self.heartbeat_ttl = float(heartbeat_ttl
+                                   if heartbeat_ttl is not None else 10.0)
+        self.barrier_timeout_s = float(
+            barrier_timeout_s if barrier_timeout_s is not None
+            else _flags.flag("elastic_barrier_timeout_s"))
+        self.debounce = int(debounce if debounce is not None
+                            else _flags.flag("elastic_rescale_debounce"))
+        self.poll_interval = float(poll_interval)
+        self.view: Optional[WorldView] = None
+        self.last_event: Optional[RescaleEvent] = None
+        self.rescales = 0
+        self.fallbacks = 0
+        self.evicted = False
+        self._last_committed: Optional[int] = None
+        self._pending_members: Optional[tuple] = None
+        self._pending_count = 0
+        self._sampler = None
+        _coordinators.add(self)
+
+    # -- plumbing --------------------------------------------------------
+    def _client(self):
+        if self._kv is None:
+            from ..ps import PsClient
+
+            self._kv = PsClient([self._master])
+        return self._kv
+
+    def _member_key(self) -> str:
+        return f"elastic/{self.job_id}/{self.node_id}"
+
+    def _member_prefix(self) -> str:
+        return f"elastic/{self.job_id}/"
+
+    def _alive(self) -> List[str]:
+        prefix = self._member_prefix()
+        alive = self._client().kv_alive(prefix)
+        return sorted(k[len(prefix):] for k in alive)
+
+    def _read_epoch(self) -> Optional[Dict[str, Any]]:
+        raw = self._client().kv_get(_epoch_key(self.job_id))
+        if not raw:
+            return None
+        try:
+            doc = json.loads(raw)
+            return {"epoch": int(doc["epoch"]),
+                    "members": [str(m) for m in doc["members"]]}
+        except (ValueError, KeyError, TypeError):
+            return None  # torn/corrupt doc: treated as absent this poll
+
+    def _propose(self, members: Sequence[str]) -> int:
+        """Publish a bumped epoch with the observed member set. Racing
+        proposers converge: both read the same stored epoch and write the
+        same bump; a conflicting member list settles last-writer-wins and
+        every barrier loop re-reads the stored document, so all nodes
+        adopt the same final (epoch, members)."""
+        stored = self._read_epoch()
+        base = max(stored["epoch"] if stored else 0,
+                   self.view.epoch if self.view else 0)
+        epoch = base + 1
+        doc = json.dumps({"epoch": epoch, "members": sorted(members)})
+        self._client().kv_put(_epoch_key(self.job_id), doc)
+        self._emit("propose", epoch=epoch, members=sorted(members))
+        # racing same-epoch proposers settle last-writer-wins; adopt the
+        # STORED document if ours lost so this node barriers on the same
+        # (epoch, members) the winner published (the barrier loop re-reads
+        # too — this just converges one turn earlier)
+        echo = self._read_epoch()
+        if echo and (echo["epoch"] != epoch
+                     or sorted(echo["members"]) != sorted(members)):
+            return echo["epoch"]
+        return epoch
+
+    def register(self):
+        if self.evicted:
+            return  # a deregistered lease must STAY gone (evict_self);
+            # join() lifts the latch for a deliberate rejoin
+        self._client().kv_lease(self._member_key(), str(os.getpid()),
+                                self.heartbeat_ttl)
+
+    def heartbeat(self):
+        self.register()
+
+    def note_commit(self, step: int):
+        """Record the last durably committed training step — the value the
+        barrier publishes so peers can agree on the resume boundary."""
+        self._last_committed = int(step)
+
+    def attach_sampler(self, sampler):
+        """Auto-reshard: every installed epoch calls
+        ``sampler.set_world(rank, world)`` (GlobalStepSampler /
+        DistributedBatchSampler duck) so the data stream and accumulation
+        factor follow the world with no caller wiring."""
+        self._sampler = sampler
+        if self.view is not None and hasattr(sampler, "set_world"):
+            sampler.set_world(self.view.rank, self.view.world)
+        return sampler
+
+    # -- membership protocol ---------------------------------------------
+    def form(self, expected: Optional[int] = None,
+             timeout: Optional[float] = None) -> WorldView:
+        """Initial formation: register, wait for `expected` members (or
+        np_min), propose/adopt the first epoch and barrier on it."""
+        return self._join(expected=expected, timeout=timeout)
+
+    def join(self, timeout: Optional[float] = None) -> WorldView:
+        """(Re)join a running job: register and propose an epoch whose
+        membership includes this node — survivors observe the bump and
+        barrier into the grown world (one epoch bump per join). Clears a
+        prior evict_self latch: rejoining is the one deliberate way back
+        in after an eviction."""
+        self.evicted = False
+        return self._join(expected=None, timeout=timeout)
+
+    def _join(self, expected: Optional[int],
+              timeout: Optional[float]) -> WorldView:
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else self.barrier_timeout_s)
+        want = int(expected) if expected else None
+        last_err: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            try:
+                self.register()
+                doc = self._read_epoch()
+                if doc and self.node_id in doc["members"] and (
+                        self.view is None or doc["epoch"] > self.view.epoch):
+                    try:
+                        return self._barrier_and_install(doc, deadline).new
+                    except LateJoiner:
+                        # superseded mid-barrier by a document that omits
+                        # us (we lost a propose race): fall through and
+                        # propose a follow-up epoch that includes us —
+                        # join() owns the deadline budget for exactly this
+                        pass
+                alive = self._alive()
+                # never PROPOSE a world outside [np_min, np_max]: an
+                # over-max joiner keeps waiting (a seat may free up) and
+                # times out alone rather than bumping the survivors into
+                # an epoch they would have to fall back from
+                if (self.node_id in alive
+                        and (want is None or len(alive) >= want)
+                        and self.np_min <= len(alive) <= self.np_max):
+                    self._propose(alive)
+            except ConnectionError as e:
+                last_err = e  # master hiccup: retry within the deadline
+            time.sleep(self.poll_interval)
+        self.fallbacks += 1
+        raise RescaleFallback(
+            f"formation/join for job {self.job_id!r} timed out "
+            f"(expected={expected}, last_err={last_err!r}) — escalate to "
+            "whole-pod restart")
+
+    def poll(self) -> Optional[RescaleEvent]:
+        """Step-boundary tick: refresh the lease, detect epoch bumps or
+        membership drift, run the barrier when a rescale is due. Master
+        outages fail SOFT (None) — training continues, like
+        ElasticManager.heartbeat; only an in-progress barrier that cannot
+        complete raises RescaleFallback."""
+        if self.evicted:
+            return None  # no heartbeat, no barriers: survivors must see
+            # the lease stay gone so the shrink actually lands
+        try:
+            self.heartbeat()
+            doc = self._read_epoch()
+        except ConnectionError:
+            return None  # transient outage: next boundary retries
+        if doc and self.view is not None and (
+                doc["epoch"] > self.view.epoch
+                or (doc["epoch"] == self.view.epoch
+                    and tuple(sorted(doc["members"])) != self.view.members)):
+            # epoch bump — or a same-epoch document that superseded the
+            # member list this node installed (it lost a propose race
+            # after its confirm read): the stored document is
+            # authoritative, converge onto it
+            if self.node_id not in doc["members"]:
+                raise LateJoiner(doc["epoch"], doc["members"], self.node_id)
+            deadline = time.monotonic() + self.barrier_timeout_s
+            return self._barrier_and_install(doc, deadline)
+        try:
+            alive = self._alive()
+        except ConnectionError:
+            return None
+        if self.view is None or not alive:
+            return None
+        observed = tuple(sorted(alive))
+        if observed == self.view.members:
+            self._pending_members, self._pending_count = None, 0
+            return None
+        # debounce: the SAME changed set must hold for consecutive polls
+        if observed == self._pending_members:
+            self._pending_count += 1
+        else:
+            self._pending_members, self._pending_count = observed, 1
+        if self._pending_count < self.debounce:
+            return None
+        self._pending_members, self._pending_count = None, 0
+        new_world = len(observed)
+        if not (self.np_min <= new_world <= self.np_max):
+            self.fallbacks += 1
+            raise RescaleFallback(
+                f"membership changed to world={new_world}, outside "
+                f"[{self.np_min}, {self.np_max}] — escalate to whole-pod "
+                "restart")
+        epoch = self._propose(observed)
+        doc = {"epoch": epoch, "members": sorted(observed)}
+        deadline = time.monotonic() + self.barrier_timeout_s
+        return self._barrier_and_install(doc, deadline)
+
+    def _check_bounds(self, world: int, epoch: int):
+        """An adopted epoch document outside [np_min, np_max] cannot be
+        barriered into in place — the same escalation as the drift-detect
+        path, enforced on EVERY install route (adopt, join, supersede)."""
+        if not (self.np_min <= world <= self.np_max):
+            self.fallbacks += 1
+            raise RescaleFallback(
+                f"epoch {epoch} proposes world={world}, outside "
+                f"[{self.np_min}, {self.np_max}] — escalate to whole-pod "
+                "restart")
+
+    def _barrier_and_install(self, doc: Dict[str, Any],
+                             deadline: float) -> RescaleEvent:
+        """Barrier on `doc`'s epoch: every member writes an epoch-scoped
+        barrier lease and waits for all. Re-reads the stored epoch each
+        turn — a newer proposal supersedes this barrier mid-flight (the
+        member set changed again), and the final stored document is what
+        every node converges on. Deadline-bounded: raises RescaleFallback
+        rather than hanging."""
+        epoch, members = doc["epoch"], list(doc["members"])
+        if self.node_id not in members:
+            raise LateJoiner(epoch, members, self.node_id)
+        self._check_bounds(len(members), epoch)
+        payload = json.dumps({"step": self._last_committed})
+        barrier_ttl = max(self.barrier_timeout_s, self.heartbeat_ttl * 2)
+        while time.monotonic() < deadline:
+            try:
+                # keep the MEMBER lease fresh too: a barrier that waits
+                # past heartbeat_ttl must not let every waiter's lease
+                # expire, or the first post-install poll sees a mutilated
+                # member set and tears the just-installed world again
+                self.register()
+                self._client().kv_lease(
+                    _barrier_prefix(self.job_id, epoch) + self.node_id,
+                    payload, barrier_ttl)
+                latest = self._read_epoch()
+                if latest and (latest["epoch"] > epoch or (
+                        latest["epoch"] == epoch
+                        and sorted(latest["members"]) != sorted(members))):
+                    # a newer epoch OR a same-epoch member list that lost
+                    # to ours in the propose race: the stored document is
+                    # the one everyone must converge on
+                    epoch, members = latest["epoch"], list(latest["members"])
+                    if self.node_id not in members:
+                        raise LateJoiner(epoch, members, self.node_id)
+                    self._check_bounds(len(members), epoch)
+                    payload = json.dumps({"step": self._last_committed})
+                    continue
+                prefix = _barrier_prefix(self.job_id, epoch)
+                present = self._client().kv_alive(prefix)
+                here = {k[len(prefix):]: v for k, v in present.items()}
+                if all(m in here for m in members):
+                    # confirm the document did not flip between the read
+                    # above and the completeness scan; a change loops back
+                    # to the adopt branch next turn
+                    confirm = self._read_epoch()
+                    if confirm and (confirm["epoch"] != epoch or sorted(
+                            confirm["members"]) != sorted(members)):
+                        continue
+                    return self._install(epoch, members, here)
+            except ConnectionError:
+                pass  # master hiccup mid-barrier: retry within deadline
+            time.sleep(self.poll_interval)
+        self.fallbacks += 1
+        self._emit("barrier_timeout", epoch=epoch, members=members)
+        raise RescaleFallback(
+            f"epoch {epoch} barrier timed out after "
+            f"{self.barrier_timeout_s}s (members={members}) — escalate to "
+            "whole-pod restart")
+
+    def _install(self, epoch: int, members: List[str],
+                 barrier_values: Dict[str, str]) -> RescaleEvent:
+        peer_steps: Dict[str, Optional[int]] = {}
+        for m in members:
+            try:
+                peer_steps[m] = json.loads(barrier_values[m]).get("step")
+            except (KeyError, ValueError, TypeError):
+                peer_steps[m] = None
+        old = self.view
+        new_view = WorldView(epoch, members, self.node_id)
+        # reshard BEFORE committing the view: an attached sampler that
+        # cannot deal this world (non-power-of-two, world > microbatches)
+        # must surface as the documented whole-pod escalation with the
+        # coordinator still coherent, not a raw ValueError with the view
+        # already bumped and the sampler dealing for the old world
+        if self._sampler is not None and hasattr(self._sampler, "set_world"):
+            try:
+                self._sampler.set_world(new_view.rank, new_view.world)
+            except ValueError as e:
+                self.fallbacks += 1
+                self._emit("reshard_failed", epoch=epoch,
+                           world=new_view.world, error=str(e))
+                raise RescaleFallback(
+                    f"world={new_view.world} cannot reshard the attached "
+                    f"sampler ({e}) — escalate to whole-pod restart")
+        self.view = new_view
+        event = RescaleEvent(old, self.view, peer_steps)
+        self.last_event = event
+        if old is not None:
+            self.rescales += 1
+        self._emit("install", kind=event.kind, epoch=epoch,
+                   world=self.view.world, rank=self.view.rank)
+        self._count(f"elastic_rescale_{event.kind}s"
+                    if old is not None else "elastic_formations")
+        return event
+
+    def evict_self(self, reason: str = "straggler"):
+        """The shrink path, self-directed: deregister this node's lease so
+        survivors observe the membership change and rescale in place (what
+        FLAGS_elastic_straggler_evict does on a straggler trip)."""
+        self.evicted = True
+        self._emit("evict", reason=reason)
+        self._count("elastic_self_evictions")
+        try:
+            self._client().kv_del(self._member_key())
+        except ConnectionError:
+            pass  # the lease will expire on its own — same outcome, later
+
+    # -- observability ---------------------------------------------------
+    def accumulation_factor(self) -> Optional[int]:
+        sampler = self._sampler
+        if sampler is not None and hasattr(sampler, "accumulation_factor"):
+            return int(sampler.accumulation_factor)
+        return None
+
+    def state(self) -> Dict[str, Any]:
+        v = self.view
+        return {
+            "job": self.job_id,
+            "node": self.node_id,
+            "epoch": None if v is None else v.epoch,
+            "world": None if v is None else v.world,
+            "rank": None if v is None else v.rank,
+            "members": [] if v is None else list(v.members),
+            "rescales": self.rescales,
+            "fallbacks": self.fallbacks,
+            "evicted": self.evicted,
+            "last_committed": self._last_committed,
+            "accumulation_factor": self.accumulation_factor(),
+            "last_event": (None if self.last_event is None
+                           else repr(self.last_event)),
+        }
+
+    def _emit(self, phase: str, **attrs):
+        try:
+            from ...core import dispatch
+
+            dispatch._emit("elastic", site=self.node_id, phase=phase,
+                           **attrs)
+        except Exception:
+            pass  # observability must never take the rescale path down
+
+    @staticmethod
+    def _count(key: str, n: float = 1):
+        try:
+            from ...core import dispatch
+
+            dispatch._counter_add(key, n)
+        except Exception:
+            pass

@@ -43,8 +43,8 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-__all__ = ["FleetAggregator", "MemoryKv", "ObsPublisher", "obs_key",
-           "obs_prefix"]
+__all__ = ["FleetAggregator", "MemoryKv", "ObsPublisher",
+           "StragglerDetector", "obs_key", "obs_prefix"]
 
 
 def obs_prefix(job_id: str = "default") -> str:
@@ -122,6 +122,11 @@ class ObsPublisher:
         self._diag_addr = diag_addr
         self.publishes = 0
         self.failures = 0
+        # per-worker step-progress heartbeat (ISSUE 14 straggler defense):
+        # note_step feeds these; the snapshot publishes them so the fleet
+        # can compare workers' step cadence without any extra RPC
+        self._elastic: Dict[str, Any] = {}
+        self._last_step_wall: Optional[float] = None
 
     @classmethod
     def from_elastic(cls, manager, diag_addr: Optional[str] = None,
@@ -141,6 +146,25 @@ class ObsPublisher:
 
     def key(self) -> str:
         return obs_key(self.job_id, self.node_id)
+
+    def note_step(self, step: int, step_ms: float, epoch: Optional[int] = None,
+                  accum: Optional[int] = None):
+        """Record one completed training step — the per-worker
+        step-progress heartbeat the straggler detector and fleet_top read.
+        `epoch` is the elastic membership epoch; `accum` the current
+        accumulation factor. EMA-smoothed (0.5/step): the detector judges
+        sustained cadence, not single-step noise."""
+        prev = self._elastic.get("step_ms")
+        self._elastic.update({
+            "step": int(step),
+            "step_ms": (float(step_ms) if prev is None
+                        else prev + 0.5 * (float(step_ms) - prev)),
+        })
+        if epoch is not None:
+            self._elastic["epoch"] = int(epoch)
+        if accum is not None:
+            self._elastic["accum"] = int(accum)
+        self._last_step_wall = time.time()
 
     def snapshot(self) -> Dict[str, Any]:
         """The compact per-worker doc: identity + diag address + health +
@@ -162,6 +186,26 @@ class ObsPublisher:
                            "histograms": hists}
         except Exception:
             metrics_doc = None
+        elastic = dict(self._elastic)
+        if self._last_step_wall is not None:
+            # step lag: how stale this worker's last completed step is —
+            # the fleet-visible "is it making progress" signal
+            elastic["step_lag_ms"] = round(
+                (time.time() - self._last_step_wall) * 1000.0, 1)
+        if "epoch" not in elastic or "accum" not in elastic:
+            # fall back to the live RescaleCoordinator for this node
+            try:
+                from .elastic import state as _estate
+
+                for row in _estate():
+                    if row["node"] == self.node_id:
+                        elastic.setdefault("epoch", row["epoch"])
+                        if row["accumulation_factor"] is not None:
+                            elastic.setdefault(
+                                "accum", row["accumulation_factor"])
+                        break
+            except Exception:
+                pass
         return {
             "node": self.node_id,
             "host": socket.gethostname(),
@@ -169,6 +213,7 @@ class ObsPublisher:
             "diag": self._diag_addr or _diag.address(),
             "wall": time.time(),
             "step": health.get("step"),
+            "elastic": elastic,
             "health": {
                 "status": health.get("status"),
                 "reasons": health.get("reasons"),
@@ -201,6 +246,174 @@ class ObsPublisher:
             self._client().kv_del(self.key())
         except Exception:
             pass
+
+
+class StragglerDetector:
+    """Fleet-level straggler defense (ISSUE 14 layer 4): each worker
+    compares ITS OWN published step time against the fleet median from the
+    live ``obs/<job>/*`` leases. A worker sustained past
+    ``FLAGS_elastic_straggler_pct`` slower than the median for
+    ``FLAGS_elastic_straggler_sustain`` consecutive checks trips once —
+    a sentinel-style ``straggler`` flight event + counter, an external
+    sentinel latch (``straggler[<node>]``) that degrades this worker's
+    /healthz — and, with ``FLAGS_elastic_straggler_evict`` (or an
+    ``on_evict`` callback), evicts the worker through the elastic shrink
+    path: the coordinator deregisters its lease, survivors observe the
+    membership change and rescale in place.
+
+    Detection is decentralized — no coordinator process: every worker
+    runs the same arithmetic over the same KV view and only ever judges
+    itself, so a partitioned or dead master simply pauses detection
+    (checks fail soft), exactly like the heartbeats."""
+
+    def __init__(self, publisher: ObsPublisher, *, coordinator=None,
+                 pct: Optional[float] = None, sustain: Optional[int] = None,
+                 evict: Optional[bool] = None, on_evict=None,
+                 min_interval_s: float = 0.0):
+        from ...core import flags as _flags
+
+        self.publisher = publisher
+        self.coordinator = coordinator
+        # per-check cost is a kv_alive prefix scan + one JSON decode per
+        # worker — O(W^2) master load fleet-wide when called every step.
+        # Large fleets should set min_interval_s near their publish
+        # cadence so scans amortize; 0 keeps per-step detection (tests,
+        # small worlds)
+        self.min_interval_s = float(min_interval_s)
+        self._last_scan_wall = 0.0
+        self.pct = float(pct if pct is not None
+                         else _flags.flag("elastic_straggler_pct"))
+        self.sustain = max(1, int(
+            sustain if sustain is not None
+            else _flags.flag("elastic_straggler_sustain")))
+        self.evict = bool(evict if evict is not None
+                          else _flags.flag("elastic_straggler_evict"))
+        self.on_evict = on_evict
+        self.breach_streak = 0
+        self.tripped = False
+        self.trips = 0
+        self.evicted = False
+        self.last_ratio: Optional[float] = None
+        self.tripped_at: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.pct > 0
+
+    def _sentinel_key(self) -> str:
+        return f"straggler[{self.publisher.node_id}]"
+
+    def check(self) -> Optional[Dict[str, Any]]:
+        """One detection pass (call each step, after
+        ``publisher.note_step`` + ``publish``). Returns the trip doc the
+        first time this worker trips, else None."""
+        if not self.enabled or self.evicted:
+            return None
+        if self.min_interval_s > 0:
+            now = time.time()
+            if now - self._last_scan_wall < self.min_interval_s:
+                return None
+            self._last_scan_wall = now
+        try:
+            snaps = self.publisher._client().kv_alive(
+                obs_prefix(self.publisher.job_id))
+        except Exception:
+            return None  # master outage: detection pauses, fails soft
+        import statistics
+
+        step_ms: Dict[str, float] = {}
+        prefix = obs_prefix(self.publisher.job_id)
+        for key, value in snaps.items():
+            try:
+                doc = json.loads(value)
+                ms = (doc.get("elastic") or {}).get("step_ms")
+                if ms is not None:
+                    step_ms[key[len(prefix):]] = float(ms)
+            except (ValueError, TypeError):
+                continue
+        mine = step_ms.get(self.publisher.node_id)
+        if mine is None or len(step_ms) < 2:
+            return None  # nothing to compare against
+        median = statistics.median(step_ms.values())
+        if median <= 0:
+            return None
+        self.last_ratio = mine / median
+        slow = mine > median * (1.0 + self.pct / 100.0)
+        if self.tripped:
+            if not slow:  # recovered: clear the latch, /healthz greens
+                self.tripped = False
+                self.breach_streak = 0
+                self._sentinel("clear")
+            return None
+        self.breach_streak = self.breach_streak + 1 if slow else 0
+        if self.breach_streak < self.sustain:
+            return None
+        self.tripped = True
+        self.trips += 1
+        self.breach_streak = 0
+        self.tripped_at = time.time()
+        doc = {
+            "node": self.publisher.node_id,
+            "step_ms": round(mine, 3),
+            "fleet_median_ms": round(median, 3),
+            "ratio": round(self.last_ratio, 3),
+            "pct": self.pct,
+            "sustain": self.sustain,
+        }
+        self._sentinel("trip", **doc)
+        self._emit("trip", **doc)
+        if self.evict or self.on_evict is not None:
+            # latch `evicted` only when something actually deregisters the
+            # worker; with no mechanism wired, stay merely tripped so the
+            # recovery branch can still clear the /healthz latch
+            if self.on_evict is not None:
+                self.evicted = True
+                self._emit("evict", **doc)
+                self.on_evict(doc)
+            elif self.coordinator is not None:
+                self.evicted = True
+                self._emit("evict", **doc)
+                self.coordinator.evict_self(reason="straggler")
+        return doc
+
+    def _sentinel(self, what: str, **attrs):
+        try:
+            from ...profiler import sentinel as _sent
+
+            if what == "trip":
+                drift = ((self.last_ratio or 1.0) - 1.0) * 100.0
+                _sent.trip_external(self._sentinel_key(), drift_pct=drift,
+                                    **attrs)
+            else:
+                _sent.clear_external(self._sentinel_key())
+        except Exception:
+            pass  # the detector must never take the training loop down
+
+    def _emit(self, phase: str, **attrs):
+        try:
+            from ...core import dispatch
+
+            dispatch._emit("straggler", site=self.publisher.node_id,
+                           phase=phase, **attrs)
+            dispatch._counter_add(
+                "elastic_straggler_trips" if phase == "trip"
+                else "elastic_straggler_evictions", 1)
+        except Exception:
+            pass
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "pct": self.pct,
+            "sustain": self.sustain,
+            "evict": self.evict,
+            "tripped": self.tripped,
+            "trips": self.trips,
+            "evicted": self.evicted,
+            "breach_streak": self.breach_streak,
+            "last_ratio": (None if self.last_ratio is None
+                           else round(self.last_ratio, 3)),
+        }
 
 
 def _split_labels(fullname: str):
@@ -296,6 +509,7 @@ class FleetAggregator:
         rows = []
         for node, doc in sorted(self.snapshots().items()):
             h = doc.get("health") or {}
+            e = doc.get("elastic") or {}
             rows.append({
                 "node": node,
                 "host": doc.get("host"),
@@ -306,6 +520,13 @@ class FleetAggregator:
                 "age_s": round(now - float(doc.get("wall") or now), 2),
                 "diag": doc.get("diag"),
                 "engines": h.get("engines") or {},
+                # elastic-rescale columns (ISSUE 14): membership epoch,
+                # per-worker step lag, accumulation factor
+                "epoch": e.get("epoch"),
+                "elastic_step": e.get("step"),
+                "step_ms": e.get("step_ms"),
+                "step_lag_ms": e.get("step_lag_ms"),
+                "accum": e.get("accum"),
             })
         return rows
 

@@ -172,15 +172,27 @@ class ModelCheckpoint(Callback):
     pipelined boundary snapshots with retention and a crash-consistent
     LATEST pointer instead of ad-hoc per-epoch file writes. `save_freq`
     accepts `"auto"` for CheckFreq cadence tuning against the
-    FLAGS_ckpt_overhead_pct overhead budget."""
+    FLAGS_ckpt_overhead_pct overhead budget.
 
-    def __init__(self, save_freq=1, save_dir=None):
+    `resume=True` (default) restores the latest snapshot from `save_dir`
+    on train begin — params, optimizer accumulators AND the data-iterator
+    state (sampler epoch/cursor + framework RNG, when `fit` handed the
+    train loader over) — and reports `resume_epoch` so `fit` continues at
+    the next epoch instead of re-reading the data from the top."""
+
+    def __init__(self, save_freq=1, save_dir=None, resume=True):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.resume = bool(resume)
+        self.resume_epoch = 0
         self.checkpointer = None
         self._cadence = None
         self._t0 = None
+        self._train_loader = None  # set by fit() for iterator-state resume
+
+    def set_train_loader(self, loader):
+        self._train_loader = loader
 
     def on_train_begin(self, logs=None):
         if not self.save_dir:
@@ -188,15 +200,25 @@ class ModelCheckpoint(Callback):
         from ..distributed.checkpoint import (
             AsyncCheckpointer,
             CheckpointCadence,
+            restore_training_state,
             training_state,
         )
 
+        optimizer = getattr(self.model, "_optimizer", None)
+        data = self._train_loader
+        if data is not None and not hasattr(data, "state_dict"):
+            data = None
+        state = training_state(self.model.network, optimizer, data=data)
         self.checkpointer = AsyncCheckpointer(self.save_dir)
+        self.resume_epoch = 0
+        if self.resume:
+            restored = self.checkpointer.restore_latest(state)
+            if restored is not None:
+                restore_training_state(state, optimizer=optimizer,
+                                       data=data)
+                self.resume_epoch = restored + 1
         self._cadence = CheckpointCadence(
-            self.checkpointer,
-            training_state(self.model.network,
-                           getattr(self.model, "_optimizer", None)),
-            self.save_freq,
+            self.checkpointer, state, self.save_freq,
         )
 
     def on_epoch_begin(self, epoch, logs=None):

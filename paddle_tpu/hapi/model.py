@@ -133,17 +133,30 @@ class Model:
         # gets the CheckFreq cadence tuner under the FLAGS_ckpt_overhead_pct
         # budget, and a classic final.pdparams/.pdopt pair lands at train
         # end for Model.load workflows
+        ckpt_cb = None
         if save_dir:
             from .callbacks import ModelCheckpoint
 
             ckpt_cb = ModelCheckpoint(save_freq=save_freq, save_dir=save_dir)
             ckpt_cb.set_model(self)
+            ckpt_cb.set_train_loader(train_loader)
             cbks.append(ckpt_cb)
         self.stop_training = False  # stale stop from a previous fit()
         cbks.on_train_begin()
-        for epoch in range(epochs):
+        # a save_dir with committed snapshots resumes at the NEXT epoch —
+        # params, optimizer moments and the data-iterator state (sampler
+        # epoch/cursor, RNG) all came back in on_train_begin, so the run
+        # continues instead of re-reading every epoch from the top
+        start_epoch = ckpt_cb.resume_epoch if ckpt_cb is not None else 0
+        train_sampler = getattr(train_loader, "batch_sampler", None)
+        for epoch in range(start_epoch, epochs):
             if self.stop_training:
                 break
+            if hasattr(train_sampler, "set_epoch"):
+                # epoch-deterministic shuffling: the sampler's permutation
+                # is a function of the epoch index, so a resumed run draws
+                # the same per-epoch streams the original would have
+                train_sampler.set_epoch(epoch)
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()

@@ -23,6 +23,7 @@ from .dataset import (  # noqa: F401
 from .sampler import (  # noqa: F401
     BatchSampler,
     DistributedBatchSampler,
+    GlobalStepSampler,
     RandomSampler,
     Sampler,
     SequenceSampler,

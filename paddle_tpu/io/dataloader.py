@@ -322,6 +322,10 @@ class DataLoader:
         self.worker_collate_fn = worker_collate_fn
         self.return_numpy = bool(return_numpy)
         self._pool = None  # persistent multiprocess pool state
+        # live-iteration consumption tracking (see state_dict): sampler
+        # state at iteration start + batches the caller has consumed since
+        self._live_start = None
+        self._live_consumed = 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -339,16 +343,104 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
+    # -- resumable-iterator state (paddle.distributed.checkpoint) ----------
+    def state_dict(self):
+        """Sampler epoch/cursor + the framework RNG — what
+        ``training_state(..., data=loader)`` packs next to params so a
+        resumed run continues the data stream mid-epoch instead of
+        re-reading it from the top (each sample consumed exactly once).
+
+        The cursor reflects batches the CALLER has consumed, not how far
+        the prefetchers have advanced the sampler — with num_workers>0 the
+        sampler runs up to num_workers*prefetch_factor batches ahead, and
+        checkpointing that inflated cursor would skip never-trained
+        samples on resume."""
+        from ..core import random as _random
+
+        doc = {"rng": tuple(_random.default_generator.get_state())}
+        sampler = getattr(self, "batch_sampler", None)
+        if sampler is not None and hasattr(sampler, "state_dict"):
+            if self._live_start is not None:
+                s = dict(self._live_start)
+                s["cursor"] = int(s.get("cursor", 0)) + self._live_consumed
+            else:
+                s = sampler.state_dict()
+            doc["sampler"] = s
+        return doc
+
+    def load_state_dict(self, state):
+        from ..core import random as _random
+
+        if "rng" in state:
+            _random.default_generator.set_state(tuple(state["rng"]))
+        sampler = getattr(self, "batch_sampler", None)
+        if (sampler is not None and "sampler" in state
+                and hasattr(sampler, "load_state_dict")):
+            sampler.load_state_dict(state["sampler"])
+        self._live_start = None
+        self._live_consumed = 0
+
+    def _tracked(self, gen):
+        """Count batches handed to the caller so state_dict can report a
+        consumption cursor even while prefetchers run the sampler ahead.
+        The snapshot is taken before the first pull (nothing has advanced
+        yet); normal exhaustion hands authority back to the sampler (whose
+        epoch-end state — cursor reset — is then correct)."""
+        sampler = self.batch_sampler
+        if self._live_start is not None and hasattr(sampler,
+                                                    "load_state_dict"):
+            # the previous iteration was ABANDONED mid-epoch: rewind the
+            # sampler's prefetch overshoot to the consumption point, else
+            # the never-delivered prefetched batches are skipped forever.
+            # Rewind only a pure overshoot — if anything else moved
+            # (set_epoch, an explicit cursor seek), the caller's state wins
+            want = dict(self._live_start)
+            want["cursor"] = int(want.get("cursor", 0)) + self._live_consumed
+            cur = sampler.state_dict()
+            cur_c = int(cur.get("cursor", 0))
+            # an epoch-scoped sampler (has an "epoch" field) resets its
+            # cursor to 0 when the PREFETCHER drains the whole epoch —
+            # with the epoch unchanged that 0 is overshoot too, not a
+            # caller reset (GlobalStepSampler's global cursor never
+            # wraps, so 0 there means an explicit seek and wins). A
+            # caller who consumed EVERY batch before breaking gets the
+            # reset state as-is — rewinding to the full count would make
+            # the next epoch iterate empty
+            try:
+                total = len(sampler)
+            except TypeError:
+                total = None
+            wrapped = ("epoch" in cur and cur_c == 0
+                       and 0 < int(want["cursor"])
+                       and (total is None or int(want["cursor"]) < total))
+            if ({k: v for k, v in cur.items() if k != "cursor"}
+                    == {k: v for k, v in want.items() if k != "cursor"}
+                    and (cur_c > int(want["cursor"]) or wrapped)):
+                sampler.load_state_dict(want)
+        self._live_start = sampler.state_dict()
+        self._live_consumed = 0
+        for batch in gen:
+            # count BEFORE the yield: the generator only resumes at the
+            # next pull, and a batch handed to the caller is consumed
+            self._live_consumed += 1
+            yield batch
+        self._live_start = None
+        self._live_consumed = 0
+
     def __iter__(self):
         if self._iterable_mode:
             if self.num_workers > 0 and not self.use_thread_workers:
                 return self._iter_iterable_multiprocess()
             return self._iter_iterable()
         if self.num_workers == 0:
-            return self._iter_single()
-        if self.use_thread_workers:
-            return self._iter_threaded()
-        return self._iter_multiprocess()
+            it = self._iter_single()
+        elif self.use_thread_workers:
+            it = self._iter_threaded()
+        else:
+            it = self._iter_multiprocess()
+        if hasattr(self.batch_sampler, "state_dict"):
+            it = self._tracked(it)
+        return it
 
     def _fetch(self, indices):
         samples = [self.dataset[i] for i in indices]

@@ -103,7 +103,12 @@ def health_doc() -> Tuple[int, Dict[str, Any]]:
     if stall_ms > 0 and hb_age is not None and hb_age > stall_ms:
         reasons.append("stalled")
     tripped = _sentinel.tripped()
-    if tripped:
+    # straggler[<node>] keys are latched by the FLEET detector (this
+    # worker measurably slower than the fleet median) — same degraded
+    # semantics, distinct reason so operators see WHICH defense fired
+    if any(k.startswith("straggler[") for k in tripped):
+        reasons.append("straggler")
+    if any(not k.startswith("straggler[") for k in tripped):
         reasons.append("perf_regression")
     engs = engines()
     eng_health = {str(getattr(e, "_uid", i)): e.health
@@ -112,7 +117,7 @@ def health_doc() -> Tuple[int, Dict[str, Any]]:
         reasons.append("engines_dead")
     if not reasons:
         status = "ok"
-    elif reasons == ["perf_regression"]:
+    elif all(r in ("perf_regression", "straggler") for r in reasons):
         status = "degraded"  # still alive — but measurably slower
     else:
         status = "unhealthy"
@@ -210,6 +215,22 @@ def statusz_text() -> str:
             f"stall_ms = {round(c.get('ckpt_pipeline_stall_ms', 0.0), 2)}\n")
     except Exception as e:
         out.append(f"  <checkpoint counters unavailable: {e!r}>\n")
+    try:
+        from ..distributed.fleet import elastic as _elastic
+
+        rows = _elastic.state()
+        if rows:
+            out.append(_section("elastic rescale"))
+            for r in rows:
+                out.append(
+                    f"  {r['node']}: epoch={r['epoch']} world={r['world']} "
+                    f"rank={r['rank']} accum={r['accumulation_factor']} "
+                    f"rescales={r['rescales']} fallbacks={r['fallbacks']} "
+                    f"evicted={r['evicted']} "
+                    f"last_committed={r['last_committed']} "
+                    f"last_event={r['last_event']}\n")
+    except Exception as e:
+        out.append(f"  <elastic state unavailable: {e!r}>\n")
     try:
         out.append(_section("perf-regression sentinel"))
         st = _sentinel.state()

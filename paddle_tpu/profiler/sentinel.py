@@ -55,12 +55,14 @@ from ..core import flags as _flags
 
 __all__ = [
     "PerfSentinel",
+    "clear_external",
     "default_sentinel",
     "lap",
     "observe",
     "reset",
     "retire",
     "state",
+    "trip_external",
     "tripped",
 ]
 
@@ -318,6 +320,34 @@ class PerfSentinel:
             "keys": keys,
         }
 
+    # -- externally driven keys (fleet straggler detector) ----------------
+    def trip_external(self, key: str, **attrs):
+        """Latch `key` tripped on behalf of an EXTERNAL detector (the
+        fleet StragglerDetector compares this worker against the fleet
+        median — a judgment no in-process EMA can make). The key degrades
+        /healthz like any sentinel trip and stays latched until
+        clear_external / retire. Idempotent while latched."""
+        with self._lock:
+            st = self._state_locked(key)
+            if st.tripped:
+                return
+            st.tripped = True
+            st.trips += 1
+        self._report("trip", key, float(attrs.get("drift_pct", 0.0)),
+                     self._states[key])
+
+    def clear_external(self, key: str):
+        """Clear an externally tripped key (the detector observed the
+        worker back under the fleet threshold)."""
+        with self._lock:
+            st = self._states.get(key)
+            if st is None or not st.tripped:
+                return
+            st.tripped = False
+            st.breach = 0
+            st.clear_streak = 0
+        self._report("clear", key, 0.0, st)
+
     def retire(self, prefix: str):
         """Drop every key starting with ``prefix`` (Engine.close retires
         its ``serve_decode[<uid>:``/``serve_queue_wait[<uid>]`` keys). A
@@ -368,6 +398,14 @@ def tripped() -> List[str]:
 
 def state() -> Dict[str, Any]:
     return _default.state()
+
+
+def trip_external(key: str, **attrs):
+    _default.trip_external(key, **attrs)
+
+
+def clear_external(key: str):
+    _default.clear_external(key)
 
 
 def retire(prefix: str):

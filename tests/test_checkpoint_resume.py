@@ -359,7 +359,8 @@ def test_snapshot_overlap_bitwise_boundary_state(tmp_path, monkeypatch):
         step()  # step k: the boundary state to snapshot
         state = training_state(net, opt)
         state.refresh()
-        boundary = {k: np.asarray(v._value).copy() for k, v in state.items()}
+        boundary = {k: np.asarray(getattr(v, "_value", v)).copy()
+                    for k, v in state.items()}
         ck = AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
         ck.save(0, state)  # async: persist overlaps the next steps
         for _ in range(3):  # steps k+1..k+3 mutate/donate the live buffers
@@ -371,7 +372,9 @@ def test_snapshot_overlap_bitwise_boundary_state(tmp_path, monkeypatch):
         # the live state moved on...
         state.refresh()
         moved = any(
-            not np.array_equal(np.asarray(state[k]._value), boundary[k])
+            not np.array_equal(
+                np.asarray(getattr(state[k], "_value", state[k])),
+                boundary[k])
             for k in boundary
         )
         assert moved
@@ -385,7 +388,8 @@ def test_snapshot_overlap_bitwise_boundary_state(tmp_path, monkeypatch):
         restore_training_state(state2, optimizer=opt2)
         state2.refresh()
         for k, v in boundary.items():
-            np.testing.assert_array_equal(np.asarray(state2[k]._value), v)
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state2[k], "_value", state2[k])), v)
     finally:
         lazy.flush_if_pending("test_teardown")
         lazy.drain_async()
@@ -721,9 +725,113 @@ def test_chaos_fleet_probe_cli():
     fault-free run."""
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_fleet_probe.py"),
-         "--np", "2", "--steps", "16"],
+         "--np", "2", "--steps", "16", "--scenario", "fleet"],
         capture_output=True, text=True, timeout=540,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
     )
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "ALL SCENARIOS PASSED" in out.stdout
+
+
+@pytest.mark.slow
+def test_chaos_fleet_probe_elastic_cli():
+    """The elastic-rescale chaos gate (ISSUE 14 acceptance): shrink ends
+    with survivor params+moments bitwise-identical to a fault-free run at
+    matched global batch with ZERO whole-pod restarts; grow re-expands
+    within one epoch bump with rebalanced accumulation factors; a slowed
+    worker is detected against the fleet median and evicted through the
+    same shrink path within the sustain window. Exits nonzero on any
+    violation."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_fleet_probe.py"),
+         "--np", "2", "--steps", "18", "--scenario", "elastic"],
+        capture_output=True, text=True, timeout=540,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "ALL SCENARIOS PASSED" in out.stdout
+    import json as _json
+
+    rows = {r["scenario"]: r for r in
+            (_json.loads(ln) for ln in out.stdout.splitlines()
+             if ln.startswith("{"))}
+    assert rows["shrink"]["survivor_starts"] == 1  # zero pod restarts
+    assert rows["shrink"]["bitwise_identical_to_matched_batch_baseline"]
+    assert rows["grow"]["re_expanded_in_one_epoch_bump"]
+    assert rows["straggler"]["detected_within_window"]
+
+
+SIGTERM_EXACTLY_ONCE_SCRIPT = textwrap.dedent(
+    """
+    import os, signal, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, sys.argv[4])
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.checkpoint as ckmod
+    ckmod._HAS_ORBAX = False
+    from paddle_tpu.distributed.checkpoint import (
+        AsyncCheckpointer, train_step_range, training_state)
+    from paddle_tpu.io import GlobalStepSampler
+    from paddle_tpu.resilience import PreemptionGuard
+
+    ckdir, consumed_log, kill_at = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    paddle.seed(7)
+    net = paddle.nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    # 96 samples, G=8 -> 12 steps/epoch; 24 steps = exactly 2 epochs
+    sampler = GlobalStepSampler(96, 8, microbatch_size=8, seed=3)
+    X = np.random.default_rng(0).standard_normal((96, 8)).astype(np.float32)
+    ck = AsyncCheckpointer(ckdir)
+    state = training_state(net, opt, data=sampler)
+    for step in train_step_range(24, ck, state, save_freq=1,
+                                 guard=PreemptionGuard(), optimizer=opt,
+                                 data=sampler):
+        ids = sampler.local_ids(step)
+        sampler.cursor = step + 1
+        with open(consumed_log, "a") as f:
+            f.write(f"{step} " + " ".join(map(str, ids)) + "\\n")
+        x = paddle.to_tensor(X[ids])
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step == kill_at:
+            # the guard latches; the step FINISHES, emergency-saves at the
+            # boundary (iterator state included), then raises Preempted
+            os.kill(os.getpid(), signal.SIGTERM)
+    """
+)
+
+
+@pytest.mark.slow
+def test_sigterm_resume_consumes_each_sample_exactly_once(tmp_path):
+    """ISSUE 14 acceptance: a SIGTERM'd-and-resumed single-process run
+    consumes every sample exactly once — the data-iterator state (epoch,
+    global-step cursor) rides the two-phase commit, so the relaunch
+    continues the stream where the emergency save cut it instead of
+    re-reading the epoch from the top."""
+    script = tmp_path / "run.py"
+    script.write_text(SIGTERM_EXACTLY_ONCE_SCRIPT)
+    ckdir = str(tmp_path / "ck")
+    consumed = str(tmp_path / "consumed.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    first = subprocess.run(
+        [sys.executable, str(script), ckdir, consumed, "7", REPO],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert first.returncode == 128 + 15, (first.returncode, first.stderr)
+    second = subprocess.run(
+        [sys.executable, str(script), ckdir, consumed, "-1", REPO],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert second.returncode == 0, (second.returncode, second.stderr)
+
+    lines = [ln.split() for ln in open(consumed).read().splitlines()]
+    steps = [int(ln[0]) for ln in lines]
+    # resume continued at step 8 — nothing replayed, nothing skipped
+    assert steps == list(range(24)), steps
+    for epoch in range(2):
+        ids = [int(tok) for ln in lines[epoch * 12:(epoch + 1) * 12]
+               for tok in ln[1:]]
+        assert sorted(ids) == list(range(96))  # exactly once per epoch

@@ -1,0 +1,910 @@
+"""Elastic rescale (ISSUE 14): membership-epoch barrier protocol,
+deterministic resharding, straggler defense, iterator-state checkpoints.
+
+Fast tests drive the RescaleCoordinator over the in-memory KV double
+(MemoryKv — same lease semantics as the TCP master); the real wire path
+plus the bitwise shrink/grow/straggler guarantees are gated by the slow
+chaos probe (tools/chaos_fleet_probe.py --scenario elastic, wired in
+test_checkpoint_resume.py).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (
+    LateJoiner,
+    RescaleCoordinator,
+    RescaleFallback,
+    WorldView,
+    deterministic_tree_sum,
+)
+from paddle_tpu.distributed.fleet.obs import (
+    MemoryKv,
+    ObsPublisher,
+    StragglerDetector,
+)
+from paddle_tpu.io import DistributedBatchSampler, GlobalStepSampler
+
+
+def _coord(kv, node, **kw):
+    kw.setdefault("np_min", 1)
+    kw.setdefault("np_max", 8)
+    kw.setdefault("poll_interval", 0.005)
+    kw.setdefault("barrier_timeout_s", 5.0)
+    kw.setdefault("debounce", 1)
+    return RescaleCoordinator(kv=kv, job_id="jt", node_id=node, **kw)
+
+
+def _form_pair(kv):
+    a, b = _coord(kv, "A"), _coord(kv, "B")
+    a.register()
+    b.register()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(a=a.form(expected=2)))
+    t.start()
+    vb = b.form(expected=2)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    return a, b, out["a"], vb
+
+
+# ---------------------------------------------------------------------------
+# epoch-barrier protocol
+# ---------------------------------------------------------------------------
+def test_formation_barrier_assigns_ranks_and_epoch():
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    assert va.epoch == vb.epoch >= 1
+    assert va.members == vb.members == ("A", "B")
+    assert (va.rank, vb.rank) == (0, 1)
+    assert va.world == vb.world == 2
+
+
+def test_epoch_bump_ordering_is_monotonic_across_rescales():
+    """Every installed epoch strictly exceeds the previous one, across a
+    shrink, a grow, and a second shrink — the epoch is the fleet's
+    monotonic membership clock."""
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    epochs = [va.epoch]
+    # shrink: B's lease expires
+    kv.kv_del("elastic/jt/B")
+    ev = a.poll()
+    assert ev is not None and ev.kind == "shrink" and ev.new.world == 1
+    epochs.append(ev.new.epoch)
+    # grow: B rejoins — survivors barrier on ITS proposed epoch
+    b2 = _coord(kv, "B")
+    out = {}
+    t = threading.Thread(target=lambda: out.update(v=b2.join(timeout=5)))
+    t.start()
+    grow = None
+    deadline = time.time() + 5
+    while grow is None and time.time() < deadline:
+        a.heartbeat()
+        grow = a.poll()
+    t.join(timeout=5)
+    assert grow is not None and grow.kind == "grow" and grow.new.world == 2
+    assert out["v"].epoch == grow.new.epoch
+    epochs.append(grow.new.epoch)
+    # second shrink
+    kv.kv_del("elastic/jt/B")
+    ev2 = a.poll()
+    assert ev2 is not None and ev2.kind == "shrink"
+    epochs.append(ev2.new.epoch)
+    assert epochs == sorted(set(epochs)), epochs  # strictly increasing
+
+
+def test_racing_proposers_converge_on_one_epoch():
+    """Both survivors observe the same death and propose concurrently:
+    they must land on the SAME epoch and member list (idempotent bump),
+    not two competing barriers."""
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    c = _coord(kv, "C")
+    out = {}
+    t = threading.Thread(target=lambda: out.update(v=c.join(timeout=5)))
+    t.start()
+    evs = {}
+
+    def poll_until(name, coord):
+        deadline = time.time() + 5
+        while name not in evs and time.time() < deadline:
+            ev = coord.poll()  # blocks in the barrier once the bump lands
+            if ev is not None:
+                evs[name] = ev
+            coord.heartbeat()
+    pollers = [threading.Thread(target=poll_until, args=(n, co))
+               for n, co in (("a", a), ("b", b))]
+    for p in pollers:
+        p.start()
+    for p in pollers:
+        p.join(timeout=10)
+    t.join(timeout=5)
+    assert set(evs) == {"a", "b"}
+    assert evs["a"].new.epoch == evs["b"].new.epoch == out["v"].epoch
+    assert evs["a"].new.members == ("A", "B", "C")
+
+
+def test_late_joiner_rejected_mid_barrier():
+    """A node registering while an epoch's member snapshot is already
+    published must NOT join that barrier — it raises LateJoiner and gets
+    a follow-up epoch that includes it."""
+    kv = MemoryKv()
+    a = _coord(kv, "A")
+    a.register()
+    va = a.form(expected=1)
+    # a barrier document for the NEXT epoch that does not include C
+    kv.kv_put("elastic-epoch/jt",
+              '{"epoch": %d, "members": ["A"]}' % (va.epoch + 1))
+    c = _coord(kv, "C")
+    c.register()
+    c.view = WorldView(va.epoch, ["A", "C"], "C")  # pretend C was a member
+    with pytest.raises(LateJoiner):
+        c._barrier_and_install(
+            {"epoch": va.epoch + 1, "members": ["A"]},
+            time.monotonic() + 2)
+    # the documented recovery: join() proposes an epoch that includes C
+    out = {}
+    t = threading.Thread(target=lambda: out.update(v=c.join(timeout=5)))
+    t.start()
+    ev = None
+    deadline = time.time() + 5
+    while ev is None and time.time() < deadline:
+        ev = a.poll()
+    t.join(timeout=5)
+    assert ev is not None and "C" in ev.new.members
+    assert out["v"].epoch == ev.new.epoch
+
+
+class _DeadKv:
+    """KV double whose every verb raises ConnectionError after `alive_for`
+    calls — the master dying mid-rescale."""
+
+    def __init__(self, inner, alive_for=0):
+        self._inner = inner
+        self._budget = alive_for
+
+    def _gate(self):
+        if self._budget <= 0:
+            raise ConnectionError("master unreachable")
+        self._budget -= 1
+
+    def kv_put(self, *a):
+        self._gate()
+        return self._inner.kv_put(*a)
+
+    def kv_get(self, *a):
+        self._gate()
+        return self._inner.kv_get(*a)
+
+    def kv_lease(self, *a):
+        self._gate()
+        return self._inner.kv_lease(*a)
+
+    def kv_del(self, *a):
+        self._gate()
+        return self._inner.kv_del(*a)
+
+    def kv_alive(self, *a):
+        self._gate()
+        return self._inner.kv_alive(*a)
+
+
+def test_master_outage_during_rescale_falls_back_never_hangs():
+    """The master dies mid-barrier: the coordinator must raise
+    RescaleFallback within the deadline (whole-pod restart escalation),
+    never hang — and a transient outage outside a barrier fails soft."""
+    inner = MemoryKv()
+    kv = _DeadKv(inner, alive_for=1000)
+    a = _coord(kv, "A", barrier_timeout_s=0.5)
+    a.register()
+    a.form(expected=1)
+    # outage outside a barrier: poll fails SOFT
+    kv._budget = 0
+    assert a.poll() is None
+    # outage mid-barrier: deadline-bounded fallback
+    t0 = time.monotonic()
+    with pytest.raises(RescaleFallback):
+        a._barrier_and_install({"epoch": a.view.epoch + 1,
+                                "members": ["A", "GHOST"]},
+                               time.monotonic() + 0.5)
+    assert time.monotonic() - t0 < 5.0
+    assert a.fallbacks >= 1
+
+
+def test_world_outside_np_bounds_escalates():
+    kv = MemoryKv()
+    a = _coord(kv, "A", np_min=2, np_max=4, debounce=1)
+    b = _coord(kv, "B", np_min=2, np_max=4, debounce=1)
+    a.register()
+    b.register()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(v=a.form(expected=2)))
+    t.start()
+    b.form(expected=2)
+    t.join(timeout=10)
+    kv.kv_del("elastic/jt/B")  # world would shrink to 1 < np_min
+    with pytest.raises(RescaleFallback):
+        for _ in range(5):
+            a.poll()
+
+
+def test_evicted_node_poll_raises_late_joiner():
+    """A node that finds itself excluded from a newer epoch (evicted) gets
+    LateJoiner from poll — the rejoin-or-exit decision is the caller's."""
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    kv.kv_put("elastic-epoch/jt",
+              '{"epoch": %d, "members": ["A"]}' % (vb.epoch + 1))
+    with pytest.raises(LateJoiner):
+        b.poll()
+
+
+# ---------------------------------------------------------------------------
+# deterministic resharding
+# ---------------------------------------------------------------------------
+def test_global_step_sampler_pure_and_disjoint_across_worlds():
+    mk = lambda rank, world: GlobalStepSampler(
+        103, 16, microbatch_size=4, seed=7, rank=rank, world=world)
+    s1 = mk(0, 1)
+    for step in (0, 3, 11, 29):
+        ids = s1.global_ids(step)
+        # identical on every instance, any world — a pure function
+        assert np.array_equal(mk(1, 2).global_ids(step), ids)
+        # the world split covers the global set disjointly, in order
+        got = mk(0, 2).local_ids(step) + mk(1, 2).local_ids(step)
+        assert got == ids.tolist()
+        got4 = sum((mk(r, 4).local_ids(step) for r in range(4)), [])
+        assert got4 == ids.tolist()
+
+
+def test_global_step_sampler_excludes_pad_set():
+    """ISSUE 14 satellite: the DistributedBatchSampler pads an epoch with
+    wrapped duplicates; the global-step-indexed stream must exclude them —
+    no sample id appears twice in one epoch, under ANY world."""
+    n = 103  # not divisible: 6 steps of 16 consumed, 7-sample tail dropped
+    s = GlobalStepSampler(n, 16, microbatch_size=4, seed=1)
+    for epoch in range(3):
+        ids = np.concatenate(
+            [s.global_ids(epoch * s.steps_per_epoch + k)
+             for k in range(s.steps_per_epoch)])
+        assert len(ids) == len(set(ids.tolist()))  # exactly-once
+        assert ids.max() < n  # never a wrapped pad id
+    # the pad set the DistributedBatchSampler WOULD use is nonempty here —
+    # proving the exclusion is meaningful, not vacuous
+    d = DistributedBatchSampler(list(range(n)), batch_size=4,
+                                num_replicas=2, rank=0, shuffle=True)
+    assert len(d.epoch_pad_ids()) == 1
+
+
+def test_global_step_sampler_accumulation_compensation():
+    s = GlobalStepSampler(128, 16, microbatch_size=4, seed=0, rank=0,
+                          world=4)
+    assert s.accumulation_factor == 1
+    s.set_world(0, 2)
+    assert s.accumulation_factor == 2  # shrink: k doubles
+    s.set_world(0, 1)
+    assert s.accumulation_factor == 4  # shrink again
+    ids = s.global_ids(5)
+    mbs = s.microbatches(5)
+    assert len(mbs) == 4 and np.concatenate(mbs).tolist() == ids.tolist()
+    with pytest.raises(ValueError):
+        s.set_world(0, 3)  # not a power of two
+    with pytest.raises(ValueError):
+        GlobalStepSampler(128, 24, microbatch_size=4)  # 6 microbatches
+
+
+def test_tree_sum_association_is_world_invariant():
+    rng = np.random.default_rng(0)
+    mbs = [rng.standard_normal(7).astype(np.float32) for _ in range(8)]
+    full = deterministic_tree_sum(mbs)
+    for world in (1, 2, 4, 8):
+        blk = len(mbs) // world
+        parts = [deterministic_tree_sum(mbs[r * blk:(r + 1) * blk])
+                 for r in range(world)]
+        assert np.array_equal(deterministic_tree_sum(parts), full), world
+
+
+def test_global_step_sampler_iter_and_state_roundtrip():
+    s = GlobalStepSampler(96, 8, microbatch_size=8, seed=3)
+    first = list(iter(s))  # one epoch of 12 steps
+    assert len(first) == 12 and s.cursor == 12
+    s2 = GlobalStepSampler(96, 8, microbatch_size=8, seed=0)
+    s2.load_state_dict(s.state_dict())
+    assert s2.seed == 3 and s2.cursor == 12
+    with pytest.raises(ValueError):
+        GlobalStepSampler(96, 16, microbatch_size=8).load_state_dict(
+            s.state_dict())  # mismatched stream geometry refuses
+
+
+def test_distributed_batch_sampler_cursor_resume_and_set_world():
+    mk = lambda: DistributedBatchSampler(list(range(10)), batch_size=2,
+                                         num_replicas=3, rank=0,
+                                         shuffle=True)
+    d = mk()
+    it = iter(d)
+    first = next(it)
+    assert d.state_dict() == {"epoch": 0, "cursor": 1}
+    resumed = mk()
+    resumed.load_state_dict(d.state_dict())
+    assert [first] + list(iter(resumed)) == list(iter(mk()))
+    # rescale fix-up recomputes the shard geometry in place
+    d2 = mk()
+    d2.set_world(0, 2)
+    assert d2.nranks == 2 and d2.total_size == 10
+    assert d2.epoch_pad_ids() == []
+    with pytest.raises(ValueError):
+        d2.set_world(5, 2)
+
+
+# ---------------------------------------------------------------------------
+# straggler defense
+# ---------------------------------------------------------------------------
+def _publish_fleet(kv, step_ms_by_node, steps=6):
+    pubs = {n: ObsPublisher(kv=kv, job_id="jt", node_id=n)
+            for n in step_ms_by_node}
+    for i in range(steps):
+        for n, p in pubs.items():
+            p.note_step(i, step_ms_by_node[n])
+            p.publish()
+    return pubs
+
+
+def test_straggler_detector_trips_on_sustained_median_breach():
+    from paddle_tpu.profiler import sentinel
+
+    sentinel.reset()
+    kv = MemoryKv()
+    pubs = _publish_fleet(kv, {"F": 10.0, "S": 100.0})
+    det = StragglerDetector(pubs["S"], pct=50.0, sustain=3, evict=False)
+    trips = [det.check() for _ in range(4)]
+    trip = next(t for t in trips if t)
+    assert trip["node"] == "S" and trip["ratio"] > 1.5
+    assert trips[0] is None and trips[1] is None  # sustain, not one-shot
+    assert "straggler[S]" in sentinel.tripped()
+    # the fast worker never trips
+    fast = StragglerDetector(pubs["F"], pct=50.0, sustain=3)
+    assert all(fast.check() is None for _ in range(6))
+    # recovery clears the latch
+    for i in range(20):
+        pubs["S"].note_step(10 + i, 10.0)
+        pubs["S"].publish()
+    for _ in range(3):
+        det.check()
+    assert "straggler[S]" not in sentinel.tripped()
+    sentinel.reset()
+
+
+def test_straggler_trip_degrades_healthz():
+    from paddle_tpu.profiler import diag, sentinel
+
+    sentinel.reset()
+    try:
+        kv = MemoryKv()
+        pubs = _publish_fleet(kv, {"F": 10.0, "S": 100.0})
+        det = StragglerDetector(pubs["S"], pct=50.0, sustain=1)
+        assert det.check() is not None
+        code, doc = diag.health_doc()
+        assert code == 503
+        assert doc["status"] == "degraded"
+        assert "straggler" in doc["reasons"]
+    finally:
+        sentinel.reset()
+
+
+def test_straggler_eviction_goes_through_shrink_path():
+    from paddle_tpu.profiler import sentinel
+
+    sentinel.reset()
+    try:
+        kv = MemoryKv()
+        a = _coord(kv, "F")
+        s = _coord(kv, "S")
+        a.register()
+        s.register()
+        out = {}
+        t = threading.Thread(target=lambda: out.update(v=a.form(expected=2)))
+        t.start()
+        s.form(expected=2)
+        t.join(timeout=10)
+        pubs = _publish_fleet(kv, {"F": 10.0, "S": 100.0})
+        det = StragglerDetector(pubs["S"], coordinator=s, pct=50.0,
+                                sustain=1, evict=True)
+        assert det.check() is not None
+        assert det.evicted and s.evicted
+        # the straggler's lease is gone -> survivors shrink in place
+        ev = a.poll()
+        assert ev is not None and ev.kind == "shrink"
+        assert ev.new.members == ("F",)
+    finally:
+        sentinel.reset()
+
+
+def test_obs_payload_carries_elastic_columns():
+    kv = MemoryKv()
+    pub = ObsPublisher(kv=kv, job_id="jt", node_id="N")
+    pub.note_step(7, 12.5, epoch=3, accum=2)
+    doc = pub.snapshot()
+    e = doc["elastic"]
+    assert e["step"] == 7 and e["epoch"] == 3 and e["accum"] == 2
+    assert e["step_ms"] == 12.5 and e["step_lag_ms"] >= 0
+    # the aggregator's health rows surface them (fleet_top columns)
+    from paddle_tpu.distributed.fleet.obs import FleetAggregator
+
+    pub.publish()
+    rows = FleetAggregator(kv=kv, job_id="jt").fleet_health()
+    row = next(r for r in rows if r["node"] == "N")
+    assert row["epoch"] == 3 and row["accum"] == 2
+    assert row["step_lag_ms"] is not None
+
+
+# ---------------------------------------------------------------------------
+# iterator-state checkpoints (fast path; the SIGTERM subprocess test lives
+# in test_checkpoint_resume.py)
+# ---------------------------------------------------------------------------
+def test_training_state_packs_and_restores_data_blob(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.checkpoint as ckmod
+    from paddle_tpu.distributed.checkpoint import (
+        AsyncCheckpointer,
+        restore_training_state,
+        training_state,
+    )
+
+    prev = ckmod._HAS_ORBAX
+    ckmod._HAS_ORBAX = False
+    try:
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        sampler = GlobalStepSampler(64, 8, microbatch_size=4, seed=11)
+        sampler.cursor = 5
+        ck = AsyncCheckpointer(str(tmp_path))
+        state = training_state(net, opt, data=sampler)
+        ck.save(4, state, blocking=True)
+
+        sampler2 = GlobalStepSampler(64, 8, microbatch_size=4, seed=0)
+        net2 = paddle.nn.Linear(4, 2)
+        opt2 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                     parameters=net2.parameters())
+        state2 = training_state(net2, opt2, data=sampler2)
+        got = ck.restore_latest(state2)
+        assert got == 4
+        restore_training_state(state2, optimizer=opt2, data=sampler2)
+        assert sampler2.seed == 11 and sampler2.cursor == 5
+    finally:
+        ckmod._HAS_ORBAX = prev
+
+
+def test_dataloader_state_roundtrip_covers_rng():
+    from paddle_tpu.core import random as prandom
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    import paddle_tpu as paddle
+
+    ds = TensorDataset([paddle.to_tensor(np.arange(8, dtype=np.float32))])
+    loader = DataLoader(ds, batch_size=2)
+    prandom.seed(1234)
+    st = loader.state_dict()
+    assert st["rng"][0] == 1234
+    prandom.seed(999)
+    loader.load_state_dict(st)
+    assert prandom.get_rng_state()[0] == 1234
+
+
+def test_model_fit_resumes_from_save_dir(tmp_path):
+    """hapi satellite: a second fit() over the same save_dir continues at
+    the next epoch with restored params/moments/RNG — the final state is
+    bitwise the one an uninterrupted run produces."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.checkpoint as ckmod
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+
+    prev = ckmod._HAS_ORBAX
+    ckmod._HAS_ORBAX = False
+    try:
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 4)).astype(np.float32)
+        Y = rng.standard_normal((32, 2)).astype(np.float32)
+        ds = [(X[i], Y[i]) for i in range(32)]
+
+        def run(save_dir, epochs):
+            paddle.seed(0)
+            m = Model(nn.Linear(4, 2))
+            m.prepare(
+                paddle.optimizer.Adam(learning_rate=1e-2,
+                                      parameters=m.network.parameters()),
+                paddle.nn.MSELoss())
+            m.fit(ds, batch_size=8, epochs=epochs, save_dir=save_dir,
+                  verbose=0)
+            return m.network.weight.numpy().copy()
+
+        d1 = str(tmp_path / "resumed")
+        run(d1, 2)           # interrupted after 2 epochs
+        w_resumed = run(d1, 4)   # continues at epoch 2
+        w_straight = run(str(tmp_path / "straight"), 4)
+        np.testing.assert_array_equal(w_resumed, w_straight)
+    finally:
+        ckmod._HAS_ORBAX = prev
+
+
+# ---------------------------------------------------------------------------
+# wiring: manager hook, statusz, flags
+# ---------------------------------------------------------------------------
+def test_elastic_manager_on_rescale_inplace_path(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    deploys = []
+
+    class FakePod:
+        def __init__(self):
+            self.containers = [self]
+            deploys.append(1)
+            self.exit_code = None
+
+        def deploy(self):
+            pass
+
+        def stop(self):
+            self.exit_code = 0
+
+    rescales = []
+
+    def on_rescale(members):
+        rescales.append(list(members))
+        return True
+
+    m = ElasticManager(FakePod, job_id="j2", registry_dir=str(tmp_path),
+                       np_min=1, np_max=2, watch_interval=0.01,
+                       on_rescale=on_rescale)
+    m._node_id = "hostA"
+    m.register()
+    other = ElasticManager(FakePod, job_id="j2",
+                           registry_dir=str(tmp_path))
+    other._node_id = "hostB"
+    other.register()
+    m.launch()
+    pods_before = len(deploys)
+
+    def finish():
+        time.sleep(0.15)
+        other.deregister()  # membership change mid-watch
+        time.sleep(0.3)
+        for c in m.pod.containers:
+            c.exit_code = 0
+
+    t = threading.Thread(target=finish)
+    t.start()
+    rc = m.watch(timeout=10)
+    t.join()
+    assert rc == 0
+    assert rescales and rescales[-1] == ["hostA"]
+    assert m.inplace_rescales >= 1
+    assert len(deploys) == pods_before  # NO whole-pod rebuild happened
+
+
+def test_statusz_renders_elastic_section():
+    from paddle_tpu.profiler import diag
+
+    kv = MemoryKv()
+    c = _coord(kv, "Z")
+    c.register()
+    c.form(expected=1)
+    txt = diag.statusz_text()
+    assert "elastic rescale" in txt
+    assert "Z: epoch=" in txt
+
+
+def test_elastic_flags_documented():
+    from paddle_tpu.core.flags import describe_flags
+
+    docs = describe_flags("elastic")
+    names = {d["name"] for d in docs}
+    for name in ("elastic_barrier_timeout_s", "elastic_rescale_debounce",
+                 "elastic_straggler_pct", "elastic_straggler_sustain",
+                 "elastic_straggler_evict"):
+        assert "FLAGS_" + name in names, name
+        entry = next(d for d in docs if d["name"] == "FLAGS_" + name)
+        assert entry["doc"], name
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+def test_evict_self_latch_survives_poll_and_clears_on_join():
+    """An evicted node's next poll()/heartbeat() must NOT re-lease the
+    deleted member key (that would undo the shrink before survivors'
+    debounce ever observed it); join() is the one deliberate way back."""
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    b.evict_self(reason="test")
+    assert b.poll() is None
+    b.heartbeat()
+    assert "elastic/jt/B" not in kv.kv_alive("elastic/jt/")
+    ev = a.poll()
+    assert ev is not None and ev.kind == "shrink" and ev.new.world == 1
+    # deliberate rejoin lifts the latch and re-registers
+    out = {}
+    t = threading.Thread(target=lambda: out.update(v=b.join(timeout=5)))
+    t.start()
+    deadline = time.monotonic() + 5
+    grow = None
+    while grow is None and time.monotonic() < deadline:
+        grow = a.poll()
+        time.sleep(0.005)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert not b.evicted
+    assert grow is not None and grow.kind == "grow"
+    assert out["v"].members == ("A", "B")
+
+
+def test_straggler_evict_without_mechanism_stays_clearable():
+    """evict=True with neither coordinator= nor on_evict= must not latch
+    `evicted` (nothing deregistered the worker) — the trip stays a plain
+    sentinel latch that recovery can clear, not a permanent 503."""
+    from paddle_tpu.profiler import sentinel
+
+    sentinel.reset()
+    try:
+        kv = MemoryKv()
+        pubs = _publish_fleet(kv, {"F": 10.0, "S": 100.0})
+        det = StragglerDetector(pubs["S"], pct=50.0, sustain=1, evict=True)
+        assert det.check() is not None
+        assert det.tripped and not det.evicted
+        assert "straggler[S]" in sentinel.tripped()
+        for i in range(20):
+            pubs["S"].note_step(10 + i, 10.0)
+            pubs["S"].publish()
+        for _ in range(3):
+            det.check()
+        assert "straggler[S]" not in sentinel.tripped()
+    finally:
+        sentinel.reset()
+
+
+def test_dataloader_cursor_tracks_consumption_not_prefetch():
+    """With prefetching workers the sampler runs ahead of training; the
+    checkpointed cursor must count batches the CALLER consumed, or a
+    resumed run skips never-trained samples."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    import paddle_tpu as paddle
+
+    n, bs = 64, 4
+    ds = TensorDataset([paddle.to_tensor(np.arange(n, dtype=np.int64))])
+    def make_loader():
+        smp = GlobalStepSampler(n, bs, seed=3, shuffle=True)
+        return DataLoader(ds, batch_sampler=smp, num_workers=2,
+                          use_thread_workers=True, prefetch_factor=2,
+                          return_numpy=True)
+
+    loader = make_loader()
+    consumed = []
+    it = iter(loader)
+    for _ in range(5):
+        (batch,) = next(it)
+        consumed.extend(np.asarray(batch).ravel().tolist())
+    state = loader.state_dict()
+    assert state["sampler"]["cursor"] == 5  # NOT 5 + prefetch depth
+    # prefetch really did run the sampler ahead (else this test is vacuous)
+    assert loader.batch_sampler.cursor > 5
+
+    loader2 = make_loader()
+    loader2.load_state_dict(state)
+    rest = []
+    for (batch,) in loader2:
+        rest.extend(np.asarray(batch).ravel().tolist())
+    assert sorted(consumed + rest) == list(range(n))  # exactly once
+
+
+def test_install_reshard_failure_escalates_not_corrupts():
+    """A world the attached sampler cannot deal (non-power-of-two) must
+    surface as RescaleFallback with the coordinator view AND sampler still
+    on the old world — not a raw ValueError with the view already bumped."""
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    smp = GlobalStepSampler(64, 16, microbatch_size=4, rank=va.rank,
+                            world=va.world)
+    a.attach_sampler(smp)
+    with pytest.raises(RescaleFallback):
+        a._install(va.epoch + 1, ["A", "B", "C"], {})
+    assert a.view.epoch == va.epoch and a.view.world == 2
+    assert smp.world == 2 and smp.rank == va.rank
+
+
+def test_join_past_np_max_never_proposes():
+    """An over-capacity joiner times out alone (RescaleFallback) without
+    writing an epoch document the survivors would have to fall back from."""
+    kv = MemoryKv()
+    a = _coord(kv, "A", np_max=2)
+    b = _coord(kv, "B", np_max=2)
+    a.register()
+    b.register()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(a=a.form(expected=2)))
+    t.start()
+    vb = b.form(expected=2)
+    t.join(timeout=10)
+    c = _coord(kv, "C", np_max=2)
+    with pytest.raises(RescaleFallback):
+        c.join(timeout=0.4)
+    doc = a._read_epoch()
+    assert doc is not None and doc["epoch"] == vb.epoch
+    assert sorted(doc["members"]) == ["A", "B"]
+
+
+def test_abandoned_iterator_rewinds_prefetch_overshoot():
+    """Breaking out of a prefetching loader mid-epoch must not leave the
+    sampler at the prefetch-advanced cursor: the next iteration (and any
+    checkpoint) resumes at the consumption point."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    import paddle_tpu as paddle
+
+    n, bs = 64, 4
+    ds = TensorDataset([paddle.to_tensor(np.arange(n, dtype=np.int64))])
+    smp = GlobalStepSampler(n, bs, seed=5, shuffle=True)
+    loader = DataLoader(ds, batch_sampler=smp, num_workers=2,
+                        use_thread_workers=True, prefetch_factor=2,
+                        return_numpy=True)
+    it = iter(loader)
+    seen = []
+    for _ in range(3):
+        (batch,) = next(it)
+        seen.extend(np.asarray(batch).ravel().tolist())
+    it.close()  # abandon mid-epoch; prefetch ran the sampler ahead
+    assert smp.cursor > 3
+    pure = GlobalStepSampler(n, bs, seed=5, shuffle=True)
+    (batch,) = next(iter(loader))  # new iteration rewinds to batch 3
+    np.testing.assert_array_equal(np.asarray(batch).ravel(),
+                                  pure.local_ids(3))
+
+
+def test_distributed_batch_sampler_world_change_resets_cursor():
+    """The per-rank batch cursor indexes a world-specific interleaving —
+    a rescale resets it rather than skipping/duplicating on the new shard."""
+    d = DistributedBatchSampler(list(range(12)), batch_size=2,
+                                num_replicas=3, rank=0, shuffle=True)
+    next(iter(d))
+    assert d.state_dict()["cursor"] == 1
+    d.set_world(0, 2)
+    assert d.state_dict() == {"epoch": 0, "cursor": 0}
+
+
+def test_same_epoch_propose_race_converges_on_stored_doc():
+    """Two proposers racing the SAME epoch number with different member
+    lists must converge on the stored (last-written) document — the loser
+    adopts it instead of installing a divergent WorldView (split-brain)."""
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    # simulate a lost race: A proposes epoch E+1 with a 3-member list,
+    # then the store is overwritten at the SAME epoch with {A, B} (the
+    # competitor's propose landed last)
+    import json as _json
+
+    from paddle_tpu.distributed.fleet.elastic import _epoch_key
+
+    won = {"epoch": va.epoch + 1, "members": ["A", "B"]}
+    kv.kv_put(_epoch_key("jt"), _json.dumps(won))
+    out = {}
+    tb = threading.Thread(target=lambda: out.update(b=b.poll()))
+    tb.start()
+    ev = a.poll()  # adopts the stored doc, barriers with B
+    tb.join(timeout=10)
+    assert not tb.is_alive()
+    assert ev is not None and a.view.members == ("A", "B")
+    assert a.view.epoch == won["epoch"]
+    assert b.view.members == ("A", "B") and b.view.epoch == won["epoch"]
+
+
+def test_fully_prefetched_abandoned_epoch_still_rewinds():
+    """When the prefetch window covers the WHOLE epoch the sampler's
+    epilogue resets its cursor to 0; an abandoned iteration must still
+    rewind to the consumption point, not replay the epoch head."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    import paddle_tpu as paddle
+
+    n, bs = 20, 2  # 10 batches; prefetch window 4*3 >= 10 drains it all
+    ds = TensorDataset([paddle.to_tensor(np.arange(n, dtype=np.int64))])
+    smp = DistributedBatchSampler(list(range(n)), batch_size=bs,
+                                  num_replicas=1, rank=0, shuffle=True)
+    loader = DataLoader(ds, batch_sampler=smp, num_workers=4,
+                        use_thread_workers=True, prefetch_factor=3,
+                        return_numpy=True)
+    it = iter(loader)
+    seen = []
+    for _ in range(3):
+        (batch,) = next(it)
+        seen.extend(np.asarray(batch).ravel().tolist())
+    time.sleep(0.3)  # let the prefetchers drain (and wrap) the sampler
+    assert smp.state_dict()["cursor"] == 0  # the epilogue reset fired
+    it.close()
+    rest = []
+    for (batch,) in loader:
+        rest.extend(np.asarray(batch).ravel().tolist())
+    assert len(rest) == n - len(seen)
+    assert sorted(seen + rest) == list(range(n))  # no replay, no skips
+
+
+def test_barrier_wait_keeps_member_lease_fresh():
+    """A barrier that outlasts heartbeat_ttl must keep refreshing the
+    node's MEMBER lease — an installed world whose waiters' leases all
+    expired would be torn down again by the first drift poll."""
+    kv = MemoryKv()
+    a = _coord(kv, "A", heartbeat_ttl=0.15, barrier_timeout_s=2.0)
+    b = _coord(kv, "B", heartbeat_ttl=0.15, barrier_timeout_s=2.0)
+    a.register()
+    b.register()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(a=a.form(expected=2)))
+    t.start()
+    time.sleep(0.5)  # A waits in the barrier >> ttl before B arrives
+    assert "elastic/jt/A" in kv.kv_alive("elastic/jt/")  # lease stayed fresh
+    vb = b.form(expected=2)
+    t.join(timeout=10)
+    assert not t.is_alive() and vb.world == 2
+    assert sorted(kv.kv_alive("elastic/jt/")) == ["elastic/jt/A",
+                                                  "elastic/jt/B"]
+
+
+def test_join_retries_after_mid_barrier_supersede():
+    """A joiner whose adopted barrier is superseded by a doc omitting it
+    must re-propose within its deadline, not escape with LateJoiner."""
+    import json as _json
+
+    from paddle_tpu.distributed.fleet.elastic import _epoch_key
+
+    kv = MemoryKv()
+    a, b, va, vb = _form_pair(kv)
+    # plant a stale doc that names a NEWER epoch but omits C: C's join
+    # adopts it, gets LateJoiner mid-barrier, and must fall through to
+    # proposing a follow-up epoch that includes it
+    kv.kv_put(_epoch_key("jt"),
+              _json.dumps({"epoch": va.epoch + 1, "members": ["A", "B"]}))
+    c = _coord(kv, "C")
+    out = {}
+    tc = threading.Thread(target=lambda: out.update(v=c.join(timeout=8)))
+    tc.start()
+    deadline = time.monotonic() + 8
+    while "v" not in out and time.monotonic() < deadline:
+        for surv in (a, b):
+            try:
+                surv.poll()
+            except RescaleFallback:
+                pass
+        time.sleep(0.01)
+    tc.join(timeout=8)
+    assert not tc.is_alive()
+    assert out["v"].members == ("A", "B", "C")
+
+
+def test_fully_consumed_abandoned_epoch_does_not_rewind():
+    """Breaking on the LAST batch (epoch fully consumed, generator never
+    finalized) must keep the sampler's reset state — rewinding to the
+    full count would make the next epoch yield nothing."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    import paddle_tpu as paddle
+
+    n, bs = 20, 2
+    ds = TensorDataset([paddle.to_tensor(np.arange(n, dtype=np.int64))])
+    smp = DistributedBatchSampler(list(range(n)), batch_size=bs,
+                                  num_replicas=1, rank=0, shuffle=True)
+    loader = DataLoader(ds, batch_sampler=smp, num_workers=4,
+                        use_thread_workers=True, prefetch_factor=3,
+                        return_numpy=True)
+    it = iter(loader)
+    count = 0
+    for _ in range(n // bs):  # consume EVERY batch, then break (no
+        next(it)              # StopIteration — _live_start stays set)
+        count += 1
+    it.close()
+    assert smp.state_dict() == {"epoch": 0, "cursor": 0}  # epilogue reset
+    batches = sum(1 for _ in loader)  # guard must NOT rewind cursor to 10
+    assert batches == n // bs  # full epoch again, not zero

@@ -1,5 +1,6 @@
 """Fleet-scale chaos gate: multi-process training under host kills, fleet/PS
-partitions, and lease expiry (ISSUE 8 — CheckFreq at mesh scale).
+partitions, lease expiry (ISSUE 8 — CheckFreq at mesh scale) and elastic
+in-place rescale (ISSUE 14 — shrink/grow/straggler).
 
 `chaos_probe.py` proves single-process recovery; this probe proves the
 "≤1-step loss, bitwise-identical final state" guarantee survives the faults
@@ -23,12 +24,38 @@ does its worst:
               the KV view, declares the host dead (SIGKILL), relaunches —
               same ≤1-step-loss + bitwise bound.
 
+The ELASTIC scenarios run a different worker: one LOGICAL replica trained
+data-parallel — every worker seeds the same model, a `GlobalStepSampler`
+deals each global step's microbatches to ranks, per-rank partial gradients
+are tree-summed (`deterministic_tree_sum`: fixed association, world-size
+independent) and exchanged through the shared filesystem, so the update
+trajectory is bitwise-identical for ANY power-of-two world at matched
+global batch. A `RescaleCoordinator` barriers membership epochs at step
+boundaries:
+
+  shrink      SIGKILL one worker mid-step. Survivors observe the lease
+              expiry, barrier on the epoch bump, roll back to the last
+              committed boundary (≤1 step), raise their accumulation
+              factor to hold the global batch constant, and finish
+              IN-PLACE (zero restarts) with params+moments
+              bitwise-identical to a fault-free 1-worker run at matched
+              global batch.
+  grow        the killed node rejoins (--join): one more epoch bump
+              re-expands the world, accumulation factors rebalance, the
+              joiner catches up from the most-advanced peer's checkpoint
+              — finals stay bitwise vs the matched-batch baseline.
+  straggler   one worker is artificially slowed; its own
+              StragglerDetector (fleet-median comparison over the obs
+              leases) trips within the sustain window and evicts it
+              through the same shrink path; survivors finish bitwise.
+
 Usage:
     JAX_PLATFORMS=cpu python tools/chaos_fleet_probe.py \
-        [--np 2] [--steps 20] [--scenario all|sigkill|partition|lease]
+        [--np 2] [--steps 20] \
+        [--scenario all|sigkill|partition|lease|elastic|shrink|grow|straggler]
 
-Exits nonzero on any unrecovered fault. Wired into CI as a slow-marked
-subprocess test (tests/test_checkpoint_resume.py), like serve_probe /
+Exits nonzero on any unrecovered fault. Wired into CI as slow-marked
+subprocess tests (tests/test_checkpoint_resume.py), like serve_probe /
 chaos_probe.
 """
 from __future__ import annotations
@@ -152,9 +179,247 @@ def worker_main(args):
         log(f"done {step} {lv:.9g}")
     state.refresh()
     np.savez(os.path.join(wdir, "final.npz"),
-             **{k: np.asarray(v._value) for k, v in state.items()})
+             **{k: np.asarray(v._value) for k, v in state.items()
+                if hasattr(v, "_value")})
     log("final")
     obs_pub.withdraw()
+    mgr.deregister()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Elastic worker: ONE logical replica, data-parallel over whatever world
+# exists — deterministic resharding + accumulation compensation (ISSUE 14)
+# ---------------------------------------------------------------------------
+class _Rescaled(Exception):
+    def __init__(self, event):
+        self.event = event
+
+
+def elastic_worker_main(args):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.checkpoint as ckmod
+    from paddle_tpu.distributed.checkpoint import (
+        AsyncCheckpointer,
+        restore_training_state,
+        training_state,
+    )
+    from paddle_tpu.distributed.fleet.elastic import (
+        ElasticManager,
+        RescaleCoordinator,
+        deterministic_tree_sum,
+    )
+    from paddle_tpu.distributed.fleet.obs import ObsPublisher, StragglerDetector
+    from paddle_tpu.io import GlobalStepSampler
+    from paddle_tpu.resilience import PreemptionGuard
+
+    ckmod._HAS_ORBAX = False  # the two-phase fallback commit is under test
+
+    wdir = args.dir
+    fleet_root = args.fleet_root
+    os.makedirs(wdir, exist_ok=True)
+    log_path = os.path.join(wdir, "log.txt")
+
+    def log(line):
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+
+    log(f"start {os.getpid()}")
+    mgr = ElasticManager(
+        lambda: None, job_id=args.job, master=args.master,
+        np_min=1, np_max=max(args.np, 2), heartbeat_ttl=args.ttl,
+    )
+    coord = RescaleCoordinator(mgr, poll_interval=0.02,
+                               barrier_timeout_s=20.0,
+                               debounce=2)
+    pub = ObsPublisher.from_elastic(mgr)
+    det = StragglerDetector(pub, coordinator=coord)
+
+    # deterministic workload — identical on EVERY worker: one logical
+    # replica, the data a pure function of the sample index
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+    )
+    params = list(net.parameters())
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+    drng = np.random.default_rng(1234)
+    N, G, MB = 128, 16, 4  # 4 microbatches/step, steps_per_epoch = 8
+    X = drng.standard_normal((N, 8)).astype(np.float32)
+    Y = drng.standard_normal((N, 4)).astype(np.float32)
+    sampler = GlobalStepSampler(N, G, microbatch_size=MB, seed=9)
+    M = sampler.num_microbatches
+
+    if args.join:
+        view = coord.join(timeout=30.0)
+    else:
+        view = coord.form(expected=args.np, timeout=30.0)
+    coord.attach_sampler(sampler)
+    log(f"view {view.epoch} {view.world} {view.rank} "
+        f"accum={sampler.accumulation_factor}")
+
+    ck = AsyncCheckpointer(os.path.join(wdir, "ck"), max_to_keep=3)
+    state = training_state(net, opt, data=sampler)
+    guard = PreemptionGuard()
+    guard.bind(ck, state)
+    guard.install()
+
+    def micro_grads(ids):
+        x = paddle.to_tensor(X[ids])
+        y = paddle.to_tensor(Y[ids])
+        opt.clear_grad()
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        return [np.asarray(p.grad.numpy(), dtype=np.float32).copy()
+                for p in params], float(loss)
+
+    def write_partial(epoch, step, rank, partial):
+        tag = os.path.join(fleet_root, f"g.{epoch}.{step}.{rank}.npz")
+        # np.savez appends ".npz" to names without it — keep the suffix
+        tmp = tag.replace(".npz", f".tmp{os.getpid()}.npz")
+        np.savez(tmp, **{f"p{i}": a for i, a in enumerate(partial)})
+        os.replace(tmp, tag)
+
+    def read_partial(epoch, step, rank):
+        path = os.path.join(fleet_root, f"g.{epoch}.{step}.{rank}.npz")
+        try:
+            with np.load(path) as z:
+                return [z[f"p{i}"].copy() for i in range(len(params))]
+        except (OSError, KeyError, ValueError):
+            return None  # mid-rename / not yet written
+
+    def exchange(view, step, partial):
+        """All-gather the rank partials for this (epoch, step). Polls the
+        coordinator while waiting so a peer death mid-exchange turns into
+        a rescale instead of a deadlock."""
+        write_partial(view.epoch, step, view.rank, partial)
+        deadline = time.time() + 60.0
+        got = {view.rank: partial}
+        while time.time() < deadline:
+            for r in range(view.world):
+                if r not in got:
+                    p = read_partial(view.epoch, step, r)
+                    if p is not None:
+                        got[r] = p
+            if len(got) == view.world:
+                return [got[r] for r in range(view.world)]
+            ev = coord.poll()
+            if ev is not None:
+                raise _Rescaled(ev)
+            time.sleep(0.01)
+        raise RuntimeError(f"gradient exchange timed out at step {step}")
+
+    def rollback(event):
+        """Rescale recovery: roll back to the last committed boundary
+        (≤1 step) and — when a peer is ahead (grow join) — catch up from
+        the most advanced member's checkpoint."""
+        restored = ck.restore_latest(state)
+        if restored is not None:
+            restore_training_state(state, optimizer=opt, data=sampler)
+        base = -1 if restored is None else restored
+        peer_steps = {n: s for n, s in (event.peer_steps or {}).items()
+                      if s is not None and n != coord.node_id}
+        if peer_steps:
+            peer, target = max(peer_steps.items(), key=lambda kv: kv[1])
+            if target > base:
+                pck = AsyncCheckpointer(
+                    os.path.join(fleet_root, peer, "ck"), max_to_keep=3)
+                r2 = pck.restore_latest(state)
+                if r2 is not None:
+                    restore_training_state(state, optimizer=opt,
+                                           data=sampler)
+                    base = r2
+        return base + 1
+
+    # resume (relaunch after a kill / --join): own checkpoint first, then
+    # any more-advanced peer discovered at the join barrier
+    restored = ck.restore_latest(state)
+    if restored is not None:
+        restore_training_state(state, optimizer=opt, data=sampler)
+        coord.note_commit(restored)
+        log(f"resume {restored + 1}")
+    next_step = 0 if restored is None else restored + 1
+    if args.join and coord.last_event is not None:
+        next_step = max(next_step, rollback(coord.last_event))
+        log(f"joined {next_step} world={coord.view.world} "
+            f"accum={sampler.accumulation_factor}")
+
+    while next_step < args.steps:
+        step = next_step
+        try:
+            view = coord.view
+            t0 = time.time()
+            mb_losses = []
+            mbg = []
+            for ids in sampler.microbatches(step):
+                g, lval = micro_grads(ids)
+                mbg.append(g)
+                mb_losses.append(lval)
+            partial = [deterministic_tree_sum([g[i] for g in mbg])
+                       for i in range(len(params))]
+            compute_ms = (time.time() - t0) * 1000.0
+            if view.world > 1:
+                # the exchange WAIT is excluded from this worker's step
+                # time: data-parallel steps are fleet-synchronous, so wall
+                # time is everyone's straggler-bound pace — the detector
+                # must see each worker's OWN compute cadence
+                ranks = exchange(view, step, partial)
+                total = [deterministic_tree_sum([rp[i] for rp in ranks])
+                         for i in range(len(params))]
+            else:
+                total = partial
+            t1 = time.time()
+            opt.clear_grad()
+            for p, g in zip(params, total):
+                p.grad = paddle.to_tensor(g / np.float32(M))
+            opt.step()
+            opt.clear_grad()
+            sampler.cursor = step + 1  # checkpoint the stream position
+            ck.save(step, state, blocking=True)  # durable == noteable
+            coord.note_commit(step)
+            compute_ms += (time.time() - t1) * 1000.0
+            log(f"done {step} {np.mean(mb_losses):.9g}")
+            if args.slow_after is not None and step >= args.slow_after:
+                if args.slow_after == step:
+                    log(f"slow {step}")
+                time.sleep(args.slow_ms / 1000.0)
+                compute_ms += args.slow_ms
+            if args.step_sleep:
+                time.sleep(args.step_sleep)  # scenario pacing, all workers
+            pub.note_step(step, compute_ms,
+                          epoch=view.epoch,
+                          accum=sampler.accumulation_factor)
+            pub.publish()
+            det.check()
+            if det.evicted:
+                log(f"evicted {step}")
+                break
+            guard.step_boundary(step)
+            ev = coord.poll()
+            if ev is not None:
+                raise _Rescaled(ev)
+            next_step = step + 1
+        except _Rescaled as r:
+            next_step = rollback(r.event)
+            log(f"rescale {r.event.kind} {r.event.new.epoch} "
+                f"world={r.event.new.world} rank={r.event.new.rank} "
+                f"accum={sampler.accumulation_factor} next={next_step}")
+
+    if not det.evicted:
+        state.refresh()
+        np.savez(os.path.join(wdir, "final.npz"),
+                 **{k: np.asarray(v._value) for k, v in state.items()
+                    if hasattr(v, "_value")})
+        log("final")
+    guard.uninstall()
+    pub.withdraw()
     mgr.deregister()
     return 0
 
@@ -466,12 +731,222 @@ def scenario_lease(root, master, np_, steps, baseline, results):
     return ok
 
 
+# ---------------------------------------------------------------------------
+# Elastic scenarios: in-place shrink / grow / straggler eviction (ISSUE 14)
+# ---------------------------------------------------------------------------
+def _spawn_elastic(worker_id, master, fleet_root, steps, np_, ttl, job,
+                   join=False, slow_after=None, slow_ms=0,
+                   straggler_env=None, step_sleep=0.0):
+    wdir = os.path.join(fleet_root, f"w{worker_id}")
+    cmd = [sys.executable, os.path.abspath(__file__), "--elastic-worker",
+           "--worker-id", str(worker_id), "--master", master,
+           "--dir", wdir, "--fleet-root", fleet_root,
+           "--steps", str(steps), "--np", str(np_), "--ttl", str(ttl),
+           "--job", job, "--step-sleep", str(step_sleep)]
+    if join:
+        cmd.append("--join")
+    if slow_after is not None:
+        cmd += ["--slow-after", str(slow_after), "--slow-ms", str(slow_ms)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_CURRENT_ENDPOINT=f"w{worker_id}")
+    env.update(straggler_env or {})
+    os.makedirs(wdir, exist_ok=True)
+    errlog = open(os.path.join(wdir, "stderr.txt"), "ab")
+    return subprocess.Popen(cmd, env=env, stdout=errlog, stderr=errlog)
+
+
+def _elastic_baseline(root, steps):
+    """Fault-free MATCHED-GLOBAL-BATCH reference: ONE worker, world 1 —
+    the full global batch via accumulation. The elastic contract says any
+    power-of-two world (and any shrink/grow path between them) lands
+    bitwise on this trajectory."""
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    fleet_root = os.path.join(root, "elastic-baseline")
+    os.makedirs(fleet_root, exist_ok=True)
+    try:
+        p = _spawn_elastic(0, master, fleet_root, steps, 1, ttl=1.5,
+                           job="ebase")
+        rc = p.wait(timeout=180)
+        if rc != 0:
+            raise RuntimeError(f"elastic baseline failed rc={rc}")
+        return _load_final(os.path.join(fleet_root, "w0"))
+    finally:
+        srv.stop()
+
+
+def _count_lines(lines, prefix):
+    return sum(1 for ln in lines if ln.startswith(prefix))
+
+
+def scenario_shrink(root, np_, steps, baseline, results):
+    ttl = 1.5
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    fleet_root = os.path.join(root, "shrink")
+    os.makedirs(fleet_root, exist_ok=True)
+    victim, survivor = np_ - 1, 0
+    dirs = [os.path.join(fleet_root, f"w{i}") for i in range(np_)]
+    procs = [_spawn_elastic(i, master, fleet_root, steps, np_, ttl,
+                            job="eshrink", step_sleep=0.15)
+             for i in range(np_)]
+    try:
+        _wait_done_at_least(dirs[victim], max(2, steps // 3))
+        procs[victim].send_signal(signal.SIGKILL)  # host dies mid-step
+        procs[victim].wait()
+        rcs = [procs[i].wait(timeout=180) for i in range(np_)
+               if i != victim]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+    slines = _log_lines(dirs[survivor])
+    starts = _count_lines(slines, "start ")
+    rescaled = any(ln.startswith("rescale shrink") for ln in slines)
+    final = _load_final(dirs[survivor])
+    bitwise = _finals_bitwise_equal(final, baseline(steps))
+    # in-place contract: the survivor PROCESS never restarted, and after
+    # the rescale its accumulation factor doubled (world np_ -> np_-1 ...
+    # with np_=2: 2 -> 4 microbatches per step)
+    accum_ok = any("accum=4" in ln for ln in slines
+                   if ln.startswith("rescale shrink")) or np_ != 2
+    ok = (all(rc == 0 for rc in rcs) and starts == 1 and rescaled
+          and bitwise and accum_ok)
+    results.append({
+        "scenario": "shrink", "ok": ok, "rcs": rcs,
+        "survivor_starts": starts, "rescaled_in_place": rescaled,
+        "accum_rebalanced": accum_ok,
+        "bitwise_identical_to_matched_batch_baseline": bitwise,
+    })
+    return ok
+
+
+def scenario_grow(root, np_, steps, baseline, results):
+    ttl = 1.5
+    steps = max(steps, 40)
+    base = baseline(steps)  # sequential: fleet timing stays clean
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    fleet_root = os.path.join(root, "grow")
+    os.makedirs(fleet_root, exist_ok=True)
+    victim, survivor = np_ - 1, 0
+    dirs = [os.path.join(fleet_root, f"w{i}") for i in range(np_)]
+    # paced fleet: the relaunch pays a full interpreter+jax import, which
+    # must land while the survivors are still mid-run
+    procs = [_spawn_elastic(i, master, fleet_root, steps, np_, ttl,
+                            job="egrow", step_sleep=0.4)
+             for i in range(np_)]
+    try:
+        _wait_done_at_least(dirs[victim], max(2, steps // 8))
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        # survivors shrink in place...
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            if any(ln.startswith("rescale shrink")
+                   for ln in _log_lines(dirs[survivor])):
+                break
+            time.sleep(0.1)
+        # ...then the dead node rejoins: ONE more epoch bump re-expands
+        procs[victim] = _spawn_elastic(victim, master, fleet_root, steps,
+                                       np_, ttl, job="egrow", join=True,
+                                       step_sleep=0.4)
+        rcs = [p.wait(timeout=240) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+    slines = _log_lines(dirs[survivor])
+    vlines = _log_lines(dirs[victim])
+    shrink_epochs = [int(ln.split()[2]) for ln in slines
+                     if ln.startswith("rescale shrink")]
+    grow_epochs = [int(ln.split()[2]) for ln in slines
+                   if ln.startswith("rescale grow")]
+    # "re-expands within one epoch bump": the grow epoch is exactly the
+    # shrink epoch + 1 — no flapping, no intermediate barriers
+    one_bump = (len(shrink_epochs) == 1 and len(grow_epochs) == 1
+                and grow_epochs[0] == shrink_epochs[0] + 1)
+    rejoined = any(ln.startswith("joined") for ln in vlines)
+    accum_ok = (any("accum=2" in ln for ln in slines
+                    if ln.startswith("rescale grow")) or np_ != 2)
+    finals = [_load_final(d) for d in dirs]
+    bitwise = all(_finals_bitwise_equal(f, base) for f in finals)
+    ok = (all(rc == 0 for rc in rcs) and one_bump and rejoined
+          and accum_ok and bitwise)
+    results.append({
+        "scenario": "grow", "ok": ok, "rcs": rcs,
+        "shrink_epochs": shrink_epochs, "grow_epochs": grow_epochs,
+        "re_expanded_in_one_epoch_bump": one_bump,
+        "joiner_caught_up": rejoined, "accum_rebalanced": accum_ok,
+        "bitwise_identical_to_matched_batch_baseline": bitwise,
+    })
+    return ok
+
+
+def scenario_straggler(root, np_, steps, baseline, results):
+    ttl = 1.5
+    sustain = 3
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    fleet_root = os.path.join(root, "straggler")
+    os.makedirs(fleet_root, exist_ok=True)
+    victim, survivor = np_ - 1, 0
+    slow_after = max(2, steps // 3)
+    straggler_env = {
+        "FLAGS_elastic_straggler_pct": "50",
+        "FLAGS_elastic_straggler_sustain": str(sustain),
+        "FLAGS_elastic_straggler_evict": "1",
+    }
+    dirs = [os.path.join(fleet_root, f"w{i}") for i in range(np_)]
+    procs = [_spawn_elastic(
+        i, master, fleet_root, steps, np_, ttl, job="estrag",
+        slow_after=slow_after if i == victim else None, slow_ms=400,
+        straggler_env=straggler_env) for i in range(np_)]
+    try:
+        rcs = [p.wait(timeout=240) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+    vlines = _log_lines(dirs[victim])
+    slines = _log_lines(dirs[survivor])
+    evict_steps = [int(ln.split()[1]) for ln in vlines
+                   if ln.startswith("evicted ")]
+    # the detector needs its EMA past the threshold plus `sustain`
+    # consecutive checks — a small constant window past the slowdown start
+    window = sustain + 5
+    detected_in_window = bool(evict_steps) and (
+        evict_steps[0] - slow_after <= window)
+    survivors_rescaled = any(ln.startswith("rescale shrink")
+                             for ln in slines)
+    final = _load_final(dirs[survivor])
+    bitwise = _finals_bitwise_equal(final, baseline(steps))
+    ok = (all(rc == 0 for rc in rcs) and detected_in_window
+          and survivors_rescaled and bitwise)
+    results.append({
+        "scenario": "straggler", "ok": ok, "rcs": rcs,
+        "slow_after": slow_after, "evicted_at": evict_steps,
+        "detected_within_window": detected_in_window,
+        "sustain_window_steps": window,
+        "survivors_rescaled": survivors_rescaled,
+        "bitwise_identical_to_matched_batch_baseline": bitwise,
+    })
+    return ok
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--np", type=int, default=2)
     ap.add_argument("--steps", type=int, default=20)
+    # groups: "fleet" = the ISSUE 8 scenarios, "elastic" = the ISSUE 14
+    # in-place rescale scenarios, "all" = everything
     ap.add_argument("--scenario", default="all",
-                    choices=["all", "sigkill", "partition", "lease"])
+                    choices=["all", "fleet", "sigkill", "partition",
+                             "lease", "elastic", "shrink", "grow",
+                             "straggler"])
     # worker mode (internal)
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--worker-id", type=int, default=0,
@@ -485,32 +960,67 @@ def main(argv=None):
                     help=argparse.SUPPRESS)
     ap.add_argument("--stall-at", type=int, default=None,
                     help=argparse.SUPPRESS)
+    # elastic worker mode (internal)
+    ap.add_argument("--elastic-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--fleet-root", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--job", default=JOB_ID, help=argparse.SUPPRESS)
+    ap.add_argument("--join", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--slow-after", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--slow-ms", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--step-sleep", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.worker:
         return worker_main(args)
+    if args.elastic_worker:
+        return elastic_worker_main(args)
 
     sys.path.insert(0, REPO)
     results = []
     ok = True
+    elastic_scenarios = ("elastic", "shrink", "grow", "straggler")
     with tempfile.TemporaryDirectory() as root:
         srv = _start_master(0)
         master = f"127.0.0.1:{srv.port}"
         try:
             baseline = None
-            if args.scenario in ("all", "sigkill", "lease"):
+            if args.scenario in ("all", "fleet", "sigkill", "lease"):
                 baseline = _baseline(root, master, args.np, args.steps)
-            if args.scenario in ("all", "sigkill"):
+            if args.scenario in ("all", "fleet", "sigkill"):
                 ok &= scenario_sigkill(root, master, args.np, args.steps,
                                        baseline, results)
-            if args.scenario in ("all", "lease"):
+            if args.scenario in ("all", "fleet", "lease"):
                 ok &= scenario_lease(root, master, args.np, args.steps,
                                      baseline, results)
         finally:
             srv.stop()
-        if args.scenario in ("all", "partition"):
+        if args.scenario in ("all", "fleet", "partition"):
             # runs its own master (it must die and come back mid-run)
             ok &= scenario_partition(root, args.np, args.steps, results)
+        if args.scenario in ("all",) + elastic_scenarios:
+            # matched-global-batch baselines, cached per step count (the
+            # grow scenario stretches its run so the rejoin lands mid-run)
+            _ebase_cache = {}
+
+            def ebase(steps):
+                if steps not in _ebase_cache:
+                    _ebase_cache[steps] = _elastic_baseline(
+                        os.path.join(root, f"ebase-{steps}"), steps)
+                return _ebase_cache[steps]
+
+            if args.scenario in ("all", "elastic", "shrink"):
+                ok &= scenario_shrink(root, args.np, args.steps, ebase,
+                                      results)
+            if args.scenario in ("all", "elastic", "grow"):
+                ok &= scenario_grow(root, args.np, args.steps, ebase,
+                                    results)
+            if args.scenario in ("all", "elastic", "straggler"):
+                ok &= scenario_straggler(root, args.np, args.steps, ebase,
+                                         results)
 
     for r in results:
         print(json.dumps(r))

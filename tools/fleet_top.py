@@ -28,15 +28,21 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _fmt_opt(v, suffix=""):
+    return "-" if v is None else f"{v}{suffix}"
+
+
 def _render_health(rows) -> str:
     if not rows:
         return "(no live obs/<job>/* leases — is the fleet publishing?)"
-    cols = ["node", "status", "step", "age_s", "pid", "diag", "reasons",
-            "engines"]
+    cols = ["node", "status", "step", "epoch", "lag_ms", "accum", "age_s",
+            "pid", "diag", "reasons", "engines"]
     table = [cols]
     for r in rows:
         table.append([
             str(r["node"]), str(r["status"]), str(r["step"]),
+            _fmt_opt(r.get("epoch")), _fmt_opt(r.get("step_lag_ms")),
+            _fmt_opt(r.get("accum")),
             str(r["age_s"]), str(r["pid"]), str(r["diag"]),
             ",".join(r["reasons"]) or "-",
             ",".join(f"{k}:{v}" for k, v in sorted(r["engines"].items()))

@@ -49,6 +49,7 @@ SUBMODULES = [
     "profiler.diag",
     "profiler.sentinel",
     "distributed.fleet.obs",
+    "distributed.fleet.elastic",
     "resilience",
     "quantization",
     "incubate",
