@@ -941,11 +941,35 @@ def _observability_block(steps=6, bsz=8):
         out["sentinel_false_positives"] = int(
             prof.dispatch_counters()["perf_regressions"] - before)
         out["sentinel_window_steps"] = n_window
+
+        # -- attribution layer (ISSUE 15): telemetry overhead + top program
+        # cost. Overhead is analytic — the marginal host record cost (the
+        # one measurement definition, attribution.measure_record_cost_ms)
+        # over the measured steady step — and the fleet-visible top-1
+        # program by measured EMA rides along so the BENCH_* trajectory
+        # shows WHERE the step time goes, not just how much there is.
+        from paddle_tpu.profiler import attribution as _attr
+
+        paddle.set_flags({"FLAGS_sentinel_pct": 0.0,
+                          "FLAGS_telemetry": True})
+        for i in range(10):
+            _one_step(net, opt, loss_fn, batches[i % len(batches)])
+        pnames = _attr.group_names(list(net.parameters()))
+        rec_ms = _attr.measure_record_cost_ms(pnames)
+        out["telemetry_record_cost_ms"] = round(rec_ms, 4)
+        out["telemetry_overhead_pct"] = round(
+            rec_ms / max(out["step_ms"], 1e-9) * 100.0, 4)
+        paddle.set_flags({"FLAGS_telemetry": False})
+        # top EXECUTABLE program by measured EMA (the step-lap keys are
+        # host-inclusive and would always win — not the question here)
+        top = [r for r in _attr.costs_summary(8) if r["category"] != "step"]
+        out["program_cost_top1"] = top[0] if top else None
         return out
     finally:
         paddle.set_flags({"FLAGS_fault_inject": "",
                           "FLAGS_trace_ring_size": 4096,
                           "FLAGS_sentinel_pct": 0.0,
+                          "FLAGS_telemetry": False,
                           "FLAGS_eager_lazy_dispatch": False,
                           "FLAGS_eager_step_capture": True,
                           "FLAGS_retry_backoff_ms": 5.0})
@@ -1057,6 +1081,14 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps / baseline, 3),
     }
+    # a failing trajectory block must name itself IN the JSON record —
+    # silently omitting the key made a broken block indistinguishable from
+    # a BENCH_*=0 skip when reading BENCH_*.json files later
+    def _block_failed(name, e):
+        tail = _tb_tail(e)
+        result.setdefault("failed_blocks", {})[name] = tail
+        print(f"# {name} block FAILED: {tail}", file=sys.stderr)
+
     # estimated peak HBM of the donated whole-step program (static liveness
     # plan, analysis.memory) — the memory-trajectory entry for BENCH_* files
     try:
@@ -1066,39 +1098,36 @@ def main():
             plan.donation_credit_bytes / 2**20, 1
         )
     except Exception as e:
-        print(f"# memory plan FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        _block_failed("memory_plan", e)
     # resilience trajectory block (retries / fallbacks / recovery overhead /
     # sentinel-is-free proof) — BENCH_RESILIENCE=0 skips it
     if os.environ.get("BENCH_RESILIENCE", "1") == "1":
         try:
             result["resilience"] = _resilience_block()
         except Exception as e:
-            print(f"# resilience block FAILED: {_tb_tail(e)}",
-                  file=sys.stderr)
+            _block_failed("resilience", e)
     # checkpoint-overhead trajectory block (auto cadence vs off, overhead %
     # vs budget, snapshot/commit split) — BENCH_CHECKPOINT=0 skips it
     if os.environ.get("BENCH_CHECKPOINT", "1") == "1":
         try:
             result["checkpoint"] = _checkpoint_block()
         except Exception as e:
-            print(f"# checkpoint block FAILED: {_tb_tail(e)}",
-                  file=sys.stderr)
+            _block_failed("checkpoint", e)
     # observability trajectory block (flight-recorder overhead %, events/
-    # step, per-emit cost) — BENCH_OBSERVABILITY=0 skips it
+    # step, per-emit cost, telemetry overhead, top program cost) —
+    # BENCH_OBSERVABILITY=0 skips it
     if os.environ.get("BENCH_OBSERVABILITY", "1") == "1":
         try:
             result["observability"] = _observability_block()
         except Exception as e:
-            print(f"# observability block FAILED: {_tb_tail(e)}",
-                  file=sys.stderr)
+            _block_failed("observability", e)
     # elastic-rescale trajectory block (rescale downtime, steps/s before/
     # after shrink, straggler detection latency) — BENCH_ELASTIC=0 skips it
     if os.environ.get("BENCH_ELASTIC", "1") == "1":
         try:
             result["elastic"] = _elastic_block()
         except Exception as e:
-            print(f"# elastic block FAILED: {_tb_tail(e)}",
-                  file=sys.stderr)
+            _block_failed("elastic", e)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)
     print(json.dumps(result), flush=True)

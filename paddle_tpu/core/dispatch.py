@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from collections import OrderedDict
 from types import MappingProxyType
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -84,6 +85,52 @@ def _trace_module():
 def _emit(kind, site="", **attrs):
     """Flight-recorder emit (paddle.profiler.trace), lazily bound."""
     _trace_module().emit(kind, site=site, **attrs)
+
+
+_attribution = None
+
+
+def _attribution_module():
+    global _attribution
+    if _attribution is None:
+        from ..profiler import attribution as a
+
+        _attribution = a
+    return _attribution
+
+
+def _note_op_program(name, fn, kw_items, vals, t0):
+    """Attribution hook for one per-op launch: register the op's static
+    profile once per name (spec-only thunk — closure-holding fns are
+    measured but never pinned) and feed the measured wall time into the
+    per-key EMA (paddle.profiler.attribution)."""
+    try:
+        a = _attribution_module()
+        key = "op:" + name
+        if not a.known(key):
+            # first sight of this op name = the call that traced+compiled
+            # its jit wrapper: register the static side (spec-only thunk;
+            # closure-holding fns register measured-only, never pinned)
+            # and SKIP the measurement — compiles are never folded into
+            # the measured EMA, same contract as the other categories
+            thunk = None
+            if _cache_token(fn) is not None:
+                kw = dict(kw_items)
+                specs = tuple(
+                    jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                    if isinstance(v, (jax.Array, np.ndarray)) else v
+                    for v in vals
+                )
+
+                def thunk(_fn=fn, _kw=kw, _specs=specs):
+                    return jax.make_jaxpr(
+                        lambda *args: _fn(*args, **_kw))(*_specs)
+
+            a.register(key, "op", jaxpr_thunk=thunk)
+            return
+        a.note_run(key, "op", (time.perf_counter() - t0) * 1000.0)
+    except Exception:
+        pass  # attribution must never break the op
 
 
 # ---------------------------------------------------------------------------
@@ -235,12 +282,21 @@ def _reset_counters_locked():
         # regressed) and clears (a tripped key recovering re-baselines)
         perf_regressions=0,
         perf_regression_clears=0,
+        # attribution layer (ISSUE 15): program cost-registry
+        # registrations, fused-telemetry steps/spikes (the labeled family
+        # names WHICH parameter group spiked), and postmortem-directory
+        # prunes (FLAGS_postmortem_keep)
+        program_registrations=0,
+        telemetry_steps=0,
+        telemetry_spikes=0,
+        postmortems_pruned=0,
         serve_shed_reasons={},
         serve_expire_stages={},
         flush_reasons={},
         capture_fallback_reasons={},
         fault_sites={},
         perf_regression_sites={},
+        telemetry_spike_groups={},
     )
 
 
@@ -642,12 +698,15 @@ def apply(
             if (jit and flags.flag("eager_op_jit"))
             else None
         )
+        t0 = time.perf_counter()
         if jfn is not None:
             out_vals = _rexec("op", lambda: jfn(*vals))
         else:
             kw = dict(kw_items)
             out_vals = _rexec("op", lambda: fn(*vals, **kw))
         _count_program("op")
+        _note_op_program(op_name or getattr(fn, "__name__", "op"),
+                         fn, kw_items, vals, t0)
         return _wrap_outputs(out_vals, stop_gradient=True, node=None)
 
     # run the recorded primal through a CACHED forward+vjp program when the
@@ -681,6 +740,7 @@ def apply(
         # normalize list outputs to tuple so cotangent pytree structure is fixed
         return tuple(res) if isinstance(res, list) else res
 
+    t0 = time.perf_counter()
     if jitted_vjp is not None:
         out_vals, vjp_fn = _rexec("op", lambda: jitted_vjp(*vals))
         is_jit_vjp = True
@@ -690,6 +750,8 @@ def apply(
         )
         is_jit_vjp = False
     _count_program("op")
+    _note_op_program(op_name or getattr(fn, "__name__", "op"),
+                     fn, kw_items, vals, t0)
 
     # AMP O1 casts inputs (e.g. fp32 weight → bf16) before the op; the
     # reference records the cast op so its backward restores fp32 grads

@@ -355,6 +355,47 @@ define_flag(
     "number of trailing flight-recorder events included in each postmortem "
     "dump (the event tail that explains what led up to the crash)",
 )
+define_flag(
+    "postmortem_keep", 32,
+    "bound on the number of postmortem JSON files kept in "
+    "FLAGS_postmortem_dir: every dump prunes the OLDEST dumps past this "
+    "count (a flapping sentinel or a rescue storm cannot grow the "
+    "directory without limit); pruned files are counted in "
+    "dispatch_counters()['postmortems_pruned'] and reported by the "
+    "/postmortems diagnostics endpoint. 0 = unbounded",
+)
+# ---------------------------------------------------------------------------
+# Attribution layer (paddle.profiler.attribution — see OBSERVABILITY.md
+# "Attribution & triage")
+# ---------------------------------------------------------------------------
+define_flag(
+    "telemetry", False,
+    "fused numerics telemetry (paddle.profiler.attribution): the fused "
+    "optimizer update (and the captured whole-step program) computes one "
+    "extra stacked vector output — per-parameter grad-norm, param-norm, "
+    "and update-norm sums of squares — inside the SAME traced program "
+    "(zero extra device launches; programs-per-step stays 13/3/1 per "
+    "tier, and step numerics are bitwise-identical to telemetry-off). "
+    "The host reads the vector each step into per-group gauges "
+    "(telemetry_* metric families), a bounded history ring "
+    "(FLAGS_telemetry_history) the triage postmortems dump, and one "
+    "'telemetry' flight event per step. Off by default: reading the "
+    "vector synchronizes with the step program on the host",
+)
+define_flag(
+    "telemetry_history", 64,
+    "per-step telemetry records kept in the attribution history ring — "
+    "the 'last N telemetry vectors' a triage postmortem includes so an "
+    "out-of-trend parameter group is visible in context",
+)
+define_flag(
+    "telemetry_spike_factor", 10.0,
+    "a parameter group whose grad-norm exceeds this multiple of its own "
+    "EMA (or goes non-finite) is recorded as a telemetry spike: counted "
+    "(telemetry_spikes + the telemetry_spike_groups labeled family), "
+    "named in the per-step telemetry flight event, and listed first in "
+    "the postmortem triage section",
+)
 # ---------------------------------------------------------------------------
 # Ops plane (paddle.profiler.diag / paddle.profiler.sentinel — see
 # OBSERVABILITY.md "Ops plane")

@@ -58,10 +58,40 @@ __all__ = [
 ]
 
 
-def _add_time(key: str, t0: float):
+def _add_time(key: str, t0: float) -> float:
     from . import dispatch
 
-    dispatch._counters[key] += (time.perf_counter() - t0) * 1000.0
+    dt_ms = (time.perf_counter() - t0) * 1000.0
+    dispatch._counters[key] += dt_ms
+    return dt_ms
+
+
+def _note_program(key: str, category: str, dt_ms: float):
+    """Feed one measured program run into the attribution cost registry
+    (paddle.profiler.attribution) — the same duration the dispatch timers
+    book, so the per-key EMA and replay_time_ms agree."""
+    try:
+        from ..profiler import attribution as _attribution
+
+        _attribution.note_run(key, category, dt_ms)
+    except Exception:
+        pass  # attribution must never break the program
+
+
+def _register_program(key: str, category: str, **kw):
+    try:
+        from ..profiler import attribution as _attribution
+
+        _attribution.register(key, category, **kw)
+    except Exception:
+        pass
+
+
+def _sig_id(sig) -> str:
+    try:
+        return f"{hash(sig) & 0xFFFF:04x}"
+    except TypeError:
+        return "anon"
 
 
 def drain_async():
@@ -402,6 +432,7 @@ def _flush(seg: _Segment, reason: str):
     check = bool(flags.flag("check_nan_inf"))
     n_ops = len(seg.ops)
     sig = _seg_signature(seg)
+    skey = f"segment:{_sig_id(sig)}"
     jfn = dispatch._lru_get(_segment_cache, sig)
     fresh = jfn is None
     fut = None
@@ -437,7 +468,7 @@ def _flush(seg: _Segment, reason: str):
         if not fresh:
             t0 = time.perf_counter()
             out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals))
-            _add_time("replay_time_ms", t0)
+            _note_program(skey, "segment", _add_time("replay_time_ms", t0))
         elif fut is not None:
             # second flush of a signature whose fused program is compiling
             # in the background: join it (a compile-thread exception
@@ -463,8 +494,18 @@ def _flush(seg: _Segment, reason: str):
             dispatch._emit("async_join", site="segment")
             t0 = time.perf_counter()
             out = dispatch._rexec("segment", lambda: jfn(seg.ext_vals))
-            _add_time("replay_time_ms", t0)
+            _note_program(skey, "segment", _add_time("replay_time_ms", t0))
         else:
+            # attribution cost registry: a fresh segment signature
+            # registers its static profile at build time (spec-only
+            # thunk — the plan pins no user data, per _segment_fn)
+            _register_program(
+                skey, "segment",
+                jaxpr_thunk=(
+                    lambda _plan=plan, _specs=tuple(seg.ext_specs):
+                    _segment_jaxpr(_plan, _specs)),
+                ops=n_ops,
+            )
             submitted = None
             if _async.enabled():
                 jfn_bg = _build_segment_fn(plan, check)
@@ -908,6 +949,9 @@ class _CaptureEntry:
 
     __slots__ = ("exe", "param_idx", "extra_idx", "param_slots",
                  "extra_slots", "rest_slots", "warmed", "rescue",
+                 # fused numerics telemetry (FLAGS_telemetry): the traced
+                 # program carries one extra stacked vector output
+                 "telemetry",
                  # async host pipeline: the in-flight background AOT
                  # compile (FLAGS_eager_async_compile); steps arriving
                  # before it finishes resolve on the 3-program path
@@ -933,6 +977,13 @@ def _capture_on() -> bool:
         and bool(flags.flag("eager_step_capture"))
         and not flags.flag("check_nan_inf")
     )
+
+
+def _telemetry_on() -> bool:
+    # fused numerics telemetry (paddle.profiler.attribution): changes the
+    # traced step/update program (one extra stacked output), so it keys
+    # the capture cache exactly like the rescue sentinel
+    return bool(flags.flag("telemetry"))
 
 
 def _observer() -> _Observer:
@@ -1261,7 +1312,9 @@ def _run_accum_microstep(seg, root, seg_sig, tape_key, leaves, slots, pos,
         return False
     rv = root._value
     lkey = hash(seg_sig)
+    akey = f"accum:{_sig_id(seg_sig)}"
     try:
+        built_fn = None
         if entry is None:
             accum_fn, rest_slots = _accum_step_fn(
                 _seg_plan(seg), len(seg.ext_vals), tuple(slots),
@@ -1269,6 +1322,7 @@ def _run_accum_microstep(seg, root, seg_sig, tape_key, leaves, slots, pos,
                 with_grad_in,
             )
             entry = (jax.jit(accum_fn), rest_slots)
+            built_fn = accum_fn
             dispatch._counters["capture_accum_builds"] += 1
             dispatch._lru_put(
                 _capture_cache, key, entry,
@@ -1286,11 +1340,25 @@ def _run_accum_microstep(seg, root, seg_sig, tape_key, leaves, slots, pos,
             if with_grad_in else (),
             tuple(ext[s] for s in rest_slots),
         )
+        if built_fn is not None:
+            # attribution cost registry: the accumulate-only microstep
+            # program registers at build time (spec-only thunk; the plan
+            # closure pins no user data)
+            specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), args
+            )
+            _register_program(
+                akey, "accum",
+                jaxpr_thunk=(lambda _fn=built_fn, _s=specs:
+                             jax.make_jaxpr(_fn)(*_s)),
+            )
         t0 = time.perf_counter()
         out = dispatch._rexec(
             "captured", lambda: jfn(*args), fresh=fresh, ladder_key=lkey,
         )
-        _add_time("compile_time_ms" if fresh else "replay_time_ms", t0)
+        dt = _add_time("compile_time_ms" if fresh else "replay_time_ms", t0)
+        if not fresh:
+            _note_program(akey, "accum", dt)
     except BaseException as e:
         if not isinstance(e, Exception):
             raise
@@ -1471,7 +1539,9 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     from ..resilience import rescue as _rescue
 
     rescue_on = _rescue.active()
-    apply_update = make_fused_update(opt, params, sentinel=rescue_on)
+    tele_on = _telemetry_on()
+    apply_update = make_fused_update(opt, params, sentinel=rescue_on,
+                                     telemetry=tele_on)
     has_grad_in = rec.grad_prev_vals is not None
 
     def step_fn(p_vals, sts, lr, extra_vals, rest_vals, gp_in, gx_in):
@@ -1506,18 +1576,18 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
         # written back to p.grad stay unclipped, exactly like the eager
         # path, which never writes the clipped values back.
         upd_g = tuple(clip_fn(list(gp))) if clip_fn is not None else gp
-        if rescue_on:
-            # numeric-rescue sentinel (paddle.resilience): one extra scalar
-            # output of the SAME program; the update is where-gated on it
-            # in-program, so a non-finite step leaves params/state untouched
-            # at zero extra launches
-            new_p, new_s, bad = apply_update(p_vals, upd_g, lr, sts)
-            return results, gp, gx, tuple(new_p), tuple(new_s), bad
-        new_p, new_s = apply_update(p_vals, upd_g, lr, sts)
-        return results, gp, gx, tuple(new_p), tuple(new_s)
+        # numeric-rescue sentinel and fused telemetry (paddle.resilience /
+        # paddle.profiler.attribution): extra OUTPUTS of the SAME program —
+        # the sentinel scalar where-gates the update in-program, the
+        # telemetry vector stacks per-param grad/param/update norms — so
+        # both add zero program launches and never perturb the update math
+        upd = apply_update(p_vals, upd_g, lr, sts)
+        new_p, new_s = upd[0], upd[1]
+        return (results, gp, gx, tuple(new_p), tuple(new_s)) + tuple(upd[2:])
 
     entry = _CaptureEntry()
     entry.rescue = rescue_on
+    entry.telemetry = tele_on
     # donate params + optimizer state: XLA reuses their HBM buffers for the
     # updated values (the compile_train_step discipline, earned by plain
     # eager code). Batch data / extra leaves are NOT donated — they are
@@ -1666,13 +1736,14 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     # deleted buffers, so such faults skip in-place retry and resolve via
     # the 3-program fallback (injected faults raise pre-launch and retry)
     unsafe = entry.donated
+    ckey = f"captured:{_sig_id(rec.seg_sig)}"
     t0 = time.perf_counter()
     if entry.warmed:
         out = dispatch._rexec(
             "captured", lambda: entry.exe(*args), ladder_key=lkey,
             retry_unsafe=unsafe,
         )
-        _add_time("replay_time_ms", t0)
+        _note_program(ckey, "captured", _add_time("replay_time_ms", t0))
     else:
         import warnings
 
@@ -1689,11 +1760,43 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
                               ladder_key=lkey, retry_unsafe=unsafe)
         _add_time("compile_time_ms", t0)
         entry.warmed = True
-    if entry.rescue:
-        results, gp, gx, new_p, new_s, bad = out
-    else:
-        results, gp, gx, new_p, new_s = out
-        bad = None
+        # attribution cost registry: the captured step registers its
+        # static profile at build time. Weak thunks (the registry must
+        # never outlive the capture cache — same discipline as
+        # captured_step_program): the jaxpr trace and the XLA
+        # cost_analysis both run lazily at the first program_costs read.
+        import weakref as _weakref
+
+        eref = _weakref.ref(entry)
+
+        def _cap_jaxpr(_r=eref):
+            e = _r()
+            if e is None or e.arg_specs is None:
+                return None
+            return jax.make_jaxpr(e.step_fn)(*e.arg_specs)
+
+        def _cap_cost(_r=eref):
+            e = _r()
+            if e is None or e.arg_specs is None:
+                return None
+            ca = getattr(e.exe, "cost_analysis", None)
+            if ca is not None:
+                try:
+                    return ca()
+                except Exception:
+                    pass
+            try:
+                return e.exe.lower(*e.arg_specs).cost_analysis()
+            except Exception:
+                return None
+
+        _roles, _donated = _capture_arg_roles(entry)
+        _register_program(ckey, "captured", jaxpr_thunk=_cap_jaxpr,
+                          cost_thunk=_cap_cost, donated=len(_donated))
+    results, gp, gx, new_p, new_s = out[:5]
+    _extra = list(out[5:])
+    bad = _extra.pop(0) if entry.rescue else None
+    tele = _extra.pop(0) if entry.telemetry else None
 
     _tls.capture_deferred = None
     rec.stub_seg.flushed = True
@@ -1737,6 +1840,16 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     if obs is not None:
         obs.events, obs.dirty = [], False  # stays armed for the next step
         obs.pos = 0  # an accumulation cycle completed; next one starts fresh
+    if tele is not None:
+        # fused telemetry host-read BEFORE the rescue policy runs, so a
+        # rescue postmortem's tail already carries the spike event
+        try:
+            from ..profiler import attribution as _attribution
+
+            _attribution.record_telemetry(
+                _attribution.group_names(params), tele)
+        except Exception:
+            pass
     if bad is not None:
         from ..resilience import rescue as _rescue
 
@@ -1795,7 +1908,8 @@ def step_capture_step(optimizer) -> bool:
     key = (rec.seg_sig, rec.tape_key, opt_fp,
            bool(flags.flag("eager_capture_donate")),
            rec.grad_prev_vals is not None,  # accumulation: grad-in program
-           _rescue.active())  # the sentinel changes the traced program
+           _rescue.active(),  # the sentinel changes the traced program
+           _telemetry_on())  # ... and so does the fused telemetry vector
     try:
         entry = dispatch._lru_get(_capture_cache, key)
     except TypeError:
@@ -1964,6 +2078,7 @@ class _ServeProgram:
             if self._exe_plain is None:
                 self._exe_plain = jax.jit(self.fn)
             exe, fresh = self._exe_plain, not self._built_plain
+        akey = "serve:" + ":".join(str(x) for x in self.key)
         t0 = time.perf_counter()
         if fresh:
             # first call = trace + XLA compile; backends without real
@@ -1981,10 +2096,35 @@ class _ServeProgram:
                            key=str(self.key), donated=bool(
                                donate and self.donate_argnums))
             _add_time("compile_time_ms", t0)
+            # attribution cost registry: one entry per serving bucket
+            # signature. Weak thunk — the step fn closes over the model,
+            # and the registry must never outlive the serve cache.
+            import weakref as _weakref
+
+            pref = _weakref.ref(self)
+            try:
+                specs = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+                    tuple(args),
+                )
+
+                def _serve_jaxpr(_r=pref, _s=specs):
+                    p = _r()
+                    if p is None:
+                        return None
+                    return jax.make_jaxpr(p.fn)(*_s)
+
+                _register_program(
+                    akey, "serve", jaxpr_thunk=_serve_jaxpr,
+                    donated=len(self.donate_argnums)
+                    if (donate and self.donate_argnums) else 0,
+                )
+            except Exception:
+                pass
         else:
             out = exe(*args)
             dispatch._counters["serve_capture_replays"] += 1
-            _add_time("replay_time_ms", t0)
+            _note_program(akey, "serve", _add_time("replay_time_ms", t0))
         return out
 
 

@@ -206,6 +206,18 @@ class ObsPublisher:
                         break
             except Exception:
                 pass
+        # attribution layer (ISSUE 15): ship the top measured program
+        # costs and the hottest telemetry group so fleet_top --programs
+        # and the per-host grad-norm column need no extra RPC
+        programs = None
+        telemetry = None
+        try:
+            from ...profiler import attribution as _attribution
+
+            programs = _attribution.costs_summary(5)
+            telemetry = _attribution.telemetry_summary()
+        except Exception:
+            pass
         return {
             "node": self.node_id,
             "host": socket.gethostname(),
@@ -214,6 +226,8 @@ class ObsPublisher:
             "wall": time.time(),
             "step": health.get("step"),
             "elastic": elastic,
+            "programs": programs,
+            "telemetry": telemetry,
             "health": {
                 "status": health.get("status"),
                 "reasons": health.get("reasons"),
@@ -510,6 +524,7 @@ class FleetAggregator:
         for node, doc in sorted(self.snapshots().items()):
             h = doc.get("health") or {}
             e = doc.get("elastic") or {}
+            t = doc.get("telemetry") or {}
             rows.append({
                 "node": node,
                 "host": doc.get("host"),
@@ -527,8 +542,34 @@ class FleetAggregator:
                 "step_ms": e.get("step_ms"),
                 "step_lag_ms": e.get("step_lag_ms"),
                 "accum": e.get("accum"),
+                # attribution columns (ISSUE 15): the hottest telemetry
+                # group's grad norm, when FLAGS_telemetry is on there
+                "grad_norm": t.get("grad_norm"),
+                "grad_norm_group": t.get("group"),
             })
         return rows
+
+    # -- fleet-merged program costs (ISSUE 15) ---------------------------
+    def fleet_programs(self, k: int = 10) -> List[Dict[str, Any]]:
+        """Top-``k`` program costs across the fleet, by measured EMA ms:
+        every live host's published ``programs`` summary merged into one
+        ranked table (``fleet_top --programs`` renders this)."""
+        rows: List[Dict[str, Any]] = []
+        for node, doc in sorted(self.snapshots().items()):
+            for row in doc.get("programs") or []:
+                try:
+                    rows.append({
+                        "node": node,
+                        "key": str(row.get("key")),
+                        "category": row.get("category"),
+                        "ema_ms": float(row.get("ema_ms") or 0.0),
+                        "runs": int(row.get("runs") or 0),
+                        "drift_pct": row.get("drift_pct"),
+                    })
+                except (TypeError, ValueError):
+                    continue  # torn/hostile row: skip, never crash
+        rows.sort(key=lambda r: -r["ema_ms"])
+        return rows[:max(1, k)]
 
     # -- merged chrome trace ---------------------------------------------
     def clock_offset_s(self, addr: str, samples: int = 3) -> float:

@@ -271,6 +271,17 @@ class GlobalStepSampler(Sampler):
         self.cursor = 0  # next global step to consume
         self._perm_cache = (None, None)  # (epoch, permutation)
         self.set_world(rank, world)
+        # triage sample-id recovery (paddle.profiler.attribution): ids at
+        # step s are a pure function of (seed, epoch, s), so a postmortem
+        # can name the offending batch's samples from the step number
+        # alone. Registration is weak — diagnostics never extend the data
+        # pipeline's lifetime — and the latest sampler wins.
+        try:
+            from ..profiler import attribution as _attribution
+
+            _attribution.register_sampler(self)
+        except Exception:
+            pass
 
     # -- geometry --------------------------------------------------------
     @property

@@ -27,7 +27,7 @@ from .lr import LRScheduler
 _jit_update_cache: Dict = {}
 
 
-def make_fused_update(opt, params, sentinel=False):
+def make_fused_update(opt, params, sentinel=False, telemetry=False):
     """Pure multi-tensor update applier `(p_vals, g_vals, lr, states) ->
     (new_ps, new_states)` over `opt`'s rule for `params`.
 
@@ -44,6 +44,16 @@ def make_fused_update(opt, params, sentinel=False):
     and where-gates the whole update on it: a non-finite step returns the
     ORIGINAL params and state. The scan and the gate are folded into the
     same traced program, so rescue adds zero program launches.
+
+    With `telemetry=True` (FLAGS_telemetry, paddle.profiler.attribution)
+    the applier appends one MORE output — a stacked `(n_params, 3)` f32
+    vector of per-parameter sums of squares: grad², param², and
+    (new_p − p)² — the fused-numerics telemetry the attribution layer
+    reduces to per-group grad-norm / param-norm / update-ratio on the
+    host. Same mechanism as the sentinel: extra outputs of the SAME
+    traced program, zero extra launches, and the update chain itself is
+    untouched, so step numerics stay bitwise-identical to telemetry-off.
+    Output order is always (new_ps, new_states[, bad][, telemetry]).
 
     With FLAGS_pallas_fused_update (on TPU, or under the interpret flag),
     eligible parameters route through the hand-written Pallas kernel
@@ -69,6 +79,7 @@ def make_fused_update(opt, params, sentinel=False):
             for gv in g_vals:
                 bad = bad | jnp.any(~jnp.isfinite(gv))
         new_ps, new_sts = [], []
+        tele_rows = []
         for pv, gv, st, hy in zip(p_vals, g_vals, states, hypers):
             if gv.dtype != pv.dtype:
                 gv = gv.astype(pv.dtype)
@@ -86,11 +97,25 @@ def make_fused_update(opt, params, sentinel=False):
                     nst = jax.tree_util.tree_map(
                         lambda o, n: jnp.where(bad, o, n), st, nst
                     )
+            if telemetry:
+                # fused numerics telemetry: per-param sums of squares of
+                # the (post-cast) grad, the param, and the APPLIED update
+                # (post-gate, so a rescued step reports a zero update) —
+                # independent extra outputs, the update chain is untouched
+                f32 = jnp.float32
+                tele_rows.append(jnp.stack([
+                    jnp.sum(jnp.square(gv.astype(f32))),
+                    jnp.sum(jnp.square(pv.astype(f32))),
+                    jnp.sum(jnp.square((np_ - pv).astype(f32))),
+                ]))
             new_ps.append(np_)
             new_sts.append(nst)
-        if not sentinel:
-            return new_ps, new_sts
-        return new_ps, new_sts, bad
+        out = (new_ps, new_sts)
+        if sentinel:
+            out = out + (bad,)
+        if telemetry:
+            out = out + (jnp.stack(tele_rows),)
+        return out
 
     return apply_update
 
@@ -214,6 +239,9 @@ class Optimizer:
             g_vals = list(g_vals)
             g_vals[0] = jnp.full_like(g_vals[0], jnp.nan)
         sentinel = _rescue.active()
+        from ..profiler import attribution as _attribution
+
+        telemetry = _attribution.telemetry_active()
         states = []
         for p in params:
             st = self._accumulators.get(id(p))
@@ -241,6 +269,7 @@ class Optimizer:
             per_hypers,
             self._weight_decay,
             sentinel,
+            telemetry,
             pallas,
             tuple(
                 (id(p), p._value.shape, p._value.dtype, g.dtype)
@@ -257,6 +286,7 @@ class Optimizer:
                 per_hypers,
                 self._weight_decay,
                 sentinel,
+                telemetry,
                 pallas,
                 tuple(
                     (p._value.shape, str(p._value.dtype), str(g.dtype))
@@ -269,20 +299,25 @@ class Optimizer:
             # make_fused_update binds a bare weight-decay shim, NOT `self`:
             # this cache is global and capturing the instance would pin its
             # accumulators (potentially hundreds of MB of moments) forever
-            fn = jax.jit(make_fused_update(self, params, sentinel=sentinel))
+            fn = jax.jit(make_fused_update(self, params, sentinel=sentinel,
+                                           telemetry=telemetry))
             _jit_update_cache[key] = fn
         p_vals = [p._value for p in params]
         lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
         out = _rrt.execute("optimizer", lambda: fn(p_vals, g_vals, lr, states))
-        if sentinel:
-            new_ps, new_sts, bad = out
-        else:
-            new_ps, new_sts = out
-            bad = None
+        new_ps, new_sts = out[0], out[1]
+        extra = list(out[2:])
+        bad = extra.pop(0) if sentinel else None
+        tele = extra.pop(0) if telemetry else None
         _count_program("optimizer")
         for p, npv, nst in zip(params, new_ps, new_sts):
             p._value = npv
             self._accumulators[id(p)] = nst
+        if tele is not None:
+            # fused telemetry host-read BEFORE the rescue policy, so a
+            # rescue postmortem's tail already carries the spike event
+            _attribution.record_telemetry(
+                _attribution.group_names(params), tele)
         if bad is not None:
             # host-read of the fused sentinel (same program's output —
             # no extra launch); applies skip / lr_backoff / abort

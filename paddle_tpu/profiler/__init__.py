@@ -37,8 +37,14 @@ from ..core.dispatch import (  # noqa: F401
 from . import metrics  # noqa: F401
 from . import trace  # noqa: F401
 
+# attribution layer (OBSERVABILITY.md "Attribution & triage"): the program
+# cost registry, fused numerics telemetry, and postmortem triage
+from . import attribution  # noqa: F401
+
 __all__ = [
+    "attribution",
     "diag",
+    "program_costs",
     "sentinel",
     "Profiler",
     "ProfilerState",
@@ -266,12 +272,18 @@ class Profiler:
             events = list(_host_events)
         flight = _trace.events()
         events = events + _trace.chrome_trace_events(flight)
+        # per-program counter lanes (attribution): every measured program
+        # run is a "C" sample, so each program key's wall time plots as
+        # its own lane next to the flight instants and request lanes
+        counter_events = attribution.chrome_counter_events()
+        events = events + counter_events
         doc = {
             "traceEvents": events,
             "metadata": {
                 "device_trace_dir": self._device_dir,
                 "framework": "paddle_tpu",
                 "flight_recorder_events": len(flight),
+                "program_counter_samples": len(counter_events),
             },
         }
         with open(path, "w") as f:
@@ -376,6 +388,13 @@ class StepTimer:
         if not self._marked_ms or self.ema_ms is None:
             return 0.0
         return abs(self.ema_ms - self._marked_ms) / self._marked_ms * 100.0
+
+
+def program_costs(top_k: int = 5, static: bool = True):
+    """Per-program cost profiles (paddle.profiler.attribution): the static
+    flop/byte/top-ops estimate of every registered executable paired with
+    its measured wall-time EMA — see attribution.program_costs."""
+    return attribution.program_costs(top_k=top_k, static=static)
 
 
 def measure_programs(step_fn, *args, warmup: int = 2, **kwargs):

@@ -232,6 +232,27 @@ def statusz_text() -> str:
     except Exception as e:
         out.append(f"  <elastic state unavailable: {e!r}>\n")
     try:
+        from . import attribution as _attribution
+
+        costs = _attribution.costs_summary(8)
+        tele = _attribution.telemetry_state()
+        out.append(_section("attribution"))
+        if not costs:
+            out.append("  no measured programs yet\n")
+        for row in costs:
+            out.append(
+                f"  {row['key']}: {row['ema_ms']}ms ema "
+                f"({row['category']}, {row['runs']} runs, "
+                f"drift={row['drift_pct']}%)\n")
+        out.append(f"  telemetry: enabled={tele['enabled']} "
+                   f"steps={tele['steps']} groups={len(tele['groups'])}\n")
+        for name, g in sorted(tele["groups"].items()):
+            out.append(
+                f"    {name}: grad_norm={g['grad_norm']} "
+                f"update_ratio={g['update_ratio']} spikes={g['spikes']}\n")
+    except Exception as e:
+        out.append(f"  <attribution state unavailable: {e!r}>\n")
+    try:
         out.append(_section("perf-regression sentinel"))
         st = _sentinel.state()
         out.append(f"  enabled = {st['enabled']}  pct = {st['pct']}  "
@@ -285,7 +306,7 @@ def statusz_text() -> str:
 _INDEX = (
     "paddle_tpu diagnostics server\n"
     "endpoints: /metrics /healthz /readyz /flight?kind=&site=&last=N "
-    "/postmortems /postmortems/<name> /statusz /clockz\n"
+    "/postmortems /postmortems/<name> /programz /statusz /clockz\n"
 )
 
 
@@ -331,6 +352,22 @@ def _route(path: str, qs: Dict[str, List[str]]) -> Tuple[int, str, bytes]:
         doc = {"wall": time.time(), "perf_ns": time.perf_counter_ns(),
                "pid": os.getpid()}
         return 200, "application/json", json.dumps(doc).encode()
+    if path == "/programz":
+        # attribution layer (ISSUE 15): per-program cost profiles (static
+        # flop/byte/top-ops estimates + measured wall-time EMAs) and the
+        # fused-telemetry state — everything a "which program got slower /
+        # which group blew up" question needs, as one JSON doc
+        from . import attribution as _attribution
+
+        static = _q1(qs, "static") not in ("0", "false", "off")
+        k_s = _q1(qs, "top")
+        doc = {
+            "programs": _attribution.program_costs(
+                top_k=int(k_s) if k_s else 5, static=static),
+            "telemetry": _attribution.telemetry_state(),
+        }
+        return (200, "application/json",
+                json.dumps(doc, default=str).encode())
     if path == "/statusz":
         return 200, "text/plain; charset=utf-8", statusz_text().encode()
     if path == "/postmortems" or path.startswith("/postmortems/"):
@@ -353,7 +390,16 @@ def _postmortems_route(path: str) -> Tuple[int, str, bytes]:
                                     "mtime": st.st_mtime})
                 except OSError:
                     continue
-        doc = {"dir": directory or None, "postmortems": entries}
+        try:
+            from ..core import dispatch
+
+            pruned = int(dispatch.dispatch_counters().get(
+                "postmortems_pruned", 0) or 0)
+        except Exception:
+            pruned = 0
+        doc = {"dir": directory or None, "postmortems": entries,
+               "keep": int(_flags.flag("postmortem_keep")),
+               "pruned": pruned}
         return 200, "application/json", json.dumps(doc).encode()
     name = path[len("/postmortems/"):]
     # strict basename allowlist: this endpoint must never become a file

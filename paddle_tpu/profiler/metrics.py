@@ -299,6 +299,7 @@ _DISPATCH_LABEL_KEYS = {
     "serve_shed_reasons": "reason",
     "serve_expire_stages": "stage",
     "perf_regression_sites": "site",
+    "telemetry_spike_groups": "group",
 }
 
 

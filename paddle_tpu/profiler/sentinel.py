@@ -257,6 +257,17 @@ class PerfSentinel:
                 dispatch._counter_add("perf_regression_clears", 1)
         except Exception:
             pass
+        if what == "trip":
+            try:
+                # attribution triage: record the tripped key so the
+                # postmortem's attribution section names the regressed
+                # program key even when the cost registry's own drift
+                # arithmetic disagrees with the sentinel's
+                from . import attribution as _attribution
+
+                _attribution.note_regression(key, drift)
+            except Exception:
+                pass
         try:
             from . import trace as _trace
 

@@ -237,12 +237,54 @@ def dump_postmortem(reason: str, exc: Optional[BaseException] = None,
                 json.dump(doc, f, indent=1, default=str)
             os.replace(tmp, path)
             _pm_last_path = path
+            _prune_postmortems(directory, keep_path=path)
             emit("postmortem", site=reason, path=path)
             return path
         except Exception:
             return None
         finally:
             _pm_active = False
+
+
+def _prune_postmortems(directory: str, keep_path: Optional[str] = None):
+    """Bound the postmortem directory to FLAGS_postmortem_keep files,
+    oldest-first (a flapping sentinel or a rescue storm must not grow it
+    without limit). The just-written dump is never pruned; pruned files
+    are counted (postmortems_pruned) and reported by /postmortems."""
+    keep = int(_flags.flag("postmortem_keep"))
+    if keep <= 0:
+        return  # 0 = unbounded (the pre-ISSUE-15 behavior)
+    try:
+        entries = []
+        for name in os.listdir(directory):
+            if not (name.startswith("postmortem_") and name.endswith(".json")):
+                continue
+            p = os.path.join(directory, name)
+            try:
+                entries.append((os.stat(p).st_mtime, name, p))
+            except OSError:
+                continue
+        if len(entries) <= keep:
+            return
+        entries.sort()  # oldest first
+        pruned = 0
+        for _mtime, _name, p in entries[: len(entries) - keep]:
+            if keep_path is not None and os.path.abspath(p) == os.path.abspath(
+                    keep_path):
+                continue
+            try:
+                os.remove(p)
+                pruned += 1
+            except OSError:
+                continue
+        if pruned:
+            from ..core import dispatch
+
+            # _counter_add: the watchdog daemon and persist threads dump
+            # postmortems too, so the count must be race-free off-thread
+            dispatch._counter_add("postmortems_pruned", pruned)
+    except Exception:
+        pass  # pruning must never fail the dump that triggered it
 
 
 def _build_postmortem(reason, exc, attrs) -> Dict[str, Any]:
@@ -290,6 +332,17 @@ def _build_postmortem(reason, exc, attrs) -> Dict[str, Any]:
         doc["resilience"] = _rt.state()
     except Exception:
         doc["resilience"] = None
+    # spike auto-triage (paddle.profiler.attribution): which program key's
+    # measured EMA moved (cost-registry diff + the sentinel-tripped keys),
+    # which parameter group's grad-norm broke trend (last N fused-telemetry
+    # records), and the offending batch's sample ids recovered from the
+    # registered GlobalStepSampler
+    try:
+        from . import attribution as _attribution
+
+        doc["attribution"] = _attribution.triage_section()
+    except Exception:
+        doc["attribution"] = None
     return doc
 
 

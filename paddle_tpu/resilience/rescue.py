@@ -124,6 +124,18 @@ def handle_sentinel(optimizer, bad) -> bool:
     from ..core import dispatch
 
     dispatch._counters["numeric_rescues"] += 1
+    step = _current_step()
+    dispatch._emit("rescue", site="optimizer", policy=mode(), step=step)
+    # triage postmortem (no-op unless FLAGS_postmortem_dir is set): the
+    # attribution section names the out-of-trend parameter group (fused
+    # telemetry recorded the spike BEFORE this handler ran) and recovers
+    # the offending batch's sample ids from the registered sampler;
+    # FLAGS_postmortem_keep bounds a rescue storm's dump volume
+    try:
+        dispatch._trace_module().dump_postmortem(
+            "numeric_rescue", policy=mode(), step=step)
+    except Exception:
+        pass  # diagnostics must never add a second failure
     scaler = getattr(optimizer, "_rescue_scaler", None)
     if scaler is not None:
         # dynamic loss scaling reacts to the rescued step exactly as it

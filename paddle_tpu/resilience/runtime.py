@@ -192,16 +192,26 @@ def on_step_end(source: str = "train"):
     except Exception:
         pass  # observability must never break the step boundary
     try:
+        key = source
+        if source == "train":
+            from ..core import lazy as _lazy
+
+            sig = _lazy.step_signature_id()
+            if sig is not None:
+                key = f"train[{sig}]"
+        # attribution cost registry: the step-boundary lap feeds the
+        # host-inclusive `step`-category EMA (a slowdown BETWEEN program
+        # launches still attributes to its train/serve key). Inner try:
+        # an attribution failure must not cost the sentinel its lap.
+        try:
+            from ..profiler import attribution as _attribution
+
+            _attribution.step_lap(key)
+        except Exception:
+            pass
         from ..profiler import sentinel as _sentinel
 
         if _sentinel.PerfSentinel.enabled():
-            key = source
-            if source == "train":
-                from ..core import lazy as _lazy
-
-                sig = _lazy.step_signature_id()
-                if sig is not None:
-                    key = f"train[{sig}]"
             _sentinel.default_sentinel().lap(key)
     except Exception:
         pass  # the sentinel must never break the step boundary

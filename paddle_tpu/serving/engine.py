@@ -666,6 +666,17 @@ class Engine:
         _sentinel.retire(f"serve[{self._uid}]")
         _sentinel.retire(f"serve_decode[{self._uid}:")
         _sentinel.retire(f"serve_queue_wait[{self._uid}]")
+        # ... and its attribution cost-registry entries (program keys and
+        # the step-lap key): registry state must not grow with replica
+        # churn, and a dead engine's programs must drop out of /programz
+        try:
+            from ..profiler import attribution as _attribution
+
+            _attribution.retire(f"serve:prefill:{self._uid}:")
+            _attribution.retire(f"serve:decode:{self._uid}:")
+            _attribution.retire(f"serve[{self._uid}]")
+        except Exception:
+            pass
         # ... and its heartbeat source: a closed-without-drain engine must
         # not leave a stale armed source pinning /healthz at 'stalled'
         try:

@@ -1,0 +1,425 @@
+"""Attribution layer (ISSUE 15): program cost registry, fused numerics
+telemetry, and spike auto-triage.
+
+Covers paddle.profiler.attribution end to end on CPU:
+  - the cost registry sees all five executable categories (per-op pjit,
+    lazy segment, captured step, accumulate-only microstep, serving
+    bucket) plus the step-boundary lap keys, with static profiles
+    (flops/bytes/top-ops/est-peak) computed lazily from the traced jaxprs;
+  - FLAGS_telemetry adds ZERO device programs at every execution tier
+    (per-op / lazy-3 / captured-1, per measure_programs) and keeps step
+    numerics bitwise-identical to telemetry-off;
+  - a forced sentinel trip and a forced nan-rescue each dump a postmortem
+    whose `attribution` section names the regressed key, the out-of-trend
+    parameter group, and the offending batch's sample ids (recovered as a
+    pure function of the step from GlobalStepSampler);
+  - FLAGS_postmortem_keep bounds the postmortem directory oldest-first;
+  - the /programz diagnostics endpoint and the fleet-merged program-cost
+    table (fleet_top --programs data path) serve the same registry.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof
+from paddle_tpu.core import lazy
+from paddle_tpu.profiler import attribution, sentinel, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    lazy._tls.observer = None
+    lazy._capture_cache.clear()
+    prof.reset_dispatch_counters()
+    attribution.reset()
+    sentinel.reset()
+    trace.clear()
+    try:
+        yield
+    finally:
+        lazy.flush_if_pending("test_teardown")
+        lazy.drain_async()
+        paddle.set_flags({
+            "FLAGS_eager_lazy_dispatch": False,
+            "FLAGS_eager_step_capture": True,
+            "FLAGS_eager_async_compile": True,
+            "FLAGS_telemetry": False,
+            "FLAGS_numeric_rescue": "",
+            "FLAGS_fault_inject": "",
+            "FLAGS_postmortem_dir": "",
+            "FLAGS_postmortem_keep": 32,
+            "FLAGS_sentinel_pct": 0.0,
+        })
+        attribution.reset()
+        sentinel.reset()
+        lazy._tls.observer = None
+
+
+def _set_tier(tier):
+    paddle.set_flags({
+        "FLAGS_eager_lazy_dispatch": tier in ("lazy", "captured"),
+        "FLAGS_eager_step_capture": tier == "captured",
+        "FLAGS_eager_async_compile": False,
+    })
+
+
+def _trainer(seed=0, lr=1e-2, bsz=4, accum=1):
+    paddle.seed(seed)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4)
+    )
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((bsz, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (bsz,)))
+
+    def cycle():
+        for _ in range(accum):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return model, opt, cycle
+
+
+def _keys(prefix):
+    return [k for k in attribution.program_costs(static=False)
+            if k.startswith(prefix)]
+
+
+# ---------------------------------------------------------------------------
+# cost registry: all five executable categories + the step lap
+# ---------------------------------------------------------------------------
+def test_registry_sees_per_op_programs():
+    _set_tier("per_op")
+    _model, _opt, cycle = _trainer()
+    for _ in range(3):
+        cycle()
+    keys = _keys("op:")
+    assert keys, attribution.program_costs(static=False)
+    costs = attribution.program_costs(static=False)
+    assert all(costs[k]["category"] == "op" for k in keys)
+    # measured EMA fed from the dispatch-timer bracket
+    assert any(costs[k]["ema_ms"] is not None for k in keys)
+
+
+def test_registry_sees_segment_captured_and_step_keys():
+    _set_tier("captured")
+    _model, _opt, cycle = _trainer()
+    for _ in range(7):
+        cycle()
+    costs = attribution.program_costs(static=False)
+    assert _keys("segment:"), costs.keys()
+    assert _keys("captured:"), costs.keys()
+    # the step-boundary lap attributes host-inclusive time per train key
+    step_keys = [k for k, v in costs.items() if v["category"] == "step"]
+    assert any(k.startswith("train") for k in step_keys), costs.keys()
+
+
+def test_registry_sees_accum_microstep_programs():
+    _set_tier("captured")
+    _model, _opt, cycle = _trainer(accum=2)
+    for _ in range(6):
+        cycle()
+    assert prof.dispatch_counters()["capture_accum_replays"] >= 1
+    assert _keys("accum:"), attribution.program_costs(static=False).keys()
+
+
+def test_registry_sees_serving_bucket_programs():
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    _set_tier("per_op")
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    eng = serving.Engine(model, serving.ServingConfig(
+        block_size=8, prompt_buckets=[8], num_blocks=24))
+    try:
+        eng.serve([[1, 2, 3], [5, 6]], max_new_tokens=4)
+        keys = _keys("serve:")
+        assert any(":prefill:" in k or k.startswith("serve:prefill")
+                   for k in keys), keys
+        assert any(":decode:" in k or k.startswith("serve:decode")
+                   for k in keys), keys
+        uid = eng._uid
+    finally:
+        eng.close()
+    # Engine.close retires its registry entries (no replica-churn growth)
+    assert not [k for k in _keys("serve:") if f":{uid}:" in k]
+
+
+def test_static_profile_flops_top_ops_and_peak():
+    _set_tier("captured")
+    _model, _opt, cycle = _trainer()
+    for _ in range(7):
+        cycle()
+    costs = attribution.program_costs(top_k=3)
+    key = _keys("captured:")[0]
+    row = costs[key]
+    assert row["flops_est"] > 0
+    assert row["bytes_est"] > 0
+    assert row["eqns"] > 0
+    assert row["top_ops"] and row["top_ops"][0]["flops_est"] >= \
+        row["top_ops"][-1]["flops_est"]
+    # dot_general dominates an MLP step
+    assert row["top_ops"][0]["op"] == "dot_general", row["top_ops"]
+    assert row.get("est_peak_hbm_mb") is not None and \
+        row["est_peak_hbm_mb"] > 0
+    # measured side rides along, and the program_cost_* families exist
+    assert row["runs"] >= 1
+    text = prof.metrics.prometheus_text()
+    assert "paddle_program_cost_measured_ms{" in text
+    assert "paddle_program_cost_runs{" in text
+
+
+# ---------------------------------------------------------------------------
+# fused telemetry: zero extra programs per tier, bitwise step parity
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("tier,golden", [("per_op", None), ("lazy", 3),
+                                         ("captured", 1)])
+def test_telemetry_adds_zero_programs(tier, golden):
+    _set_tier(tier)
+    _model, _opt, cycle = _trainer()
+    off = prof.measure_programs(cycle, warmup=6)
+    paddle.set_flags({"FLAGS_telemetry": True})
+    _model2, _opt2, cycle2 = _trainer()
+    on = prof.measure_programs(cycle2, warmup=6)
+    paddle.set_flags({"FLAGS_telemetry": False})
+    assert on["programs"] == off["programs"], (tier, on["programs"],
+                                               off["programs"])
+    if golden is not None:
+        assert on["programs"] == golden, (tier, on["programs"])
+    assert prof.dispatch_counters()["telemetry_steps"] >= 1
+
+
+@pytest.mark.parametrize("tier", ["per_op", "captured"])
+def test_telemetry_bitwise_step_parity(tier):
+    def run(telemetry):
+        _set_tier(tier)
+        paddle.set_flags({"FLAGS_telemetry": telemetry})
+        model, opt, cycle = _trainer()
+        losses = [float(cycle()) for _ in range(6)]
+        params = [np.asarray(p.numpy()) for p in model.parameters()]
+        states = []
+        for p in model.parameters():
+            st = opt._accumulators.get(id(p)) or {}
+            states.append({k: np.asarray(v) for k, v in st.items()})
+        paddle.set_flags({"FLAGS_telemetry": False})
+        return losses, params, states
+
+    l_off, p_off, s_off = run(False)
+    attribution.reset()
+    lazy._tls.observer = None
+    lazy._capture_cache.clear()
+    l_on, p_on, s_on = run(True)
+    assert l_on == l_off
+    for a, b in zip(p_on, p_off):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_on, s_off):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_telemetry_records_groups_and_event():
+    _set_tier("captured")
+    paddle.set_flags({"FLAGS_telemetry": True})
+    _model, _opt, cycle = _trainer()
+    for _ in range(7):
+        cycle()
+    st = attribution.telemetry_state()
+    assert st["enabled"] and st["steps"] >= 7
+    assert st["groups"], st
+    g = next(iter(st["groups"].values()))
+    assert g["grad_norm"] is not None and g["param_norm"] is not None
+    assert st["tail"] and "groups" in st["tail"][-1]
+    evs = trace.events(kind="telemetry")
+    assert evs and evs[-1].attrs["groups"] == len(
+        st["tail"][-1]["groups"])
+    # per-group gauges in the unified registry
+    text = prof.metrics.prometheus_text()
+    assert "paddle_telemetry_grad_norm{" in text
+    assert "paddle_telemetry_update_ratio{" in text
+
+
+# ---------------------------------------------------------------------------
+# triage: sentinel trip + nan rescue postmortems carry attribution
+# ---------------------------------------------------------------------------
+def test_sentinel_trip_postmortem_names_regressed_key(tmp_path):
+    paddle.set_flags({"FLAGS_postmortem_dir": str(tmp_path),
+                      "FLAGS_sentinel_pct": 20.0,
+                      "FLAGS_sentinel_warmup_steps": 3,
+                      "FLAGS_sentinel_sustain_steps": 2})
+    for _ in range(6):
+        sentinel.observe("train[feed]", 10.0)
+    for _ in range(4):
+        sentinel.observe("train[feed]", 40.0)
+    pms = [f for f in os.listdir(tmp_path) if "perf_regression" in f]
+    assert len(pms) == 1, os.listdir(tmp_path)
+    doc = json.load(open(tmp_path / pms[0]))
+    att = doc["attribution"]
+    tripped = att["programs"]["tripped"]
+    assert tripped and tripped[-1]["key"] == "train[feed]"
+    assert tripped[-1]["drift_pct"] > 20.0
+    # schema: the three triage sections are always present
+    assert set(att) == {"programs", "telemetry", "batch"}
+    assert "regressed" in att["programs"] and "top_measured" in att["programs"]
+    assert "spiking_groups" in att["telemetry"] and "tail" in att["telemetry"]
+    assert "sample_ids" in att["batch"]
+
+
+def test_nan_rescue_postmortem_names_spiking_group_and_samples(tmp_path):
+    from paddle_tpu.io import GlobalStepSampler
+
+    _set_tier("per_op")
+    paddle.set_flags({"FLAGS_postmortem_dir": str(tmp_path),
+                      "FLAGS_numeric_rescue": "skip",
+                      "FLAGS_telemetry": True})
+    sampler = GlobalStepSampler(64, global_batch_size=8, seed=3)
+    model, opt, cycle = _trainer()
+    fed = []
+    for i in range(3):
+        fed.append([int(v) for v in sampler.local_ids(sampler.cursor)])
+        sampler.cursor += 1
+        if i == 2:  # one-step injection window: exactly one rescue
+            paddle.set_flags({"FLAGS_fault_inject": "nan:grads:p=1:x=1"})
+        cycle()
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+    assert prof.dispatch_counters()["numeric_rescues"] == 1
+    pms = [f for f in os.listdir(tmp_path) if "numeric_rescue" in f]
+    assert len(pms) == 1, os.listdir(tmp_path)
+    doc = json.load(open(tmp_path / pms[0]))
+    att = doc["attribution"]
+    # the nan'd grad is a spike: the group is named, out of trend
+    assert att["telemetry"]["spiking_groups"], att["telemetry"]
+    assert att["telemetry"]["total_spikes"] >= 1
+    last = att["telemetry"]["tail"][-1]["groups"]
+    assert any(v["spike"] for v in last.values())
+    # sample-id recovery: ids of the offending step, pure fn of the step
+    assert att["batch"]["sampler"] is True
+    assert att["batch"]["step"] == 2
+    assert att["batch"]["sample_ids"] == fed[-1]
+    # the rescued step left params untouched AND the rescue event is in
+    # the postmortem's own tail
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "rescue" in kinds and "telemetry" in kinds
+
+
+def test_sample_id_recovery_matches_sampler():
+    from paddle_tpu.io import GlobalStepSampler
+
+    sampler = GlobalStepSampler(128, global_batch_size=16, seed=11)
+    for _ in range(5):
+        sampler.cursor += 1
+    sec = attribution.triage_section()
+    assert sec["batch"]["step"] == 4
+    assert sec["batch"]["sample_ids"] == [
+        int(v) for v in sampler.local_ids(4)]
+    assert sec["batch"]["epoch"] == 0
+
+
+# ---------------------------------------------------------------------------
+# postmortem directory bounding (FLAGS_postmortem_keep)
+# ---------------------------------------------------------------------------
+def test_postmortem_keep_prunes_oldest_first(tmp_path):
+    paddle.set_flags({"FLAGS_postmortem_dir": str(tmp_path),
+                      "FLAGS_postmortem_keep": 4})
+    paths = [trace.dump_postmortem("test", n=i) for i in range(9)]
+    assert all(p for p in paths)
+    files = sorted(f for f in os.listdir(tmp_path) if f.endswith(".json"))
+    assert len(files) == 4
+    # oldest pruned first: the newest four survive
+    survivors = {os.path.basename(p) for p in paths[-4:]}
+    assert set(files) == survivors
+    assert prof.dispatch_counters()["postmortems_pruned"] == 5
+
+
+def test_postmortem_keep_zero_is_unbounded(tmp_path):
+    paddle.set_flags({"FLAGS_postmortem_dir": str(tmp_path),
+                      "FLAGS_postmortem_keep": 0})
+    for i in range(6):
+        trace.dump_postmortem("test", n=i)
+    assert len([f for f in os.listdir(tmp_path)
+                if f.endswith(".json")]) == 6
+
+
+# ---------------------------------------------------------------------------
+# /programz + /postmortems pruned count + fleet merge
+# ---------------------------------------------------------------------------
+def test_programz_endpoint_serves_registry_and_telemetry(tmp_path):
+    import urllib.request
+
+    from paddle_tpu.profiler import diag
+
+    _set_tier("captured")
+    paddle.set_flags({"FLAGS_telemetry": True})
+    _model, _opt, cycle = _trainer()
+    for _ in range(7):
+        cycle()
+    addr = diag.start(port=0)
+    try:
+        with urllib.request.urlopen(f"http://{addr}/programz",
+                                    timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert any(k.startswith("captured:") for k in doc["programs"])
+        assert doc["telemetry"]["enabled"] is True
+        assert doc["telemetry"]["groups"]
+        paddle.set_flags({"FLAGS_postmortem_dir": str(tmp_path),
+                          "FLAGS_postmortem_keep": 2})
+        for i in range(4):
+            trace.dump_postmortem("test", n=i)
+        with urllib.request.urlopen(f"http://{addr}/postmortems",
+                                    timeout=5) as r:
+            pm = json.loads(r.read().decode())
+        assert pm["keep"] == 2 and pm["pruned"] == 2
+        assert len(pm["postmortems"]) == 2
+        # /statusz renders the attribution section
+        with urllib.request.urlopen(f"http://{addr}/statusz",
+                                    timeout=5) as r:
+            body = r.read()
+        assert b"attribution" in body and b"telemetry:" in body
+    finally:
+        diag.stop()
+
+
+def test_fleet_programs_merges_and_ranks():
+    from paddle_tpu.distributed.fleet.obs import (FleetAggregator, MemoryKv,
+                                                  ObsPublisher)
+
+    attribution.note_run("captured:aaaa", "captured", 5.0)
+    attribution.note_run("segment:bbbb", "segment", 1.0)
+    kv = MemoryKv()
+    pub = ObsPublisher(kv=kv, job_id="j", node_id="n0")
+    assert pub.publish()
+    agg = FleetAggregator(kv=kv, job_id="j")
+    rows = agg.fleet_programs(k=5)
+    assert rows and rows[0]["key"] == "captured:aaaa"
+    assert rows[0]["node"] == "n0" and rows[0]["ema_ms"] == 5.0
+    # the health table picks up the telemetry column schema (None when off)
+    health = agg.fleet_health()
+    assert "grad_norm" in health[0]
+
+
+def test_chrome_counter_lanes_in_export(tmp_path):
+    _set_tier("captured")
+    _model, _opt, cycle = _trainer()
+    for _ in range(7):
+        cycle()
+    path = str(tmp_path / "trace.json")
+    prof.Profiler(timer_only=True).export(path)
+    doc = json.load(open(path))
+    lanes = [e for e in doc["traceEvents"] if e.get("ph") == "C"
+             and e.get("cat") == "attribution"]
+    assert lanes and any("captured:" in e["name"] for e in lanes)
+    assert doc["metadata"]["program_counter_samples"] == len(lanes)

@@ -5,9 +5,13 @@ Point it at the elastic TCP lease/KV master any fleet job already runs
 under ``obs/<job>/<node>`` via ``ObsPublisher``) and get one merged view:
 
   default         one health row per live worker (node, status, step,
-                  snapshot age, diag address, engine healths)
+                  snapshot age, diag address, engine healths, and — when
+                  FLAGS_telemetry is on there — the hottest parameter
+                  group's grad norm)
   --metrics       one merged Prometheus exposition, every family labeled
                   host="<node>" — pipe to a file and point promtool at it
+  --programs      fleet-merged top-k program costs by measured wall-time
+                  EMA (the attribution cost registry, ISSUE 15)
   --trace OUT     one merged chrome trace with a process lane per host
                   (clock-offset-aligned flight rings pulled over each
                   worker's diagnostics server) — load in Perfetto
@@ -32,21 +36,60 @@ def _fmt_opt(v, suffix=""):
     return "-" if v is None else f"{v}{suffix}"
 
 
+def _esc(v):
+    """Hostile names (program keys arrive from remote snapshots, exactly
+    like node names) escaped per the exposition rules so a newline or
+    quote cannot tear the rendered table."""
+    from paddle_tpu.profiler.metrics import escape_label_value
+
+    return escape_label_value(str(v))
+
+
+def _fmt_gnorm(r):
+    gn = r.get("grad_norm")
+    if gn is None:
+        return "-"
+    group = r.get("grad_norm_group")
+    val = gn if isinstance(gn, str) else f"{float(gn):.4g}"
+    return f"{val}@{_esc(group)}" if group else str(val)
+
+
 def _render_health(rows) -> str:
     if not rows:
         return "(no live obs/<job>/* leases — is the fleet publishing?)"
-    cols = ["node", "status", "step", "epoch", "lag_ms", "accum", "age_s",
-            "pid", "diag", "reasons", "engines"]
+    cols = ["node", "status", "step", "epoch", "lag_ms", "accum", "gnorm",
+            "age_s", "pid", "diag", "reasons", "engines"]
     table = [cols]
     for r in rows:
         table.append([
-            str(r["node"]), str(r["status"]), str(r["step"]),
+            _esc(r["node"]), str(r["status"]), str(r["step"]),
             _fmt_opt(r.get("epoch")), _fmt_opt(r.get("step_lag_ms")),
-            _fmt_opt(r.get("accum")),
+            _fmt_opt(r.get("accum")), _fmt_gnorm(r),
             str(r["age_s"]), str(r["pid"]), str(r["diag"]),
             ",".join(r["reasons"]) or "-",
             ",".join(f"{k}:{v}" for k, v in sorted(r["engines"].items()))
             or "-",
+        ])
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = []
+    for j, row in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _render_programs(rows) -> str:
+    if not rows:
+        return ("(no program costs published — are workers running with "
+                "measured programs?)")
+    cols = ["node", "program", "category", "ema_ms", "runs", "drift_pct"]
+    table = [cols]
+    for r in rows:
+        table.append([
+            _esc(r["node"]), _esc(r["key"]), str(r.get("category")),
+            f"{r['ema_ms']:.4f}", str(r["runs"]),
+            _fmt_opt(r.get("drift_pct"), "%"),
         ])
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = []
@@ -64,6 +107,11 @@ def main(argv=None):
     ap.add_argument("--job", default="default", help="fleet job id")
     ap.add_argument("--metrics", action="store_true",
                     help="print the merged Prometheus exposition and exit")
+    ap.add_argument("--programs", action="store_true",
+                    help="print the fleet-merged top-k program costs by "
+                         "measured ms and exit")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the --programs table")
     ap.add_argument("--trace", metavar="OUT",
                     help="write the merged chrome trace JSON to OUT")
     ap.add_argument("--flight-kind", default=None,
@@ -82,6 +130,14 @@ def main(argv=None):
 
     if args.metrics:
         sys.stdout.write(agg.merged_prometheus_text())
+        return 0
+    if args.programs:
+        rows = agg.fleet_programs(k=args.top)
+        if args.json:
+            for r in rows:
+                print(json.dumps(r))
+        else:
+            print(_render_programs(rows))
         return 0
     if args.trace:
         doc = agg.merged_chrome_trace(kind=args.flight_kind, last=args.last)

@@ -17,6 +17,13 @@ example (and a tiny GPT serving engine):
   trace-overhead        tracing on (default ring) costs < 1% steps/s vs
                         FLAGS_trace_ring_size=0, measured on the captured
                         steady state; events/step is reported
+  triage                (ISSUE 15) a one-step nan:grads injection and a
+                        forced steady slowdown each dump EXACTLY ONE
+                        postmortem whose attribution section names the
+                        slowed program key, the spiking parameter group,
+                        and the offending batch's sample ids (recovered
+                        from GlobalStepSampler); telemetry-on overhead
+                        gated < 1% analytically
 
 Exits nonzero on any failed gate (tests/test_observability.py runs this
 CLI as a slow subprocess test).
@@ -33,6 +40,8 @@ import os
 import sys
 import tempfile
 import time
+
+import numpy as np
 
 if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
     import jax
@@ -574,6 +583,156 @@ def scenario_sentinel(batches, results, pmdir):
     return ok
 
 
+def scenario_triage(batches, results, pmdir, budget_pct=1.0):
+    """The ISSUE-15 attribution gate: with FLAGS_telemetry on and a
+    GlobalStepSampler driving the batches, (a) a one-step nan:grads
+    injection under numeric_rescue=skip dumps EXACTLY ONE numeric_rescue
+    postmortem whose attribution names the spiking param group and the
+    offending batch's sample ids; (b) a forced steady slowdown trips the
+    sentinel EXACTLY ONCE, and its perf_regression postmortem's
+    attribution names the slowed program key (train), the spike that
+    preceded it, and the step's sample ids; (c) telemetry-on overhead is
+    gated < budget analytically (host record cost per step over step
+    time — the device-side work is folded into the step program and adds
+    zero launches, bitwise-identically; see tests/test_attribution.py)."""
+    from paddle_tpu.io import GlobalStepSampler
+    from paddle_tpu.profiler import attribution
+
+    # lazy tier, capture off: the sentinel/step key stays a stable 'train'
+    # (no capture re-arm can retire it mid-scenario), and nan:grads fires
+    # directly in the fused update instead of via a capture fallback. A
+    # prior scenario's ARMED controller would still tag the key with its
+    # signature — drop the thread's observer so the key is clean.
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": False})
+    from paddle_tpu.core import lazy as _lazy_mod
+
+    _lazy_mod._tls.observer = None
+    _fresh()
+    attribution.reset()
+    checks = {}
+    m = {}
+    try:
+        paddle.set_flags({"FLAGS_postmortem_dir": pmdir,
+                          "FLAGS_numeric_rescue": "skip",
+                          "FLAGS_telemetry": True})
+        net, opt, loss_fn = _build()
+        # one sample pool; the sampler's ids pick each step's batch, so a
+        # postmortem's recovered ids are checkable against what we fed
+        xs = np.concatenate([b[0] for b in batches])
+        ys = np.concatenate([b[1] for b in batches])
+        sampler = GlobalStepSampler(len(xs), global_batch_size=BATCH,
+                                    seed=5)
+        fed = {}
+
+        def sampled_step():
+            step_no = sampler.cursor
+            ids = [int(i) for i in sampler.local_ids(step_no)]
+            sampler.cursor += 1
+            fed[step_no] = ids
+            return _one_step(net, opt, loss_fn, (xs[ids], ys[ids]))
+
+        for _ in range(8):  # settle: compiles must not poison the baseline
+            sampled_step()
+        from paddle_tpu.core import lazy as _lazy
+
+        _lazy.drain_async()
+        sampled_step()
+        paddle.set_flags({"FLAGS_sentinel_pct": 30.0,
+                          "FLAGS_sentinel_warmup_steps": 6,
+                          "FLAGS_sentinel_sustain_steps": 3})
+        prof.sentinel.reset()
+        t_window = []
+        for _ in range(10):  # steady window: arms the sentinel baseline
+            t0 = time.perf_counter()
+            sampled_step()
+            t_window.append(time.perf_counter() - t0)
+        step_ms = sorted(t_window)[len(t_window) // 2] * 1000.0
+
+        # (a) one-step nan injection -> exactly one rescue postmortem
+        paddle.set_flags({"FLAGS_fault_inject": "nan:grads:p=1:x=1"})
+        sampled_step()
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+        c = prof.dispatch_counters()
+        checks["one_rescue"] = c["numeric_rescues"] == 1
+        rescue_pms = [f for f in os.listdir(pmdir)
+                      if f.startswith("postmortem_numeric_rescue")]
+        checks["one_rescue_postmortem"] = len(rescue_pms) == 1
+        spiking_group = None
+        if rescue_pms:
+            with open(os.path.join(pmdir, rescue_pms[0])) as f:
+                doc = json.load(f)
+            att = doc["attribution"]
+            spiking = att["telemetry"]["spiking_groups"]
+            spiking_group = spiking[0] if spiking else None
+            checks["rescue_names_spiking_group"] = bool(spiking)
+            checks["rescue_names_sample_ids"] = (
+                att["batch"]["sample_ids"] == fed.get(att["batch"]["step"]))
+        for _ in range(4):  # settle back before the slowdown phase
+            sampled_step()
+
+        # (b) forced steady slowdown -> exactly one perf_regression
+        # postmortem whose attribution names the slowed key + the spike
+        base_ms = max(step_ms, 1.0)
+        for _ in range(16):
+            sampled_step()
+            time.sleep(base_ms / 1000.0)
+        c = prof.dispatch_counters()
+        checks["exactly_one_trip"] = c["perf_regressions"] == 1
+        trip_pms = [f for f in os.listdir(pmdir)
+                    if f.startswith("postmortem_perf_regression")]
+        checks["one_trip_postmortem"] = len(trip_pms) == 1
+        if trip_pms:
+            with open(os.path.join(pmdir, trip_pms[0])) as f:
+                doc = json.load(f)
+            att = doc["attribution"]
+            tripped = att["programs"]["tripped"]
+            checks["trip_names_slowed_key"] = bool(
+                tripped and tripped[-1]["key"].startswith("train")
+                and tripped[-1]["drift_pct"] > 30.0)
+            checks["trip_carries_spike_history"] = (
+                att["telemetry"]["total_spikes"] >= 1
+                and spiking_group is not None)
+            checks["trip_names_sample_ids"] = (
+                att["batch"]["sample_ids"] == fed.get(att["batch"]["step"]))
+            m["tripped_key"] = None if not tripped else tripped[-1]["key"]
+            m["spiking_group"] = spiking_group
+
+        # (c) telemetry-on overhead, analytic: marginal host record cost
+        # (tight-loop microbench over the live group names — the one
+        # measurement definition in attribution.measure_record_cost_ms)
+        # × one record/step over steady step time, same house style as
+        # the flight-recorder per-emit bound; the live EMA — which folds
+        # in cache-warming noise an A/B cannot attribute — rides along
+        # unguarded. Runs LAST: the microbench mutates telemetry state.
+        m["telemetry_steps"] = int(
+            prof.dispatch_counters()["telemetry_steps"])
+        live_ms = attribution.telemetry_record_cost_ms() or 0.0
+        pnames = attribution.group_names(list(net.parameters()))
+        rec_ms = attribution.measure_record_cost_ms(pnames)
+        overhead_pct = rec_ms / max(step_ms, 1e-9) * 100.0
+        checks["telemetry_overhead_under_budget"] = overhead_pct < budget_pct
+        m.update({
+            "telemetry_record_cost_ms": round(rec_ms, 4),
+            "telemetry_record_cost_live_ms": round(live_ms, 4),
+            "step_ms": round(step_ms, 3),
+            "telemetry_overhead_pct": round(overhead_pct, 4),
+        })
+    finally:
+        paddle.set_flags({"FLAGS_postmortem_dir": "",
+                          "FLAGS_numeric_rescue": "",
+                          "FLAGS_telemetry": False,
+                          "FLAGS_sentinel_pct": 0.0,
+                          "FLAGS_fault_inject": "",
+                          "FLAGS_eager_step_capture": True})
+        prof.sentinel.reset()
+        attribution.reset()
+    ok = all(checks.values())
+    results.append(dict({"scenario": "triage", "ok": ok,
+                         "budget_pct": budget_pct}, **checks, **m))
+    return ok
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=STEPS)
@@ -595,6 +754,12 @@ def main(argv=None):
                                    args.overhead_budget_pct)
         with tempfile.TemporaryDirectory() as pmdir:
             ok &= scenario_sentinel(batches, results, pmdir)
+        # the triage scenario runs SEQUENTIALLY after the other slow
+        # probes (never in parallel with them: CPU contention makes the
+        # timing-based fleet/elastic gates flake)
+        with tempfile.TemporaryDirectory() as pmdir:
+            ok &= scenario_triage(batches, results, pmdir,
+                                  args.overhead_budget_pct)
         if not args.skip_overhead:
             ok &= scenario_trace_overhead(batches, results,
                                           args.overhead_budget_pct)
@@ -605,6 +770,8 @@ def main(argv=None):
             "FLAGS_trace_ring_size": 4096,
             "FLAGS_trace_stall_ms": 0.0,
             "FLAGS_sentinel_pct": 0.0,
+            "FLAGS_telemetry": False,
+            "FLAGS_numeric_rescue": "",
             "FLAGS_eager_lazy_dispatch": False,
             "FLAGS_eager_step_capture": True,
             "FLAGS_retry_backoff_ms": 5.0,

@@ -48,6 +48,7 @@ SUBMODULES = [
     "profiler.trace",
     "profiler.diag",
     "profiler.sentinel",
+    "profiler.attribution",
     "distributed.fleet.obs",
     "distributed.fleet.elastic",
     "resilience",
