@@ -1099,6 +1099,24 @@ def main():
         )
     except Exception as e:
         _block_failed("memory_plan", e)
+    # planner-chosen remat at a 60%-of-unplanned budget: record the
+    # planned-vs-unplanned est_peak_hbm_mb pair so BENCH_*.json trajectories
+    # show what the planner buys — BENCH_MEMORY_PLAN=0 skips it
+    if os.environ.get("BENCH_MEMORY_PLAN", "1") == "1":
+        try:
+            unplanned_mb = result["est_peak_hbm_mb"]
+            rplan = step.plan_remat(budget_mb=0.6 * unplanned_mb)
+            result["memory_plan"] = {
+                "budget_mb": round(0.6 * unplanned_mb, 1),
+                "est_peak_hbm_unplanned_mb": unplanned_mb,
+                "est_peak_hbm_planned_mb": round(
+                    rplan.peak_after_bytes / 2**20, 1),
+                "recompute_pct": round(rplan.recompute_pct, 1),
+                "cut_points": list(rplan.cut_points),
+                "feasible": rplan.feasible,
+            }
+        except Exception as e:
+            _block_failed("memory_plan_remat", e)
     # resilience trajectory block (retries / fallbacks / recovery overhead /
     # sentinel-is-free proof) — BENCH_RESILIENCE=0 skips it
     if os.environ.get("BENCH_RESILIENCE", "1") == "1":

@@ -711,5 +711,6 @@ def enforce(diags: List[Diagnostic], where: str, level: Optional[int] = None):
 
 from . import passes as _builtin_passes  # noqa: E402,F401  (registers the suite)
 from . import memory  # noqa: E402  (registers memory_budget / donation_safety)
+from . import plan  # noqa: E402  (remat planner over the liveness estimates)
 
-__all__ += ["memory"]
+__all__ += ["memory", "plan"]

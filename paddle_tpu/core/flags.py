@@ -225,6 +225,28 @@ define_flag(
     "combine with FLAGS_check_programs to warn (1) or raise (2) at "
     "Executor.run compile time and lazy-segment flush",
 )
+define_flag(
+    "memory_plan", "",
+    "turn the memory_budget liveness estimate into an optimizer "
+    "(paddle_tpu.analysis.plan): 'auto' makes the whole-step capture trace "
+    "and jit.compile_train_step build a rematerialization plan whenever "
+    "FLAGS_memory_budget_mb > 0 — the forward is sliced into planner-chosen "
+    "jax.checkpoint stages so the step's estimated peak HBM fits the "
+    "budget, recomputing only the slices peak-liveness demands (bitwise-"
+    "identical numerics; a failed plan build falls back to the unplanned "
+    "step as a counted reason). Empty (default) = plans are only built "
+    "when explicitly requested (graph_lint --plan, plan_remat())",
+)
+define_flag(
+    "offload_overhead_pct", 1.0,
+    "measured-overhead budget (% of step time) for the optimizer host-"
+    "offload scheduler (paddle_tpu.optimizer.offload): cold accumulator "
+    "groups are parked in host memory between their update reads, and the "
+    "scheduler shrinks/regrows the offloaded set from blocked-transfer "
+    "EMAs so the prefetch stall it adds to a step stays under this budget "
+    "(the CheckFreq tune-to-a-measured-budget discipline, like "
+    "FLAGS_ckpt_overhead_pct)",
+)
 # ---------------------------------------------------------------------------
 # Resilience runtime (paddle.resilience — see RESILIENCE.md)
 # ---------------------------------------------------------------------------

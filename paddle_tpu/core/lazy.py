@@ -960,7 +960,11 @@ class _CaptureEntry:
                  # arg ShapeDtypeStructs of the first replay, and whether
                  # params/state were donated — captured_step_program()
                  # retraces these for the memory planner without compiling
-                 "step_fn", "arg_specs", "donated", "__weakref__")
+                 "step_fn", "arg_specs", "donated",
+                 # planner-guided remat (analysis.plan): the RematPlan this
+                 # build applied (or proved empty), None when FLAGS_memory_plan
+                 # did not ask for one
+                 "mem_plan", "__weakref__")
 
 
 class _CaptureIneligible(Exception):
@@ -976,6 +980,16 @@ def _capture_on() -> bool:
         bool(flags.flag("eager_lazy_dispatch"))
         and bool(flags.flag("eager_step_capture"))
         and not flags.flag("check_nan_inf")
+    )
+
+
+def _mem_plan_on() -> bool:
+    # planner-guided remat for the captured step: FLAGS_memory_plan=auto
+    # plans against FLAGS_memory_budget_mb (a budget of 0 keeps the linter
+    # semantics — nothing to optimize against)
+    return (
+        str(flags.flag("memory_plan")) == "auto"
+        and float(flags.flag("memory_budget_mb")) > 0
     )
 
 
@@ -1544,46 +1558,56 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
                                      telemetry=tele_on)
     has_grad_in = rec.grad_prev_vals is not None
 
-    def step_fn(p_vals, sts, lr, extra_vals, rest_vals, gp_in, gx_in):
-        ext = [None] * n_ext
-        for s, v in zip(rest_slots, rest_vals):
-            ext[s] = v
+    def make_step_fn(planned_loss=None):
+        def step_fn(p_vals, sts, lr, extra_vals, rest_vals, gp_in, gx_in):
+            ext = [None] * n_ext
+            for s, v in zip(rest_slots, rest_vals):
+                ext[s] = v
 
-        def loss_of(dp, dx):
-            e = list(ext)
-            for s, v in zip(param_slots, dp):
-                e[s] = v
-            for s, v in zip(extra_slots, dx):
-                e[s] = v
-            results = fwd(e)
-            return results[root_op][root_out], results
+            if planned_loss is not None:
+                # planner-guided remat: the loss path replays as the sliced
+                # jax.checkpoint stages the RematPlan chose (same eqns, same
+                # order — bitwise-equal values, recomputed in the backward)
+                def loss_of(dp, dx):
+                    return planned_loss(dp, dx, tuple(rest_vals))
+            else:
+                def loss_of(dp, dx):
+                    e = list(ext)
+                    for s, v in zip(param_slots, dp):
+                        e[s] = v
+                    for s, v in zip(extra_slots, dx):
+                        e[s] = v
+                    results = fwd(e)
+                    return results[root_op][root_out], results
 
-        loss_val, vjp, results = jax.vjp(
-            loss_of, tuple(p_vals), tuple(extra_vals), has_aux=True
-        )
-        del loss_val  # the loss is results[root_op][root_out]
-        gp, gx = vjp(jnp.ones(seed_shape, seed_dtype))
-        if has_grad_in:
-            # accumulation: fold this microstep's grads into the k-1-step
-            # partial sums, prev + new — the eager sweep's accumulate order
-            gp = tuple(a + b for a, b in zip(gp_in, gp))
-            gx = tuple(a + b for a, b in zip(gx_in, gx))
-        # grad clipping (built-in configs only): the SAME pure function the
-        # eager Optimizer.step() applies between backward and the fused
-        # update (nn/clip.py _pure), over the param grads in param-list
-        # order — global-norm reduction order and all. The update (and the
-        # non-finite sentinel, when on) sees the CLIPPED grads; the grads
-        # written back to p.grad stay unclipped, exactly like the eager
-        # path, which never writes the clipped values back.
-        upd_g = tuple(clip_fn(list(gp))) if clip_fn is not None else gp
-        # numeric-rescue sentinel and fused telemetry (paddle.resilience /
-        # paddle.profiler.attribution): extra OUTPUTS of the SAME program —
-        # the sentinel scalar where-gates the update in-program, the
-        # telemetry vector stacks per-param grad/param/update norms — so
-        # both add zero program launches and never perturb the update math
-        upd = apply_update(p_vals, upd_g, lr, sts)
-        new_p, new_s = upd[0], upd[1]
-        return (results, gp, gx, tuple(new_p), tuple(new_s)) + tuple(upd[2:])
+            loss_val, vjp, results = jax.vjp(
+                loss_of, tuple(p_vals), tuple(extra_vals), has_aux=True
+            )
+            del loss_val  # the loss is results[root_op][root_out]
+            gp, gx = vjp(jnp.ones(seed_shape, seed_dtype))
+            if has_grad_in:
+                # accumulation: fold this microstep's grads into the k-1-step
+                # partial sums, prev + new — the eager sweep's accumulate order
+                gp = tuple(a + b for a, b in zip(gp_in, gp))
+                gx = tuple(a + b for a, b in zip(gx_in, gx))
+            # grad clipping (built-in configs only): the SAME pure function the
+            # eager Optimizer.step() applies between backward and the fused
+            # update (nn/clip.py _pure), over the param grads in param-list
+            # order — global-norm reduction order and all. The update (and the
+            # non-finite sentinel, when on) sees the CLIPPED grads; the grads
+            # written back to p.grad stay unclipped, exactly like the eager
+            # path, which never writes the clipped values back.
+            upd_g = tuple(clip_fn(list(gp))) if clip_fn is not None else gp
+            # numeric-rescue sentinel and fused telemetry (paddle.resilience /
+            # paddle.profiler.attribution): extra OUTPUTS of the SAME program —
+            # the sentinel scalar where-gates the update in-program, the
+            # telemetry vector stacks per-param grad/param/update norms — so
+            # both add zero program launches and never perturb the update math
+            upd = apply_update(p_vals, upd_g, lr, sts)
+            new_p, new_s = upd[0], upd[1]
+            return (results, gp, gx, tuple(new_p), tuple(new_s)) + tuple(upd[2:])
+
+        return step_fn
 
     entry = _CaptureEntry()
     entry.rescue = rescue_on
@@ -1595,8 +1619,6 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     # opts out (keeps the 1-program step, drops in-place reuse) for code
     # that holds aliases of param/state buffers across steps.
     donate = (0, 1) if flags.flag("eager_capture_donate") else ()
-    entry.exe = jax.jit(step_fn, donate_argnums=donate)
-    entry.step_fn = step_fn
     entry.arg_specs = None  # recorded at first replay
     entry.donated = bool(donate)
     entry.param_idx = param_idx
@@ -1606,7 +1628,95 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     entry.rest_slots = rest_slots
     entry.warmed = False
     entry.pending = None
+    entry.mem_plan = None
+    planned_loss = None
+    if _mem_plan_on():
+        # planner-guided remat (FLAGS_memory_plan=auto): slice this step's
+        # loss replay into jax.checkpoint stages chosen against
+        # FLAGS_memory_budget_mb. Every op output of the capture escapes to
+        # the host write-back (the _flush contract), so the planner usually
+        # proves there is nothing profitable to cut and returns an identity
+        # plan — honesty over wishful savings. A failed BUILD aborts the
+        # capture through the ladder as a counted reason (the CUDA Graphs
+        # bail-out contract), never a half-applied plan.
+        try:
+            entry.mem_plan, planned_loss = _build_capture_plan(
+                rec, opt, entry, make_step_fn, fwd,
+                n_ext, param_slots, extra_slots, rest_slots,
+                root_op, root_out)
+        except Exception as e:
+            from ..analysis import plan as _plan_mod
+
+            _plan_mod.record_failure("capture", e)
+            raise _CaptureIneligible("memory_plan_failed")
+    step_fn = make_step_fn(planned_loss)
+    entry.exe = jax.jit(step_fn, donate_argnums=donate)
+    entry.step_fn = step_fn
     return entry
+
+
+def _build_capture_plan(rec, opt, entry, make_step_fn, fwd, n_ext,
+                        param_slots, extra_slots, rest_slots,
+                        root_op, root_out):
+    """Build (and maybe bind) a RematPlan for one capture build. Returns
+    ``(plan, planned_loss)`` where planned_loss is None when the plan has no
+    cuts. The measure oracle re-traces the FULL candidate step (forward,
+    vjp, clip, fused update, donation) and reads the planner's peak — the
+    recorded before/after figures are exact est_peak_hbm_mb values, not a
+    side model."""
+    from .. import analysis
+    from ..analysis import memory as _memory
+    from ..analysis import plan as _plan_mod
+
+    _p, _s, cargs = _capture_args(rec, opt, entry)
+    specs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), cargs)
+    entry.arg_specs = specs
+    p_specs, _s_specs, _lr, extra_specs, rest_specs, _gp, _gx = specs
+    res_tree = [None]
+
+    def loss_pure(dp, dx, rest_vals):
+        # the capture's loss path with every array input explicit, flat
+        # outputs (loss first, then every op output — they all escape)
+        ext = [None] * n_ext
+        for s, v in zip(rest_slots, rest_vals):
+            ext[s] = v
+        for s, v in zip(param_slots, dp):
+            ext[s] = v
+        for s, v in zip(extra_slots, dx):
+            ext[s] = v
+        results = fwd(ext)
+        flat, tree = jax.tree_util.tree_flatten(results)
+        res_tree[0] = tree
+        return (results[root_op][root_out], *flat)
+
+    loss_closed = jax.make_jaxpr(loss_pure)(
+        tuple(p_specs), tuple(extra_specs), tuple(rest_specs))
+
+    def bind_loss(flat_fn):
+        def planned_loss(dp, dx, rest_vals):
+            flat, _ = jax.tree_util.tree_flatten(
+                (tuple(dp), tuple(dx), tuple(rest_vals)))
+            outs = flat_fn(*flat)
+            results = jax.tree_util.tree_unflatten(res_tree[0], outs[1:])
+            return outs[0], results
+        return planned_loss
+
+    roles, donated = _capture_arg_roles(entry)
+
+    def measure(flat_fn):
+        pl = bind_loss(flat_fn) if flat_fn is not None else None
+        closed = jax.make_jaxpr(make_step_fn(pl))(*specs)
+        ctx = analysis.Context(closed, roles, "captured-step",
+                               donated=donated)
+        return _memory.plan_memory(ctx).peak_bytes
+
+    budget = int(float(flags.flag("memory_budget_mb")) * (1 << 20))
+    plan = _plan_mod.build_remat_plan(
+        loss_closed, budget_bytes=budget, measure=measure, source="capture")
+    if plan.has_cuts:
+        return plan, bind_loss(plan.bind())
+    return plan, None
 
 
 def _aot_compile(exe, specs):
@@ -1629,6 +1739,12 @@ def _capture_args(rec: _DeferredStep, opt, entry: _CaptureEntry):
     leaves = rec.leaves
     params = [leaves[i] for i in entry.param_idx]
     ext = seg.ext_vals
+    sched = getattr(opt, "_offload_sched", None)
+    if sched is not None:
+        # host-offload: parked accumulator groups must be device arrays
+        # before they feed the captured executable (the wait is booked as
+        # the scheduler's blocked time)
+        sched.ensure_resident(opt, params)
     states = []
     for p in params:
         st = opt._accumulators.get(id(p))
@@ -1909,7 +2025,12 @@ def step_capture_step(optimizer) -> bool:
            bool(flags.flag("eager_capture_donate")),
            rec.grad_prev_vals is not None,  # accumulation: grad-in program
            _rescue.active(),  # the sentinel changes the traced program
-           _telemetry_on())  # ... and so does the fused telemetry vector
+           _telemetry_on(),  # ... and so does the fused telemetry vector
+           # planner-guided remat: the plan derives deterministically from
+           # (signature, budget), so mode + budget fingerprint the plan
+           # into the step key — a budget change recompiles, not replays
+           (str(flags.flag("memory_plan")), float(flags.flag("memory_budget_mb")))
+           if _mem_plan_on() else None)
     try:
         entry = dispatch._lru_get(_capture_cache, key)
     except TypeError:

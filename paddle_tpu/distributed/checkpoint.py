@@ -903,6 +903,13 @@ class TrainingState(dict):
         self.update(self._model.state_dict())
         opt = self._optimizer
         if opt is not None:
+            # commit point: a compiled step's stacked moments and the host-
+            # offload scheduler's parked groups both write back through this
+            # hook before the snapshot reads a single accumulator — restore
+            # is exact no matter where a moment physically lived
+            sync = getattr(opt, "_lazy_state_sync", None)
+            if sync is not None:
+                sync()
             # keyed by parameter INDEX, not name: auto-generated param names
             # are process-global ("param_7"), so a relaunch's fresh model
             # would never match name-keyed entries

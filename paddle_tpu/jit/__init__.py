@@ -347,7 +347,7 @@ class CompiledTrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, mesh=None,
-                 in_shardings=None, grad_input_idx=()):
+                 in_shardings=None, grad_input_idx=(), memory_plan=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -365,8 +365,19 @@ class CompiledTrainStep:
         # embedding rows are step inputs, their grads push to the host
         # table; reference: distributed_push_sparse after the backward)
         self._grad_input_idx = tuple(int(i) for i in grad_input_idx)
+        # planner-guided remat (analysis.plan): None = follow
+        # FLAGS_memory_plan; "auto" = plan against FLAGS_memory_budget_mb;
+        # an explicit RematPlan is rebound to this step's traced loss
+        self._memory_plan_req = memory_plan
+        self._mem_plan = None  # the active RematPlan (None = unplanned)
 
     def _init_opt_state(self):
+        sched = getattr(self.optimizer, "_offload_sched", None)
+        if sched is not None:
+            # compile_train_step pins its optimizer state as donated device
+            # arrays — anything the offload scheduler parked must come home
+            # before the program takes ownership
+            sched.ensure_resident(self.optimizer, self._params)
         states = []
         for p in self._params:
             st = self.optimizer._accumulators.get(id(p))
@@ -376,12 +387,51 @@ class CompiledTrainStep:
             states.append(st)
         return states
 
-    def _build(self):
+    def _make_loss_core(self):
+        """The pure loss path `(p_vals, diff_vals, b_vals, key, batch_vals)
+        -> (loss, new_buffers)` — every array input explicit (no tracer
+        closure), so the remat planner can trace it standalone, slice it
+        into jax.checkpoint stages, and substitute the planned callable
+        into the step with identical semantics."""
         model = self.model
         loss_fn = self.loss_fn
-        opt = self.optimizer
         params = self._params
         buffers = self._buffers
+        gidx = self._grad_input_idx
+
+        def loss_core(p_vals, diff_vals, b_vals, key, batch_vals):
+            full = list(batch_vals)
+            for i, v in zip(gidx, diff_vals):
+                full[i] = v
+            ins = [Tensor(v, stop_gradient=True) for v in full]
+            with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
+                    no_grad(), _random.rng_scope(key):
+                out = model(*ins[:-1]) if len(ins) > 1 else model(ins[0])
+                loss = loss_fn(out, ins[-1]) if loss_fn is not None else out
+                # buffer values after forward (BN running stats updates)
+                new_b = tuple(b._value for b in buffers)
+            lv = loss._value if isinstance(loss, Tensor) else loss
+            return lv, new_b
+
+        return loss_core
+
+    def _wrap_flat_loss(self, flat_fn):
+        """Adapt a planned flat callable (the sliced loss jaxpr's invars in
+        flat order) back to the loss_core signature."""
+        n_b = len(self._buffers)
+
+        def planned_loss(p_vals, diff_vals, b_vals, key, batch_vals):
+            flat, _tree = jax.tree_util.tree_flatten(
+                (tuple(p_vals), tuple(diff_vals), tuple(b_vals), key,
+                 tuple(batch_vals)))
+            outs = flat_fn(*flat)
+            return outs[0], tuple(outs[1:1 + n_b])
+
+        return planned_loss
+
+    def _make_step_fn(self, planned_loss=None):
+        opt = self.optimizer
+        params = self._params
         hyper = self._hyper
         rule = type(opt)._update
 
@@ -397,21 +447,13 @@ class CompiledTrainStep:
         asp_masks = [_asp._mask_for(p) for p in params]
 
         gidx = self._grad_input_idx
+        loss_core = planned_loss if planned_loss is not None \
+            else self._make_loss_core()
 
         def step_fn(p_vals, opt_states, b_vals, key, lr, *batch_vals):
             def loss_of(p_vals, diff_vals):
-                full = list(batch_vals)
-                for i, v in zip(gidx, diff_vals):
-                    full[i] = v
-                ins = [Tensor(v, stop_gradient=True) for v in full]
-                with _bind_values(params + buffers, list(p_vals) + list(b_vals)), \
-                        no_grad(), _random.rng_scope(key):
-                    out = model(*ins[:-1]) if len(ins) > 1 else model(ins[0])
-                    loss = loss_fn(out, ins[-1]) if loss_fn is not None else out
-                    # buffer values after forward (BN running stats updates)
-                    new_b = tuple(b._value for b in buffers)
-                lv = loss._value if isinstance(loss, Tensor) else loss
-                return lv, new_b
+                return loss_core(p_vals, diff_vals, b_vals, key,
+                                 tuple(batch_vals))
 
             (loss, new_b), (grads, in_grads) = jax.value_and_grad(
                 loss_of, argnums=(0, 1), has_aux=True
@@ -439,9 +481,95 @@ class CompiledTrainStep:
                 new_s.append(ns_)
             return loss, in_grads, tuple(new_p), tuple(new_s), new_b
 
+        return step_fn
+
+    def _build(self):
+        plan = self._mem_plan
+        planned = None
+        if plan is not None and plan.has_cuts:
+            planned = self._wrap_flat_loss(plan.bind())
+        step_fn = self._make_step_fn(planned)
         # donate params and optimizer state: XLA reuses their HBM buffers
         self._step_fn_raw = step_fn
         return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def _loss_specs(self):
+        p, st, b, key, _lr, *batch = self._arg_specs
+        diff = tuple(batch[i] for i in self._grad_input_idx)
+        return (tuple(p), diff, tuple(b), key, tuple(batch))
+
+    def plan_remat(self, budget_mb=None, max_evals=8):
+        """Build a :class:`analysis.plan.RematPlan` for this step's current
+        shapes (needs one executed step, like ``memory_plan()``): trace the
+        loss path, search planner-chosen ``jax.checkpoint`` segmentations,
+        and verify each candidate's peak by re-planning the FULL step
+        (forward + backward + donated update) with the sliced loss
+        substituted in. ``budget_mb=None`` reads FLAGS_memory_budget_mb.
+        The returned plan feeds ``memory_plan=`` on a new step (or is
+        applied automatically under ``memory_plan='auto'``)."""
+        if self._arg_specs is None:
+            raise RuntimeError(
+                "plan_remat() needs one executed step first (the argument "
+                "shapes are taken from the last call)"
+            )
+        from .. import analysis
+        from ..analysis import memory as _memory
+        from ..analysis import plan as _plan
+        from ..core import flags as _flags
+
+        budget_mb = (float(_flags.flag("memory_budget_mb"))
+                     if budget_mb is None else float(budget_mb))
+        loss_closed = jax.make_jaxpr(self._make_loss_core())(
+            *self._loss_specs())
+        roles, don = self._roles_and_donated()
+
+        def measure(flat_fn) -> int:
+            planned = (self._wrap_flat_loss(flat_fn)
+                       if flat_fn is not None else None)
+            closed = jax.make_jaxpr(self._make_step_fn(planned))(
+                *self._arg_specs)
+            ctx = analysis.Context(closed, roles, "compile_train_step",
+                                   donated=don)
+            return _memory.plan_memory(ctx).peak_bytes
+
+        return _plan.build_remat_plan(
+            loss_closed, budget_bytes=int(budget_mb * (1 << 20)),
+            measure=measure, source="compile_train_step",
+            max_evals=max_evals)
+
+    def _resolve_plan(self):
+        """The RematPlan to apply for the current shapes, or None. Explicit
+        plans are rebound to a fresh loss trace; 'auto' (parameter or
+        FLAGS_memory_plan) plans against FLAGS_memory_budget_mb. A failed
+        build is counted (memory_plan_failures) and falls back unplanned."""
+        from ..analysis import plan as _plan
+        from ..core import flags as _flags
+
+        req = self._memory_plan_req
+        mode = req if req is not None else str(_flags.flag("memory_plan"))
+        if not mode:
+            return None
+        try:
+            if isinstance(mode, _plan.RematPlan):
+                fresh = jax.make_jaxpr(self._make_loss_core())(
+                    *self._loss_specs())
+                if mode.n_eqns != len(fresh.jaxpr.eqns):
+                    raise ValueError(
+                        f"explicit RematPlan indexes {mode.n_eqns} top-level "
+                        f"eqns but this step's loss traces to "
+                        f"{len(fresh.jaxpr.eqns)} — replan for these shapes")
+                mode.closed = fresh
+                return mode if mode.has_cuts else None
+            if mode != "auto":
+                raise ValueError(
+                    f"memory_plan={mode!r}: expected 'auto' or a RematPlan")
+            if float(_flags.flag("memory_budget_mb")) <= 0:
+                return None
+            plan = self.plan_remat()
+            return plan if plan.has_cuts else None
+        except Exception as e:
+            _plan.record_failure("compile_train_step", e)
+            return None
 
     def _roles_and_donated(self):
         """(invar roles, donated flat invar indices) for the traced step:
@@ -501,8 +629,7 @@ class CompiledTrainStep:
 
     @no_grad()
     def __call__(self, *batch) -> Tensor:
-        if self._step is None:
-            self._step = self._build()
+        if self._opt_state is None:
             self._opt_state = self._init_opt_state()
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         p_vals = tuple(p._value for p in self._params)
@@ -520,6 +647,16 @@ class CompiledTrainStep:
                 lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), args
             )
             self._static_donation_diags = None  # re-verify the new program
+            if (self._memory_plan_req is not None
+                    or self._mem_plan is not None or self._step is None):
+                # (re)plan remat for the new shapes — the plan indexes the
+                # loss trace's equations, so it is shape-specific. With no
+                # plan requested this is a no-op and the jitted step is
+                # reused across batch shapes exactly as before.
+                self._step = None
+        if self._step is None:
+            self._mem_plan = self._resolve_plan()
+            self._step = self._build()
         from ..core import flags as _flags
 
         if int(_flags.flag("check_programs")):
@@ -542,9 +679,9 @@ class CompiledTrainStep:
 
 
 def compile_train_step(model, loss_fn, optimizer, mesh=None, in_shardings=None,
-                       grad_input_idx=()):
+                       grad_input_idx=(), memory_plan=None):
     return CompiledTrainStep(model, loss_fn, optimizer, mesh, in_shardings,
-                             grad_input_idx)
+                             grad_input_idx, memory_plan)
 
 
 # ---------------------------------------------------------------------------

@@ -16,3 +16,4 @@ from .optimizer import (  # noqa: F401
     Optimizer,
     RMSProp,
 )
+from . import offload  # noqa: F401  (host offload of cold optimizer state)

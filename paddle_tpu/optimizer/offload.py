@@ -1,0 +1,488 @@
+"""Host offload of cold optimizer state (paddle.optimizer.offload).
+
+The liveness planner (paddle_tpu.analysis.plan.cold_state_indices) proves
+what every Adam-family trainer already knows: the moment accumulators are
+*cold* — their only reads and writes happen inside the trailing fused
+update, so their HBM buffers sit dead through the whole forward + backward.
+This scheduler parks those buffers in host memory between step boundaries
+and prefetches them back before the update that consumes them:
+
+    step N ends   -> D2H copies enqueued on the worker thread (overlapped
+                     behind whatever the host does next — data loading,
+                     the next forward's dispatch)
+    step N+1 begins (Optimizer.step entry) -> H2D prefetch enqueued
+    update reads accumulators -> ensure_resident() joins the prefetch;
+                     any wait is *measured* as blocked time
+
+Cadence discipline is CheckFreq's (PAPERS.md), the same loop PR 8 runs for
+snapshot persistence: measured transfer EMAs against an overhead budget.
+When the blocked-time share of a step exceeds ``FLAGS_offload_overhead_pct``
+the scheduler halves the offloaded set (largest groups stay — they buy the
+most HBM per transfer); when it stays well under, the set regrows. Restore
+is exact because offload rides the existing two-phase checkpoint commit:
+``state_dict()`` runs the optimizer's ``_lazy_state_sync`` hook, which this
+module chains to make every stashed group resident first — a snapshot never
+sees a half-transferred moment, and ``set_state_dict`` simply overwrites
+the stash entries with restored device arrays.
+
+Scope: the eager fused step and the whole-step capture (their accumulator
+reads go through ``ensure_resident``). ``jit.compile_train_step`` pins its
+optimizer state as donated device arrays for the program's lifetime — a
+step that keeps state in HBM by construction has nothing to offload.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["enable", "disable", "scheduler_of", "state"]
+
+_MB = float(1 << 20)
+
+
+class _HostValue:
+    """One accumulator array parked in host memory. Stored *inside* the
+    optimizer's accumulator dict in place of the device array, so every
+    code path that replaces accumulator entries (set_state_dict, elastic
+    reshard) naturally overwrites the stash instead of leaking it."""
+
+    __slots__ = ("host", "shape", "dtype")
+
+    def __init__(self, host: np.ndarray, shape, dtype):
+        self.host = host
+        self.shape = shape
+        self.dtype = dtype
+
+    def device(self):
+        return jnp.asarray(self.host)
+
+
+class _OffloadScheduler:
+    """Per-optimizer offload state machine. All mutation of accumulator
+    dicts happens under ``_lock``; the worker thread only ever swaps an
+    entry it can still identify (value identity checked under the lock), so
+    a concurrent restore/reshard that replaced the entry wins."""
+
+    def __init__(self, opt, *, overhead_pct: Optional[float] = None,
+                 min_bytes: int = 1 << 16):
+        from ..core import flags as _flags
+
+        self._opt_ref = weakref.ref(opt)
+        self.overhead_pct = (
+            float(_flags.flag("offload_overhead_pct"))
+            if overhead_pct is None else float(overhead_pct))
+        self.min_bytes = int(min_bytes)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inflight: set = set()  # (id(state_dict), key) being transferred
+        self._jobs: List[Tuple] = []
+        self._stop = False
+        # (id(state_dict), key) -> (state_dict, key, nbytes); insertion holds
+        # a strong ref to the dict only while the group is selected
+        self._groups: Dict[Tuple[int, str], Tuple[dict, str, int]] = {}
+        self._selected: Optional[List[Tuple[int, str]]] = None
+        self._max_groups: Optional[int] = None  # tuning knob (None = all)
+        self._cold_source = "heuristic"
+        # measured EMAs (ms): device->host, host->device, blocked-at-update
+        self.d2h_ema_ms = 0.0
+        self.h2d_ema_ms = 0.0
+        self.blocked_ema_ms = 0.0
+        self.step_ema_ms = 0.0
+        self.overhead_pct_ema = 0.0
+        self.d2h_count = 0
+        self.h2d_count = 0
+        self.shrinks = 0
+        self.regrows = 0
+        self.steps = 0
+        self._t_step_begin: Optional[float] = None
+        self._worker = threading.Thread(
+            target=self._run, name="paddle-offload", daemon=True)
+        self._worker.start()
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._jobs and not self._stop:
+                    self._cv.wait(timeout=0.1)
+                if self._stop and not self._jobs:
+                    return
+                job = self._jobs.pop(0)
+            try:
+                self._do_job(job)
+            except Exception:
+                with self._lock:
+                    self._inflight.discard(job[:2])
+                    self._cv.notify_all()
+
+    def _do_job(self, job):
+        did, key, st, direction = job
+        t0 = time.perf_counter()
+        if direction == "d2h":
+            with self._lock:
+                val = st.get(key)
+            if val is not None and not isinstance(val, _HostValue):
+                host = np.asarray(val)
+                hv = _HostValue(host, tuple(val.shape), val.dtype)
+                with self._lock:
+                    if st.get(key) is val:  # nobody replaced it meanwhile
+                        st[key] = hv
+                dt = (time.perf_counter() - t0) * 1000.0
+                self.d2h_ema_ms = _ema(self.d2h_ema_ms, dt)
+                self.d2h_count += 1
+        else:  # h2d prefetch
+            with self._lock:
+                val = st.get(key)
+            if isinstance(val, _HostValue):
+                dev = val.device()
+                dev.block_until_ready()
+                with self._lock:
+                    if st.get(key) is val:
+                        st[key] = dev
+                dt = (time.perf_counter() - t0) * 1000.0
+                self.h2d_ema_ms = _ema(self.h2d_ema_ms, dt)
+                self.h2d_count += 1
+        with self._lock:
+            self._inflight.discard((did, key))
+            self._cv.notify_all()
+
+    def _enqueue(self, st: dict, key: str, direction: str):
+        did = id(st)
+        with self._lock:
+            if (did, key) in self._inflight:
+                return
+            self._inflight.add((did, key))
+            self._jobs.append((did, key, st, direction))
+            self._cv.notify_all()
+
+    # -- group selection ---------------------------------------------------
+    def _select_groups(self, opt):
+        """Choose the accumulator entries to offload: planner-marked cold
+        state over the last captured step program when one exists, else the
+        shape heuristic (any non-scalar accumulator >= min_bytes — for the
+        Adam family exactly the moment tensors, not the beta powers)."""
+        params = [p for p in opt._param_list() if not p.stop_gradient]
+        entries: List[Tuple[dict, str, int]] = []
+        for p in params:
+            st = opt._accumulators.get(id(p))
+            if not st:
+                continue
+            for k in sorted(st):
+                v = st[k]
+                shape = getattr(v, "shape", ())
+                nbytes = int(getattr(v, "nbytes", 0) or 0)
+                if len(tuple(shape)) >= 1 and nbytes >= self.min_bytes:
+                    entries.append((st, k, nbytes))
+        cold = self._planner_cold_keys(opt, params)
+        if cold is not None:
+            entries = [e for e in entries if (id(e[0]), e[1]) in cold]
+            self._cold_source = "planner"
+        self._groups = {(id(st), k): (st, k, nb) for st, k, nb in entries}
+        # largest first: each transfer has fixed overhead, big groups buy
+        # the most HBM per ms of transfer
+        order = sorted(self._groups, key=lambda g: -self._groups[g][2])
+        self._selected = order
+        if self._max_groups is not None:
+            self._selected = order[:self._max_groups]
+
+    def _planner_cold_keys(self, opt, params):
+        """(id(state_dict), key) pairs the remat planner proves cold over
+        the last captured step program, or None when no capture replayed
+        yet (the caller falls back to the shape heuristic)."""
+        try:
+            from ..core import lazy as _lazy
+            from ..analysis import plan as _plan
+
+            prog = _lazy.captured_step_program()
+            if prog is None:
+                return None
+            closed, _donated, roles = prog
+            cold = _plan.cold_state_indices(closed, roles)
+            if not cold:
+                return None
+            cold_idx = {
+                int(name[len("opt_state"):])
+                for _i, name in cold if name.startswith("opt_state")
+            }
+            # opt_state leaves flatten params-outer, sorted-keys-inner —
+            # the same order _capture_args builds the states tuple
+            keys = set()
+            flat = 0
+            for p in params:
+                st = opt._accumulators.get(id(p)) or {}
+                for k in sorted(st):
+                    if flat in cold_idx:
+                        keys.add((id(st), k))
+                    flat += 1
+            return keys or None
+        except Exception:
+            return None
+
+    # -- step-boundary hooks (Optimizer.step) ------------------------------
+    def step_begin(self):
+        """Optimizer.step() entry: start prefetching every offloaded group
+        back to the device, overlapped behind the step's own dispatch."""
+        self._t_step_begin = time.perf_counter()
+        with self._lock:
+            groups = list(self._selected or ())
+        for g in groups:
+            ent = self._groups.get(g)
+            if ent is None:
+                continue
+            st, k, _nb = ent
+            if isinstance(st.get(k), _HostValue):
+                self._enqueue(st, k, "h2d")
+
+    def step_end(self):
+        """Optimizer.step() exit: book the step's measured figures, retune
+        the offloaded set against the overhead budget, and enqueue the D2H
+        copies for the groups that stay offloaded."""
+        opt = self._opt_ref()
+        if opt is None:
+            return
+        now = time.perf_counter()
+        if self._t_step_begin is not None:
+            step_ms = (now - self._t_step_begin) * 1000.0
+            self.step_ema_ms = _ema(self.step_ema_ms, step_ms)
+        self.steps += 1
+        if self._selected is None or (
+                self._cold_source == "heuristic" and self.steps <= 8):
+            # early steps re-run selection: the first captured-step replay
+            # usually lands a few steps in, upgrading the cold-group choice
+            # from the shape heuristic to the planner's liveness proof
+            self._select_groups(opt)
+        self._retune()
+        with self._lock:
+            groups = list(self._selected or ())
+        for g in groups:
+            ent = self._groups.get(g)
+            if ent is None:
+                continue
+            st, k, _nb = ent
+            v = st.get(k)
+            if v is not None and not isinstance(v, _HostValue):
+                self._enqueue(st, k, "d2h")
+        self._publish()
+
+    def _retune(self):
+        """CheckFreq discipline: measured overhead vs the budget. Blocked
+        EMA over step EMA is the truthful cost — transfers that finished
+        behind the step are free no matter how many bytes moved."""
+        if self.step_ema_ms <= 0.0 or self._selected is None:
+            return
+        pct = 100.0 * self.blocked_ema_ms / self.step_ema_ms
+        self.overhead_pct_ema = pct
+        n_all = len(self._groups)
+        n_sel = len(self._selected)
+        if pct > self.overhead_pct and n_sel > 0:
+            self._max_groups = max(0, n_sel // 2)
+            self.shrinks += 1
+            # decay the blocked EMA so one spike doesn't pin the set at the
+            # shrunken size forever; the next overrun shrinks again
+            self.blocked_ema_ms *= 0.5
+            self._reselect()
+        elif pct < 0.25 * self.overhead_pct and n_sel < n_all:
+            self._max_groups = min(n_all, max(1, n_sel * 2))
+            self.regrows += 1
+            self._reselect()
+
+    def _reselect(self):
+        order = sorted(self._groups, key=lambda g: -self._groups[g][2])
+        keep = order if self._max_groups is None else order[:self._max_groups]
+        with self._lock:
+            dropped = [g for g in (self._selected or ()) if g not in set(keep)]
+            self._selected = keep
+        # groups leaving the offload set come home for good
+        for g in dropped:
+            ent = self._groups.get(g)
+            if ent is not None and isinstance(ent[0].get(ent[1]), _HostValue):
+                self._enqueue(ent[0], ent[1], "h2d")
+
+    # -- consumer-side hooks ------------------------------------------------
+    def ensure_resident(self, opt, params) -> float:
+        """Make every accumulator of ``params`` a device array again,
+        joining in-flight transfers first. Returns (and books) the blocked
+        milliseconds — the scheduler's honest overhead figure."""
+        t0 = time.perf_counter()
+        waited = False
+        dicts = []
+        for p in params:
+            st = opt._accumulators.get(id(p))
+            if st:
+                dicts.append(st)
+        with self._lock:
+            pending = {(id(st), k) for st in dicts for k in st}
+            while self._inflight & pending:
+                waited = True
+                self._cv.wait(timeout=0.1)
+        for st in dicts:
+            for k in list(st):
+                v = st.get(k)
+                if isinstance(v, _HostValue):
+                    waited = True
+                    dev = v.device()
+                    with self._lock:
+                        if st.get(k) is v:
+                            st[k] = dev
+        blocked_ms = (time.perf_counter() - t0) * 1000.0 if waited else 0.0
+        self.blocked_ema_ms = _ema(self.blocked_ema_ms, blocked_ms)
+        return blocked_ms
+
+    def sync(self):
+        """Drain the worker and bring EVERY stashed group resident — the
+        two-phase checkpoint commit and state_dict() run through this, so a
+        snapshot always sees whole device arrays."""
+        opt = self._opt_ref()
+        with self._lock:
+            while self._inflight:
+                self._cv.wait(timeout=0.1)
+        if opt is None:
+            return
+        for p in opt._param_list():
+            st = opt._accumulators.get(id(p))
+            if not st:
+                continue
+            for k in list(st):
+                v = st.get(k)
+                if isinstance(v, _HostValue):
+                    with self._lock:
+                        if st.get(k) is v:
+                            st[k] = v.device()
+
+    def stop(self):
+        self.sync()
+        with self._lock:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5.0)
+
+    # -- observability ------------------------------------------------------
+    def offloaded_bytes(self) -> int:
+        total = 0
+        with self._lock:
+            sel = list(self._selected or ())
+        for g in sel:
+            ent = self._groups.get(g)
+            if ent is not None and isinstance(ent[0].get(ent[1]), _HostValue):
+                total += ent[2]
+        return total
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "groups_total": len(self._groups),
+            "groups_selected": len(self._selected or ()),
+            "cold_source": self._cold_source,
+            "offloaded_mb": round(self.offloaded_bytes() / _MB, 3),
+            "d2h_ema_ms": round(self.d2h_ema_ms, 3),
+            "h2d_ema_ms": round(self.h2d_ema_ms, 3),
+            "blocked_ema_ms": round(self.blocked_ema_ms, 3),
+            "step_ema_ms": round(self.step_ema_ms, 3),
+            "overhead_pct_ema": round(self.overhead_pct_ema, 3),
+            "overhead_budget_pct": self.overhead_pct,
+            "d2h_count": self.d2h_count,
+            "h2d_count": self.h2d_count,
+            "shrinks": self.shrinks,
+            "regrows": self.regrows,
+            "steps": self.steps,
+        }
+
+    def _publish(self):
+        try:
+            from ..core import dispatch
+
+            dispatch._emit("offload", site="optimizer", phase="step",
+                           groups=len(self._selected or ()),
+                           offloaded_mb=round(self.offloaded_bytes() / _MB, 3),
+                           overhead_pct=round(self.overhead_pct_ema, 3))
+        except Exception:
+            pass
+        try:
+            from ..profiler import metrics as _metrics
+
+            reg = _metrics.default_registry()
+            reg.gauge("memory_plan_offload_groups",
+                      doc="accumulator groups currently selected for host "
+                          "offload").set(len(self._selected or ()))
+            reg.gauge("memory_plan_offload_mb",
+                      doc="bytes of optimizer state parked on the host, MB"
+                      ).set(self.offloaded_bytes() / _MB)
+            reg.gauge("memory_plan_offload_overhead_pct",
+                      doc="measured blocked time as % of step time (EMA); "
+                          "budget is FLAGS_offload_overhead_pct"
+                      ).set(self.overhead_pct_ema)
+        except Exception:
+            pass
+
+
+def _ema(cur: float, new: float, alpha: float = 0.2) -> float:
+    return new if cur == 0.0 else (1.0 - alpha) * cur + alpha * new
+
+
+# ---------------------------------------------------------------------------
+# Public API + registry (the /statusz section reads state())
+# ---------------------------------------------------------------------------
+_registry: "weakref.WeakValueDictionary[int, _OffloadScheduler]" = (
+    weakref.WeakValueDictionary())
+_reg_lock = threading.Lock()
+
+
+def enable(optimizer, *, overhead_pct: Optional[float] = None,
+           min_bytes: int = 1 << 16) -> _OffloadScheduler:
+    """Attach a host-offload scheduler to ``optimizer``. Idempotent: a
+    second call returns the existing scheduler. ``overhead_pct`` overrides
+    FLAGS_offload_overhead_pct for this optimizer; ``min_bytes`` is the
+    smallest accumulator worth a round trip (beta-power scalars never
+    qualify)."""
+    sched = getattr(optimizer, "_offload_sched", None)
+    if sched is not None:
+        return sched
+    sched = _OffloadScheduler(optimizer, overhead_pct=overhead_pct,
+                              min_bytes=min_bytes)
+    optimizer._offload_sched = sched
+    # chain the checkpoint sync hook: state_dict() / TrainingState.refresh
+    # call _lazy_state_sync before reading accumulators — offload joins the
+    # same commit point so snapshots are exact (two-phase commit intact)
+    prev = getattr(optimizer, "_lazy_state_sync", None)
+
+    def _sync_chain(_prev=prev, _s=weakref.ref(sched)):
+        if _prev is not None:
+            _prev()
+        s = _s()
+        if s is not None:
+            s.sync()
+
+    optimizer._lazy_state_sync = _sync_chain
+    sched._prev_sync = prev  # for disable()
+    with _reg_lock:
+        _registry[id(optimizer)] = sched
+    return sched
+
+
+def disable(optimizer) -> None:
+    """Detach and stop the scheduler; every stashed group is brought back
+    to the device first, so training continues exactly where it was."""
+    sched = getattr(optimizer, "_offload_sched", None)
+    if sched is None:
+        return
+    sched.stop()
+    optimizer._offload_sched = None
+    optimizer._lazy_state_sync = getattr(sched, "_prev_sync", None)
+    with _reg_lock:
+        _registry.pop(id(optimizer), None)
+
+
+def scheduler_of(optimizer) -> Optional[_OffloadScheduler]:
+    return getattr(optimizer, "_offload_sched", None)
+
+
+def state() -> List[Dict[str, Any]]:
+    """Snapshots of every live scheduler (the /statusz 'memory plan &
+    offload' section)."""
+    with _reg_lock:
+        scheds = list(_registry.values())
+    return [s.snapshot() for s in scheds]

@@ -199,6 +199,13 @@ class Optimizer:
         # plus step-signature observation for the capture controller.
         from ..resilience import runtime as _rrt
 
+        # host-offload boundary (optimizer/offload.py): start the H2D
+        # prefetch of parked accumulator groups now, overlapped behind the
+        # step's own dispatch; step_end() below books the measured figures
+        # and enqueues the next D2H sweep
+        sched = getattr(self, "_offload_sched", None)
+        if sched is not None:
+            sched.step_begin()
         try:
             if _lazy.step_capture_step(self):
                 self._step_count += 1
@@ -214,6 +221,8 @@ class Optimizer:
             if params_grads:
                 self._apply_fused(params_grads)
         finally:
+            if sched is not None:
+                sched.step_end()
             # resilience step boundary: advances the fault-injection step
             # counter and the degradation ladder's cooldown clocks
             _rrt.on_step_end()
@@ -242,6 +251,12 @@ class Optimizer:
         from ..profiler import attribution as _attribution
 
         telemetry = _attribution.telemetry_active()
+        sched = getattr(self, "_offload_sched", None)
+        if sched is not None:
+            # join the prefetch: any accumulator still parked on the host
+            # comes back NOW, and the wait is booked as blocked time (the
+            # overhead figure the scheduler tunes against)
+            sched.ensure_resident(self, params)
         states = []
         for p in params:
             st = self._accumulators.get(id(p))

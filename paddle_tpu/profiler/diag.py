@@ -253,6 +253,39 @@ def statusz_text() -> str:
     except Exception as e:
         out.append(f"  <attribution state unavailable: {e!r}>\n")
     try:
+        from ..analysis import plan as _plan
+        from ..optimizer import offload as _offload
+
+        plans = _plan.state()
+        offl = _offload.state()
+        if plans or offl:
+            out.append(_section("memory plan & offload"))
+            for src, doc in sorted(plans.items()):
+                if doc.get("failed"):
+                    out.append(f"  {src}: FAILED {doc.get('error')}\n")
+                    continue
+                out.append(
+                    f"  {src}: {'feasible' if doc['feasible'] else 'best-effort'} "
+                    f"peak {doc['peak_before_mb']}->{doc['peak_after_mb']}MB "
+                    f"(budget {doc['budget_mb']}MB) "
+                    f"recompute={doc['recompute_pct']}% "
+                    f"cuts={doc['cut_points']} "
+                    f"fingerprint={doc['fingerprint']} "
+                    f"evals={doc['evals']} build_ms={doc['build_ms']}\n")
+            for s in offl:
+                out.append(
+                    f"  offload[{s['cold_source']}]: "
+                    f"{s['groups_selected']}/{s['groups_total']} groups "
+                    f"{s['offloaded_mb']}MB parked  "
+                    f"overhead={s['overhead_pct_ema']}% "
+                    f"(budget {s['overhead_budget_pct']}%)  "
+                    f"d2h={s['d2h_count']}x{s['d2h_ema_ms']}ms "
+                    f"h2d={s['h2d_count']}x{s['h2d_ema_ms']}ms "
+                    f"blocked_ema={s['blocked_ema_ms']}ms "
+                    f"shrinks={s['shrinks']} regrows={s['regrows']}\n")
+    except Exception as e:
+        out.append(f"  <memory plan state unavailable: {e!r}>\n")
+    try:
         out.append(_section("perf-regression sentinel"))
         st = _sentinel.state()
         out.append(f"  enabled = {st['enabled']}  pct = {st['pct']}  "

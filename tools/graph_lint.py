@@ -66,6 +66,11 @@ def main(argv=None) -> int:
                          "runs carry the full breakdown in 'data') and "
                          "emits an error-severity diagnostic when the "
                          "estimate exceeds the budget")
+    ap.add_argument("--plan", action="store_true",
+                    help="with --memory-budget-mb: run the remat planner and "
+                         "print the chosen plan (cut points, peak before/"
+                         "after, predicted recompute %%); JSON runs emit the "
+                         "full plan as a 'memory_plan' record")
     ap.add_argument("--fail-on", default="error",
                     choices=["info", "warning", "error"],
                     help="exit nonzero at/above this severity (default: error)")
@@ -108,6 +113,19 @@ def main(argv=None) -> int:
     diags = analysis.check(target, specs, passes=passes,
                            memory_budget_mb=args.memory_budget_mb)
 
+    plan = None
+    if args.plan:
+        if args.memory_budget_mb is None:
+            raise SystemExit("graph_lint: --plan requires --memory-budget-mb")
+        from paddle_tpu.analysis import plan as plan_mod
+        try:
+            plan = plan_mod.plan_program(
+                target, specs, memory_budget_mb=args.memory_budget_mb)
+        except Exception as e:  # planner failure is a finding, not a crash
+            plan_mod.record_failure("graph_lint", e)
+            print(f"graph_lint: plan failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
     if args.json:
         for d in diags:
             print(json.dumps({
@@ -117,15 +135,25 @@ def main(argv=None) -> int:
                 "dtypes": list(d.dtypes),
                 "data": d.data,
             }))
+        if plan is not None:
+            print(json.dumps({
+                "severity": "info", "pass": "memory_plan", "op": None,
+                "message": plan.summary(), "hint": None, "source": None,
+                "shapes": [], "dtypes": [],
+                "data": plan.to_dict(),
+            }))
     else:
         if not diags:
             print(f"graph_lint: {args.model_file}: clean "
                   f"({len(analysis.pass_names())} passes)")
         for d in diags:
             print(f"  {d}")
+        if plan is not None:
+            print(plan.summary())
         # analysis-related flags in effect, so CI logs show the exact mode
         active = (describe_flags("check") + describe_flags("eager_lazy")
-                  + describe_flags("memory_budget"))
+                  + describe_flags("memory_budget")
+                  + describe_flags("memory_plan"))
         flags_str = ", ".join(f"{f['name']}={f['value']}" for f in active)
         counts = {}
         for d in diags:

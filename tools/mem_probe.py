@@ -1,0 +1,239 @@
+"""Memory-plan probe: planner-guided remat & host-offload acceptance gate.
+
+The CI-facing proof of the ISSUE-16 acceptance criteria, run on a small
+GPT (the planner's target workload — activation-dominated attention):
+
+  planned-under-budget   at a budget of 60% of the unconstrained planner
+                         peak, ``plan_remat()`` returns a FEASIBLE plan
+                         whose replanned full-step peak (forward +
+                         backward + donated update) is under the budget,
+                         with predicted recompute strictly below the
+                         uniform per-block checkpoint plan (100%)
+  bitwise-parity         every loss of an N-step planned run is bitwise
+                         identical to the unplanned run (same seed/data)
+                         — remat must not change numerics, only memory
+  beats-naive-recompute  the planned step's steps/s strictly beats the
+                         same model built with cfg.use_recompute=True
+                         (uniform per-block recompute — the measured 4/3
+                         step tax from PROFILE_GPT.md)
+  offload-overhead       host offload of cold Adam state: transfers
+                         actually happen, offload on/off final params and
+                         losses are bitwise equal, and the measured
+                         blocked-time share of the step (the overlap
+                         failure residue) stays under
+                         --overhead-budget-pct (analytic gate)
+
+Exits nonzero on any failed gate (tests/test_memory_plan2.py runs this
+CLI as a slow subprocess test). Prints ALL SCENARIOS PASSED on success.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/mem_probe.py [--steps 8]
+                                                [--overhead-budget-pct 1.0]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.models.gpt import (  # noqa: E402
+    GPTConfig,
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+)
+from paddle_tpu.optimizer import offload  # noqa: E402
+
+# small but activation-dominated: bsz*heads*seq*seq attention scores dwarf
+# the parameter bytes, so a 60% budget is reachable by remat alone. The
+# vocab is kept SMALL so the transformer blocks dominate step flops —
+# naive per-block recompute skips the embedding/logits tail, so a big
+# vocab would let it recompute far less than its nominal 100% and the
+# throughput comparison would measure the model mix, not the planner
+BSZ, SEQ = 4, 256
+CFG = dict(vocab_size=256, hidden_size=128, num_layers=4, num_heads=4,
+           max_seq_len=SEQ, dropout=0.0, attn_dropout=0.0)
+
+
+def _batches(steps, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(steps):
+        ids = rng.integers(0, CFG["vocab_size"], (BSZ, SEQ + 1)).astype("int32")
+        out.append((paddle.to_tensor(ids[:, :-1]),
+                    paddle.to_tensor(ids[:, 1:])))
+    return out
+
+
+def _build_step(use_recompute=False, memory_plan=None, seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(use_recompute=use_recompute, **CFG)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return crit(logits.astype("float32"), labels)
+
+    return paddle.jit.compile_train_step(model, loss_fn, opt,
+                                         memory_plan=memory_plan)
+
+
+def _run(step, batches):
+    return [np.asarray(step(x, y).numpy()) for x, y in batches]
+
+
+def _time_steps(step, batches, rounds=3):
+    """Best-of-``rounds`` total wall time over the batch list (the step is
+    already compiled/warm); min filters CPU scheduling noise."""
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for x, y in batches:
+            float(step(x, y))  # host read = hard sync
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def scenario_plan_and_parity(args):
+    batches = _batches(args.steps)
+
+    # unplanned reference: unconstrained peak + bitwise baseline
+    base = _build_step()
+    base_losses = _run(base, batches)
+    peak_mb = base.memory_plan().peak_bytes / 2**20
+    budget_mb = 0.6 * peak_mb
+
+    plan = base.plan_remat(budget_mb=budget_mb)
+    print(plan.summary())
+    assert plan.has_cuts, "planner chose no cuts at a 60% budget"
+    assert plan.feasible, (
+        f"plan infeasible: {plan.peak_after_bytes / 2**20:.2f}MB "
+        f"> budget {budget_mb:.2f}MB ({plan.note})")
+    assert plan.peak_after_bytes <= budget_mb * 2**20
+    assert plan.recompute_pct < 100.0, (
+        "planner should beat the uniform per-block plan's 100% recompute, "
+        f"got {plan.recompute_pct:.1f}%")
+
+    # fresh identical step with the plan applied: bitwise losses
+    planned = _build_step(memory_plan=plan)
+    planned_losses = _run(planned, batches)
+    for i, (a, b) in enumerate(zip(base_losses, planned_losses)):
+        assert np.array_equal(a, b), (
+            f"step {i}: planned loss {b!r} != unplanned {a!r}")
+    print(f"  bitwise parity over {args.steps} steps: OK "
+          f"(final loss {float(base_losses[-1]):.6f})")
+    return planned, batches
+
+
+def scenario_throughput(args, planned, batches):
+    naive = _build_step(use_recompute=True)
+    _run(naive, batches[:1])  # compile + warm
+    _run(planned, batches[:1])
+    t_planned = _time_steps(planned, batches)
+    t_naive = _time_steps(naive, batches)
+    sps_p = len(batches) / t_planned
+    sps_n = len(batches) / t_naive
+    print(f"  planned {sps_p:.2f} steps/s vs naive per-block recompute "
+          f"{sps_n:.2f} steps/s ({sps_p / sps_n:.2f}x)")
+    assert sps_p > sps_n, (
+        f"planned remat ({sps_p:.2f} steps/s) must strictly beat naive "
+        f"full per-block checkpoint ({sps_n:.2f} steps/s)")
+
+
+def scenario_offload(args):
+    def train(use_offload, steps=10, seed=0):
+        paddle.seed(seed)
+        m = nn.Sequential(nn.Linear(128, 256), nn.GELU(approximate=True),
+                          nn.Linear(256, 16))
+        o = paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=m.parameters())
+        if use_offload:
+            offload.enable(o, overhead_pct=args.overhead_budget_pct,
+                           min_bytes=1024)
+        lf = nn.CrossEntropyLoss()
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(steps):
+            x = paddle.to_tensor(
+                rng.standard_normal((256, 128)).astype("float32"))
+            y = paddle.to_tensor(rng.integers(0, 16, (256,)).astype("int64"))
+            loss = lf(m(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(np.asarray(loss.numpy()))
+        return m, o, losses
+
+    m0, _o0, base = train(False)
+    m1, o1, offl = train(True)
+    sched = offload.scheduler_of(o1)
+    snap = sched.snapshot()
+    print(f"  offload snapshot: {snap}")
+    assert snap["d2h_count"] > 0, "no device->host transfers happened"
+    for i, (a, b) in enumerate(zip(base, offl)):
+        assert np.array_equal(a, b), f"step {i}: offload changed the loss"
+    for pa, pb in zip(m0.parameters(), m1.parameters()):
+        assert np.array_equal(pa.numpy(), pb.numpy()), pa.name
+    # the analytic overhead gate: share of step time spent blocked on a
+    # host->device fetch that failed to overlap (EMA over the run)
+    overhead = snap["overhead_pct_ema"]
+    assert overhead < args.overhead_budget_pct, (
+        f"offload blocked-time overhead {overhead:.3f}% >= "
+        f"budget {args.overhead_budget_pct}%")
+    print(f"  overlap overhead {overhead:.3f}% < "
+          f"{args.overhead_budget_pct}% budget: OK")
+    offload.disable(o1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="mem_probe")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--overhead-budget-pct", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    failed = []
+    planned = batches = None
+    scenarios = []
+
+    def _plan_and_parity():
+        nonlocal planned, batches
+        planned, batches = scenario_plan_and_parity(args)
+
+    scenarios.append(("planned-under-budget+bitwise-parity", _plan_and_parity))
+    scenarios.append(("beats-naive-recompute",
+                      lambda: scenario_throughput(args, planned, batches)))
+    scenarios.append(("offload-overhead", lambda: scenario_offload(args)))
+
+    for name, fn in scenarios:
+        print(f"=== {name} ===")
+        try:
+            if name == "beats-naive-recompute" and planned is None:
+                raise RuntimeError("skipped: planning scenario failed")
+            fn()
+            print(f"=== {name}: PASSED ===")
+        except Exception as e:
+            failed.append(name)
+            print(f"=== {name}: FAILED: {type(e).__name__}: {e} ===")
+
+    if failed:
+        print(f"FAILED SCENARIOS: {', '.join(failed)}")
+        return 1
+    print("ALL SCENARIOS PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
