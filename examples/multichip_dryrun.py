@@ -1,0 +1,138 @@
+"""Multichip dryrun builders for the sharding analyzer / graph_lint --mesh.
+
+The CPU-simulated hybrid-parallel GPT step at dryrun shapes — the same
+model/mesh family the MULTICHIP_r0*.json snapshots exercise — exposed as
+graph_lint model builders so the static analysis suite (per-shard memory,
+donation proofs, collective cost, resharding lints) can gate it in CI
+without compiling or running a step:
+
+    python tools/graph_lint.py examples/multichip_dryrun.py --mesh dp=2,mp=2
+    python tools/graph_lint.py examples/multichip_dryrun.py --mesh pp=2 \
+        --builder build_model_pp
+
+``build_model(mesh_axes=...)`` returns ``(ShardedTrainStep, input_specs)``;
+graph_lint routes that pair through
+``paddle_tpu.analysis.sharding.check_sharded_step``. The pipeline builder
+returns a plain traced function whose ``shard_map`` region the base
+analyzer now recurses into.
+
+Run as a script it executes one real step per mesh config (the smoke path
+the `__graft_entry__` dryrun uses for every factorization of the device
+count).
+"""
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F  # noqa: F401 (re-export convenience)
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import (
+    GPTConfig, GPTForPretraining, GPTPretrainingCriterion,
+)
+
+# dryrun shapes: tiny but with every parallel-relevant dim divisible by
+# the mesh axes (heads by mp, batch by dp×sharding, layers by pp)
+VOCAB = 512
+SEQ = 16
+
+
+def _init_fleet(mesh_axes):
+    axes = dict(mesh_axes or {"dp": 2, "mp": 2})
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": int(axes.get("dp", 1)),
+        "mp_degree": int(axes.get("mp", 1)),
+        "pp_degree": int(axes.get("pp", 1)),
+        "sharding_degree": int(axes.get("sharding", 1)),
+        "sep_degree": int(axes.get("sep", 1)),
+    }
+    if int(axes.get("sharding", 1)) > 1:
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    # fleet.init back-fills leftover devices into dp — read the ACTUAL
+    # mesh so batch shapes divide it (dp may exceed the requested degree)
+    hcg = fleet.get_hybrid_communicate_group()
+    return {
+        "dp": hcg.get_data_parallel_world_size(),
+        "mp": hcg.get_model_parallel_world_size(),
+        "pp": hcg.get_pipe_parallel_world_size(),
+        "sharding": hcg.get_sharding_parallel_world_size(),
+        "sep": hcg.get_sep_parallel_world_size(),
+    }
+
+
+def _gpt(axes):
+    paddle.seed(0)
+    n_heads = 4 * max(1, int(axes.get("mp", 1)))
+    cfg = GPTConfig(
+        vocab_size=VOCAB, hidden_size=32 * n_heads // 4,
+        num_layers=2 * max(1, int(axes.get("pp", 1))), num_heads=n_heads,
+        max_seq_len=64, dropout=0.0, attn_dropout=0.0,
+    )
+    model = GPTForPretraining(cfg)
+    model = fleet.distributed_model(model)
+    criterion = GPTPretrainingCriterion(cfg)
+
+    def loss_fn(logits, labels):
+        return criterion(logits, labels)
+
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01
+    )
+    opt = fleet.distributed_optimizer(opt)
+    return model, loss_fn, opt
+
+
+def build_model(mesh_axes=None):
+    """(ShardedTrainStep, input_specs) for the GSPMD hybrid step — default
+    mesh dp=2×mp=2; graph_lint --mesh overrides the axes."""
+    axes = _init_fleet(mesh_axes)
+    model, loss_fn, opt = _gpt(axes)
+    step = fleet.distributed_train_step(model, loss_fn, opt)
+    bsz = 2 * max(1, int(axes.get("dp", 1)) * int(axes.get("sharding", 1)))
+    specs = [
+        paddle.static.InputSpec([bsz, SEQ], "int64"),
+        paddle.static.InputSpec([bsz, SEQ], "int64"),
+    ]
+    return step, specs
+
+
+def build_model_pp(mesh_axes=None):
+    """The pp=2 pipeline step's loss program as (fn, input_specs): the
+    shard_map(gpipe) region the base analyzer recurses into (per-shard
+    body avals, explicit ppermute/psum collectives)."""
+    axes = _init_fleet(mesh_axes or {"pp": 2})
+    model, loss_fn, opt = _gpt(axes)
+    step = fleet.distributed_train_step(model, loss_fn, opt)
+    # per-microbatch batch must divide dp×sharding; num_micro defaults to pp
+    bsz = (max(1, int(axes.get("pp", 1)))
+           * max(1, int(axes.get("dp", 1)) * int(axes.get("sharding", 1))))
+    specs = [
+        paddle.static.InputSpec([bsz, SEQ], "int64"),
+        paddle.static.InputSpec([bsz, SEQ], "int64"),
+    ]
+    return step, specs
+
+
+def main():
+    import numpy as np
+
+    step, specs = build_model()
+    x = paddle.randint(0, VOCAB, [int(specs[0].shape[0]), SEQ])
+    y = paddle.randint(0, VOCAB, [int(specs[0].shape[0]), SEQ])
+    loss = step(x, y)
+    print(f"dryrun loss: {float(np.asarray(loss.numpy())):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -167,8 +167,14 @@ class FlatOp:
 
 
 # control-flow primitives: recursed into as separate scopes (their bodies see
-# sliced/carried values, so invars cannot be substituted 1:1)
-_SCOPE_PRIMS = {"scan", "while", "cond", "switch"}
+# sliced/carried values, so invars cannot be substituted 1:1). shard_map is
+# scoped for the same reason in the default (global-shape) analysis: its body
+# vars carry PER-SHARD avals, so substituting the global-shaped outer atoms
+# through the boundary would mix global and per-shard buffer sizes in one
+# producer chain. The mesh-scoped analyzer (analysis.sharding) inlines
+# through it instead, after rewriting every outer aval to its per-shard
+# shape.
+_SCOPE_PRIMS = {"scan", "while", "cond", "switch", "shard_map"}
 
 
 def _as_open(j):
@@ -183,6 +189,11 @@ def _sub_jaxprs(eqn):
     for control-flow bodies, (None, []) for plain primitives."""
     name = eqn.primitive.name
     if name == "scan":
+        return "scope", [eqn.params["jaxpr"]]
+    if name == "shard_map":
+        # per-shard body avals — a scope, NOT a call: the params carry a
+        # "jaxpr" key, but call-inlining would substitute global-shaped
+        # outer atoms for per-shard body invars (unsound sizes/chains)
         return "scope", [eqn.params["jaxpr"]]
     if name == "while":
         return "scope", [eqn.params["cond_jaxpr"], eqn.params["body_jaxpr"]]
@@ -362,12 +373,28 @@ class Context:
         self.alias_groups = list(alias_groups or [])
         self.alias_refs: Dict[int, List] = dict(alias_refs or {})
         self.memory_budget_mb = memory_budget_mb
-        self.ops, self.producers, self.out_atoms = (
-            _inline_ops(closed) if closed is not None else ([], {}, [])
-        )
+        # mesh-scoped subclasses (analysis.sharding.ShardContext) set these
+        # before delegating here; every pass can getattr-free test
+        # ``ctx.mesh_axes`` to know whether avals are per-shard
+        if not hasattr(self, "mesh_axes"):
+            self.mesh_axes = None
+        if not hasattr(self, "in_specs"):
+            self.in_specs = None
+        # canonical per-invar atoms: the top-level jaxpr Vars by default; a
+        # mesh-scoped context replaces them with per-shard CanonVars so
+        # invar_roles()/plan_memory operate on what one chip actually holds
+        self.invar_atoms: List = []
+        self.ops, self.producers, self.out_atoms = self._build_ir()
+        if not self.invar_atoms and self.jaxpr is not None:
+            self.invar_atoms = list(self.jaxpr.invars)
+
+    def _build_ir(self):
+        """(ops, producers, out_atoms) — overridden by ShardContext with the
+        per-shard inliner."""
+        return _inline_ops(self.closed) if self.closed is not None else ([], {}, [])
 
     def invar_roles(self):
-        invars = list(self.jaxpr.invars)
+        invars = list(self.invar_atoms)
         roles = self.roles
         if len(roles) < len(invars):
             roles = roles + [("arg", str(i)) for i in range(len(roles), len(invars))]
@@ -377,10 +404,10 @@ class Context:
         used = set()
         for op in self.ops:
             for a in op.invars:
-                if isinstance(a, jax.core.Var):
+                if isinstance(a, (jax.core.Var, CanonVar)):
                     used.add(a)
         for a in self.out_atoms:
-            if isinstance(a, jax.core.Var):
+            if isinstance(a, (jax.core.Var, CanonVar)):
                 used.add(a)
         return used
 
@@ -712,5 +739,6 @@ def enforce(diags: List[Diagnostic], where: str, level: Optional[int] = None):
 from . import passes as _builtin_passes  # noqa: E402,F401  (registers the suite)
 from . import memory  # noqa: E402  (registers memory_budget / donation_safety)
 from . import plan  # noqa: E402  (remat planner over the liveness estimates)
+from . import sharding  # noqa: E402  (registers collective_cost / resharding_lint)
 
-__all__ += ["memory", "plan"]
+__all__ += ["memory", "plan", "sharding"]

@@ -266,10 +266,27 @@ def _scope_peak(ops, scope, scope_peaks) -> int:
     return peak
 
 
-def plan_memory(ctx: Context, donated: Optional[Sequence[int]] = None
-                ) -> MemoryPlan:
+def plan_memory(ctx: Context, donated: Optional[Sequence[int]] = None,
+                *, mesh=None, in_specs=None) -> MemoryPlan:
     """Liveness simulation of ``ctx``'s program; ``donated`` overrides the
-    context's donated invar-index set (e.g. to compare with/without)."""
+    context's donated invar-index set (e.g. to compare with/without).
+
+    ``mesh``/``in_specs`` rebuild the context per-shard first (via
+    ``analysis.sharding.shard_context``) so every buffer is sized to one
+    device's shard and the returned peak is **per device** — the multi-chip
+    budget ROADMAP item 1 needs. A context that is already mesh-scoped
+    (``ctx.mesh_axes`` set) is planned as-is."""
+    if mesh is not None and getattr(ctx, "mesh_axes", None) is None:
+        from .sharding import shard_context
+
+        ctx = shard_context(
+            ctx.closed, ctx.roles, mesh=mesh, in_specs=in_specs,
+            donated=getattr(ctx, "donated", ()),
+            source=ctx.source,
+            memory_budget_mb=getattr(ctx, "memory_budget_mb", None),
+            alias_groups=getattr(ctx, "alias_groups", None),
+            alias_refs=getattr(ctx, "alias_refs", None),
+        )
     donated_set = set(
         donated if donated is not None else getattr(ctx, "donated", ()) or ()
     )
@@ -437,11 +454,14 @@ def memory_budget(ctx: Context) -> List[Diagnostic]:
             f"({len([b for b in plan.buffers if b.donated])} donated buffers)"
             if donated else ""
         )
+        # mesh-scoped contexts carry per-shard avals, so the whole plan —
+        # peak, inputs, donation credit — is what ONE device holds
+        per_dev = (" per device" if getattr(ctx, "mesh_axes", None) else "")
         diags.append(Diagnostic(
             Severity.INFO, "memory_budget",
             plan.peak_op_path
             if 0 <= plan.peak_index < plan.n_ops else "program",
-            f"estimated peak HBM {_fmt_bytes(plan.peak_bytes)} "
+            f"estimated peak HBM{per_dev} {_fmt_bytes(plan.peak_bytes)} "
             f"(inputs {_fmt_bytes(plan.input_bytes)}, consts "
             f"{_fmt_bytes(plan.const_bytes)}, outputs "
             f"{_fmt_bytes(plan.output_bytes)}{credit}); "
@@ -450,11 +470,12 @@ def memory_budget(ctx: Context) -> List[Diagnostic]:
             dtypes=tuple(b.dtype for b in top),
             data=plan.to_dict(),
         ))
+    per_dev = (" per device" if getattr(ctx, "mesh_axes", None) else "")
     budget_bytes = int(budget_mb * _MB) if budget_mb else None
     if budget_bytes is not None and plan.peak_bytes > budget_bytes:
         diags.append(Diagnostic(
             Severity.ERROR, "memory_budget", "program",
-            f"estimated peak HBM {_fmt_bytes(plan.peak_bytes)} exceeds the "
+            f"estimated peak HBM{per_dev} {_fmt_bytes(plan.peak_bytes)} exceeds the "
             f"declared budget of {budget_mb:g} MB "
             f"(FLAGS_memory_budget_mb)",
             hint="shrink batch/activation sizes, enable whole-step capture "

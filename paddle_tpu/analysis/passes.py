@@ -360,7 +360,50 @@ def redundant_ops(ctx: Context) -> List[Diagnostic]:
                         hint="use F.log_softmax: one fused op, and it cannot "
                              "underflow to log(0) = -inf",
                     ))
+        elif op.name in ("psum", "psum2") and \
+                getattr(ctx, "mesh_axes", None) is None:
+            # collective idioms on plain contexts; a mesh-scoped context
+            # defers to resharding_lint (analysis.sharding) so the full
+            # suite never reports one defect twice
+            p = prod.get(op.invars[0]) if op.invars else None
+            if p is not None and p.name in ("psum", "psum2"):
+                a0 = set(_coll_axis_names(op.params))
+                a1 = set(_coll_axis_names(p.params))
+                # psum(psum(x, 'a'), 'b') is the legitimate staged two-axis
+                # reduction — only the SAME axis set is redundant
+                if a0 and a0 == a1:
+                    diags.append(Diagnostic(
+                        Severity.WARNING, "redundant_ops", op.path,
+                        f"psum∘psum over the same axis {sorted(a0)}: the "
+                        "second all-reduce multiplies by the group size and "
+                        "doubles the wire traffic",
+                        hint="reduce once (or psum(x, ('a','b')) for one "
+                             "fused all-reduce over both axes)",
+                        shapes=(atom_shape(op.invars[0]),),
+                    ))
+        elif op.name in ("slice", "dynamic_slice", "squeeze") and \
+                getattr(ctx, "mesh_axes", None) is None:
+            p = prod.get(op.invars[0]) if op.invars else None
+            if p is not None and p.name == "all_gather" and \
+                    atom_shape(op.outvars[0]) == atom_shape(p.invars[0]):
+                diags.append(Diagnostic(
+                    Severity.WARNING, "redundant_ops", op.path,
+                    "all_gather immediately sliced back to the local shard: "
+                    "a full-axis round trip that ends where it started",
+                    hint="drop the gather (the shard is already local) or "
+                         "keep the gathered value if other shards are read",
+                    shapes=(atom_shape(p.invars[0]),),
+                ))
     return diags
+
+
+def _coll_axis_names(params):
+    ax = params.get("axes", params.get("axis_name"))
+    if ax is None:
+        return ()
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
 
 
 # ---------------------------------------------------------------------------

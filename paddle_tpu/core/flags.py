@@ -226,6 +226,15 @@ define_flag(
     "Executor.run compile time and lazy-segment flush",
 )
 define_flag(
+    "comm_ratio_warn", 0.0,
+    "comm/compute threshold (bytes on wire per flop) for the "
+    "paddle_tpu.analysis collective_cost pass: when > 0, a checked sharded "
+    "program whose ring-ICI wire bytes divided by estimated flops exceeds "
+    "this ratio gets a warning-severity diagnostic naming the heaviest "
+    "collective (0 = report the ratio informationally, never warn); "
+    "combine with FLAGS_check_programs to surface it at build time",
+)
+define_flag(
     "memory_plan", "",
     "turn the memory_budget liveness estimate into an optimizer "
     "(paddle_tpu.analysis.plan): 'auto' makes the whole-step capture trace "

@@ -565,6 +565,7 @@ class FleetAggregator:
                         "ema_ms": float(row.get("ema_ms") or 0.0),
                         "runs": int(row.get("runs") or 0),
                         "drift_pct": row.get("drift_pct"),
+                        "comm_bytes": row.get("comm_bytes"),
                     })
                 except (TypeError, ValueError):
                     continue  # torn/hostile row: skip, never crash

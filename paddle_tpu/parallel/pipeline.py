@@ -196,6 +196,7 @@ class PipelinedTrainStep:
             )
         self._hyper = optimizer._hyper()
         self._step = None
+        self._loss_program = None  # forward GPipe loss (for the analyzer)
         self._stacked = None      # list of [L, ...] arrays, one per block param
         self._stacked_state = None
         self._repl_state = None
@@ -297,7 +298,10 @@ class PipelinedTrainStep:
             acc[id(p)] = dict(st)
 
     # ---- build ------------------------------------------------------------
-    def _build(self):
+    def _step_parts(self):
+        """(step_fn, in_shardings, out_shardings) pre-jit — the sharding
+        analyzer traces step_fn at per-shard shapes without compiling;
+        _build wraps the same triple in jax.jit."""
         from ..jit import _bind_values
         from ..core import random as _random
 
@@ -380,6 +384,17 @@ class PipelinedTrainStep:
             axis_names={"pp", "dp"}, check_vma=False,
         )
 
+        def loss_program(repl_vals, stacked_vals, b_vals, key, x, y):
+            # forward GPipe loss only — the static analyzer traces this when
+            # jax<0.5 cannot differentiate through shard_map (same schedule,
+            # same ppermute/psum collectives, no optimizer tail)
+            x_mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            y_mb = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+            return smapped(tuple(repl_vals), tuple(stacked_vals),
+                           tuple(b_vals), key, x_mb, y_mb)
+
+        self._loss_program = loss_program
+
         def step_fn(repl_vals, stacked_vals, repl_states, stacked_states,
                     b_vals, key, lr, x, y):
             # microbatch: [B, ...] -> [M, B//M, ...]
@@ -441,10 +456,40 @@ class PipelinedTrainStep:
                  tuple(repl for _ in self._buffers), repl, repl,
                  batch_sh, batch_sh)
         out_sh = (repl, repl_sh, stacked_sh, rs_sh, ss_sh)
+        return step_fn, in_sh, out_sh
+
+    def _build(self):
+        step_fn, in_sh, out_sh = self._step_parts()
         return jax.jit(
             step_fn, in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=(0, 1, 2, 3),
         )
+
+    def _check_programs(self, batch):
+        """FLAGS_check_programs gate before the first compile — the same
+        per-shard analysis suite ShardedTrainStep runs (1 = warn,
+        2 = raise on errors); trace failures never block training."""
+        from ..core.flags import flag as _flag
+
+        if not int(_flag("check_programs")):
+            return
+        try:
+            from ..analysis import enforce
+            from ..analysis.sharding import check_sharded_step
+
+            specs = [
+                jax.ShapeDtypeStruct(
+                    tuple((b._value if isinstance(b, Tensor)
+                           else np.asarray(b)).shape),
+                    (b._value if isinstance(b, Tensor)
+                     else np.asarray(b)).dtype,
+                )
+                for b in batch
+            ]
+            diags = check_sharded_step(self, specs, source="pipelined-step")
+        except Exception:
+            return
+        enforce(diags, "pipelined_train_step")
 
     # ---- call -------------------------------------------------------------
     @no_grad()
@@ -455,6 +500,7 @@ class PipelinedTrainStep:
             self._stacked = self._init_stacked()
             self._stacked_state = self._init_stacked_state()
             self._repl_state = self._init_repl_state()
+            self._check_programs((x, y))
             self._step = self._build()
             # lazy write-back hooks: state_dict() on the model/optimizer
             # pulls the authoritative stacked values without paying the

@@ -188,12 +188,13 @@ class ShardedTrainStep:
             states.append(st)
         return states
 
-    def _shardings(self):
+    def _shardings(self, opt_state=None):
         mesh = self.mesh
+        states = opt_state if opt_state is not None else self._opt_state
         p_specs = [param_spec(p, self.zero_stage, mesh) for p in self._params]
         p_sh = tuple(NamedSharding(mesh, s) for s in p_specs)
         st_sh = []
-        for p, spec, st in zip(self._params, p_specs, self._opt_state):
+        for p, spec, st in zip(self._params, p_specs, states):
             st_sh.append(
                 {
                     k: NamedSharding(
@@ -209,7 +210,12 @@ class ShardedTrainStep:
         batch_spec = P(self.batch_axes if self.batch_axes else None)
         return p_sh, tuple(st_sh), b_sh, NamedSharding(mesh, batch_spec)
 
-    def _build(self, n_batch_args):
+    def _step_parts(self, n_batch_args, opt_state=None):
+        """(step_fn, in_shardings, out_shardings) — the traced function and
+        its declared shardings, pre-jit. The sharding analyzer
+        (analysis.sharding.check_sharded_step) traces step_fn at per-shard
+        shapes without paying the XLA compile; _build wraps the same triple
+        in jax.jit."""
         from ..jit import _bind_values
         from ..core import random as _random
 
@@ -346,14 +352,45 @@ class ShardedTrainStep:
                 new_s.append(ns_)
             return loss, tuple(in_grads), tuple(new_p), tuple(new_s), new_b
 
-        p_sh, st_sh, b_sh, batch_sh = self._shardings()
+        p_sh, st_sh, b_sh, batch_sh = self._shardings(opt_state)
         repl = NamedSharding(self.mesh, P())
         in_sh = (p_sh, st_sh, b_sh, repl, repl) + (batch_sh,) * n_batch_args
         out_sh = (repl, (batch_sh,) * len(gidx), p_sh, st_sh, b_sh)
+        return step_fn, in_sh, out_sh
+
+    def _build(self, n_batch_args):
+        step_fn, in_sh, out_sh = self._step_parts(n_batch_args)
         return jax.jit(
             step_fn, in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=(0, 1),
         )
+
+    def _check_programs(self, batch):
+        """FLAGS_check_programs gate: run the per-shard analysis suite over
+        the traced step before the first compile. Same enforcement point as
+        Executor.run (1 = warn, 2 = raise on errors); the trace itself must
+        never block training, so its failures are swallowed."""
+        from ..core.flags import flag as _flag
+
+        if not int(_flag("check_programs")):
+            return
+        try:
+            from ..analysis import enforce
+            from ..analysis.sharding import check_sharded_step
+
+            specs = [
+                jax.ShapeDtypeStruct(
+                    tuple((b._value if isinstance(b, Tensor)
+                           else np.asarray(b)).shape),
+                    (b._value if isinstance(b, Tensor)
+                     else np.asarray(b)).dtype,
+                )
+                for b in batch
+            ]
+            diags = check_sharded_step(self, specs, source="sharded-step")
+        except Exception:
+            return
+        enforce(diags, "sharded_train_step")
 
     @no_grad()
     def __call__(self, *batch) -> Tensor:
@@ -378,6 +415,7 @@ class ShardedTrainStep:
                 for st, sh in zip(self._opt_state, st_sh)
             ]
         if self._step is None:
+            self._check_programs(batch)
             self._step = self._build(len(batch))
         _, _, _, batch_sh = self._shardings()
         batch_vals = [

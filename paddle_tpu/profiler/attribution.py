@@ -364,10 +364,24 @@ def _jaxpr_profile(closed, top_k: int = 5) -> Dict[str, Any]:
         row[0] += f
         row[1] += 1
     top = sorted(by_name.items(), key=lambda kv: -kv[1][0])[:max(1, top_k)]
+    # ring-ICI wire bytes of the program's collectives (analysis.sharding):
+    # zero for single-chip programs, so their profiles are unchanged
+    comm_bytes = collective_count = 0
+    try:
+        from ..analysis.sharding import collective_records
+
+        recs = collective_records(
+            type("_Ops", (), {"collectives": None, "ops": ops})())
+        comm_bytes = int(sum(r.total_wire_bytes for r in recs))
+        collective_count = int(sum(r.count for r in recs))
+    except Exception:
+        pass
     return {
         "eqns": len(ops),
         "flops_est": int(flops),
         "bytes_est": int(bytes_est),
+        "comm_bytes": comm_bytes,
+        "collective_count": collective_count,
         "top_ops": [
             {"op": name, "flops_est": int(f), "count": int(n)}
             for name, (f, n) in top
@@ -441,6 +455,12 @@ def _static_profile(prog: _Program, top_k: int = 5) -> Optional[Dict]:
                 reg.gauge("program_cost_est_peak_hbm_mb",
                           doc="planner-estimated peak HBM per program key, MB",
                           labels=labels).set(float(peak))
+            comm = static.get("comm_bytes")
+            if comm:
+                reg.gauge("program_cost_comm_bytes",
+                          doc="ring-ICI wire bytes per device per run "
+                              "(analysis.sharding collective cost model)",
+                          labels=labels).set(float(comm))
         except Exception:
             pass
     return static or None
@@ -490,7 +510,11 @@ def costs_summary(k: int = 5) -> List[Dict[str, Any]]:
         {"key": p.key, "category": p.category,
          "ema_ms": round(p.ema_ms, 4), "runs": p.runs,
          "drift_pct": (None if p.drift_pct() is None
-                       else round(p.drift_pct(), 2))}
+                       else round(p.drift_pct(), 2)),
+         # from the CACHED static profile only — the snapshot path must
+         # never force a jaxpr trace (bounded-size contract)
+         "comm_bytes": (p._static or {}).get("comm_bytes")
+         if isinstance(p._static, dict) else None}
         for p in progs[:max(1, k)]
     ]
 

@@ -1091,6 +1091,7 @@ _sys.modules[__name__ + ".analysis"] = analysis
 # of paddle_tpu.static.analysis.memory would RE-EXECUTE memory.py under
 # the static package name (and its relative imports would break)
 _sys.modules[__name__ + ".analysis.memory"] = analysis.memory
+_sys.modules[__name__ + ".analysis.sharding"] = analysis.sharding
 
 __all__ += ["analysis"]
 

@@ -130,3 +130,38 @@ def test_lint_input_spec_override_and_pass_subset(tmp_path, capsys):
     assert rc == 0  # hazard pass not selected
     rc = cli.main([str(mod), "--input-spec", "2,3:float32"])
     assert rc == 1
+
+
+@pytest.mark.parametrize("mesh,builder", [
+    ("dp=2,mp=2", "build_model"),
+    ("pp=2", "build_model_pp"),
+])
+def test_multichip_dryrun_mesh_lint_error_clean(mesh, builder,
+                                                check_programs_on, capsys):
+    """The multichip CI gate from ISSUE 17: per-shard linting of the
+    hybrid-parallel dryrun GPT builders (GSPMD sharded step and the GPipe
+    pipelined step) must be error-clean under FLAGS_check_programs=1.
+    Runs the CLI in-process — the 8 simulated devices from conftest
+    already cover every mesh here, so no subprocess spawn is needed."""
+    rc = _cli().main([os.path.join(REPO, "examples", "multichip_dryrun.py"),
+                      "--mesh", mesh, "--builder", builder])
+    out = capsys.readouterr().out
+    assert rc == 0, f"error-severity diagnostics under --mesh {mesh}:\n{out}"
+    assert "error[" not in out
+    # the per-shard passes actually ran: collective cost + per-device memory
+    assert "collective_cost" in out
+    assert "FLAGS_check_programs=1" in out
+
+
+def test_mesh_lint_json_carries_collective_records(capsys):
+    rc = _cli().main([os.path.join(REPO, "examples", "multichip_dryrun.py"),
+                      "--mesh", "dp=2,mp=2", "--json"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert rc == 0
+    recs = [json.loads(l) for l in lines]
+    cost = [r for r in recs if r["pass"] == "collective_cost"]
+    assert cost and cost[0]["data"]["comm_bytes"] > 0
+    assert all({"kind", "axes", "wire_bytes"} <= set(c)
+               for c in cost[0]["data"]["collectives"])
+    mem = [r for r in recs if r["pass"] == "memory_budget"]
+    assert mem and "per device" in mem[0]["message"]

@@ -83,13 +83,15 @@ def _render_programs(rows) -> str:
     if not rows:
         return ("(no program costs published — are workers running with "
                 "measured programs?)")
-    cols = ["node", "program", "category", "ema_ms", "runs", "drift_pct"]
+    cols = ["node", "program", "category", "ema_ms", "runs", "drift_pct",
+            "comm_bytes"]
     table = [cols]
     for r in rows:
         table.append([
             _esc(r["node"]), _esc(r["key"]), str(r.get("category")),
             f"{r['ema_ms']:.4f}", str(r["runs"]),
             _fmt_opt(r.get("drift_pct"), "%"),
+            _fmt_opt(r.get("comm_bytes"), ""),
         ])
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = []

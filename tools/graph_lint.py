@@ -19,6 +19,17 @@ returning one of:
 ``--input-spec`` accepts ``1,3,64,64:float32 8,16:int64`` style overrides.
 Exit status: 1 when any diagnostic at or above ``--fail-on`` (default:
 error) is found, else 0 — the CI self-lint step keys on this.
+
+``--mesh dp=2,mp=2`` runs the per-shard analyzer: the host platform is
+forced to simulate prod(axes) devices before jax initializes, the builder
+is called with ``mesh_axes=<axes>`` when it accepts that keyword, and a
+builder returning a sharded/pipelined train step is routed through
+``analysis.sharding.check_sharded_step`` (per-shard memory & donation,
+collective cost, resharding lints):
+
+    python tools/graph_lint.py examples/multichip_dryrun.py --mesh dp=2,mp=2
+    python tools/graph_lint.py examples/multichip_dryrun.py --mesh pp=2 \\
+        --builder build_model_pp
 """
 from __future__ import annotations
 
@@ -71,6 +82,12 @@ def main(argv=None) -> int:
                          "print the chosen plan (cut points, peak before/"
                          "after, predicted recompute %%); JSON runs emit the "
                          "full plan as a 'memory_plan' record")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="per-shard analysis under a device mesh, e.g. "
+                         "dp=2,mp=2 — simulates prod(axes) host devices, "
+                         "passes mesh_axes= to the builder, and routes "
+                         "train-step targets through "
+                         "analysis.sharding.check_sharded_step")
     ap.add_argument("--fail-on", default="error",
                     choices=["info", "warning", "error"],
                     help="exit nonzero at/above this severity (default: error)")
@@ -86,6 +103,26 @@ def main(argv=None) -> int:
     # force CPU before jax initializes: linting must run without the
     # accelerator (same bootstrap as the examples / tests)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    mesh_axes = None
+    if args.mesh:
+        # parsed by hand (not parse_mesh) so the simulated device count is
+        # in XLA_FLAGS before anything touches the jax backend
+        mesh_axes = {}
+        for part in args.mesh.replace(";", ",").split(","):
+            if not part.strip():
+                continue
+            name, _, size = part.partition("=")
+            mesh_axes[name.strip()] = int(size) if size else 1
+        n_dev = 1
+        for s in mesh_axes.values():
+            n_dev *= max(1, int(s))
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xla_flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{xla_flags} "
+                f"--xla_force_host_platform_device_count={n_dev}"
+            ).strip()
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -101,7 +138,13 @@ def main(argv=None) -> int:
             f"graph_lint: {args.model_file} has no {args.builder}() — "
             "expose a builder returning (model, input_specs) or a Program"
         )
-    built = builder()
+    import inspect
+    try:
+        takes_mesh = "mesh_axes" in inspect.signature(builder).parameters
+    except (TypeError, ValueError):
+        takes_mesh = False
+    built = builder(mesh_axes=mesh_axes) if (mesh_axes and takes_mesh) \
+        else builder()
     if isinstance(built, tuple) and len(built) == 2:
         target, specs = built
     else:
@@ -110,11 +153,22 @@ def main(argv=None) -> int:
         specs = [_parse_spec(s) for s in args.input_spec]
 
     passes = args.passes.split(",") if args.passes else None
-    diags = analysis.check(target, specs, passes=passes,
-                           memory_budget_mb=args.memory_budget_mb)
+    if hasattr(target, "_step_parts"):
+        # a sharded/pipelined train step: per-shard analysis
+        from paddle_tpu.analysis.sharding import check_sharded_step
+        diags = check_sharded_step(target, specs, passes=passes,
+                                   memory_budget_mb=args.memory_budget_mb)
+    else:
+        diags = analysis.check(target, specs, passes=passes,
+                               memory_budget_mb=args.memory_budget_mb)
 
     plan = None
     if args.plan:
+        if hasattr(target, "_step_parts"):
+            raise SystemExit(
+                "graph_lint: --plan is single-program; not supported for "
+                "sharded/pipelined train-step targets"
+            )
         if args.memory_budget_mb is None:
             raise SystemExit("graph_lint: --plan requires --memory-budget-mb")
         from paddle_tpu.analysis import plan as plan_mod
@@ -154,6 +208,8 @@ def main(argv=None) -> int:
         active = (describe_flags("check") + describe_flags("eager_lazy")
                   + describe_flags("memory_budget")
                   + describe_flags("memory_plan"))
+        if mesh_axes:
+            active += describe_flags("comm_ratio")
         flags_str = ", ".join(f"{f['name']}={f['value']}" for f in active)
         counts = {}
         for d in diags:

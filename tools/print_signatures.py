@@ -26,6 +26,7 @@ SUBMODULES = [
     "static",
     "static.analysis",
     "static.analysis.memory",
+    "static.analysis.sharding",
     "linalg",
     "metric",
     "distributed",
