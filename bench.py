@@ -977,6 +977,138 @@ def _observability_block(steps=6, bsz=8):
         res.reset()
 
 
+def _multichip_capture_child():
+    """Child process for the multichip_capture block: 8 simulated CPU
+    devices, dp2×mp2 mesh, one MLP trainer run twice — through the eager
+    whole-step capture tier (ISSUE 18) and through ShardedTrainStep — and
+    ONE JSON line on stdout with programs/step, steps/s for both, the
+    donation verdict, bitwise parity, and the per-device peak-HBM estimate
+    from the per-shard analyzer."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as prof
+    from paddle_tpu.core import lazy
+    from paddle_tpu.parallel import topology
+    from paddle_tpu.parallel.sharding import ShardedTrainStep, shard_params
+
+    mesh = topology.init_mesh(dp=2, mp=2)
+    steps = int(os.environ.get("BENCH_MULTICHIP_CAPTURE_STEPS", 30))
+
+    def make_trainer(seed=0):
+        paddle.seed(seed)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(64, 128), paddle.nn.ReLU(),
+            paddle.nn.Linear(128, 16))
+        model[0].weight.dist_spec = (None, "mp")
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=model.parameters())
+        return model, opt, paddle.nn.CrossEntropyLoss()
+
+    rng = np.random.default_rng(7)
+    xb = rng.standard_normal((8, 64)).astype(np.float32)
+    yb = rng.integers(0, 16, (8,))
+    batch_sh = NamedSharding(mesh, P(("dp",)))
+
+    # -- captured eager tier -------------------------------------------------
+    model, opt, loss_fn = make_trainer()
+    shard_params(model, mesh)
+    x, y = paddle.to_tensor(xb), paddle.to_tensor(yb)
+    x._value = jax.device_put(x._value, batch_sh)
+    y._value = jax.device_put(y._value, batch_sh)
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": True,
+                      "FLAGS_eager_step_capture": True,
+                      "FLAGS_eager_async_compile": False})
+
+    def one_step():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(6):  # warmup: arm + build + first replays
+        one_step()
+    c0 = prof.dispatch_counters()
+    t0 = time.time()
+    for _ in range(steps):
+        one_step()
+    lazy.flush_if_pending("bench")
+    cap_dt = time.time() - t0
+    c1 = prof.dispatch_counters()
+    programs_per_step = (c1["programs"] - c0["programs"]) / steps
+    replays = c1["capture_sharded_replays"] - c0["capture_sharded_replays"]
+    state = lazy.step_capture_state()
+    paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    cap_params = [np.asarray(p._value) for p in model.parameters()]
+
+    # per-device peak HBM of the captured sharded program (per-shard
+    # liveness plan over the capture registry's traced step)
+    est_peak_mb = None
+    try:
+        from paddle_tpu.analysis.memory import plan_memory
+        from paddle_tpu.analysis.sharding import captured_step_context
+
+        est_peak_mb = round(
+            plan_memory(captured_step_context()).peak_bytes / 2**20, 3)
+    except Exception:
+        pass
+
+    # -- ShardedTrainStep reference ------------------------------------------
+    model2, opt2, loss_fn2 = make_trainer()
+    shard_params(model2, mesh)
+    sts = ShardedTrainStep(model2, loss_fn2, opt2, mesh=mesh)
+    x2, y2 = paddle.to_tensor(xb), paddle.to_tensor(yb)
+    for _ in range(6):
+        sts(x2, y2)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = sts(x2, y2)
+    float(loss)
+    sts_dt = time.time() - t0
+    # parity at matched step count (both trainers ran 6 + steps updates)
+    ref_params = [np.asarray(p._value) for p in model2.parameters()]
+    bitwise = all(a.tobytes() == b.tobytes()
+                  for a, b in zip(cap_params, ref_params))
+
+    print(json.dumps({
+        "mesh": "dp2mp2",
+        "devices": len(jax.devices()),
+        "programs_per_step_captured": round(programs_per_step, 3),
+        "captured_replays_per_step": round(replays / steps, 3),
+        "captured_steps_per_s": round(steps / cap_dt, 2),
+        "sharded_train_step_steps_per_s": round(steps / sts_dt, 2),
+        "tier": state.get("tier"),
+        "donated": bool(state.get("donated")),
+        "donation_fallbacks": c1["capture_donation_fallbacks"],
+        "bitwise_equal_sharded_train_step": bitwise,
+        "est_peak_hbm_per_device_mb": est_peak_mb,
+    }), flush=True)
+
+
+def _multichip_capture_block():
+    """Spawn the dp2×mp2 capture-vs-ShardedTrainStep comparison in a
+    subprocess: the simulated 8-device mesh needs XLA_FLAGS set before jax
+    initializes, so it cannot run in the bench main process (which is
+    already bound to the real backend)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               BENCH_MULTICHIP_CAPTURE_CHILD="1")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"multichip_capture child rc={out.returncode}: "
+            + (out.stderr or "")[-800:])
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    return json.loads(lines[-1])
+
+
 def _backend_or_skip():
     """Probe the accelerator backend before any model builds. When the
     TPU/axon backend cannot initialize (tunnel down, relay unavailable),
@@ -1146,6 +1278,15 @@ def main():
             result["elastic"] = _elastic_block()
         except Exception as e:
             _block_failed("elastic", e)
+    # sharded whole-step capture trajectory block (ISSUE 18): programs/step
+    # on the simulated dp2×mp2 mesh, captured vs ShardedTrainStep steps/s,
+    # donation state, est per-device peak HBM — joins the MULTICHIP rows;
+    # BENCH_MULTICHIP_CAPTURE=0 skips it
+    if os.environ.get("BENCH_MULTICHIP_CAPTURE", "1") == "1":
+        try:
+            result["multichip_capture"] = _multichip_capture_block()
+        except Exception as e:
+            _block_failed("multichip_capture", e)
     # primary result first: a hard failure in the extra configs must not
     # lose the main measurement (one-JSON-line stdout contract)
     print(json.dumps(result), flush=True)
@@ -1179,5 +1320,8 @@ def main():
 
 
 if __name__ == "__main__":
+    if os.environ.get("BENCH_MULTICHIP_CAPTURE_CHILD") == "1":
+        _multichip_capture_child()
+        sys.exit(0)
     _backend_or_skip()
     main()

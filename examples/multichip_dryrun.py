@@ -124,6 +124,70 @@ def build_model_pp(mesh_axes=None):
     return step, specs
 
 
+def build_model_captured(mesh_axes=None):
+    """Arm the eager whole-step capture tier on a sharded MLP trainer and
+    return ``(lazy.captured_step_handle(), None)`` — graph_lint --mesh
+    routes the handle through ``check_sharded_step``, which rebuilds the
+    per-shard context (and per-position donation verdicts) from the
+    capture registry. Runs real eager steps until the capture replays, so
+    this builder is slower than the trace-only ones."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from paddle_tpu.core import lazy
+    from paddle_tpu.parallel import topology
+    from paddle_tpu.parallel.sharding import shard_params
+    import paddle_tpu.profiler as prof
+
+    axes = dict(mesh_axes or {"dp": 2, "mp": 2})
+    if int(axes.get("pp", 1)) > 1:
+        raise SystemExit(
+            "build_model_captured: pipelined (pp>1) meshes refuse capture "
+            "(shard_map autodiff limitation) — lint the pp step via "
+            "build_model_pp instead")
+    mesh = topology.init_mesh(**{k: int(v) for k, v in axes.items()})
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    if int(axes.get("mp", 1)) > 1:
+        model[0].weight.dist_spec = (None, "mp")
+    opt = paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    shard_params(model, mesh)
+    batch_sh = NamedSharding(mesh, P(tuple(
+        a for a in ("dp", "sharding") if int(axes.get(a, 1)) > 1) or None))
+    rng = np.random.default_rng(7)
+    bsz = 4 * max(1, int(axes.get("dp", 1)) * int(axes.get("sharding", 1)))
+    x = paddle.to_tensor(rng.standard_normal((bsz, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (bsz,)))
+    x._value = jax.device_put(x._value, batch_sh)
+    y._value = jax.device_put(y._value, batch_sh)
+
+    lazy._tls.observer = None
+    paddle.set_flags({
+        "FLAGS_eager_lazy_dispatch": True,
+        "FLAGS_eager_step_capture": True,
+        "FLAGS_eager_async_compile": False,
+    })
+    try:
+        for _ in range(12):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if prof.dispatch_counters().get("capture_replays", 0) >= 1:
+                break
+        else:
+            raise SystemExit(
+                "build_model_captured: capture never armed in 12 steps "
+                f"(counters: {prof.dispatch_counters()})")
+    finally:
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
+    return lazy.captured_step_handle(), None
+
+
 def main():
     import numpy as np
 

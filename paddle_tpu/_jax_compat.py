@@ -9,7 +9,7 @@ imports across every version we run against. Import it from here instead:
 """
 from __future__ import annotations
 
-__all__ = ["axis_size", "shard_map"]
+__all__ = ["axis_size", "shard_map", "shardmap_autodiff_limitation"]
 
 try:  # jax >= 0.5: top-level export
     from jax import shard_map as _shard_map
@@ -72,6 +72,38 @@ def shard_map(f=None, *args, **kwargs):
 
         return functools.partial(shard_map, **kwargs)
     return _shard_map(f, **kwargs)
+
+
+def shardmap_autodiff_limitation():
+    """Reason string when the installed jax cannot differentiate through a
+    ``shard_map`` region with non-empty ``auto`` axes, else ``None``.
+
+    jax 0.4.x (including 0.4.37) hits a partial-eval bug when a shard_map
+    with auto (replicated) axes is transposed: scalar residuals produced
+    inside the manual region come out as per-shard values the transpose
+    rule cannot re-broadcast, and the trace dies deep inside
+    ``jax.interpreters.partial_eval`` with an opaque shape error. The two
+    consumers of this contract:
+
+    - ``analysis.sharding.pipelined_step_context`` falls back to a
+      forward-only loss program on affected versions (its per-shard
+      memory/donation report says so), and
+    - the whole-step capture controller (``core.lazy``) refuses to capture
+      a step on a pipelined (pp) mesh with a structured
+      ``_CaptureIneligible(shardmap_autodiff_limitation())`` instead of
+      surfacing the opaque trace error — the pp schedule is a shard_map
+      region, so capturing forward+backward there would differentiate
+      through it.
+
+    jax >= 0.5 rewrote shard_map partial-eval and does not have the bug.
+    """
+    import jax
+
+    try:
+        ver = tuple(int(x) for x in jax.__version__.split(".")[:2])
+    except ValueError:
+        return None  # unparseable dev version: assume fixed
+    return "shardmap_autodiff" if ver < (0, 5) else None
 
 
 try:  # jax >= 0.5

@@ -590,6 +590,45 @@ def donation_safety(ctx: Context) -> List[Diagnostic]:
     return diags
 
 
+def donation_verdicts(ctx: Context) -> List[Dict[str, object]]:
+    """Per-position donation_safety verdicts over ``ctx``'s donated invars.
+
+    One record per donated flat argument position:
+    ``{"position", "role", "proven", "diagnostics"}`` — ``proven`` is True
+    iff no ERROR-severity donation_safety diagnostic names the position
+    (by its ``kind:name`` role label, directly as the diagnostic's op or
+    inside a group-alias message). This is the gate the mesh-aware capture
+    controller keys donation on — EVERY position must prove, or the
+    captured program replays non-donated (capture_donation_fallbacks) —
+    and the per-position table ``graph_lint --mesh --json`` prints."""
+    from . import run_passes
+
+    donated = sorted(set(getattr(ctx, "donated", ()) or ()))
+    diags = [d for d in run_passes(ctx, ["donation_safety"])
+             if d.pass_name == "donation_safety"]
+    roles = ctx.invar_roles()
+
+    def _name(idx):
+        if idx < len(roles):
+            kind, name = roles[idx][1]
+            return f"{kind}:{name}"
+        return f"arg:{idx}"
+
+    out = []
+    for idx in donated:
+        label = _name(idx)
+        errs = [d for d in diags
+                if d.severity >= Severity.ERROR
+                and (d.op == label or label in (d.message or ""))]
+        out.append({
+            "position": int(idx),
+            "role": label,
+            "proven": not errs,
+            "diagnostics": [d.message for d in errs],
+        })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Runtime alias scan (the compile-time cross-check of the capture path's
 # aliased_leaves fallback): enumerate live Tensor objects wrapping an array

@@ -75,6 +75,7 @@ __all__ = [
     "CollectiveOp",
     "ShardContext",
     "ShardVar",
+    "captured_step_context",
     "check_sharded_step",
     "collective_records",
     "collective_stats",
@@ -1015,15 +1016,47 @@ def pipelined_step_context(step, batch_specs, *, memory_budget_mb=None,
     )
 
 
+def captured_step_context(*, memory_budget_mb=None,
+                          source=None) -> ShardContext:
+    """Per-shard analysis context for the thread's last replayed SHARDED
+    captured whole-step program (``core.lazy`` whole-step capture on a
+    mesh). Rebuilds the closed jaxpr and per-invar PartitionSpecs from the
+    capture registry — trace-only, no XLA compile. Raises RuntimeError
+    when no sharded capture has replayed on this thread yet."""
+    from ..core import lazy as _lazy
+
+    prog = _lazy.captured_step_program()
+    info = _lazy.captured_step_shard_info()
+    if prog is None or info is None:
+        raise RuntimeError(
+            "no sharded captured step has replayed on this thread; run a "
+            "captured training step on a mesh first (FLAGS_eager_step_capture "
+            "with NamedSharding params)")
+    closed, donated, roles = prog
+    mesh, in_specs, axes = info
+    return ShardContext(
+        closed, list(roles), source or "captured-sharded", mesh_axes=axes,
+        in_specs=in_specs, donated=donated,
+        memory_budget_mb=memory_budget_mb,
+    )
+
+
 def check_sharded_step(step, batch_specs, *, passes=None,
                        memory_budget_mb=None, source=None
                        ) -> List[Diagnostic]:
     """Run the full analysis suite over a sharded/pipelined train step's
     traced program at per-shard shapes — the multi-chip twin of
     ``analysis.check``. Trace-only: no XLA compile, runs in milliseconds,
-    safe as a build-time gate under ``FLAGS_check_programs``."""
+    safe as a build-time gate under ``FLAGS_check_programs``. Accepts a
+    ``ShardedTrainStep``, a ``PipelinedTrainStep``, or a
+    ``lazy.captured_step_handle()`` (batch_specs ignored for the latter —
+    the captured program embeds its own batch shapes)."""
     from . import run_passes
 
+    if getattr(step, "_captured_step", False):  # lazy captured-step handle
+        ctx = captured_step_context(memory_budget_mb=memory_budget_mb,
+                                    source=source)
+        return run_passes(ctx, passes)
     if hasattr(step, "_stacked"):  # PipelinedTrainStep (pp schedule)
         ctx = pipelined_step_context(step, batch_specs,
                                      memory_budget_mb=memory_budget_mb,

@@ -201,6 +201,15 @@ def _reset_counters_locked():
         # as one captured program (forward + backward + grad accumulate)
         capture_accum_builds=0,
         capture_accum_replays=0,
+        # mesh-aware capture (FLAGS_eager_capture_sharded): captured-step
+        # builds/replays whose executable carries declared in/out shardings
+        # over a multi-device mesh, and donated captures demoted to the
+        # non-donated rung because the per-shard donation_safety proof did
+        # not cover every donated position (capture still replays 1
+        # program/step; only in-place buffer reuse is given up)
+        capture_sharded_builds=0,
+        capture_sharded_replays=0,
+        capture_donation_fallbacks=0,
         # async host pipeline (FLAGS_eager_async_compile): background compile
         # submissions/joins, bridge flushes (fresh segments executed eagerly
         # while their fused program compiles off-thread), and captured steps

@@ -168,6 +168,19 @@ define_flag(
     "per step) without buffer donation",
 )
 define_flag(
+    "eager_capture_sharded", True,
+    "mesh-aware whole-step capture: when the armed step's parameters carry "
+    "multi-device NamedShardings, the captured program is jitted with "
+    "in_shardings/out_shardings derived from parallel.sharding param/state "
+    "specs and the same donation discipline as ShardedTrainStep — one "
+    "donated multi-chip program per step. Donation additionally requires "
+    "the analysis.sharding per-shard donation_safety proof for EVERY "
+    "donated position (unproven positions replay non-donated, counted in "
+    "capture_donation_fallbacks). Set to 0 to pin capture to the "
+    "single-chip contract (sharded params then capture without declared "
+    "shardings)",
+)
+define_flag(
     "eager_capture_warmup", 2,
     "number of consecutive identical steady-state steps observed before the "
     "whole-step capture controller captures and replays the step as one "

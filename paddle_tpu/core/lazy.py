@@ -44,7 +44,10 @@ from . import flags
 
 __all__ = [
     "LazyRef",
+    "captured_step_donation_verdicts",
+    "captured_step_handle",
     "captured_step_program",
+    "captured_step_shard_info",
     "drain_async",
     "flush_if_pending",
     "materialize",
@@ -964,13 +967,92 @@ class _CaptureEntry:
                  # planner-guided remat (analysis.plan): the RematPlan this
                  # build applied (or proved empty), None when FLAGS_memory_plan
                  # did not ask for one
-                 "mem_plan", "__weakref__")
+                 "mem_plan",
+                 # mesh-aware capture (FLAGS_eager_capture_sharded): the jax
+                 # Mesh the executable was jitted against (structural —
+                 # devices, not user buffers), the flat per-invar
+                 # PartitionSpecs fed to the per-shard analyzer, and the
+                 # per-position donation_safety verdicts recorded at build;
+                 # all None for a single-chip capture
+                 "mesh", "in_specs", "verdicts", "__weakref__")
 
 
 class _CaptureIneligible(Exception):
     def __init__(self, reason: str):
         super().__init__(reason)
         self.reason = reason
+
+
+def _capture_mesh(rec) -> Optional[Any]:
+    """Mesh of a deferred step's leaves when mesh-aware capture applies:
+    the first leaf whose committed value carries a multi-device
+    NamedSharding names it (shard_params / fleet.distributed_train_step
+    placement), else None — single-chip capture, the pre-mesh contract.
+    FLAGS_eager_capture_sharded=0 pins the single-chip path."""
+    if not flags.flag("eager_capture_sharded"):
+        return None
+    from jax.sharding import NamedSharding
+
+    for t in rec.leaves:
+        sh = getattr(t._value, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.devices.size > 1:
+            return sh.mesh
+    return None
+
+
+def _mesh_axes(mesh) -> Dict[str, int]:
+    return dict(zip((str(a) for a in mesh.axis_names),
+                    (int(s) for s in mesh.devices.shape)))
+
+
+def _mesh_tag(mesh) -> Optional[str]:
+    """Compact mesh label for attribution keys / capture state / emits:
+    'dp2mp2' on a dp2×mp2 mesh (size-1 axes elided)."""
+    if mesh is None:
+        return None
+    return "".join(
+        f"{a}{s}" for a, s in _mesh_axes(mesh).items() if s > 1) or None
+
+
+def _mesh_fingerprint(mesh, rec) -> Optional[Tuple]:
+    """The capture cache key's mesh/spec element: mesh axes/shape plus each
+    leaf's committed PartitionSpec. A respec (shard_params, an elastic
+    rescale, a topology change) re-captures under a fresh key instead of
+    replaying a stale layout; None single-chip keeps pre-mesh keys
+    unchanged."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding
+
+    specs = []
+    for t in rec.leaves:
+        sh = getattr(t._value, "sharding", None)
+        specs.append(sh.spec if isinstance(sh, NamedSharding) else None)
+    return (tuple(_mesh_axes(mesh).items()), tuple(specs))
+
+
+def _mesh_ladder_tag() -> Optional[Tuple]:
+    """Mesh component of the degradation-ladder key: captured → lazy →
+    per-op demotion is tracked per (step signature, mesh), so a fault
+    history earned on one topology never gates another — a post-rescale
+    world re-earns (or re-loses) capture on its own record."""
+    try:
+        from ..parallel.topology import get_mesh
+
+        mesh = get_mesh()
+    except Exception:
+        return None
+    if mesh is None or mesh.devices.size <= 1:
+        return None
+    return (tuple(str(a) for a in mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape))
+
+
+def _ladder_key(sig):
+    try:
+        return hash((sig, _mesh_ladder_tag()))
+    except TypeError:
+        return hash(sig)
 
 
 def _capture_on() -> bool:
@@ -1129,7 +1211,7 @@ def _step_boundary(opt):
         from . import dispatch
 
         if not dispatch._resilience_module().runtime.captured_tier_ok(
-            hash(events[0][1])
+            _ladder_key(events[0][1])
         ):
             armed = None  # ladder demoted this signature — don't arm
     if armed is not None and obs.armed != armed:
@@ -1166,7 +1248,9 @@ def step_capture_backward(root) -> bool:
     if rv.size != 1:
         return False
     seg_sig = _seg_signature(seg)
-    if not dispatch._resilience_module().runtime.captured_tier_ok(hash(seg_sig)):
+    if not dispatch._resilience_module().runtime.captured_tier_ok(
+        _ladder_key(seg_sig)
+    ):
         # degradation ladder demoted this step signature: stay on the
         # 3-program path until the cooldown re-promotes it
         return False
@@ -1325,7 +1409,7 @@ def _run_accum_microstep(seg, root, seg_sig, tape_key, leaves, slots, pos,
     except TypeError:
         return False
     rv = root._value
-    lkey = hash(seg_sig)
+    lkey = _ladder_key(seg_sig)
     akey = f"accum:{_sig_id(seg_sig)}"
     try:
         built_fn = None
@@ -1619,7 +1703,7 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     # opts out (keeps the 1-program step, drops in-place reuse) for code
     # that holds aliases of param/state buffers across steps.
     donate = (0, 1) if flags.flag("eager_capture_donate") else ()
-    entry.arg_specs = None  # recorded at first replay
+    entry.arg_specs = None  # recorded at first replay (sharded: at build)
     entry.donated = bool(donate)
     entry.param_idx = param_idx
     entry.extra_idx = extra_idx
@@ -1629,6 +1713,54 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     entry.warmed = False
     entry.pending = None
     entry.mem_plan = None
+    entry.mesh = None
+    entry.in_specs = None
+    entry.verdicts = None
+
+    # mesh-aware capture (FLAGS_eager_capture_sharded): params carrying
+    # multi-device NamedShardings get the whole step jitted as the same one
+    # SPMD program ShardedTrainStep compiles — declared in/out shardings
+    # from parallel.sharding param/state specs, donation gated on the
+    # per-shard proof below
+    mesh = _capture_mesh(rec)
+    in_shardings = out_shardings = None
+    if mesh is not None:
+        if _mesh_axes(mesh).get("pp", 1) > 1:
+            # the pipeline schedule is a shard_map region, and jax 0.4.x
+            # cannot differentiate through shard_map with auto axes (the
+            # scalar-residual partial-eval bug documented in _jax_compat):
+            # refuse structurally instead of dying mid-trace
+            from .._jax_compat import shardmap_autodiff_limitation
+
+            raise _CaptureIneligible(
+                shardmap_autodiff_limitation() or "pipelined_mesh")
+        entry.mesh = mesh
+        cap_p, cap_s, cargs = _capture_args(rec, opt, entry)
+        entry.arg_specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), cargs)
+        from ..parallel.sharding import capture_step_shardings
+
+        p_sh, st_sh = capture_step_shardings(cap_p, cap_s, mesh)
+        # lr / batch / rest / grad-in positions stay unconstrained (None):
+        # a committed dp-sharded batch keeps its layout, an uncommitted one
+        # stays free — the same caller-placed-batch contract as
+        # ShardedTrainStep, so matched specs give bitwise-equal reductions
+        in_shardings = (tuple(p_sh), tuple(st_sh)) + (None,) * 5
+        # updated params/state pinned to the INPUT layout: donation aliases
+        # per-shard and the next replay's spec fingerprint is stable. The
+        # param grads gp are pinned to the param shardings too — jit's
+        # donation aliasing greedily pairs donated inputs with ANY
+        # same-logical-shape output, and an unpinned gp whose propagated
+        # layout differs from the param's fails the XLA per-shard aliasing
+        # size check at runtime
+        out_shardings = (
+            (None, tuple(p_sh), None, tuple(p_sh), tuple(st_sh))
+            + (None,) * (int(rescue_on) + int(tele_on)))
+        flat_sh = jax.tree_util.tree_leaves((tuple(p_sh), tuple(st_sh)))
+        n_flat = len(jax.tree_util.tree_leaves(entry.arg_specs))
+        entry.in_specs = ([s.spec for s in flat_sh]
+                          + [None] * (n_flat - len(flat_sh)))
+
     planned_loss = None
     if _mem_plan_on():
         # planner-guided remat (FLAGS_memory_plan=auto): slice this step's
@@ -1650,9 +1782,71 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
             _plan_mod.record_failure("capture", e)
             raise _CaptureIneligible("memory_plan_failed")
     step_fn = make_step_fn(planned_loss)
-    entry.exe = jax.jit(step_fn, donate_argnums=donate)
     entry.step_fn = step_fn
+    if mesh is not None and donate:
+        # per-shard donation gate: donation stays on ONLY when the
+        # analysis.sharding donation_safety pass proves EVERY donated flat
+        # position at per-shard shapes; anything unproven demotes this
+        # build to non-donated replay — a counted reason
+        # (capture_donation_fallbacks), not a capture fallback: the step
+        # still replays as 1 program, only in-place reuse is given up
+        donate = _prove_sharded_donation(entry, mesh, donate)
+        entry.donated = bool(donate)
+    if mesh is not None:
+        if donate:
+            # jax 0.4.x donation sharp edge: the donation matcher compares a
+            # donated input's PER-SHARD shape against an unpinned output's
+            # GLOBAL shape, so e.g. a [16,4] weight sharded to [8,4] aliases
+            # a [8,4] logits output and XLA's runtime per-shard size check
+            # then faults the replay. Pin EVERY output before donating:
+            # probe-compile non-donated (propagation chooses the unpinned
+            # outputs' layouts), then rebuild with the inferred shardings —
+            # the second compile propagates identically, aliasing now pairs
+            # per-shard against per-shard
+            probe = jax.jit(
+                step_fn, in_shardings=in_shardings,
+                out_shardings=out_shardings,
+            ).lower(*entry.arg_specs).compile()
+            out_shardings = probe.output_shardings
+        entry.exe = jax.jit(step_fn, in_shardings=in_shardings,
+                            out_shardings=out_shardings,
+                            donate_argnums=donate)
+    else:
+        entry.exe = jax.jit(step_fn, donate_argnums=donate)
     return entry
+
+
+def _prove_sharded_donation(entry: _CaptureEntry, mesh, donate):
+    """Build-time per-shard donation proof of a mesh-aware capture: trace
+    the candidate step (no compile), run the analysis.sharding
+    donation_safety pass over the _ShardInliner-derived context, and keep
+    ``donate`` only when every donated position's verdict is proven. The
+    verdicts land on the entry for graph_lint / statusz; a tracing failure
+    counts as unproven — donation is a proof-carrying optimization here,
+    never a default."""
+    from . import dispatch
+
+    try:
+        roles, donated_idx = _capture_arg_roles(entry)
+        closed = jax.make_jaxpr(entry.step_fn)(*entry.arg_specs)
+        from ..analysis import memory as _amem
+        from ..analysis import sharding as _ashard
+
+        ctx = _ashard.shard_context(
+            closed, roles, mesh=mesh, in_specs=entry.in_specs,
+            donated=donated_idx, source="captured-sharded")
+        entry.verdicts = _amem.donation_verdicts(ctx)
+        proven = bool(entry.verdicts) and all(
+            v["proven"] for v in entry.verdicts)
+    except Exception:
+        entry.verdicts = None
+        proven = False
+    if proven:
+        return donate
+    dispatch._counters["capture_donation_fallbacks"] += 1
+    dispatch._emit("capture", site="captured", phase="donation_fallback",
+                   mesh=_mesh_tag(mesh))
+    return ()
 
 
 def _build_capture_plan(rec, opt, entry, make_step_fn, fwd, n_ext,
@@ -1707,8 +1901,19 @@ def _build_capture_plan(rec, opt, entry, make_step_fn, fwd, n_ext,
     def measure(flat_fn):
         pl = bind_loss(flat_fn) if flat_fn is not None else None
         closed = jax.make_jaxpr(make_step_fn(pl))(*specs)
-        ctx = analysis.Context(closed, roles, "captured-step",
-                               donated=donated)
+        if entry.mesh is not None:
+            # mesh-aware capture: the plan is chosen against PER-DEVICE
+            # peak — the _ShardInliner-derived context sizes every buffer
+            # at its shard shape, so FLAGS_memory_budget_mb means one
+            # chip's HBM on a mesh, not the global footprint
+            from ..analysis.sharding import shard_context
+
+            ctx = shard_context(closed, roles, mesh=entry.mesh,
+                                in_specs=entry.in_specs, donated=donated,
+                                source="captured-step")
+        else:
+            ctx = analysis.Context(closed, roles, "captured-step",
+                                   donated=donated)
         return _memory.plan_memory(ctx).peak_bytes
 
     budget = int(float(flags.flag("memory_budget_mb")) * (1 << 20))
@@ -1804,6 +2009,44 @@ def captured_step_program():
     return closed, donated, roles
 
 
+def captured_step_shard_info():
+    """``(mesh, flat per-invar PartitionSpecs, mesh axes dict)`` of the most
+    recently replayed SHARDED captured step on this thread, or None (no
+    sharded replay yet, or the cache entry was evicted and collected).
+    Pairs with :func:`captured_step_program` —
+    ``analysis.sharding.captured_step_context`` rebuilds the per-shard
+    analyzer context from the two."""
+    ref = getattr(_tls, "last_capture_entry", None)
+    entry = ref() if ref is not None else None
+    if entry is None or entry.mesh is None or entry.arg_specs is None:
+        return None
+    return entry.mesh, list(entry.in_specs or []), _mesh_axes(entry.mesh)
+
+
+def captured_step_donation_verdicts():
+    """Per-position donation_safety verdicts recorded at the last replayed
+    capture's build (``analysis.memory.donation_verdicts`` records —
+    position / role / proven / diagnostics), or None when the last replay
+    was single-chip or nothing has replayed. ``graph_lint --mesh`` prints
+    these per position in its JSON record."""
+    ref = getattr(_tls, "last_capture_entry", None)
+    entry = ref() if ref is not None else None
+    return None if entry is None else entry.verdicts
+
+
+class _CapturedStepHandle:
+    """Routable stand-in for this thread's last replayed captured step:
+    ``graph_lint --mesh`` and ``analysis.sharding.check_sharded_step``
+    dispatch on ``_captured_step`` and rebuild the per-shard context from
+    the capture registry — the handle itself pins nothing."""
+
+    _captured_step = True
+
+
+def captured_step_handle() -> _CapturedStepHandle:
+    return _CapturedStepHandle()
+
+
 def _check_captured_donation(entry: _CaptureEntry, params, states):
     # the static traced-program pass runs once per capture build (warmed is
     # set only after a successful replay, so a raising verdict re-proves)
@@ -1846,13 +2089,14 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
         # ProgramVerificationError at FLAGS_check_programs>=2 — the caller
         # resolves the deferred step on the safe 3-program path first.
         _check_captured_donation(entry, params, states)
-    lkey = hash(rec.seg_sig)
+    lkey = _ladder_key(rec.seg_sig)
     # with donation on, a REAL fault from inside exe may fire after XLA
     # consumed the param/state buffers — replaying the same args would feed
     # deleted buffers, so such faults skip in-place retry and resolve via
     # the 3-program fallback (injected faults raise pre-launch and retry)
     unsafe = entry.donated
-    ckey = f"captured:{_sig_id(rec.seg_sig)}"
+    tag = _mesh_tag(entry.mesh)
+    ckey = f"captured:{_sig_id(rec.seg_sig)}" + (f"@{tag}" if tag else "")
     t0 = time.perf_counter()
     if entry.warmed:
         out = dispatch._rexec(
@@ -1925,8 +2169,17 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     _tls.last_capture_entry = weakref.ref(entry)
     dispatch._count_program("captured")
     dispatch._counters["capture_replays"] += 1
+    if entry.mesh is not None:
+        dispatch._counters["capture_sharded_replays"] += 1
+    # per-host capture tier for /statusz + fleet obs: what the LAST replay
+    # on this thread actually ran as
+    _tls.capture_tier = {
+        "tier": "captured-sharded" if entry.mesh is not None else "captured",
+        "mesh": tag,
+        "donated": bool(entry.donated),
+    }
     dispatch._emit("capture", site="captured", phase="replay",
-                   donated=entry.donated)
+                   donated=entry.donated, mesh=tag)
 
     # the captured program subsumes the segment flush: write every op
     # output back exactly like _flush does (minus the vjp closures, which
@@ -2030,7 +2283,10 @@ def step_capture_step(optimizer) -> bool:
            # (signature, budget), so mode + budget fingerprint the plan
            # into the step key — a budget change recompiles, not replays
            (str(flags.flag("memory_plan")), float(flags.flag("memory_budget_mb")))
-           if _mem_plan_on() else None)
+           if _mem_plan_on() else None,
+           # mesh/spec fingerprint (mesh-aware capture): a respec or
+           # topology change compiles a fresh executable; None single-chip
+           _mesh_fingerprint(_capture_mesh(rec), rec))
     try:
         entry = dispatch._lru_get(_capture_cache, key)
     except TypeError:
@@ -2060,11 +2316,14 @@ def step_capture_step(optimizer) -> bool:
 
             entry, fut = dispatch._rexec(
                 "captured", _build_and_submit,
-                fresh=True, ladder_key=hash(rec.seg_sig),
+                fresh=True, ladder_key=_ladder_key(rec.seg_sig),
             )
             dispatch._counters["capture_builds"] += 1
+            if entry.mesh is not None:
+                dispatch._counters["capture_sharded_builds"] += 1
             dispatch._emit("capture", site="captured", phase="build",
-                           background=fut is not None)
+                           background=fut is not None,
+                           mesh=_mesh_tag(entry.mesh))
             dispatch._lru_put(
                 _capture_cache, key, entry,
                 evict_counter="capture_evictions",
@@ -2314,6 +2573,7 @@ def step_capture_state() -> Dict[str, Any]:
     """Snapshot of this thread's whole-step capture controller (for
     bench.py's capture-state line and paddle.profiler.measure_programs)."""
     obs = getattr(_tls, "observer", None)
+    tier_info = getattr(_tls, "capture_tier", None) or {}
     return {
         "enabled": _capture_on(),
         "armed": bool(obs is not None and obs.armed is not None),
@@ -2326,4 +2586,11 @@ def step_capture_state() -> Dict[str, Any]:
         "cycle_pos": 0 if obs is None else obs.pos,
         # async host pipeline: background compiles still in flight
         "pending_compiles": _async.pending_jobs(),
+        # mesh-aware capture: the tier the LAST replay on this thread ran
+        # as ('captured-sharded' on a multi-device mesh), its mesh tag,
+        # and whether that replay was donated — /statusz and the fleet obs
+        # snapshot render these per host
+        "tier": tier_info.get("tier"),
+        "mesh": tier_info.get("mesh"),
+        "donated": bool(tier_info.get("donated", False)),
     }

@@ -218,6 +218,25 @@ class ObsPublisher:
             telemetry = _attribution.telemetry_summary()
         except Exception:
             pass
+        # whole-step capture tier (ISSUE 18): the per-host dispatch tier —
+        # "captured-sharded@dp2mp2 donated", "captured", or None when the
+        # capture tier is off/unarmed — so fleet_top shows at a glance which
+        # hosts replay 1 program per step
+        capture = None
+        try:
+            from ...core import lazy as _lazy
+
+            cstate = _lazy.step_capture_state()
+            tier = cstate.get("tier")
+            if tier:
+                capture = tier + (f"@{cstate['mesh']}" if cstate.get("mesh")
+                                  else "")
+                if cstate.get("donated"):
+                    capture += " donated"
+            elif cstate.get("enabled"):
+                capture = "armed" if cstate.get("armed") else "warmup"
+        except Exception:
+            pass
         return {
             "node": self.node_id,
             "host": socket.gethostname(),
@@ -228,6 +247,7 @@ class ObsPublisher:
             "elastic": elastic,
             "programs": programs,
             "telemetry": telemetry,
+            "capture": capture,
             "health": {
                 "status": health.get("status"),
                 "reasons": health.get("reasons"),
@@ -546,6 +566,9 @@ class FleetAggregator:
                 # group's grad norm, when FLAGS_telemetry is on there
                 "grad_norm": t.get("grad_norm"),
                 "grad_norm_group": t.get("group"),
+                # whole-step capture tier (ISSUE 18), e.g.
+                # "captured-sharded@dp2mp2 donated"
+                "capture": doc.get("capture"),
             })
         return rows
 

@@ -351,6 +351,15 @@ class CompiledTrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # multi-chip: params/optimizer state follow parallel.sharding's
+        # capture_step_shardings specs; in_shardings gives one Sharding (or
+        # PartitionSpec, resolved on `mesh`) per batch argument. None entries
+        # stay uncommitted and XLA places them.
+        self.mesh = mesh if (mesh is not None and
+                             getattr(mesh, "devices", None) is not None and
+                             mesh.devices.size > 1) else None
+        self._in_shardings = in_shardings
+        self._placed = False  # params/state device_put once, on first call
         self._step = None
         self._step_fn_raw = None  # unjitted step fn, kept for the planner
         self._arg_specs = None  # ShapeDtypeStructs of the last call's args
@@ -483,6 +492,23 @@ class CompiledTrainStep:
 
         return step_fn
 
+    def _batch_shardings(self, n_batch):
+        """One jax Sharding (or None = uncommitted) per batch argument,
+        resolved from the user's ``in_shardings`` — PartitionSpecs bind to
+        ``self.mesh``, Shardings pass through, missing tail entries stay
+        None."""
+        from jax.sharding import NamedSharding, Sharding
+
+        given = list(self._in_shardings or [])[:n_batch]
+        given += [None] * (n_batch - len(given))
+        out = []
+        for s in given:
+            if s is None or isinstance(s, Sharding):
+                out.append(s)
+            else:  # a PartitionSpec (or axis tuple coercible to one)
+                out.append(NamedSharding(self.mesh, s))
+        return out
+
     def _build(self):
         plan = self._mem_plan
         planned = None
@@ -491,7 +517,45 @@ class CompiledTrainStep:
         step_fn = self._make_step_fn(planned)
         # donate params and optimizer state: XLA reuses their HBM buffers
         self._step_fn_raw = step_fn
+        if self.mesh is not None:
+            # mesh-aware build: pin param/state layouts to the same specs
+            # the capture tier and ShardedTrainStep derive, so the donated
+            # buffers round-trip without resharding between steps
+            from ..parallel.sharding import capture_step_shardings
+
+            p_sh, st_sh = capture_step_shardings(
+                self._params, list(self._opt_state), self.mesh)
+            batch_sh = self._batch_shardings(len(self._arg_specs) - 5)
+            in_sh = (tuple(p_sh), tuple(st_sh), None, None, None, *batch_sh)
+            out_sh = (None, None, tuple(p_sh), tuple(st_sh), None)
+            return jax.jit(step_fn, in_shardings=in_sh,
+                           out_shardings=out_sh, donate_argnums=(0, 1))
         return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def _place(self, batch_vals):
+        """device_put params/optimizer state onto their mesh shardings once
+        (first call), and the batch per ``in_shardings`` every call — the
+        mirror of ShardedTrainStep.__call__'s placement."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.sharding import capture_step_shardings
+
+        if not self._placed:
+            p_sh, st_sh = capture_step_shardings(
+                self._params, list(self._opt_state), self.mesh)
+            for p, sh in zip(self._params, p_sh):
+                p._value = jax.device_put(p._value, sh)
+            for st, shd in zip(self._opt_state, st_sh):
+                for k, sh in shd.items():
+                    st[k] = jax.device_put(st[k], sh)
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            for b in self._buffers:
+                b._value = jax.device_put(b._value, rep)
+            for p, st in zip(self._params, self._opt_state):
+                self.optimizer._accumulators[id(p)] = st
+            self._placed = True
+        batch_sh = self._batch_shardings(len(batch_vals))
+        return [v if sh is None else jax.device_put(v, sh)
+                for v, sh in zip(batch_vals, batch_sh)]
 
     def _loss_specs(self):
         p, st, b, key, _lr, *batch = self._arg_specs
@@ -632,6 +696,8 @@ class CompiledTrainStep:
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        if self.mesh is not None:
+            batch_vals = self._place(batch_vals)
         p_vals = tuple(p._value for p in self._params)
         b_vals = tuple(b._value for b in self._buffers)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)

@@ -85,6 +85,52 @@ def shard_params(model, mesh: Optional[Mesh] = None, zero_stage: int = 0):
     return model
 
 
+def capture_step_shardings(params, states, mesh: Optional[Mesh] = None):
+    """NamedShardings of the donated leaves of a mesh-aware captured step.
+
+    The whole-step capture controller (core.lazy) jits its captured program
+    with declared in/out shardings so the replay is the same one SPMD
+    program `ShardedTrainStep` compiles, buffer placement included. Per
+    parameter: the committed NamedSharding when the buffer already lives
+    distributed (shard_params / an earlier donated replay), else the
+    derived `param_spec`. Per optimizer-state leaf: the committed sharding,
+    else replicated for scalars (step counts) and the param spec mirrored
+    through `_state_spec` otherwise — exactly the layout
+    `ShardedTrainStep._shardings` declares, so a capture at matched specs
+    is bitwise-comparable. Returns ``(param_shardings, state_shardings)``
+    aligned with ``params`` / ``states`` (each state entry a dict keyed
+    like the optimizer accumulator dict)."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("capture_step_shardings requires a mesh")
+
+    def _committed(val):
+        sh = getattr(val, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.devices.size > 1:
+            return sh
+        return None
+
+    p_sh: List[NamedSharding] = []
+    st_sh: List[Dict[str, NamedSharding]] = []
+    for p, st in zip(params, states):
+        v = p._value if isinstance(p, Tensor) else p
+        psh = _committed(v) or NamedSharding(mesh, param_spec(p, 0, mesh))
+        p_sh.append(psh)
+        d = {}
+        for k in sorted(st):
+            sv = st[k]
+            csh = _committed(sv)
+            if csh is not None:
+                d[k] = csh
+            elif getattr(sv, "ndim", 0) == 0:
+                d[k] = NamedSharding(mesh, P())
+            else:
+                d[k] = NamedSharding(
+                    mesh, _state_spec(psh.spec, sv.shape, 1, mesh))
+        st_sh.append(d)
+    return tuple(p_sh), tuple(st_sh)
+
+
 import threading as _threading
 
 _constraint_tls = _threading.local()

@@ -109,6 +109,17 @@ def worker_main(args):
 
     log(f"start {os.getpid()}")
 
+    if args.capture:
+        # captured-tier chaos (ISSUE 18): the worker trains through the
+        # whole-step capture controller — after warmup the steady-state
+        # step replays as ONE donated program; a SIGKILL relaunch must
+        # re-arm and stay bitwise with the capture-off trajectory
+        paddle.set_flags({
+            "FLAGS_eager_lazy_dispatch": True,
+            "FLAGS_eager_step_capture": True,
+            "FLAGS_eager_async_compile": False,
+        })
+
     mgr = ElasticManager(
         lambda: None, job_id=JOB_ID, master=args.master,
         heartbeat_ttl=args.ttl,
@@ -177,6 +188,16 @@ def worker_main(args):
             log(f"stall {step}")
             time.sleep(args.ttl * 4)
         log(f"done {step} {lv:.9g}")
+    if args.capture:
+        from paddle_tpu.core import lazy as _lazy
+        import paddle_tpu.profiler as _prof
+
+        _lazy.flush_if_pending("final")
+        c = _prof.dispatch_counters()
+        log(f"capture builds={c['capture_builds']} "
+            f"replays={c['capture_replays']} "
+            f"fallbacks={c['capture_fallbacks']}")
+        paddle.set_flags({"FLAGS_eager_lazy_dispatch": False})
     state.refresh()
     np.savez(os.path.join(wdir, "final.npz"),
              **{k: np.asarray(v._value) for k, v in state.items()
@@ -428,7 +449,7 @@ def elastic_worker_main(args):
 # Supervisor: fleet lifecycle + fault injection + verdicts
 # ---------------------------------------------------------------------------
 def _spawn(worker_id, master, wdir, steps, np_, ttl, save_freq="1",
-           barrier=True, stall_at=None):
+           barrier=True, stall_at=None, capture=False):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            "--worker-id", str(worker_id), "--master", master,
            "--dir", wdir, "--steps", str(steps), "--np", str(np_),
@@ -437,6 +458,8 @@ def _spawn(worker_id, master, wdir, steps, np_, ttl, save_freq="1",
         cmd.append("--no-barrier")
     if stall_at is not None:
         cmd += ["--stall-at", str(stall_at)]
+    if capture:
+        cmd.append("--capture")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PADDLE_CURRENT_ENDPOINT=f"w{worker_id}")
     os.makedirs(wdir, exist_ok=True)
@@ -521,11 +544,12 @@ def _kv_alive(master, timeout=1.0):
     return sorted(k.split("/")[-1] for k in alive)
 
 
-def _run_fleet(root, master, np_, steps, save_freq="1"):
+def _run_fleet(root, master, np_, steps, save_freq="1", capture=False):
     """Launch np_ workers, wait for clean exit, return worker dirs."""
     dirs = [os.path.join(root, f"w{i}") for i in range(np_)]
     procs = [_spawn(i, master, dirs[i], steps, np_, ttl=1.5,
-                    save_freq=save_freq) for i in range(np_)]
+                    save_freq=save_freq, capture=capture)
+             for i in range(np_)]
     rcs = [p.wait(timeout=120) for p in procs]
     if any(rc != 0 for rc in rcs):
         raise RuntimeError(f"fleet run failed: rcs={rcs}")
@@ -617,6 +641,67 @@ def scenario_sigkill(root, master, np_, steps, baseline, results):
         "steps_lost": lost, "bitwise_identical": bitwise,
         "obs_all_hosts_in_merged_view": obs_live,
         "obs_dead_host_dropped": obs_dropped,
+    })
+    return ok
+
+
+def _capture_replays(lines, since_last_start=False):
+    """capture_replays from the worker's counters line(s); the relaunched
+    process logs its own line, so ``since_last_start`` isolates it."""
+    starts = [i for i, ln in enumerate(lines) if ln.startswith("start ")]
+    if since_last_start and starts:
+        lines = lines[starts[-1]:]
+    reps = [int(ln.split("replays=")[1].split()[0])
+            for ln in lines if ln.startswith("capture ")]
+    return reps[-1] if reps else 0
+
+
+def scenario_captured(root, master, np_, steps, baseline, results):
+    """Captured-tier chaos (ISSUE 18): workers train through whole-step
+    capture (1 donated replay per steady-state step). Gates: (a) the
+    captured fleet's finals are bitwise-identical to the capture-OFF
+    baseline — tier parity under real multi-process training; (b) a
+    SIGKILL victim relaunched with capture on resumes within the CheckFreq
+    bound and RE-ARMS (its relaunched process replays captured programs
+    again); (c) finals after the fault stay bitwise."""
+    ttl = 1.5
+    # (a) fault-free captured fleet == capture-off baseline, bitwise
+    cap_dirs = _run_fleet(os.path.join(root, "captured-base"), master, np_,
+                          steps, capture=True)
+    cap_finals = [_load_final(d) for d in cap_dirs]
+    tier_parity = all(_finals_bitwise_equal(f, b)
+                      for f, b in zip(cap_finals, baseline))
+    armed = all(_capture_replays(_log_lines(d)) > 0 for d in cap_dirs)
+    # (b)+(c) SIGKILL one captured worker mid-run; relaunch with capture
+    dirs = [os.path.join(root, "captured", f"w{i}") for i in range(np_)]
+    procs = [_spawn(i, master, dirs[i], steps, np_, ttl, capture=True)
+             for i in range(np_)]
+    victim = np_ - 1
+    try:
+        _wait_done_at_least(dirs[victim], steps // 3)
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        procs[victim] = _spawn(victim, master, dirs[victim], steps, np_,
+                               ttl, barrier=False, capture=True)
+        rcs = [p.wait(timeout=120) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    finals = [_load_final(d) for d in dirs]
+    lost = _steps_lost(_log_lines(dirs[victim]))
+    rearmed = _capture_replays(_log_lines(dirs[victim]),
+                               since_last_start=True) > 0
+    bitwise = all(_finals_bitwise_equal(f, b)
+                  for f, b in zip(finals, baseline))
+    ok = (all(rc == 0 for rc in rcs) and lost <= 1 and bitwise
+          and tier_parity and armed and rearmed)
+    results.append({
+        "scenario": "captured", "ok": ok, "rcs": rcs,
+        "steps_lost": lost, "bitwise_identical": bitwise,
+        "captured_tier_bitwise_vs_uncaptured": tier_parity,
+        "capture_armed_all_workers": armed,
+        "capture_rearmed_after_relaunch": rearmed,
     })
     return ok
 
@@ -944,9 +1029,9 @@ def main(argv=None):
     # groups: "fleet" = the ISSUE 8 scenarios, "elastic" = the ISSUE 14
     # in-place rescale scenarios, "all" = everything
     ap.add_argument("--scenario", default="all",
-                    choices=["all", "fleet", "sigkill", "partition",
-                             "lease", "elastic", "shrink", "grow",
-                             "straggler"])
+                    choices=["all", "fleet", "sigkill", "captured",
+                             "partition", "lease", "elastic", "shrink",
+                             "grow", "straggler"])
     # worker mode (internal)
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--worker-id", type=int, default=0,
@@ -959,6 +1044,8 @@ def main(argv=None):
     ap.add_argument("--no-barrier", dest="barrier", action="store_false",
                     help=argparse.SUPPRESS)
     ap.add_argument("--stall-at", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--capture", action="store_true",
                     help=argparse.SUPPRESS)
     # elastic worker mode (internal)
     ap.add_argument("--elastic-worker", action="store_true",
@@ -988,11 +1075,15 @@ def main(argv=None):
         master = f"127.0.0.1:{srv.port}"
         try:
             baseline = None
-            if args.scenario in ("all", "fleet", "sigkill", "lease"):
+            if args.scenario in ("all", "fleet", "sigkill", "captured",
+                                 "lease"):
                 baseline = _baseline(root, master, args.np, args.steps)
             if args.scenario in ("all", "fleet", "sigkill"):
                 ok &= scenario_sigkill(root, master, args.np, args.steps,
                                        baseline, results)
+            if args.scenario in ("all", "fleet", "captured"):
+                ok &= scenario_captured(root, master, args.np, args.steps,
+                                        baseline, results)
             if args.scenario in ("all", "fleet", "lease"):
                 ok &= scenario_lease(root, master, args.np, args.steps,
                                      baseline, results)

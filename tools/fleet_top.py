@@ -58,13 +58,14 @@ def _render_health(rows) -> str:
     if not rows:
         return "(no live obs/<job>/* leases — is the fleet publishing?)"
     cols = ["node", "status", "step", "epoch", "lag_ms", "accum", "gnorm",
-            "age_s", "pid", "diag", "reasons", "engines"]
+            "capture", "age_s", "pid", "diag", "reasons", "engines"]
     table = [cols]
     for r in rows:
         table.append([
             _esc(r["node"]), str(r["status"]), str(r["step"]),
             _fmt_opt(r.get("epoch")), _fmt_opt(r.get("step_lag_ms")),
             _fmt_opt(r.get("accum")), _fmt_gnorm(r),
+            _esc(r.get("capture")) if r.get("capture") else "-",
             str(r["age_s"]), str(r["pid"]), str(r["diag"]),
             ",".join(r["reasons"]) or "-",
             ",".join(f"{k}:{v}" for k, v in sorted(r["engines"].items()))

@@ -153,21 +153,36 @@ def main(argv=None) -> int:
         specs = [_parse_spec(s) for s in args.input_spec]
 
     passes = args.passes.split(",") if args.passes else None
-    if hasattr(target, "_step_parts"):
-        # a sharded/pipelined train step: per-shard analysis
+    captured = bool(getattr(target, "_captured_step", False))
+    verdicts = None
+    if hasattr(target, "_step_parts") or captured:
+        # a sharded/pipelined train step (or the lazy captured-step
+        # handle): per-shard analysis
         from paddle_tpu.analysis.sharding import check_sharded_step
         diags = check_sharded_step(target, specs, passes=passes,
                                    memory_budget_mb=args.memory_budget_mb)
+        if captured:
+            # per-position donation verdicts recorded at capture build (or
+            # recomputed if the build predates the verdict recorder)
+            from paddle_tpu.core import lazy as _lazy
+            verdicts = _lazy.captured_step_donation_verdicts()
+            if verdicts is None:
+                from paddle_tpu.analysis.memory import donation_verdicts
+                from paddle_tpu.analysis.sharding import captured_step_context
+                try:
+                    verdicts = donation_verdicts(captured_step_context())
+                except Exception:
+                    verdicts = None
     else:
         diags = analysis.check(target, specs, passes=passes,
                                memory_budget_mb=args.memory_budget_mb)
 
     plan = None
     if args.plan:
-        if hasattr(target, "_step_parts"):
+        if hasattr(target, "_step_parts") or captured:
             raise SystemExit(
                 "graph_lint: --plan is single-program; not supported for "
-                "sharded/pipelined train-step targets"
+                "sharded/pipelined/captured train-step targets"
             )
         if args.memory_budget_mb is None:
             raise SystemExit("graph_lint: --plan requires --memory-budget-mb")
@@ -196,6 +211,18 @@ def main(argv=None) -> int:
                 "shapes": [], "dtypes": [],
                 "data": plan.to_dict(),
             }))
+        if verdicts is not None:
+            donated = all(v.get("proven") for v in verdicts) and bool(verdicts)
+            print(json.dumps({
+                "severity": "info", "pass": "donation_verdicts", "op": None,
+                "message": (
+                    f"captured step donation: {sum(1 for v in verdicts if v.get('proven'))}"
+                    f"/{len(verdicts)} positions proven"
+                    + ("" if donated else " — replaying non-donated")),
+                "hint": None, "source": "captured-sharded",
+                "shapes": [], "dtypes": [],
+                "data": {"verdicts": verdicts, "donated": donated},
+            }))
     else:
         if not diags:
             print(f"graph_lint: {args.model_file}: clean "
@@ -204,6 +231,12 @@ def main(argv=None) -> int:
             print(f"  {d}")
         if plan is not None:
             print(plan.summary())
+        if verdicts is not None:
+            for v in verdicts:
+                state = "proven" if v.get("proven") else "UNPROVEN"
+                extra = "; ".join(v.get("diagnostics") or [])
+                print(f"  donation[{v.get('position')}] {v.get('role')}: "
+                      f"{state}" + (f" — {extra}" if extra else ""))
         # analysis-related flags in effect, so CI logs show the exact mode
         active = (describe_flags("check") + describe_flags("eager_lazy")
                   + describe_flags("memory_budget")
