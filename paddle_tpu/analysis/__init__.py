@@ -199,7 +199,12 @@ def _sub_jaxprs(eqn):
         return "scope", [eqn.params["cond_jaxpr"], eqn.params["body_jaxpr"]]
     if name in ("cond", "switch"):
         return "scope", list(eqn.params["branches"])
-    for key in ("jaxpr", "call_jaxpr"):
+    # "fun_jaxpr" is custom_vjp_call_jaxpr's primal body (custom_jvp_call
+    # carries plain "call_jaxpr"): the custom-gradient API contract is that
+    # the primal function and the fwd rule return the same primal outputs,
+    # so determinism/equivalence analysis sees through the body as an
+    # ordinary 1:1 call (arity mismatches still fall back to a scope below)
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
         sub = eqn.params.get(key)
         if sub is not None:
             return "call", [sub]
@@ -740,5 +745,6 @@ from . import passes as _builtin_passes  # noqa: E402,F401  (registers the suite
 from . import memory  # noqa: E402  (registers memory_budget / donation_safety)
 from . import plan  # noqa: E402  (remat planner over the liveness estimates)
 from . import sharding  # noqa: E402  (registers collective_cost / resharding_lint)
+from . import equivalence  # noqa: E402  (registers the equivalence pass)
 
-__all__ += ["memory", "plan", "sharding"]
+__all__ += ["memory", "plan", "sharding", "equivalence"]

@@ -464,6 +464,25 @@ def _guarded(atom, ctx, nonneg_ok=False, depth=8):
             if nonneg_ok and len(op.invars) == 2 and \
                     op.invars[0] is op.invars[1]:
                 return True
+        elif op.name == "select_n" and len(op.invars) == 3:
+            # the explicit zero-replacement guard — where(x == 0, c, x)
+            # with c > 0 (the safe-softmax / flash-attention idiom):
+            # the zero case is replaced by a positive constant and every
+            # other case is x itself, so the result never hits zero
+            pred, c0, c1 = op.invars
+            try:
+                pop = prod.get(pred)
+            except TypeError:  # Literal predicate
+                pop = None
+            if pop is not None and pop.name == "eq":
+                for const_case, x_case in ((c0, c1), (c1, c0)):
+                    c = scalar_const(const_case, prod)
+                    if not (_is_real(c) and c > 0):
+                        continue
+                    cmp = [scalar_const(o, prod) for o in pop.invars]
+                    if any(v == 0 for v in cmp if _is_real(v)) and \
+                            any(o is x_case for o in pop.invars):
+                        return True
         elif op.name in _CHAIN_PASSTHROUGH:
             stack.append(op.invars[0])
     return False
@@ -634,4 +653,176 @@ def launch_budget(ctx: Context) -> List[Diagnostic]:
             hint="unstable segment signatures (varying shapes/scalars) "
                  "defeat the segment cache — check flush_reasons",
         ))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# 6. determinism lint — the static twin of the bitwise guarantees the
+# elastic resharding contract (distributed.fleet.elastic) depends on:
+# per-replica runs must be bitwise reproducible, and cross-replica
+# reductions must be world-size invariant when world sizes stay powers of
+# two (deterministic_tree_sum's documented invariant)
+# ---------------------------------------------------------------------------
+# scatters that COMBINE duplicate-index updates (min/max are associative and
+# commutative, so their accumulation order cannot change the result)
+_ACCUM_SCATTERS = {"scatter-add", "scatter-mul"}
+# primitives whose results leave the deterministic traced world: host
+# callbacks observe wall-clock / host iteration order, so a replay is not
+# bitwise bound to the original run
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "callback",
+                   "outside_call", "host_callback_call"}
+# the sampler core: ops that consume a PRNG key atom and emit bits — two
+# samplers fed the SAME key atom draw identical streams
+_RNG_CONSUMERS = {"random_bits", "threefry2x32"}
+# 1:1 key plumbing, chased through when resolving a sampler's key root.
+# Key-DERIVING ops (random_fold_in, random_split, random_seed) deliberately
+# stop the chase: their outputs are NEW keys, and conflating them would
+# flag every split subkey pair as a reuse
+_KEY_PLUMBING = {"random_wrap", "random_unwrap"}
+
+
+def _key_root(atom, producers, depth=8):
+    while depth > 0:
+        if isinstance(atom, jax.core.Literal):
+            return atom
+        op = producers.get(atom)
+        if op is None or op.name not in _KEY_PLUMBING or not op.invars:
+            return atom
+        atom = op.invars[0]
+        depth -= 1
+    return atom
+
+
+def _index_root(atom, producers, depth=12):
+    """Chase an index tensor through shape/convert plumbing to the value
+    that actually carries the indices."""
+    while depth > 0:
+        if isinstance(atom, jax.core.Literal):
+            return atom
+        op = producers.get(atom)
+        if op is None or op.name not in _CHAIN_PASSTHROUGH or not op.invars:
+            return atom
+        atom = op.invars[0]
+        depth -= 1
+    return atom
+
+
+def _indices_provably_unique(root, producers):
+    """True when the index values cannot contain duplicates: an iota (or a
+    compile-time constant whose values are distinct)."""
+    op = producers.get(root) if not isinstance(
+        root, jax.core.Literal) else None
+    if op is not None and op.name == "iota":
+        return True
+    val = getattr(root, "val", None)  # Literal / ConstAtom
+    if val is not None:
+        try:
+            arr = np.asarray(val)
+            return arr.size == np.unique(arr).size
+        except Exception:
+            return False
+    return False
+
+
+@register_pass("determinism")
+def determinism(ctx: Context) -> List[Diagnostic]:
+    from .sharding import _axis_sizes_from_ops
+
+    diags = []
+    prod = ctx.producers
+    # mesh-scoped contexts carry axis sizes; a plain Context analyzing a
+    # shard_map-bearing program reads them off the shard_map mesh params
+    axis_sizes = getattr(ctx, "mesh_axes", None) \
+        or _axis_sizes_from_ops(ctx.ops)
+
+    # index roots of every gather in the program: a float scatter-add over
+    # the SAME index root is autodiff's gather transpose (embedding /
+    # take_along_axis gradients) — XLA combines its duplicate updates in a
+    # fixed order per compilation, and the whole-step parity certificates
+    # (analysis.equivalence) already bind it bitwise to the eager path, so
+    # it is not a user-facing hazard
+    gather_roots = set()
+    for op in ctx.ops:
+        if op.name == "gather" and len(op.invars) >= 2:
+            r = _index_root(op.invars[1], prod)
+            if not isinstance(r, jax.core.Literal):
+                gather_roots.add(id(r))
+
+    key_users = {}
+    for op in ctx.ops:
+        if op.name in _ACCUM_SCATTERS and len(op.invars) >= 3:
+            dt = atom_dtype(op.outvars[0])
+            if not _is_float(dt):
+                continue  # integer accumulation is exact in any order
+            if op.params.get("unique_indices"):
+                continue  # caller promised no duplicates
+            root = _index_root(op.invars[1], prod)
+            if _indices_provably_unique(root, prod):
+                continue
+            if not isinstance(root, jax.core.Literal) \
+                    and id(root) in gather_roots:
+                continue  # autodiff gather transpose (see above)
+            diags.append(Diagnostic(
+                Severity.WARNING, "determinism", op.path,
+                f"float {op.name} with potentially-duplicate indices: the "
+                "order duplicate updates combine in is "
+                "implementation-defined, so results need not be bitwise "
+                "reproducible across backends/compilations",
+                hint="pass unique_indices=True if the indices are provably "
+                     "unique, accumulate in int/f64 and cast, or sort "
+                     "indices first (segment_sum over sorted ids)",
+                shapes=(atom_shape(op.outvars[0]),),
+                dtypes=(str(dt),),
+            ))
+        elif op.name in ("psum", "psum2"):
+            dt = atom_dtype(op.outvars[0]) if op.outvars else None
+            if not _is_float(dt):
+                continue
+            names = _coll_axis_names(op.params)
+            n = 1
+            for a in names:
+                n *= int(axis_sizes.get(a, 1))
+            if n > 1 and (n & (n - 1)) != 0:
+                diags.append(Diagnostic(
+                    Severity.WARNING, "determinism", op.path,
+                    f"cross-replica float reduction over a group of {n} "
+                    f"(axes {list(names)}): a non-power-of-two group has no "
+                    "balanced reduction tree, so the result is not bitwise "
+                    "invariant across world sizes",
+                    hint="keep reduction group sizes powers of two, or "
+                         "route host-side re-reductions through "
+                         "deterministic_tree_sum "
+                         "(distributed.fleet.elastic), whose pairwise tree "
+                         "is world-size invariant for power-of-two counts",
+                    shapes=(atom_shape(op.outvars[0]),),
+                    dtypes=(str(dt),),
+                ))
+        elif op.name in _RNG_CONSUMERS and op.invars:
+            k = _key_root(op.invars[0], prod)
+            if not isinstance(k, jax.core.Literal):
+                key_users.setdefault(id(k), []).append(op)
+        elif op.name in _CALLBACK_PRIMS:
+            diags.append(Diagnostic(
+                Severity.WARNING, "determinism", op.path,
+                "host callback escapes the traced program: its result can "
+                "depend on wall-clock time or host iteration order, so a "
+                "replay is not bitwise bound to the original run",
+                hint="move the computation into the traced program, or "
+                     "accept that this step is unreproducible and exclude "
+                     "it from parity checks",
+            ))
+
+    for ops in key_users.values():
+        if len(ops) < 2:
+            continue
+        first = ops[0]
+        for op in ops[1:]:
+            diags.append(Diagnostic(
+                Severity.WARNING, "determinism", op.path,
+                f"PRNG key reused: the same key feeds {first.path} and "
+                f"{op.path}, which therefore draw IDENTICAL random streams",
+                hint="split or fold_in the key per consumer "
+                     "(jax.random.split / paddle.seed threading); reused "
+                     "keys silently correlate dropout masks and init draws",
+            ))
     return diags

@@ -82,6 +82,7 @@ __all__ = [
     "parse_mesh",
     "pipelined_step_context",
     "ring_wire_bytes",
+    "schedule_of",
     "shard_context",
     "sharded_step_context",
 ]
@@ -1320,4 +1321,124 @@ def resharding_lint(ctx: Context) -> List[Diagnostic]:
                 shapes=(tuple(getattr(eqn.invars[0].aval, "shape", ()))
                         if eqn.invars else (),),
             ))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Pass: collective_schedule — SPMD divergence
+# ---------------------------------------------------------------------------
+# In SPMD every rank runs the SAME program, so every rank must reach every
+# collective in the SAME order: a collective reachable only under control
+# flow predicated on a rank-varying value (the device coordinate) is the
+# classic SPMD deadlock — some ranks enter the collective, their peers
+# never arrive, and the step hangs instead of erroring.
+
+def schedule_of(ops) -> List[Dict[str, Any]]:
+    """Ordered collective schedule of a flat-op list: one record per
+    collective, in program order, ``{kind, op, path, axes, group_size,
+    payload_bytes, scope}``. This is the artifact two programs must agree
+    on to be SPMD-interchangeable; ``graph_lint --diff`` and
+    ``equivalence.program_diff`` print schedule deltas from it."""
+    axes = _axis_sizes_from_ops(ops)
+    out: List[Dict[str, Any]] = []
+    for op in ops:
+        if op.name not in _COLLECTIVE_PRIMS:
+            continue
+        names = _coll_axes(op.params)
+        payload = sum(_aval_nbytes(getattr(a, "aval", None))
+                      for a in op.invars
+                      if not isinstance(a, jax.core.Literal))
+        out.append({
+            "kind": _COLL_KIND[op.name],
+            "op": op.name,
+            "path": op.path,
+            "axes": tuple(names),
+            "group_size": _shard_factor(names, axes),
+            "payload_bytes": int(payload),
+            "scope": op.scope,
+        })
+    return out
+
+
+def _jaxpr_has_collective(j, depth=6) -> bool:
+    """True when the (closed or open) jaxpr contains a collective anywhere,
+    including nested control-flow/call bodies."""
+    if depth <= 0:
+        return False
+    open_j, _consts = _as_open(j)
+    for eqn in open_j.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            return True
+        for v in eqn.params.values():
+            subs = v if isinstance(v, (tuple, list)) else (v,)
+            for s in subs:
+                if hasattr(s, "eqns") or hasattr(s, "jaxpr"):
+                    if _jaxpr_has_collective(s, depth - 1):
+                        return True
+    return False
+
+
+def _rank_varying(atom, producers, depth=64) -> bool:
+    """True when ``atom`` derives from the device coordinate
+    (``axis_index``): a branch predicated on it takes different arms on
+    different ranks."""
+    stack = [atom]
+    steps = 0
+    while stack and steps < depth:
+        a = stack.pop()
+        steps += 1
+        if isinstance(a, jax.core.Literal):
+            continue
+        try:
+            op = producers.get(a)
+        except TypeError:
+            continue
+        if op is None:
+            continue
+        if op.name == "axis_index":
+            return True
+        stack.extend(op.invars)
+    return False
+
+
+@register_pass("collective_schedule")
+def collective_schedule(ctx) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    prod = ctx.producers
+    for op in ctx.ops:
+        if op.name in ("cond", "switch"):
+            branches = op.params.get("branches") or ()
+            if not any(_jaxpr_has_collective(b) for b in branches):
+                continue
+            pred = op.invars[0] if op.invars else None
+            if pred is not None and _rank_varying(pred, prod):
+                diags.append(Diagnostic(
+                    Severity.ERROR, "collective_schedule", op.path,
+                    f"collective inside a {op.name} branch whose predicate "
+                    "derives from axis_index: ranks taking different arms "
+                    "reach different collective schedules — the classic "
+                    "SPMD deadlock (some ranks enter the collective, peers "
+                    "never arrive)",
+                    hint="hoist the collective out of the branch, or make "
+                         "the predicate rank-invariant (e.g. reduce it with "
+                         "psum/pmax first)",
+                ))
+        elif op.name == "while":
+            bodies = [op.params.get("cond_jaxpr"),
+                      op.params.get("body_jaxpr")]
+            if not any(b is not None and _jaxpr_has_collective(b)
+                       for b in bodies):
+                continue
+            if any(_rank_varying(a, prod) for a in op.invars
+                   if not isinstance(a, jax.core.Literal)):
+                diags.append(Diagnostic(
+                    Severity.ERROR, "collective_schedule", op.path,
+                    "collective inside a while loop whose carry derives "
+                    "from axis_index: ranks can run different trip counts, "
+                    "so they disagree on how many collectives execute — "
+                    "SPMD deadlock",
+                    hint="make the trip count rank-invariant (pmax the "
+                         "continue predicate) or move the collective out "
+                         "of the loop",
+                ))
     return diags

@@ -210,6 +210,19 @@ def _reset_counters_locked():
         capture_sharded_builds=0,
         capture_sharded_replays=0,
         capture_donation_fallbacks=0,
+        # proof-carrying parity (analysis.equivalence, FLAGS_check_programs=2):
+        # structural certification of the captured 1-program step against the
+        # 3-program composition before the first donated replay — proofs run,
+        # proofs passed, proven divergences (ProgramVerificationError), and
+        # unprovable certificates demoted through the counted ladder
+        capture_equivalence_checks=0,
+        capture_equivalence_certified=0,
+        capture_equivalence_divergences=0,
+        capture_equivalence_unprovable=0,
+        # decode-mode twin: donated-vs-plain serve rung certification
+        serve_equivalence_checks=0,
+        serve_equivalence_certified=0,
+        serve_equivalence_divergences=0,
         # async host pipeline (FLAGS_eager_async_compile): background compile
         # submissions/joins, bridge flushes (fresh segments executed eagerly
         # while their fused program compiles off-thread), and captured steps

@@ -964,6 +964,11 @@ class _CaptureEntry:
                  # params/state were donated — captured_step_program()
                  # retraces these for the memory planner without compiling
                  "step_fn", "arg_specs", "donated",
+                 # proof-carrying parity (analysis.equivalence): the
+                 # independently-built 3-program reference composition and
+                 # the EquivalenceCertificate the FLAGS_check_programs=2
+                 # gate produced before the first donated replay
+                 "ref_fn", "certificate",
                  # planner-guided remat (analysis.plan): the RematPlan this
                  # build applied (or proved empty), None when FLAGS_memory_plan
                  # did not ask for one
@@ -1561,14 +1566,19 @@ def _abort_capture(reason: str, fallback: bool = True):
         t.grad = gt if cur is gt else cur
 
 
-def _plan_capture_forward(plan):
+def _plan_capture_forward(plan, stop_gradients=True):
     """Pure replay of a segment plan for whole-step capture.
 
     The tape's gradient contract is reproduced structurally: gradient flows
     ONLY through recorded ops' differentiable input positions (exactly the
     positions the per-op path takes jax.vjp over); every other array input
     is wrapped in lax.stop_gradient, so jax.vjp over this whole replay
-    equals the composition of the per-op vjps the tape would have applied."""
+    equals the composition of the per-op vjps the tape would have applied.
+
+    ``stop_gradients=False`` replays the same plan WITHOUT the gradient
+    shaping — value-level identical (stop_gradient is an identity on
+    values), used as program 1 of the 3-program reference composition the
+    equivalence prover certifies the capture against."""
 
     def fwd(ext):
         results = []
@@ -1582,7 +1592,7 @@ def _plan_capture_forward(plan):
                 else:
                     vals.append(a)  # python literal — no gradient path
                     continue
-                if not record or j not in diff_idx:
+                if stop_gradients and (not record or j not in diff_idx):
                     v = jax.lax.stop_gradient(v)
                 vals.append(v)
             out = fn(*vals, **kw)
@@ -1625,7 +1635,8 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
     leaf_slot_set = set(param_slots) | set(extra_slots)
     rest_slots = [s for s in range(n_ext) if s not in leaf_slot_set]
 
-    fwd = _plan_capture_forward(_seg_plan(seg))
+    plan = _seg_plan(seg)
+    fwd = _plan_capture_forward(plan)
     rv = rec.root._value
     root_op, root_out = rv._op_index, rv._out_index
     seed_shape, seed_dtype = rv._shape, rv._dtype
@@ -1693,7 +1704,51 @@ def _build_captured_step(rec: _DeferredStep, opt) -> _CaptureEntry:
 
         return step_fn
 
+    # the 3-program reference composition (FLAGS_check_programs=2): what the
+    # lazy tier would have executed, assembled from INDEPENDENT builds of the
+    # same three programs — (1) the segment flush's forward (the plan replay
+    # with no gradient shaping), (2) the tape backward (jax.vjp over the
+    # stop_gradient-shaped replay — the per-op-vjp composition contract
+    # documented on _plan_capture_forward), (3) the same grad-clip fold and
+    # fused optimizer update Optimizer.step() jits. The equivalence prover
+    # certifies the captured 1-program step against this BEFORE the first
+    # donated replay; never compiled, only traced.
+    ref_fwd_plain = _plan_capture_forward(plan, stop_gradients=False)
+    ref_clip_fn = capture_clip_fn(clip)
+    ref_apply = make_fused_update(opt, params, sentinel=rescue_on,
+                                  telemetry=tele_on)
+
+    def ref_step_fn(p_vals, sts, lr, extra_vals, rest_vals, gp_in, gx_in):
+        ext = [None] * n_ext
+        for s, v in zip(rest_slots, rest_vals):
+            ext[s] = v
+        e1 = list(ext)
+        for s, v in zip(param_slots, p_vals):
+            e1[s] = v
+        for s, v in zip(extra_slots, extra_vals):
+            e1[s] = v
+        results = ref_fwd_plain(e1)  # program 1: the flush's forward
+
+        def loss_of(dp, dx):
+            e = list(ext)
+            for s, v in zip(param_slots, dp):
+                e[s] = v
+            for s, v in zip(extra_slots, dx):
+                e[s] = v
+            return fwd(e)[root_op][root_out]
+
+        _loss, vjp = jax.vjp(loss_of, tuple(p_vals), tuple(extra_vals))
+        gp, gx = vjp(jnp.ones(seed_shape, seed_dtype))  # program 2: backward
+        if has_grad_in:
+            gp = tuple(a + b for a, b in zip(gp_in, gp))
+            gx = tuple(a + b for a, b in zip(gx_in, gx))
+        upd_g = tuple(ref_clip_fn(list(gp))) if ref_clip_fn is not None else gp
+        upd = ref_apply(p_vals, upd_g, lr, sts)  # program 3: fused update
+        return (results, gp, gx, tuple(upd[0]), tuple(upd[1])) + tuple(upd[2:])
+
     entry = _CaptureEntry()
+    entry.ref_fn = ref_step_fn
+    entry.certificate = None
     entry.rescue = rescue_on
     entry.telemetry = tele_on
     # donate params + optimizer state: XLA reuses their HBM buffers for the
@@ -2061,6 +2116,60 @@ def _check_captured_donation(entry: _CaptureEntry, params, states):
     )
 
 
+def _certify_capture_equivalence(entry: _CaptureEntry):
+    """FLAGS_check_programs=2 parity proof: structurally certify the
+    captured 1-program step ≡ the 3-program composition (and, sharded, the
+    donated executable's program against its non-donated probe trace — the
+    same step_fn, so the one certificate covers both) BEFORE the first
+    donated replay. Outcomes:
+
+      certified  — counted; the certificate lands on the entry (statusz)
+      divergent  — ProgramVerificationError with the structured
+                   first-divergence diagnostic; the caller resolves the
+                   step on the safe 3-program path, then surfaces it
+      unprovable — a tracing/canonicalization failure is NOT a proof of
+                   divergence: fall through the counted ladder
+                   (_CaptureIneligible) instead of crashing the step
+    """
+    from . import dispatch
+    from ..analysis import ProgramVerificationError
+    from ..analysis import equivalence as _eq
+
+    dispatch._counters["capture_equivalence_checks"] += 1
+    try:
+        cap = jax.make_jaxpr(entry.step_fn)(*entry.arg_specs)
+        ref = jax.make_jaxpr(entry.ref_fn)(*entry.arg_specs)
+        cert = _eq.prove_equivalent(
+            cap, ref, label_a="captured-step",
+            label_b="3-program-composition", source="captured-step")
+    except Exception as e:
+        dispatch._counters["capture_equivalence_unprovable"] += 1
+        dispatch._emit("capture", site="captured", phase="equivalence",
+                       result="unprovable", error=type(e).__name__)
+        raise _CaptureIneligible("equivalence_unprovable")
+    entry.certificate = cert
+    if not cert.equivalent:
+        dispatch._counters["capture_equivalence_divergences"] += 1
+        dispatch._emit("capture", site="captured", phase="equivalence",
+                       result="divergent", mesh=_mesh_tag(entry.mesh))
+        raise ProgramVerificationError(
+            "captured step is not provably equivalent to the 3-program "
+            f"composition: {cert.summary()}",
+            [d for d in [cert.divergence] if d is not None])
+    dispatch._counters["capture_equivalence_certified"] += 1
+    dispatch._emit("capture", site="captured", phase="equivalence",
+                   result="certified", mesh=_mesh_tag(entry.mesh),
+                   ops=cert.n_ops[0], outputs=cert.outputs_compared)
+
+
+def captured_step_certificate():
+    """The EquivalenceCertificate of the calling thread's last captured
+    step, or None (no capture, or FLAGS_check_programs<2 at build)."""
+    ref = getattr(_tls, "last_capture_entry", None)
+    entry = ref() if ref is not None else None
+    return entry.certificate if entry is not None else None
+
+
 def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
     from . import dispatch
 
@@ -2089,6 +2198,11 @@ def _run_captured(rec: _DeferredStep, opt, entry: _CaptureEntry) -> bool:
         # ProgramVerificationError at FLAGS_check_programs>=2 — the caller
         # resolves the deferred step on the safe 3-program path first.
         _check_captured_donation(entry, params, states)
+    if not entry.warmed and int(flags.flag("check_programs")) >= 2 \
+            and entry.ref_fn is not None:
+        # proof-carrying parity: certify captured ≡ 3-program composition
+        # before anything is donated or replayed
+        _certify_capture_equivalence(entry)
     lkey = _ladder_key(rec.seg_sig)
     # with donation on, a REAL fault from inside exe may fire after XLA
     # consumed the param/state buffers — replaying the same args would feed
@@ -2424,7 +2538,7 @@ class _ServeProgram:
     """One captured serving program (a prefill or decode bucket signature)."""
 
     __slots__ = ("key", "fn", "donate_argnums", "_exe_donate", "_exe_plain",
-                 "_built_donate", "_built_plain", "__weakref__")
+                 "_built_donate", "_built_plain", "certificate", "__weakref__")
 
     def __init__(self, key, fn, donate_argnums):
         self.key = key
@@ -2434,6 +2548,56 @@ class _ServeProgram:
         self._exe_plain = None
         self._built_donate = False
         self._built_plain = False
+        # EquivalenceCertificate binding the donated rung to the plain
+        # retry rung (FLAGS_check_programs=2), or None
+        self.certificate = None
+
+    def _certify_rungs(self, args):
+        """Proof-carrying parity for the serve ladder: before the donated
+        rung consumes its first pool, certify its trace structurally
+        equivalent to the non-donated retry rung's. Both rungs jit the
+        same ``fn`` today, so this locks the ladder invariant (a fault on
+        the donated tier replays on a PROVABLY identical program) against
+        the rungs ever being forked. Divergence raises
+        ProgramVerificationError while the pools are still intact;
+        an unprovable trace is recorded and skipped."""
+        from . import dispatch
+        from ..analysis import ProgramVerificationError
+        from ..analysis.equivalence import prove_equivalent
+
+        dispatch._counters["serve_equivalence_checks"] += 1
+        try:
+            specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+                tuple(args),
+            )
+            cert = prove_equivalent(
+                jax.make_jaxpr(self.fn)(*specs),
+                jax.make_jaxpr(self.fn)(*specs),
+                label_a="serve-donated", label_b="serve-plain",
+                source=f"serve:{self.key}",
+            )
+        except ProgramVerificationError:
+            raise
+        except Exception as e:
+            dispatch._emit("serve_capture", site="captured",
+                           phase="equivalence", key=str(self.key),
+                           result="unprovable", why=type(e).__name__)
+            return
+        if not cert.equivalent:
+            dispatch._counters["serve_equivalence_divergences"] += 1
+            dispatch._emit("serve_capture", site="captured",
+                           phase="equivalence", key=str(self.key),
+                           result="divergent")
+            raise ProgramVerificationError(
+                "donated serve rung is not provably equivalent to the "
+                "plain retry rung: " + cert.summary(),
+                [cert.divergence] if cert.divergence is not None else [])
+        self.certificate = cert
+        dispatch._counters["serve_equivalence_certified"] += 1
+        dispatch._emit("serve_capture", site="captured", phase="equivalence",
+                       key=str(self.key), result="certified",
+                       ops=cert.n_ops[0], outputs=cert.outputs_compared)
 
     def built(self, donate: bool = True) -> bool:
         return self._built_donate if donate else self._built_plain
@@ -2459,6 +2623,9 @@ class _ServeProgram:
                 self._exe_plain = jax.jit(self.fn)
             exe, fresh = self._exe_plain, not self._built_plain
         akey = "serve:" + ":".join(str(x) for x in self.key)
+        if fresh and donate and self.donate_argnums \
+                and int(flags.flag("check_programs")) >= 2:
+            self._certify_rungs(args)
         t0 = time.perf_counter()
         if fresh:
             # first call = trace + XLA compile; backends without real

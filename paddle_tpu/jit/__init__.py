@@ -379,6 +379,9 @@ class CompiledTrainStep:
         # an explicit RematPlan is rebound to this step's traced loss
         self._memory_plan_req = memory_plan
         self._mem_plan = None  # the active RematPlan (None = unplanned)
+        # EquivalenceCertificate binding the planned (remat-sliced) step to
+        # the unplanned step trace (FLAGS_check_programs=2), or None
+        self._plan_certificate = None
 
     def _init_opt_state(self):
         sched = getattr(self.optimizer, "_offload_sched", None)
@@ -509,12 +512,55 @@ class CompiledTrainStep:
                 out.append(NamedSharding(self.mesh, s))
         return out
 
+    def _certify_planned_step(self, planned_step):
+        """Proof-carrying parity for planner-guided remat
+        (FLAGS_check_programs=2): certify the plan-sliced step trace
+        structurally equivalent to the unplanned step — remat duplicates
+        under ``prevent_cse`` are an allowlisted rewrite the prover
+        canonicalizes away. Divergence means the planner changed the
+        function and raises; an unprovable trace drops the plan (counted
+        via the planner failure registry) and trains unplanned."""
+        from ..analysis import ProgramVerificationError
+        from ..analysis import plan as _plan
+        from ..analysis.equivalence import prove_equivalent
+        from ..core import dispatch
+
+        try:
+            cert = prove_equivalent(
+                jax.make_jaxpr(planned_step)(*self._arg_specs),
+                jax.make_jaxpr(self._make_step_fn(None))(*self._arg_specs),
+                label_a="planned-step", label_b="unplanned-step",
+                source="compile_train_step",
+            )
+        except Exception as e:
+            _plan.record_failure("compile_train_step", e)
+            dispatch._emit("capture", site="jit", phase="equivalence",
+                           result="unprovable", why=type(e).__name__)
+            self._mem_plan = None
+            return self._make_step_fn(None)
+        if not cert.equivalent:
+            dispatch._emit("capture", site="jit", phase="equivalence",
+                           result="divergent")
+            raise ProgramVerificationError(
+                "planner-guided remat step is not provably equivalent to "
+                "the unplanned step: " + cert.summary(),
+                [cert.divergence] if cert.divergence is not None else [])
+        self._plan_certificate = cert
+        dispatch._emit("capture", site="jit", phase="equivalence",
+                       result="certified", ops=cert.n_ops[0],
+                       outputs=cert.outputs_compared)
+        return planned_step
+
     def _build(self):
+        from ..core import flags as _flags
+
         plan = self._mem_plan
         planned = None
         if plan is not None and plan.has_cuts:
             planned = self._wrap_flat_loss(plan.bind())
         step_fn = self._make_step_fn(planned)
+        if planned is not None and int(_flags.flag("check_programs")) >= 2:
+            step_fn = self._certify_planned_step(step_fn)
         # donate params and optimizer state: XLA reuses their HBM buffers
         self._step_fn_raw = step_fn
         if self.mesh is not None:

@@ -153,6 +153,13 @@ class suppress_sharding_constraints:
         return False
 
 
+def _sharding_constraint_op(x, *, mesh, spec):
+    """Module-level op fn (stable per-op jit cache token): the GSPMD
+    sharding annotation as a regular dispatched op, so an eager constraint
+    joins the pending lazy segment instead of forcing a flush."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
 def with_sharding_constraint(x, *spec):
     """Annotation helper usable inside layer forwards (no-op without a mesh).
     The TPU analogue of inserting a c_split/c_concat/c_identity op."""
@@ -162,6 +169,41 @@ def with_sharding_constraint(x, *spec):
         return x
     if getattr(_constraint_tls, "off", False):
         return x
+    from ..core.flags import flag as _flag
+
+    if isinstance(x, Tensor) and not isinstance(val, jax.core.Tracer) \
+            and bool(_flag("eager_lazy_dispatch")):
+        # lazy-eager path: dispatch as a regular lazy op. The constraint
+        # stays inside the pending segment (one fused program, whole-step
+        # capture keeps its 3-program shape) and GSPMD resolves it at
+        # flush — the old jitted-identity eager lowering instead flushed
+        # HERE, and refused single-device committed inputs (a pallas
+        # kernel's eager flush output) against a mesh-spanning
+        # out_sharding. Per-op eager mode (lazy dispatch off) keeps the
+        # skip-on-conflict lowering below: its tensors are committed to
+        # one device, and force-resharding just this value would feed
+        # mixed placements to the next multi-arg op.
+        from ..core import dispatch
+
+        try:
+            return dispatch.apply(
+                _sharding_constraint_op, x, mesh=mesh, spec=tuple(spec),
+                op_name="sharding_constraint",
+            )
+        except Exception:
+            # repair committed-placement mismatches instead of skipping:
+            # device_put reshards a concrete value from ANY placement
+            try:
+                from ..core.lazy import materialize as _mat
+
+                out = jax.device_put(
+                    _mat(val), NamedSharding(mesh, P(*spec)))
+            except (ValueError, TypeError):
+                return x
+            t = Tensor(out, stop_gradient=x.stop_gradient)
+            t._grad_node = x._grad_node
+            t._out_index = x._out_index
+            return t
     try:
         out = jax.lax.with_sharding_constraint(val, NamedSharding(mesh, P(*spec)))
     except (ValueError, TypeError):

@@ -1065,12 +1065,21 @@ class Engine:
         donate = bool(flags.flag("serving_capture_donate"))
         prog = _lazy.serve_program(key, fn, donate_argnums=(0, 1))
         if donate and _rt.captured_tier_ok(key):
+            from ..analysis import ProgramVerificationError
+
             try:
                 return _rt.execute(
                     kind, lambda: prog.run(args, donate=True),
                     fresh=not prog.built(True), ladder_key=key,
                     retry_unsafe=True,
                 )
+            except ProgramVerificationError:
+                # the donated rung failed its equivalence certificate
+                # against the plain rung (FLAGS_check_programs=2). The
+                # check runs at trace time, BEFORE the donated program
+                # executes, so the pools are intact: take the retry-safe
+                # rung with the same buffers
+                dispatch._counters["serve_capture_fallbacks"] += 1
             except Exception as e:
                 dispatch._counters["serve_capture_fallbacks"] += 1
                 if not isinstance(e, _faults.InjectedFault):

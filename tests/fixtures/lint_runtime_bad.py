@@ -1,0 +1,32 @@
+"""Deliberately-BAD fixture for tools/lint_runtime.py (counter lock
+discipline): every pattern here must be flagged. Never imported by the
+framework — parsed as text by the self-lint test."""
+import threading
+
+_counters = {"bad_worker_ticks": 0}
+
+
+def _worker_loop():
+    while True:
+        # VIOLATION: direct counter write on a worker thread
+        _counters["bad_worker_ticks"] += 1
+
+
+def start():
+    t = threading.Thread(target=_worker_loop, daemon=True)
+    t.start()
+    return t
+
+
+def start_pool(pool, dispatch):
+    def job():
+        # VIOLATION: submitted callable writes through the module handle
+        dispatch._counters["bad_jobs"] = 1
+
+    return pool.submit(job)
+
+
+class BadThread(threading.Thread):
+    def run(self):
+        # VIOLATION: Thread-subclass run() mutates without the lock
+        _counters["bad_worker_ticks"] += 1

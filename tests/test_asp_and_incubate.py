@@ -65,35 +65,44 @@ def test_parallel_cross_entropy_parity():
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed.fleet.meta_parallel import ParallelCrossEntropy
 
+    from paddle_tpu.parallel import topology
+
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
     fleet.init(is_collective=True, strategy=strategy)
+    try:
+        rng = np.random.default_rng(0)
+        logits_np = rng.standard_normal((4, 6, 16)).astype(np.float32)
+        labels_np = rng.integers(0, 16, (4, 6))
 
-    rng = np.random.default_rng(0)
-    logits_np = rng.standard_normal((4, 6, 16)).astype(np.float32)
-    labels_np = rng.integers(0, 16, (4, 6))
-
-    logits = paddle.to_tensor(logits_np, stop_gradient=False)
-    labels = paddle.to_tensor(labels_np)
-    loss = ParallelCrossEntropy()(logits, labels)
-    # dense reference
-    x = logits_np - logits_np.max(-1, keepdims=True)
-    lse = np.log(np.exp(x).sum(-1)) - np.take_along_axis(
-        x, labels_np[..., None], axis=-1
-    )[..., 0]
-    np.testing.assert_allclose(
-        np.asarray(loss.numpy()).reshape(lse.shape), lse, rtol=1e-5, atol=1e-5
-    )
-    # grads flow
-    loss.sum().backward()
-    assert logits.grad is not None
-    softmax = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
-    expected_grad = softmax.copy()
-    np.put_along_axis(
-        expected_grad, labels_np[..., None],
-        np.take_along_axis(expected_grad, labels_np[..., None], -1) - 1.0, -1,
-    )
-    np.testing.assert_allclose(logits.grad.numpy(), expected_grad, rtol=1e-4, atol=1e-5)
+        logits = paddle.to_tensor(logits_np, stop_gradient=False)
+        labels = paddle.to_tensor(labels_np)
+        loss = ParallelCrossEntropy()(logits, labels)
+        # dense reference
+        x = logits_np - logits_np.max(-1, keepdims=True)
+        lse = np.log(np.exp(x).sum(-1)) - np.take_along_axis(
+            x, labels_np[..., None], axis=-1
+        )[..., 0]
+        np.testing.assert_allclose(
+            np.asarray(loss.numpy()).reshape(lse.shape), lse,
+            rtol=1e-5, atol=1e-5
+        )
+        # grads flow
+        loss.sum().backward()
+        assert logits.grad is not None
+        softmax = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+        expected_grad = softmax.copy()
+        np.put_along_axis(
+            expected_grad, labels_np[..., None],
+            np.take_along_axis(expected_grad, labels_np[..., None], -1) - 1.0,
+            -1,
+        )
+        np.testing.assert_allclose(logits.grad.numpy(), expected_grad,
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        # fleet.init installs the 2x4 hybrid mesh globally; later tests
+        # (serving parity) must not see sharding constraints under it
+        topology.set_mesh(None)
 
 
 def test_incubate_autograd_surface():

@@ -153,6 +153,69 @@ def test_multichip_dryrun_mesh_lint_error_clean(mesh, builder,
     assert "FLAGS_check_programs=1" in out
 
 
+def test_registry_exposes_the_new_passes():
+    from paddle_tpu import analysis as A
+
+    names = A.pass_names()
+    for p in ("determinism", "collective_schedule", "equivalence"):
+        assert p in names, names
+
+
+def _write_diff_builders(tmp_path):
+    a = tmp_path / "model_a.py"
+    a.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model():\n"
+        "    fn = lambda x: (x * 2.0 + 1.0).sum()\n"
+        "    return fn, [paddle.static.InputSpec([4], 'float32')]\n"
+    )
+    b = tmp_path / "model_b.py"
+    # only a renamed builder on purpose: exercises --builder-b resolution
+    b.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model_v2():\n"
+        "    fn = lambda x: (1.0 + 2.0 * x).sum()\n"  # commuted: equivalent
+        "    return fn, [paddle.static.InputSpec([4], 'float32')]\n"
+    )
+    c = tmp_path / "model_c.py"
+    c.write_text(
+        "import paddle_tpu as paddle\n"
+        "def build_model():\n"
+        "    fn = lambda x: (x * 3.0 + 1.0).sum()\n"  # rescaled: divergent
+        "    return fn, [paddle.static.InputSpec([4], 'float32')]\n"
+    )
+    return a, b, c
+
+
+def test_diff_mode_certifies_equivalent_builders(tmp_path, capsys):
+    a, b, _c = _write_diff_builders(tmp_path)
+    rc = _cli().main([str(a), "--diff", str(b), "--builder-b",
+                      "build_model_v2"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "EQUIVALENT" in out
+
+
+def test_diff_mode_flags_divergent_builders(tmp_path, capsys):
+    a, _b, c = _write_diff_builders(tmp_path)
+    cli = _cli()
+    rc = cli.main([str(a), "--diff", str(c)])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "DIVERGENT" in out
+
+    # --json carries the certificate + structural diff lines
+    rc = cli.main([str(a), "--diff", str(c), "--json"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert rc == 1
+    recs = [json.loads(l) for l in lines]
+    diff_recs = [r for r in recs if r["pass"] == "equivalence"]
+    assert diff_recs, recs
+    data = diff_recs[0]["data"]
+    assert data["certificate"]["equivalent"] is False
+    assert data["diff"]
+
+
 def test_mesh_lint_json_carries_collective_records(capsys):
     rc = _cli().main([os.path.join(REPO, "examples", "multichip_dryrun.py"),
                       "--mesh", "dp=2,mp=2", "--json"])

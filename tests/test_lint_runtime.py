@@ -1,0 +1,64 @@
+"""CI self-lint for the host runtime: tools/lint_runtime.py.
+
+Two obligations: (1) the shipped paddle_tpu/ tree is clean under the
+counter-lock-discipline rule (off-main-thread code must route dispatch
+counter writes through the locked helpers), and (2) the lint still bites —
+the deliberately-bad fixture in tests/fixtures/lint_runtime_bad.py yields
+exactly its three seeded violations and exit status 1.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "lint_runtime_bad.py")
+
+
+def _tool():
+    path = os.path.join(REPO, "tools", "lint_runtime.py")
+    spec = importlib.util.spec_from_file_location("lint_runtime_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_runtime_is_counter_lock_clean():
+    lr = _tool()
+    violations = lr.lint_paths([os.path.join(REPO, "paddle_tpu")])
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_repo_default_path_main_exits_zero(capsys):
+    lr = _tool()
+    assert lr.main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 violation(s)" in out
+
+
+def test_bad_fixture_yields_all_three_seeded_violations():
+    lr = _tool()
+    violations = lr.lint_paths([FIXTURE])
+    assert len(violations) == 3, violations
+    assert all(v.rule == "counter-lock-discipline" for v in violations)
+    funcs = {v.func for v in violations}
+    # Thread(target=...) function, executor .submit() nested def, and the
+    # Thread-subclass run() method are each caught
+    assert funcs == {"_worker_loop", "job", "run"}, funcs
+    for v in violations:
+        assert "_counter_add" in v.message
+
+
+def test_bad_fixture_exit_status_and_json(capsys):
+    lr = _tool()
+    assert lr.main([FIXTURE]) == 1
+    capsys.readouterr()
+    assert lr.main([FIXTURE, "--json"]) == 1
+    out = capsys.readouterr().out
+    recs = [json.loads(line) for line in out.splitlines() if line.strip()]
+    assert len(recs) == 3
+    for rec in recs:
+        assert rec["rule"] == "counter-lock-discipline"
+        assert rec["path"].endswith("lint_runtime_bad.py")
+        assert rec["line"] > 0

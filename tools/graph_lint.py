@@ -88,6 +88,17 @@ def main(argv=None) -> int:
                          "passes mesh_axes= to the builder, and routes "
                          "train-step targets through "
                          "analysis.sharding.check_sharded_step")
+    ap.add_argument("--diff", default=None, metavar="MODEL_FILE_B",
+                    help="diff mode: compare the traced program against "
+                         "this second model file's (same builder name "
+                         "unless --builder-b). Prints the structural "
+                         "equivalence certificate, op-histogram deltas and "
+                         "the ordered collective-schedule diff; exits 0 "
+                         "when the programs are provably equivalent, 1 "
+                         "otherwise")
+    ap.add_argument("--builder-b", default=None, metavar="NAME",
+                    help="builder name in the --diff file (default: same "
+                         "as --builder)")
     ap.add_argument("--fail-on", default="error",
                     choices=["info", "warning", "error"],
                     help="exit nonzero at/above this severity (default: error)")
@@ -151,6 +162,53 @@ def main(argv=None) -> int:
         target, specs = built, None
     if args.input_spec:
         specs = [_parse_spec(s) for s in args.input_spec]
+
+    if args.diff:
+        if hasattr(target, "_step_parts") or \
+                getattr(target, "_captured_step", False):
+            raise SystemExit(
+                "graph_lint: --diff compares single traced programs; "
+                "sharded/pipelined/captured train-step targets are not "
+                "supported")
+        mod_b = _load_module(args.diff)
+        bname = args.builder_b or args.builder
+        builder_b = getattr(mod_b, bname, None)
+        if builder_b is None:
+            raise SystemExit(f"graph_lint: {args.diff} has no {bname}()")
+        try:
+            takes_mesh_b = "mesh_axes" in inspect.signature(
+                builder_b).parameters
+        except (TypeError, ValueError):
+            takes_mesh_b = False
+        built_b = builder_b(mesh_axes=mesh_axes) \
+            if (mesh_axes and takes_mesh_b) else builder_b()
+        if isinstance(built_b, tuple) and len(built_b) == 2:
+            target_b, specs_b = built_b
+        else:
+            target_b, specs_b = built_b, None
+        if args.input_spec:
+            specs_b = [_parse_spec(s) for s in args.input_spec]
+        from paddle_tpu.analysis import _context_of
+        from paddle_tpu.analysis.equivalence import program_diff
+
+        closed_a, _roles_a, _src_a = _context_of(target, specs)
+        closed_b, _roles_b, _src_b = _context_of(target_b, specs_b)
+        cert, lines = program_diff(
+            closed_a, closed_b,
+            label_a=os.path.basename(args.model_file),
+            label_b=os.path.basename(args.diff))
+        if args.json:
+            print(json.dumps({
+                "severity": "info" if cert.equivalent else "error",
+                "pass": "equivalence", "op": None,
+                "message": cert.summary(), "hint": None,
+                "source": "graph_lint --diff", "shapes": [], "dtypes": [],
+                "data": {"certificate": cert.to_dict(), "diff": lines},
+            }))
+        else:
+            for line in lines:
+                print(line)
+        return 0 if cert.equivalent else 1
 
     passes = args.passes.split(",") if args.passes else None
     captured = bool(getattr(target, "_captured_step", False))

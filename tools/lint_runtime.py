@@ -1,0 +1,203 @@
+"""Host-side runtime self-lint: AST checks over the paddle_tpu source tree.
+
+The static-analysis registry (paddle_tpu.analysis) lints *traced programs*;
+this tool lints the *host runtime itself* for concurrency discipline the
+type system cannot express. One rule today:
+
+counter-lock-discipline
+    The dispatch counters (``paddle_tpu.core.dispatch._counters``) are a
+    plain dict guarded by ``_counters_lock``. Main-thread code may mutate
+    them directly (``dispatch._counters["x"] += 1`` — the framework is
+    single-threaded on the hot path, and the lock-free fast path is
+    deliberate). Code that runs OFF the main thread — ``threading.Thread``
+    targets, executor ``.submit()`` callables, ``Thread`` subclass
+    ``run()`` methods — must route every write through the locked helpers
+    (``_counter_add`` / ``_counter_set`` / ``_counter_add_labeled``):
+    a bare ``+=`` from a worker races the main thread's read-modify-write
+    and silently drops increments.
+
+Resolution is module-local and name-based (a thread target defined in one
+module and written in another is out of scope), which covers the repo's
+idiom: worker loops are defined next to the code that spawns them.
+
+Usage:
+    python tools/lint_runtime.py                # lints paddle_tpu/
+    python tools/lint_runtime.py path1 path2    # explicit files/dirs
+    python tools/lint_runtime.py --json
+
+Exit status: 1 when any violation is found, else 0 (the CI self-lint test
+keys on this).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Iterable, List, Optional, Set
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    func: str
+    message: str
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.func}: "
+                f"{self.message}")
+
+
+def _terminal_name(node) -> Optional[str]:
+    """foo / mod.foo / self.foo → 'foo' (how thread targets are named)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _counter_store_targets(stmt) -> Iterable[ast.Subscript]:
+    """Subscript STORE targets of an assignment into a *_counters dict."""
+    if isinstance(stmt, ast.AugAssign):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    else:
+        return
+    for t in targets:
+        if not isinstance(t, ast.Subscript):
+            continue
+        base = _terminal_name(t.value)
+        if base is not None and base.endswith("_counters"):
+            yield t
+
+
+def _thread_entry_points(tree: ast.AST):
+    """(names of functions used as thread targets, lambda nodes used as
+    thread targets, Thread-subclass run() method nodes)."""
+    names: Set[str] = set()
+    lambdas: List[ast.Lambda] = []
+    run_methods: List[ast.FunctionDef] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = _terminal_name(node.func)
+            if fname == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        if isinstance(kw.value, ast.Lambda):
+                            lambdas.append(kw.value)
+                        else:
+                            n = _terminal_name(kw.value)
+                            if n:
+                                names.add(n)
+            elif fname == "submit" and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Lambda):
+                    lambdas.append(arg0)
+                else:
+                    n = _terminal_name(arg0)
+                    if n:
+                        names.add(n)
+        elif isinstance(node, ast.ClassDef):
+            if any(_terminal_name(b) == "Thread" for b in node.bases):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and item.name == "run":
+                        run_methods.append(item)
+    return names, lambdas, run_methods
+
+
+def _check_counter_discipline(path: str, tree: ast.AST) -> List[Violation]:
+    names, lambdas, run_methods = _thread_entry_points(tree)
+    roots = list(lambdas) + list(run_methods)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            roots.append(node)
+    out: List[Violation] = []
+    seen: Set[int] = set()
+    for root in roots:
+        fname = getattr(root, "name", "<lambda>")
+        # the whole subtree runs on the worker thread, including nested
+        # defs (they only exist to be called from the worker loop)
+        for node in ast.walk(root):
+            for sub in _counter_store_targets(node):
+                if id(sub) in seen:
+                    continue
+                seen.add(id(sub))
+                base = _terminal_name(sub.value)
+                out.append(Violation(
+                    rule="counter-lock-discipline",
+                    path=path, line=sub.lineno, func=fname,
+                    message=(
+                        f"direct {base}[...] write inside a thread-target "
+                        "function: off-main-thread counter mutations race "
+                        "the main thread's read-modify-write — route "
+                        "through dispatch._counter_add / _counter_set "
+                        "(they take _counters_lock)"),
+                ))
+    return out
+
+
+RULES = (_check_counter_discipline,)
+
+
+def lint_paths(paths: Iterable[str]) -> List[Violation]:
+    out: List[Violation] = []
+    for path in paths:
+        files = []
+        if os.path.isdir(path):
+            for dirpath, _dirs, fnames in os.walk(path):
+                files += [os.path.join(dirpath, f) for f in sorted(fnames)
+                          if f.endswith(".py")]
+        else:
+            files.append(path)
+        for f in files:
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=f)
+            except SyntaxError as e:
+                out.append(Violation(
+                    rule="parse-error", path=f,
+                    line=getattr(e, "lineno", 0) or 0, func="<module>",
+                    message=str(e)))
+                continue
+            for rule in RULES:
+                out.extend(rule(f, tree))
+    return sorted(out, key=lambda v: (v.path, v.line))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint_runtime", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: paddle_tpu/ "
+                         "next to this script's repo root)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit violations as JSON lines")
+    args = ap.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(repo, "paddle_tpu")]
+    violations = lint_paths(paths)
+    if args.json:
+        for v in violations:
+            print(json.dumps(dataclasses.asdict(v)))
+    else:
+        for v in violations:
+            print(str(v))
+        print(f"lint_runtime: {len(violations)} violation(s) in "
+              f"{', '.join(paths)}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

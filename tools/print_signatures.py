@@ -27,6 +27,7 @@ SUBMODULES = [
     "static.analysis",
     "static.analysis.memory",
     "static.analysis.sharding",
+    "static.analysis.equivalence",
     "linalg",
     "metric",
     "distributed",
