@@ -299,6 +299,22 @@ def _reset_counters_locked():
         serve_engine_restarts=0,
         serve_health_transitions=0,
         serve_block_leaks=0,
+        # fleet front door (ISSUE 20): cross-replica routing, mid-decode
+        # failover (router_reroutes never burns a request's own retry
+        # budget), drain-to-peers handoffs, lease-plane refresh failures
+        # (fail-soft: stale table, not an outage), and the router's own
+        # zero-drop audit (router_requests_dropped must stay 0 — the
+        # serve_fleet chaos gate fails on anything else)
+        router_requests=0,
+        router_routed=0,
+        router_reroutes=0,
+        router_shed_reroutes=0,
+        router_replicas_lost=0,
+        router_drain_handoffs=0,
+        router_lease_read_failures=0,
+        router_requests_dropped=0,
+        router_autoscale_grow_proposals=0,
+        router_autoscale_shrink_proposals=0,
         # ops plane (ISSUE 13): perf-regression sentinel trips (the
         # labeled family records WHICH step-signature / serving key
         # regressed) and clears (a tripped key recovering re-baselines)

@@ -642,6 +642,64 @@ define_flag(
     "cleanly: past the cap every queued and in-flight request is answered "
     "with an error response and the engine goes 'dead' — zero hangs",
 )
+# ---------------------------------------------------------------------------
+# Fleet serving front door (paddle.serving.FrontDoor — see SERVING.md)
+# ---------------------------------------------------------------------------
+define_flag(
+    "router_reroute_budget", 2,
+    "times the serving FrontDoor may re-dispatch one request to a "
+    "surviving replica after its assigned replica died, wedged past its "
+    "restart budget, or lost its lease mid-decode (greedy decode makes "
+    "the re-run bitwise-identical). Reroutes are counted separately "
+    "(router_reroutes) and never burn FLAGS_serving_request_retries; past "
+    "the budget the request answers a structured error — never a hang",
+)
+define_flag(
+    "router_refresh_s", 1.0,
+    "minimum seconds between FrontDoor routing-table refreshes from the "
+    "obs-lease plane (queue depth / cost EMAs / health per replica); "
+    "in-process replicas are read live every pump and ignore this",
+)
+define_flag(
+    "router_lease_grace_s", 5.0,
+    "how long a remote replica may be absent from a SUCCESSFUL lease read "
+    "before the FrontDoor declares it lost and requeues its work. A "
+    "failed lease read (master partition) never starts this clock — the "
+    "router keeps routing on the last-known table "
+    "(router_lease_read_failures counts the outage)",
+)
+define_flag(
+    "router_replica_retries", 2,
+    "consecutive transport failures (submit/poll connection errors) "
+    "before the FrontDoor declares a remote replica dead and fails its "
+    "queued + in-flight work over to survivors",
+)
+define_flag(
+    "router_autoscale_p99_ms", 0.0,
+    "fleet-merged queue-wait p99 breach threshold for the FrontDoor "
+    "autoscaler: sustained past FLAGS_router_autoscale_sustain_s it "
+    "proposes a GROW through the RescaleCoordinator serve-scale document. "
+    "0 = autoscale proposals off",
+)
+define_flag(
+    "router_autoscale_sustain_s", 5.0,
+    "seconds the fleet queue-wait p99 must stay above "
+    "FLAGS_router_autoscale_p99_ms before the autoscaler proposes a grow "
+    "(debounce: a transient spike must not scale the fleet)",
+)
+define_flag(
+    "router_autoscale_idle_s", 30.0,
+    "seconds the whole fleet must sit idle (no queued, in-flight, or "
+    "parked work anywhere) before the autoscaler proposes a shrink: the "
+    "victim replica is drained gracefully (no new admissions, in-flight "
+    "completes) and then closed",
+)
+define_flag(
+    "router_autoscale_cooldown_s", 30.0,
+    "minimum seconds between autoscale proposals (grow or shrink) — the "
+    "CheckFreq discipline: let the previous action's effect land in the "
+    "measured signals before proposing another",
+)
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking (compat)")
 define_flag(
     "use_flash_attention",

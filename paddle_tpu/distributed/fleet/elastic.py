@@ -34,6 +34,8 @@ __all__ = [
     "RescaleFallback",
     "WorldView",
     "deterministic_tree_sum",
+    "read_serve_scale",
+    "serve_scale_key",
     "start_master",
     "state",
 ]
@@ -315,6 +317,37 @@ def _epoch_key(job_id: str) -> str:
     # deliberately OUTSIDE the elastic/<job>/ lease prefix: kv_alive over
     # the member prefix must never list the epoch document as a node
     return f"elastic-epoch/{job_id}"
+
+
+def serve_scale_key(job_id: str) -> str:
+    """KV key of the serving-fleet scale proposal document — like the
+    membership epoch, a kv_put document OUTSIDE every lease prefix (it is
+    a request, not a member)."""
+    return f"serve-scale/{job_id}"
+
+
+def read_serve_scale(kv, job_id: str) -> Optional[Dict[str, Any]]:
+    """The replica manager's half of the serving autoscale loop: read the
+    current scale proposal (``{proposal, target, kind, reason, node,
+    acked}``), or None when there is none / the document is torn. The
+    manager acts on un-acked proposals (spawn or retire a replica) and
+    acks via :meth:`RescaleCoordinator.ack_serve_scale` so a proposal is
+    acted on exactly once."""
+    raw = kv.kv_get(serve_scale_key(job_id))
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+        return {
+            "proposal": int(doc["proposal"]),
+            "target": int(doc["target"]),
+            "kind": str(doc.get("kind", "")),
+            "reason": str(doc.get("reason", "")),
+            "node": doc.get("node"),
+            "acked": bool(doc.get("acked", False)),
+        }
+    except (ValueError, KeyError, TypeError):
+        return None  # torn/corrupt document: treated as absent
 
 
 def _barrier_prefix(job_id: str, epoch: int) -> str:
@@ -796,6 +829,56 @@ class RescaleCoordinator:
             self._client().kv_del(self._member_key())
         except ConnectionError:
             pass  # the lease will expire on its own — same outcome, later
+
+    # -- serving-fleet autoscale (ISSUE 20) ------------------------------
+    def propose_serve_scale(self, target: int, *, reason: str,
+                            kind: Optional[str] = None,
+                            signals: Optional[Dict[str, Any]] = None,
+                            ) -> Optional[int]:
+        """Publish a serving-fleet scale proposal (the FrontDoor
+        autoscaler's grow/shrink path): one kv_put document under
+        ``serve-scale/<job>`` with a monotonically increasing proposal id,
+        which the replica manager polls (:func:`read_serve_scale`), acts
+        on, and acks. Returns the proposal id, or None when the proposal
+        was suppressed: target outside [np_min, np_max], or an identical
+        un-acked proposal is already outstanding (exactly-once per scale
+        decision — the chaos gate counts proposals)."""
+        target = int(target)
+        if not (self.np_min <= target <= self.np_max):
+            self._emit("serve_scale_refused", target=target,
+                       np_min=self.np_min, np_max=self.np_max)
+            return None
+        stored = read_serve_scale(self._client(), self.job_id)
+        if (stored is not None and not stored["acked"]
+                and stored["target"] == target):
+            return None  # already proposed, not yet acted on
+        proposal = (stored["proposal"] + 1) if stored else 1
+        if kind is None:  # infer from the previous proposal when unlabeled
+            kind = "grow"
+            if stored is not None:
+                kind = "grow" if target > stored["target"] else (
+                    "shrink" if target < stored["target"] else "reaffirm")
+        doc = {"proposal": proposal, "target": target, "kind": kind,
+               "reason": str(reason), "node": self.node_id, "acked": False}
+        if signals:
+            doc["signals"] = signals
+        self._client().kv_put(serve_scale_key(self.job_id),
+                              json.dumps(doc, default=str))
+        self._emit("serve_scale_propose", proposal=proposal, target=target,
+                   kind=kind, reason=str(reason)[:120])
+        return proposal
+
+    def ack_serve_scale(self, proposal: int):
+        """Mark a proposal acted on (the replica manager's commit): the
+        document stays for observability but stops suppressing follow-up
+        proposals."""
+        stored = read_serve_scale(self._client(), self.job_id)
+        if stored is None or stored["proposal"] != int(proposal):
+            return
+        stored["acked"] = True
+        self._client().kv_put(serve_scale_key(self.job_id),
+                              json.dumps(stored, default=str))
+        self._emit("serve_scale_ack", proposal=int(proposal))
 
     # -- observability ---------------------------------------------------
     def accumulation_factor(self) -> Optional[int]:

@@ -237,6 +237,18 @@ class ObsPublisher:
                 capture = "armed" if cstate.get("armed") else "warmup"
         except Exception:
             pass
+        # fleet serving front door (ISSUE 20): per-engine routing signals
+        # — queue depth, in-flight count, measured prefill/decode cost
+        # EMAs, the admission state, and the replica's serve address — so
+        # a cross-host FrontDoor dispatches on predicted cost (and honors
+        # health) without any extra RPC to the replica
+        serving = None
+        try:
+            rows = [eng.routing_signals() for eng in _diag.engines()]
+            if rows:
+                serving = rows
+        except Exception:
+            pass
         return {
             "node": self.node_id,
             "host": socket.gethostname(),
@@ -248,6 +260,7 @@ class ObsPublisher:
             "programs": programs,
             "telemetry": telemetry,
             "capture": capture,
+            "serving": serving,
             "health": {
                 "status": health.get("status"),
                 "reasons": health.get("reasons"),
@@ -664,13 +677,58 @@ class FleetAggregator:
                 unreachable.append(node)
                 continue
             pulled.append(node)
-            for ev in flight.get("events", []):
+            evs = flight.get("events", [])
+            # per-request serving lanes: chrome async (b/n/e) events are
+            # matched by cat+id GLOBALLY, not per pid — two hosts serving
+            # the same request-id space would interleave their spans into
+            # one corrupted lane. Prefix the lane id with the host label,
+            # escaped exactly like the merged exposition's host label, so
+            # cross-host per-request spans stay distinct.
+            from ...profiler import metrics as _metrics
+            from ...profiler import trace as _trace
+
+            esc_node = _metrics.escape_label_value(node)
+            admitted = {
+                (e.get("attrs") or {}).get("rid")
+                for e in evs
+                if e.get("kind") == "serve"
+                and (e.get("attrs") or {}).get("phase") == "admit"
+            }
+            for ev in evs:
+                ts_us = (float(ev["ts"]) - off) * 1e6
+                if ev.get("kind") == "serve":
+                    attrs = dict(ev.get("attrs") or {})
+                    phase = attrs.pop("phase", "")
+                    rids = attrs.pop("rids", None)
+                    if rids is None:
+                        rid = attrs.pop("rid", None)
+                        rids = [] if rid is None else [rid]
+                    lanes = [r for r in rids if r in admitted]
+                    for rid in lanes:
+                        if phase == "admit":
+                            ph = "b"
+                        elif phase in _trace._SERVE_END_PHASES:
+                            ph = "e"
+                        else:
+                            ph = "n"
+                        events.append({
+                            "name": "request", "cat": "serving", "ph": ph,
+                            "id": f"{esc_node}:{rid}",
+                            "ts": ts_us, "pid": lane, "tid": 1,
+                            "args": dict(attrs, phase=phase, rid=rid,
+                                         step=ev.get("step"), node=node),
+                        })
+                    if lanes:
+                        continue
+                    # engine-scoped serve events (health/restart/...) and
+                    # request events whose admit fell outside the pulled
+                    # window render as plain instants below
                 name = ev.get("kind", "?")
                 if ev.get("site"):
                     name += ":" + ev["site"]
                 events.append({
                     "name": name, "cat": "fleet", "ph": "i", "s": "t",
-                    "ts": (float(ev["ts"]) - off) * 1e6,
+                    "ts": ts_us,
                     "pid": lane, "tid": 1,
                     "args": dict(ev.get("attrs") or {}, step=ev.get("step"),
                                  node=node),

@@ -524,30 +524,20 @@ class PredictorPool:
         """The next predictor that will accept work, round-robin, routing
         around unhealthy replicas: draining/dead engines are skipped, and
         'ready'/'warming' replicas are preferred over 'degraded' ones (a
-        degraded replica still serves when it is all that's left). Raises
+        degraded replica still serves when it is all that's left). The
+        policy is the serving FrontDoor's health-preference rule
+        (serving.frontdoor.pick_serviceable) — the pool is a thin shim
+        over the fleet router's routing, not a second copy of it. Raises
         when every replica is dead/draining — fail loud, never hang."""
-        n = len(self._predictors)
-        degraded = None
-        for i in range(n):
-            idx = (self._rr + i) % n
-            p = self._predictors[idx]
-            if not p.serviceable():
-                continue
-            if p.health() == "degraded":
-                if degraded is None:
-                    degraded = (idx, p)
-                continue
-            self._rr = (idx + 1) % n
-            return p
-        if degraded is not None:
-            # an all-degraded fleet must still round-robin, not pin every
-            # request to the first degraded replica in rotation order
-            idx, p = degraded
-            self._rr = (idx + 1) % n
-            return p
-        raise RuntimeError(
-            "PredictorPool.acquire: no serviceable replica "
-            f"(healths: {[p.health() for p in self._predictors]})")
+        from ..serving.frontdoor import pick_serviceable
+
+        idx = pick_serviceable(self._predictors, rr=self._rr)
+        if idx is None:
+            raise RuntimeError(
+                "PredictorPool.acquire: no serviceable replica "
+                f"(healths: {[p.health() for p in self._predictors]})")
+        self._rr = (idx + 1) % len(self._predictors)
+        return self._predictors[idx]
 
     def healths(self) -> List[str]:
         return [p.health() for p in self._predictors]

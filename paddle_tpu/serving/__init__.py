@@ -31,6 +31,13 @@ route traffic around unhealthy replicas.
     engine.run_until_idle()
     print(engine.response(rid).tokens)
 
+The fleet **FrontDoor** (ISSUE 20, ``serving/frontdoor.py``) routes
+requests across N replicas — in-process Engines and cross-host
+ReplicaServers discovered through the obs-lease plane — with
+cost-predicted dispatch, bitwise-identical mid-decode failover (bounded
+by FLAGS_router_reroute_budget, audited to zero drops), shed re-dispatch
+honoring ``retry_after_ms``, and coordinator-driven autoscale proposals.
+
 See SERVING.md for the queue/bucket/paged-cache design and the flags
 (``paddle.describe_flags('serving')``).
 """
@@ -39,6 +46,14 @@ from __future__ import annotations
 from .admission import AdmissionController  # noqa: F401
 from .cache import BlockPool, PagedCacheView  # noqa: F401
 from .engine import HEALTH_STATES, Engine, ServingConfig  # noqa: F401
+from .frontdoor import (  # noqa: F401
+    FleetAutoscaler,
+    FrontDoor,
+    LocalReplica,
+    RemoteReplica,
+    ReplicaServer,
+    ReplicaUnreachable,
+)
 from .scheduler import (  # noqa: F401
     PRIORITIES,
     Request,
@@ -52,9 +67,15 @@ __all__ = [
     "AdmissionController",
     "BlockPool",
     "Engine",
+    "FleetAutoscaler",
+    "FrontDoor",
     "HEALTH_STATES",
+    "LocalReplica",
     "PRIORITIES",
     "PagedCacheView",
+    "RemoteReplica",
+    "ReplicaServer",
+    "ReplicaUnreachable",
     "Request",
     "RequestQueue",
     "Response",

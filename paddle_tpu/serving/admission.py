@@ -98,6 +98,10 @@ class AdmissionController:
         # bounded recent-wait window for the trip wire (the registered
         # histogram above stays lifetime, for observability)
         self._recent_waits = deque(maxlen=_TRIP_WINDOW)
+        # queue-wait EMA: the retry_after_ms hint on shed responses — how
+        # long admitted work is currently waiting, i.e. roughly when a
+        # retry would land in a shorter queue
+        self._queue_wait_ema: Optional[float] = None
 
     # -- cost feedback (engine calls these with its measured timings) -----
     def note_prefill(self, bucket: int, ms: float):
@@ -116,6 +120,19 @@ class AdmissionController:
     def note_queue_wait(self, ms: float):
         self._queue_wait.observe(ms)
         self._recent_waits.append((_time.monotonic(), float(ms)))
+        prev = self._queue_wait_ema
+        self._queue_wait_ema = (
+            float(ms) if prev is None else prev + _ALPHA * (ms - prev))
+
+    def retry_after_ms(self) -> Optional[float]:
+        """The hint shed ('overloaded') responses carry: the measured
+        queue-wait EMA — what admitted work is waiting right now, so a
+        retry after this long lands once the current backlog has drained a
+        queue-slot's worth. None during cold start (no measured waits):
+        the caller retries at its own cadence."""
+        if self._queue_wait_ema is None:
+            return None
+        return round(max(1.0, self._queue_wait_ema), 3)
 
     # -- prediction -------------------------------------------------------
     def _prefill_cost(self, bucket: int) -> Optional[float]:
@@ -218,6 +235,9 @@ class AdmissionController:
                 None if self._decode_tok_ema is None
                 else round(self._decode_tok_ema, 4)),
             "queue_wait_p99_ms": None if p99 is None else round(p99, 3),
+            "queue_wait_ema_ms": (
+                None if self._queue_wait_ema is None
+                else round(self._queue_wait_ema, 3)),
             "queue_wait_samples": self._queue_wait.count,
         }
 

@@ -221,7 +221,16 @@ class Engine:
         # with exactly one Response; anything else is a counted drop)
         self._accepted: set = set()
         self._draining = False
+        # the drain BARRIER: the ids the preemption-drain contract covers
+        # (snapshot at begin_drain). A concurrent Supervisor restart may
+        # requeue in-flight work only from inside the barrier; anything
+        # else lands as a terminal response, never re-admitted past it
+        self._drain_barrier: Optional[set] = None
         self._prev_handlers: Dict[int, Any] = {}
+        # set by a serving.frontdoor.ReplicaServer hosting this engine —
+        # published in the obs lease so a cross-host FrontDoor can route
+        # requests here
+        self.serve_addr: Optional[str] = None
         # streaming log-bucketed histogram (paddle.profiler.metrics): O(1)
         # observe, fixed memory, LIFETIME coverage — replaces the old
         # 4096-entry recent-window reservoir whose stats() paid an
@@ -461,8 +470,14 @@ class Engine:
 
     def pop_response(self, request_id: int) -> Optional[Response]:
         """``response()`` + evict — long-running callers retrieve results
-        with this so the response map doesn't grow with total traffic."""
-        return self._responses.pop(request_id, None)
+        with this so the response map doesn't grow with total traffic.
+        The id leaves the drop-audit set too: a retrieved response IS the
+        answered contract, so a mid-run pop (the ReplicaServer poll
+        pattern) must not read as a drop at the next idle edge."""
+        r = self._responses.pop(request_id, None)
+        if r is not None:
+            self._accepted.discard(request_id)
+        return r
 
     def step(self):
         """One scheduler tick: expire what already missed its deadline,
@@ -585,6 +600,33 @@ class Engine:
                        error=type(err).__name__)
         reset_serve_programs(owner=self._uid)
         for seq in list(self._active):
+            if (self._draining and self._drain_barrier is not None
+                    and seq.req.request_id not in self._drain_barrier):
+                # restart racing an installed preemption drain: work that
+                # landed AFTER the barrier snapshot (a submit or a router
+                # dispatch racing the signal handler) must not be
+                # re-admitted past the drain barrier — it answers a
+                # terminal retriable response instead (the FrontDoor
+                # re-dispatches it to a peer), never re-enters a draining
+                # engine's queue where nothing may drive it again
+                from ..core import dispatch as _dispatch
+
+                self._release(seq)
+                self._n_shed += 1
+                _dispatch._counters["serve_requests_shed"] += 1
+                self._responses[seq.req.request_id] = Response(
+                    request_id=seq.req.request_id, status="overloaded",
+                    error=("engine restarted while draining: request was "
+                           "outside the drain barrier — retry on a peer"),
+                    retriable=True,
+                    prompt_len=int(seq.req.prompt.size),
+                    submit_time=seq.req.submit_time, done_time=time.time(),
+                    retry_after_ms=self._admission.retry_after_ms(),
+                )
+                _dispatch._emit("serve", site="engine",
+                                phase="drain_barrier_refusal",
+                                rid=seq.req.request_id, engine=self._uid)
+                continue
             self._requeue_seq(seq, err, count_retry=False)
         self._pool.reset_storage()
         self._mark_degraded(f"engine restart: {type(err).__name__}")
@@ -623,6 +665,12 @@ class Engine:
 
         if not self._draining:
             self._draining = True
+            # snapshot the drain BARRIER: exactly the accepted-but-
+            # unanswered ids the drain contract covers. A Supervisor
+            # restart during the drain requeues in-flight work only from
+            # inside this set; anything racing in past it (signal-handler
+            # timing) terminal-errors instead of re-admitting
+            self._drain_barrier = set(self._accepted) - set(self._responses)
             dispatch._counters["serve_preempt_drains"] += 1
             if self._health != "dead":
                 self._set_health("draining", "preemption drain")
@@ -736,6 +784,27 @@ class Engine:
                 self._pool_plan.overhead_bytes / 2**20, 2)
         return out
 
+    def routing_signals(self) -> Dict[str, Any]:
+        """The cost/queue signals the fleet FrontDoor routes on — also
+        what the obs lease publishes per engine (the ``serving`` section),
+        so a cross-host router predicts completion from this replica's own
+        measured costs instead of round-robining blind.
+        ``prefill_ema_ms`` is the bucket-average scalar (the per-bucket
+        table rides in ``admission``)."""
+        adm = self._admission.state()
+        pre = adm.get("prefill_ema_ms") or {}
+        return {
+            "engine": self._uid,
+            "health": self._health,
+            "queue_depth": len(self._queue),
+            "inflight": len(self._active),
+            "prefill_ema_ms": (round(sum(pre.values()) / len(pre), 3)
+                               if pre else None),
+            "tok_ema_ms": adm.get("decode_tok_ema_ms"),
+            "admission": adm,
+            "serve_addr": self.serve_addr,
+        }
+
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
@@ -780,6 +849,7 @@ class Engine:
             retriable=True,
             prompt_len=int(req.prompt.size), submit_time=req.submit_time,
             done_time=time.time(),
+            retry_after_ms=self._admission.retry_after_ms(),
         )
         dispatch._emit("serve", site="engine", phase="shed",
                        rid=req.request_id, reason=decision.reason,

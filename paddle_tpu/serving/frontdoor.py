@@ -1,0 +1,1295 @@
+"""Fleet serving front door: health-routed dispatch over N engine replicas,
+mid-decode failover, and coordinator-driven autoscale — zero drops.
+
+One :class:`FrontDoor` owns a routing table of replicas — in-process
+:class:`~paddle_tpu.serving.Engine` instances (wrapped in a
+:class:`LocalReplica`, each supervised by serving/supervisor.py) and
+cross-host :class:`RemoteReplica` entries discovered through the obs-lease
+plane (``fleet/obs.py``): every lease snapshot carries a ``serving``
+section with each engine's :meth:`~paddle_tpu.serving.Engine.routing_signals`
+(queue depth, in-flight count, measured prefill/decode cost EMAs, health,
+``serve_addr``), so routing is **cost-predicted from each replica's own
+measured EMAs**, not round-robin — the same CheckFreq measure-then-decide
+discipline the admission controller applies inside one engine, applied
+across the fleet.
+
+Routing honors health: ``draining``/``dead`` replicas are never picked,
+``degraded`` is last-resort. The failure contract extends the engine's
+zero-drop guarantee across replica death:
+
+- a replica that dies (process SIGKILL, wedge past its restart budget,
+  lease lost mid-decode, sustained transport failures) has ALL of its
+  queued and in-flight requests re-dispatched to survivors — greedy decode
+  is deterministic, so the re-run reproduces **bitwise-identical tokens**;
+- reroutes are counted separately (``router_reroutes``) and NEVER burn the
+  request's engine-level retry budget; past ``FLAGS_router_reroute_budget``
+  the request answers a structured error response — never a hang;
+- a lease-master partition (a FAILED lease read) keeps the last-known
+  routing table and counts ``router_lease_read_failures``; only a replica
+  absent from a SUCCESSFUL read past ``FLAGS_router_lease_grace_s`` is
+  declared lost;
+- ``run_until_idle`` ends with the same drop audit the engine runs:
+  every submitted request must hold exactly one terminal response
+  (``router_requests_dropped`` counts violations — the chaos gate fails
+  on any).
+
+Shed (``overloaded``) responses are re-dispatched to a sibling, honoring
+the response's ``retry_after_ms`` hint (the shedding replica's measured
+queue-wait EMA) as a backoff before retrying the same replica.
+
+Autoscale: a sustained fleet queue-wait-p99 breach
+(``FLAGS_router_autoscale_p99_ms`` for ``FLAGS_router_autoscale_sustain_s``)
+proposes a GROW through the PR 14 ``RescaleCoordinator`` serve-scale
+document (``elastic.propose_serve_scale``); a sustained fully-idle fleet
+proposes a SHRINK and gracefully drains the least-loaded local replica.
+Both are debounced by ``FLAGS_router_autoscale_cooldown_s``.
+
+SIGTERM on the router (``install_preemption_handler``) drains everything:
+router-queued work is handed to serviceable peers (remote preferred —
+``router_drain_handoffs``), local engines finish their in-flight work
+under their own drain contract, and new submits answer a structured
+rejection.
+
+``tools/serve_fleet_probe.py`` is the multi-process chaos gate: replica
+SIGKILL mid-decode, lease-master partition, 2x oversubscription storm, and
+scale-up-under-storm — all with zero dropped requests and answered tokens
+bitwise-equal to a single-replica baseline.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import signal as _signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+from urllib import request as _urlreq
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..core import flags
+from .scheduler import Response
+from .supervisor import Supervisor
+
+__all__ = [
+    "FleetAutoscaler",
+    "FrontDoor",
+    "LocalReplica",
+    "RemoteReplica",
+    "ReplicaServer",
+    "ReplicaUnreachable",
+    "health_pool",
+    "pick_serviceable",
+]
+
+_FRONTDOOR_IDS = itertools.count(1)
+
+
+def health_pool(candidates):
+    """The fleet health-preference rule, in one place: serviceable
+    candidates only (never draining/dead), with 'degraded' demoted to
+    last-resort. Returns the preferred pool (healthy if any, else the
+    degraded survivors); empty when nothing serves."""
+    ok = [c for c in candidates if c.serviceable()]
+    healthy = [c for c in ok if c.health() != "degraded"]
+    return healthy or ok
+
+
+def pick_serviceable(candidates, rr: int = 0) -> Optional[int]:
+    """Round-robin index pick under the same health-preference rule —
+    the inference PredictorPool's acquire policy, shared here so the
+    pool is a thin shim over the FrontDoor's routing rather than a
+    second, drifting copy of it. Returns None when no candidate is
+    serviceable."""
+    n = len(candidates)
+    degraded = None
+    for i in range(n):
+        idx = (rr + i) % n
+        c = candidates[idx]
+        if not c.serviceable():
+            continue
+        if c.health() == "degraded":
+            if degraded is None:
+                degraded = idx
+            continue
+        return idx
+    return degraded
+
+
+class ReplicaUnreachable(RuntimeError):
+    """A transport failure talking to a remote replica (connect/timeout).
+    NOT a request failure: the router retries elsewhere, and sustained
+    unreachability (FLAGS_router_replica_retries) declares the replica
+    lost."""
+
+
+def _response_to_doc(r: Response) -> Dict[str, Any]:
+    """Response → wire doc. Logits never cross the wire (parity/debug
+    only, and per-token [vocab] rows would dwarf the payload)."""
+    return {
+        "request_id": int(r.request_id),
+        "status": r.status,
+        "tokens": [int(t) for t in r.tokens],
+        "error": r.error,
+        "retriable": bool(r.retriable),
+        "prompt_len": int(r.prompt_len),
+        "submit_time": r.submit_time,
+        "first_token_time": r.first_token_time,
+        "done_time": r.done_time,
+        "retry_after_ms": r.retry_after_ms,
+    }
+
+
+def _response_from_doc(d: Dict[str, Any]) -> Response:
+    return Response(
+        request_id=int(d["request_id"]),
+        status=str(d["status"]),
+        tokens=[int(t) for t in (d.get("tokens") or [])],
+        error=d.get("error"),
+        retriable=bool(d.get("retriable")),
+        prompt_len=int(d.get("prompt_len") or 0),
+        submit_time=float(d.get("submit_time") or 0.0),
+        first_token_time=d.get("first_token_time"),
+        done_time=d.get("done_time"),
+        retry_after_ms=d.get("retry_after_ms"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# replicas
+# ---------------------------------------------------------------------------
+class LocalReplica:
+    """An in-process Engine behind the uniform replica interface. The
+    engine is driven by the FrontDoor's own pump (one supervised tick per
+    pump), so a wedge restarts the engine in place and a restart budget
+    exhaustion surfaces as health 'dead' — which the router's sweep turns
+    into a failover, not an error passthrough."""
+
+    kind = "local"
+
+    def __init__(self, engine, *, supervised: bool = True,
+                 max_restarts: Optional[int] = None):
+        self.engine = engine
+        self.name = f"local:{engine._uid}"
+        self._sup = Supervisor(engine, max_restarts) if supervised else None
+        self._lost = False
+
+    def health(self) -> str:
+        return self.engine.health
+
+    def serviceable(self) -> bool:
+        return not self._lost and self.engine.serviceable()
+
+    def signals(self) -> Dict[str, Any]:
+        return self.engine.routing_signals()
+
+    def submit(self, prompt, **kw) -> int:
+        return self.engine.submit(prompt, **kw)
+
+    def poll(self, rids) -> Dict[int, Optional[Response]]:
+        return {rid: self.engine.pop_response(rid) for rid in rids}
+
+    def pending(self) -> int:
+        return self.engine.pending
+
+    def step(self) -> bool:
+        """One engine tick if there is work; True when a tick ran."""
+        if self.engine.pending and self.engine.health != "dead":
+            (self._sup or self.engine).step()
+            return True
+        return False
+
+    def idle_audit(self):
+        """At fleet idle: run the engine's own zero-drop/leak audit and
+        stand its watchdog heartbeat down (the run_until_idle
+        discipline — an idle engine must not read as stalled)."""
+        if self.engine.pending:
+            return
+        from ..profiler import trace as _trace
+
+        self.engine._audit_drops()
+        _trace.watchdog_disarm(f"serve[{self.engine._uid}]")
+
+    def begin_drain(self):
+        self.engine.begin_drain()
+
+    def close(self):
+        if self._sup is not None:
+            self._sup.close()
+        self.engine.close()
+
+
+class RemoteReplica:
+    """A cross-host replica behind a :class:`ReplicaServer`, discovered
+    from the obs-lease ``serving`` section. Routing signals come from the
+    lease snapshot (refreshed at the aggregator cadence); submit/poll go
+    over loopback-style HTTP to ``serve_addr``. Death is declared two
+    ways: sustained transport failures (FLAGS_router_replica_retries), or
+    absence from a SUCCESSFUL lease read past
+    FLAGS_router_lease_grace_s — a FAILED read (master partition) starts
+    neither clock."""
+
+    kind = "remote"
+
+    def __init__(self, node: str, addr: str, *, engine=None,
+                 http_timeout: float = 2.0):
+        self.node = str(node)
+        self.addr = str(addr)
+        self.name = (f"remote:{self.node}/"
+                     f"{engine if engine is not None else self.addr}")
+        self.http_timeout = float(http_timeout)
+        self._signals: Dict[str, Any] = {}
+        self._lost = False
+        self._missing_since: Optional[float] = None
+        self._transport_fails = 0
+
+    def refresh(self, row: Dict[str, Any]):
+        """A fresh lease row for this replica (the serving section)."""
+        self._signals = dict(row or {})
+        self._missing_since = None
+
+    def health(self) -> str:
+        if self._lost:
+            return "dead"
+        return str(self._signals.get("health") or "ready")
+
+    def serviceable(self) -> bool:
+        return not self._lost and self.health() not in ("draining", "dead")
+
+    def signals(self) -> Dict[str, Any]:
+        return self._signals
+
+    def pending(self) -> int:
+        sig = self._signals
+        return (int(sig.get("queue_depth") or 0)
+                + int(sig.get("inflight") or 0))
+
+    def step(self) -> bool:
+        return False  # remote replicas drive their own loop
+
+    def idle_audit(self):
+        pass
+
+    def _http(self, method: str, path: str, body=None) -> Dict[str, Any]:
+        url = f"http://{self.addr}{path}"
+        data = None if body is None else json.dumps(body).encode()
+        req = _urlreq.Request(url, data=data, method=method,
+                              headers={"Content-Type": "application/json"})
+        try:
+            with _urlreq.urlopen(req, timeout=self.http_timeout) as resp:
+                out = json.loads(resp.read().decode() or "{}")
+        except Exception as e:
+            self._transport_fails += 1
+            raise ReplicaUnreachable(
+                f"{self.name} {method} {path}: {e}") from e
+        self._transport_fails = 0
+        if "health" in out:
+            # the wire reply is fresher than the lease snapshot
+            self._signals["health"] = out["health"]
+        return out
+
+    def submit(self, prompt, *, max_new_tokens=None, eos_token_id=None,
+               deadline_ms=None, priority: str = "interactive") -> int:
+        doc = {
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+            "max_new_tokens": max_new_tokens,
+            "eos_token_id": eos_token_id,
+            "deadline_ms": deadline_ms,
+            "priority": priority,
+        }
+        return int(self._http("POST", "/submit", doc)["rid"])
+
+    def poll(self, rids) -> Dict[int, Optional[Response]]:
+        rids = list(rids)
+        if not rids:
+            return {}
+        q = ",".join(str(r) for r in rids)
+        out = self._http("GET", f"/responses?rids={q}")
+        docs = out.get("responses") or {}
+        res: Dict[int, Optional[Response]] = {}
+        for rid in rids:
+            d = docs.get(str(rid))
+            res[rid] = None if d is None else _response_from_doc(d)
+        return res
+
+    def begin_drain(self):
+        try:
+            self._http("POST", "/drain", {})
+        except ReplicaUnreachable:
+            pass  # best-effort: an unreachable replica can't drain anyway
+
+    def close(self):
+        pass  # the remote process owns its engine
+
+
+class ReplicaServer:
+    """Hosts one Engine behind a loopback HTTP plane so a cross-host
+    FrontDoor can route to it: POST /submit, GET /responses?rids=..,
+    POST /drain, GET /healthz. Sets ``engine.serve_addr`` so the obs
+    lease advertises the endpoint.
+
+    A coarse lock serializes handler threads against the pump — the
+    engine stays effectively single-threaded (its counter/queue
+    discipline assumes it), and a submit landing mid-tick waits for the
+    tick instead of racing it."""
+
+    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+                 supervised: bool = True,
+                 max_restarts: Optional[int] = None):
+        self._engine = engine
+        self._sup = Supervisor(engine, max_restarts) if supervised else None
+        self._lock = threading.RLock()
+        self._was_busy = False
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 - stdlib name
+                pass  # keep probe/test stdout clean
+
+            def _send(self, code: int, doc: Dict[str, Any]):
+                body = json.dumps(doc).encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client timed out / died mid-response — its
+                    # router re-polls or reroutes; a handler-thread
+                    # traceback dump is the only thing to suppress here
+                    pass
+
+            def do_POST(self):  # noqa: N802 - stdlib name
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n).decode() or "{}")
+                except ValueError:
+                    return self._send(400, {"error": "bad json"})
+                path = urlparse(self.path).path
+                if path == "/submit":
+                    return self._send(200, server._handle_submit(body))
+                if path == "/drain":
+                    return self._send(200, server._handle_drain())
+                self._send(404, {"error": f"no such endpoint {path}"})
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                u = urlparse(self.path)
+                if u.path == "/responses":
+                    rids: List[int] = []
+                    for part in (parse_qs(u.query).get("rids") or []):
+                        rids += [int(t) for t in part.split(",")
+                                 if t.strip()]
+                    return self._send(200, server._handle_poll(rids))
+                if u.path == "/healthz":
+                    with server._lock:
+                        return self._send(200, {
+                            "health": server._engine.health,
+                            "signals": server._engine.routing_signals(),
+                        })
+                self._send(404, {"error": f"no such endpoint {u.path}"})
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        engine.serve_addr = self.addr
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"replica-server[{engine._uid}]", daemon=True)
+        self._started = False
+
+    # -- handlers (HTTP threads, serialized by the lock) -----------------
+    def _handle_submit(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            rid = self._engine.submit(
+                np.asarray(body["prompt"], np.int64),
+                max_new_tokens=body.get("max_new_tokens"),
+                eos_token_id=body.get("eos_token_id"),
+                deadline_ms=body.get("deadline_ms"),
+                priority=body.get("priority") or "interactive",
+            )
+            return {"rid": int(rid), "health": self._engine.health}
+
+    def _handle_poll(self, rids: List[int]) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for rid in rids:
+                r = self._engine.pop_response(rid)
+                out[str(rid)] = None if r is None else _response_to_doc(r)
+            return {"responses": out, "health": self._engine.health}
+
+    def _handle_drain(self) -> Dict[str, Any]:
+        with self._lock:
+            self._engine.begin_drain()
+            return {"health": self._engine.health}
+
+    # -- the serving loop ------------------------------------------------
+    def start(self) -> "ReplicaServer":
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        return self
+
+    def pump(self) -> bool:
+        """One supervised engine tick if there is work; the idle edge
+        runs the engine's drop/leak audit and stands its watchdog down."""
+        with self._lock:
+            busy = self._engine.pending and self._engine.health != "dead"
+            if busy:
+                (self._sup or self._engine).step()
+                self._was_busy = True
+            elif self._was_busy:
+                self._was_busy = False
+                from ..profiler import trace as _trace
+
+                self._engine._audit_drops()
+                _trace.watchdog_disarm(f"serve[{self._engine._uid}]")
+            return bool(busy)
+
+    def run(self, *, publisher=None, publish_every_s: float = 0.5,
+            poll_s: float = 0.005,
+            should_stop: Optional[Callable[[], bool]] = None):
+        """Drive the replica: pump the engine, publish the obs lease at
+        a fixed cadence, sleep only when idle. This is the replica
+        worker's main loop in tools/serve_fleet_probe.py."""
+        self.start()
+        last_pub = 0.0
+        while should_stop is None or not should_stop():
+            busy = self.pump()
+            now = time.monotonic()
+            if publisher is not None and now - last_pub >= publish_every_s:
+                last_pub = now
+                try:
+                    publisher.publish()
+                except Exception:
+                    pass  # obs is observability: fail soft, keep serving
+            if not busy:
+                time.sleep(poll_s)
+            else:
+                # the handler threads contend on the same coarse lock;
+                # an unfair back-to-back reacquire would starve submits
+                # and polls for as long as the engine stays busy
+                time.sleep(0.001)
+
+    def close(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        if self._sup is not None:
+            self._sup.close()
+
+
+# ---------------------------------------------------------------------------
+# the front door
+# ---------------------------------------------------------------------------
+class _Tracked:
+    """One request the front door owns until it holds a terminal
+    response. ``reroutes`` is the router-level failover count — separate
+    from the engine-level Request.retries budget by design."""
+
+    __slots__ = ("frid", "prompt", "max_new_tokens", "eos_token_id",
+                 "deadline_ms", "priority", "submit_time", "replica", "rid",
+                 "reroutes", "not_before", "last_response")
+
+    def __init__(self, frid, prompt, max_new_tokens, eos_token_id,
+                 deadline_ms, priority, submit_time):
+        self.frid = frid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.deadline_ms = deadline_ms
+        self.priority = priority
+        self.submit_time = submit_time
+        self.replica = None
+        self.rid: Optional[int] = None
+        self.reroutes = 0
+        # earliest re-dispatch time for shed work (the retry_after_ms hint)
+        self.not_before: Optional[float] = None
+        # last shed response: passed through if the reroute budget runs out
+        self.last_response: Optional[Response] = None
+
+
+class FrontDoor:
+    """The fleet request router. See the module docstring for the
+    contract; the short version:
+
+        fd = paddle.serving.FrontDoor([engine_a, engine_b])
+        frids = [fd.submit(p, max_new_tokens=16) for p in prompts]
+        fd.run_until_idle()
+        out = [fd.pop_response(i) for i in frids]
+
+    ``engines`` may mix raw Engines (wrapped into LocalReplica) with
+    pre-built Local/RemoteReplica objects; ``aggregator`` (a
+    fleet.obs.FleetAggregator) adds lease-discovered remote replicas;
+    ``coordinator`` (a fleet.elastic.RescaleCoordinator) receives
+    autoscale proposals when FLAGS_router_autoscale_p99_ms > 0."""
+
+    def __init__(self, engines: Seq = (), *, aggregator=None,
+                 coordinator=None, supervised: bool = True,
+                 max_restarts: Optional[int] = None,
+                 on_grow: Optional[Callable] = None,
+                 on_shrink: Optional[Callable] = None,
+                 http_timeout: float = 2.0):
+        self._replicas: List[Any] = []
+        for eng in engines:
+            if isinstance(eng, (LocalReplica, RemoteReplica)):
+                self._replicas.append(eng)
+            else:
+                self._replicas.append(LocalReplica(
+                    eng, supervised=supervised, max_restarts=max_restarts))
+        self._aggregator = aggregator
+        self.http_timeout = float(http_timeout)
+        self._remote_by_addr: Dict[str, RemoteReplica] = {
+            rep.addr: rep for rep in self._replicas
+            if isinstance(rep, RemoteReplica)}
+        self._tracked: Dict[int, _Tracked] = {}
+        self._parked: List[int] = []
+        self._responses: Dict[int, Response] = {}
+        self._submitted: set = set()
+        self._retiring: List[Any] = []   # replicas draining toward close
+        self._draining = False
+        self._drain_flushed = False
+        self._rr = 0                      # round-robin tiebreak cursor
+        self._last_refresh: Optional[float] = None
+        self._prev_handlers: Dict[int, Any] = {}
+        self._poll_s = 0.005
+        self._autoscaler = FleetAutoscaler(
+            self, coordinator=coordinator, on_grow=on_grow,
+            on_shrink=on_shrink)
+
+    # -- clock (a method so tests drive it virtually) --------------------
+    def _now(self) -> float:
+        return time.time()
+
+    # -- replica management ----------------------------------------------
+    @property
+    def replicas(self) -> List[Any]:
+        return list(self._replicas)
+
+    def add_replica(self, engine_or_replica, *, supervised: bool = True,
+                    max_restarts: Optional[int] = None):
+        """Attach one more replica (the scale-up path: a freshly started
+        engine or a newly discovered remote)."""
+        from ..core import dispatch
+
+        rep = engine_or_replica
+        if not isinstance(rep, (LocalReplica, RemoteReplica)):
+            rep = LocalReplica(rep, supervised=supervised,
+                               max_restarts=max_restarts)
+        self._replicas.append(rep)
+        if isinstance(rep, RemoteReplica):
+            self._remote_by_addr[rep.addr] = rep
+        dispatch._emit("route", site="frontdoor", phase="replica_join",
+                       replica=rep.name, replica_kind=rep.kind)
+        return rep
+
+    def _alive(self, rep) -> bool:
+        return not getattr(rep, "_lost", False) and rep.health() != "dead"
+
+    def _inflight_to(self, rep) -> int:
+        return sum(1 for t in self._tracked.values() if t.replica is rep)
+
+    def _local_addrs(self) -> set:
+        return {rep.engine.serve_addr for rep in self._replicas
+                if isinstance(rep, LocalReplica)
+                and rep.engine.serve_addr}
+
+    # -- lease-plane refresh ----------------------------------------------
+    def refresh_routing(self, force: bool = False):
+        """Re-read the obs leases: join newly advertised replicas, update
+        remote signals/health, and start the grace clock for replicas
+        absent from a SUCCESSFUL read. A failed read (master partition)
+        keeps the last-known table — routing degrades to stale signals,
+        never to a dropped fleet."""
+        from ..core import dispatch
+
+        if self._aggregator is None:
+            return
+        now = self._now()
+        if (not force and self._last_refresh is not None
+                and now - self._last_refresh < float(
+                    flags.flag("router_refresh_s"))):
+            return
+        self._last_refresh = now
+        try:
+            snaps = self._aggregator.snapshots()
+        except Exception:
+            dispatch._counters["router_lease_read_failures"] += 1
+            dispatch._emit("route", site="frontdoor",
+                           phase="lease_read_failed")
+            return
+        local_addrs = self._local_addrs()
+        seen: set = set()
+        for node in sorted(snaps):
+            for row in (snaps[node].get("serving") or []):
+                addr = (row or {}).get("serve_addr")
+                if not addr or addr in local_addrs:
+                    continue  # our own engines are routed live, not by lease
+                seen.add(addr)
+                rep = self._remote_by_addr.get(addr)
+                if rep is None:
+                    rep = RemoteReplica(node, addr,
+                                        engine=row.get("engine"),
+                                        http_timeout=self.http_timeout)
+                    self._remote_by_addr[addr] = rep
+                    self._replicas.append(rep)
+                    dispatch._emit("route", site="frontdoor",
+                                   phase="replica_join", replica=rep.name,
+                                   replica_kind="remote")
+                rep.refresh(row)
+        grace = float(flags.flag("router_lease_grace_s"))
+        for addr, rep in list(self._remote_by_addr.items()):
+            if rep._lost or addr in seen:
+                continue
+            if rep._missing_since is None:
+                rep._missing_since = now
+            elif now - rep._missing_since > grace:
+                self._lose_replica(
+                    rep, f"lease lost (absent {now - rep._missing_since:.1f}"
+                         f"s > FLAGS_router_lease_grace_s)")
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, t: _Tracked, exclude=()):
+        """Cost-predicted replica choice: lowest predicted completion from
+        the replica's own measured EMAs, backlog-weighted; degraded is
+        last-resort; during a router drain remote peers are preferred
+        (the local engines are about to stop admitting)."""
+        pool = health_pool([r for r in self._replicas
+                            if r not in exclude
+                            and r not in self._retiring])
+        if not pool:
+            return None
+        if self._draining:
+            remote = [r for r in pool if r.kind == "remote"]
+            pool = remote or pool
+        max_new = int(t.max_new_tokens
+                      or flags.flag("serving_max_new_tokens"))
+        best = None
+        best_key = None
+        for i, r in enumerate(pool):
+            sig = r.signals() or {}
+            tok = float(sig.get("tok_ema_ms") or 0.0)
+            pre = float(sig.get("prefill_ema_ms") or 0.0)
+            # lease signals lag: trust whichever backlog estimate is
+            # larger — the replica's own count or what WE routed there
+            backlog = max(
+                int(sig.get("queue_depth") or 0)
+                + int(sig.get("inflight") or 0),
+                self._inflight_to(r))
+            predicted = pre + max_new * tok * (1 + backlog)
+            key = (predicted, backlog, (i - self._rr) % len(pool))
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        self._rr += 1
+        return best
+
+    def _dispatch(self, t: _Tracked, exclude=()) -> bool:
+        """Route one request to the best replica; False → caller parks."""
+        from ..core import dispatch
+
+        tried = set(exclude)
+        while True:
+            rep = self._pick(t, exclude=tried)
+            if rep is None:
+                t.replica, t.rid = None, None
+                return False
+            dl = None
+            if t.deadline_ms is not None:
+                # the deadline is wall-clock from the ORIGINAL submit:
+                # a reroute dispatches with the remaining budget, not a
+                # fresh one
+                elapsed = (self._now() - t.submit_time) * 1000.0
+                dl = max(1.0, t.deadline_ms - elapsed)
+            try:
+                rid = rep.submit(
+                    t.prompt, max_new_tokens=t.max_new_tokens,
+                    eos_token_id=t.eos_token_id,
+                    # explicit 0 = the engine's documented no-deadline
+                    # opt-out (None would re-apply the engine default)
+                    deadline_ms=(0 if dl is None else dl),
+                    priority=t.priority)
+            except ReplicaUnreachable:
+                tried.add(rep)
+                self._check_transport(rep)
+                continue
+            t.replica, t.rid = rep, rid
+            t.not_before = None
+            dispatch._counters["router_routed"] += 1
+            if self._draining and rep.kind == "remote":
+                dispatch._counters["router_drain_handoffs"] += 1
+                dispatch._emit("route", site="frontdoor",
+                               phase="drain_handoff", frid=t.frid,
+                               replica=rep.name)
+            dispatch._emit("route", site="frontdoor", phase="dispatch",
+                           frid=t.frid, replica=rep.name, rid=rid,
+                           reroutes=t.reroutes)
+            return True
+
+    def _park(self, t: _Tracked):
+        if t.frid not in self._parked:
+            self._parked.append(t.frid)
+
+    def _check_transport(self, rep):
+        if (rep.kind == "remote" and not rep._lost
+                and rep._transport_fails > int(
+                    flags.flag("router_replica_retries"))):
+            self._lose_replica(
+                rep, f"unreachable after {rep._transport_fails} "
+                     "consecutive transport failures")
+
+    def _lose_replica(self, rep, why: str):
+        """Declare one replica dead and fail ALL of its work over to
+        survivors — queued and in-flight alike (greedy decode makes the
+        re-runs bitwise-identical)."""
+        from ..core import dispatch
+
+        if getattr(rep, "_lost", False):
+            return
+        rep._lost = True
+        dispatch._counters["router_replicas_lost"] += 1
+        dispatch._emit("route", site="frontdoor", phase="replica_lost",
+                       replica=rep.name, why=why[:160])
+        for t in list(self._tracked.values()):
+            if t.replica is rep:
+                self._reroute(t, f"replica {rep.name} lost: {why}")
+
+    def _reroute(self, t: _Tracked, why: str, *,
+                 shed_hint_ms: Optional[float] = None,
+                 kind: str = "reroute"):
+        """Re-dispatch one request to a survivor. Counted in
+        router_reroutes / router_shed_reroutes — NEVER in the engine-level
+        retry budget. Past FLAGS_router_reroute_budget: the last shed
+        response passes through (still retriable), or a structured error."""
+        from ..core import dispatch
+
+        prev = t.replica
+        t.replica, t.rid = None, None
+        t.reroutes += 1
+        budget = int(flags.flag("router_reroute_budget"))
+        if t.reroutes > budget:
+            resp = t.last_response
+            if resp is None:
+                resp = Response(
+                    request_id=t.frid, status="error",
+                    error=(f"reroute budget exhausted after {t.reroutes - 1}"
+                           f" reroutes (FLAGS_router_reroute_budget="
+                           f"{budget}): {why}"),
+                    retriable=True, prompt_len=int(t.prompt.size),
+                    submit_time=t.submit_time, done_time=time.time())
+            dispatch._emit("route", site="frontdoor",
+                           phase="reroute_exhausted", frid=t.frid,
+                           reroutes=t.reroutes - 1, why=why[:160])
+            self._finish(t, resp)
+            return
+        counter = ("router_shed_reroutes" if kind == "shed"
+                   else "router_reroutes")
+        dispatch._counters[counter] += 1
+        dispatch._emit("route", site="frontdoor", phase=kind, frid=t.frid,
+                       prev=(prev.name if prev is not None else None),
+                       n=t.reroutes, why=why[:160])
+        if shed_hint_ms is not None:
+            # honor the shedding replica's retry_after_ms before trying
+            # again; a DIFFERENT sibling may take it immediately
+            t.not_before = self._now() + float(shed_hint_ms) / 1000.0
+            if self._dispatch(t, exclude=(prev,) if prev else ()):
+                return
+        elif self._dispatch(t, exclude=(prev,) if prev else ()):
+            return
+        self._park(t)
+
+    # -- terminal bookkeeping ---------------------------------------------
+    def _finish(self, t: _Tracked, resp: Response):
+        from ..core import dispatch
+
+        resp.request_id = t.frid  # responses live in the ROUTER id space
+        self._responses[t.frid] = resp
+        self._tracked.pop(t.frid, None)
+        if t.frid in self._parked:
+            self._parked.remove(t.frid)
+        dispatch._emit("route", site="frontdoor", phase="final",
+                       frid=t.frid, status=resp.status,
+                       reroutes=t.reroutes)
+
+    def _handle_response(self, t: _Tracked, resp: Response):
+        rep = t.replica
+        st = resp.status
+        if st == "overloaded":
+            # shed: the replica was busy, not broken — re-dispatch to a
+            # sibling, honoring the measured retry_after_ms hint
+            t.last_response = resp
+            self._reroute(
+                t, f"shed by {rep.name if rep else '?'}",
+                shed_hint_ms=resp.retry_after_ms, kind="shed")
+            return
+        if st == "rejected" and rep is not None and not rep.serviceable():
+            # draining/dead replica refusing admission: replica-state
+            # rejection, not a verdict on the request — try a survivor
+            self._reroute(t, f"rejected by non-serviceable {rep.name}")
+            return
+        if st == "error" and rep is not None and not rep.serviceable():
+            # the replica failed (fail_clean / drain teardown), not the
+            # request: greedy decode re-runs it identically elsewhere
+            self._reroute(t, f"replica failure on {rep.name}: {resp.error}")
+            return
+        # ok / timeout / intrinsic rejection / genuine request error
+        self._finish(t, resp)
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               priority: str = "interactive") -> int:
+        """Route one request into the fleet; returns the front-door
+        request id (the router's own id space — replica-local ids are an
+        implementation detail that changes across failovers)."""
+        from ..core import dispatch
+
+        frid = next(_FRONTDOOR_IDS)
+        if deadline_ms is None:
+            default_dl = float(flags.flag("serving_default_deadline_ms"))
+            deadline_ms = default_dl if default_dl > 0 else None
+        elif deadline_ms == 0:
+            deadline_ms = None
+        dispatch._counters["router_requests"] += 1
+        self._submitted.add(frid)
+        if self._draining:
+            self._responses[frid] = Response(
+                request_id=frid, status="rejected",
+                error="front door is draining (preemption)",
+                prompt_len=int(np.asarray(prompt).size),
+                submit_time=self._now(), done_time=time.time())
+            dispatch._emit("route", site="frontdoor", phase="reject",
+                           frid=frid, why="draining")
+            return frid
+        t = _Tracked(
+            frid=frid,
+            prompt=np.asarray(prompt, np.int64).reshape(-1),
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            deadline_ms=deadline_ms, priority=priority,
+            submit_time=self._now())
+        self._tracked[frid] = t
+        dispatch._emit("route", site="frontdoor", phase="accept",
+                       frid=frid, prompt_len=int(t.prompt.size),
+                       priority=priority)
+        if not self._dispatch(t):
+            self._park(t)
+        return frid
+
+    def response(self, frid: int) -> Optional[Response]:
+        return self._responses.get(frid)
+
+    def pop_response(self, frid: int) -> Optional[Response]:
+        r = self._responses.pop(frid, None)
+        if r is not None:
+            self._submitted.discard(frid)
+        return r
+
+    @property
+    def pending(self) -> int:
+        """Requests the front door has accepted but not yet answered."""
+        return len(self._tracked)
+
+    def pump(self) -> bool:
+        """One router tick: refresh the table, sweep dead replicas (fail
+        their work over), step local engines, poll for responses,
+        re-dispatch parked work, tick the autoscaler. Returns True when a
+        local engine made progress (the run_until_idle sleep gate)."""
+        now = self._now()
+        if self._draining and not self._drain_flushed:
+            self._flush_drain()
+        self.refresh_routing()
+        self._sweep_replicas()
+        progressed = False
+        for rep in list(self._replicas):
+            if rep.kind == "local" and not rep._lost:
+                progressed = rep.step() or progressed
+        self._poll()
+        self._redispatch_parked(now)
+        self._finish_orphans()
+        self._autoscaler.tick(now)
+        self._close_retired()
+        return progressed
+
+    def _sweep_replicas(self):
+        for rep in list(self._replicas):
+            if getattr(rep, "_lost", False):
+                continue
+            if rep.kind == "local" and rep.health() == "dead":
+                # fail_clean already answered everything with terminal
+                # errors INSIDE the engine — the router reroutes instead
+                # of passing an engine's death through to callers
+                self._lose_replica(
+                    rep, "engine dead (restart budget exhausted)")
+            elif (rep.kind == "remote"
+                  and rep._transport_fails > int(
+                      flags.flag("router_replica_retries"))):
+                self._lose_replica(
+                    rep, f"unreachable after {rep._transport_fails} "
+                         "consecutive transport failures")
+
+    def _poll(self):
+        by_rep: Dict[int, List[_Tracked]] = {}
+        reps: Dict[int, Any] = {}
+        for t in self._tracked.values():
+            if t.replica is not None and not getattr(t.replica, "_lost",
+                                                     False):
+                key = id(t.replica)
+                reps[key] = t.replica
+                by_rep.setdefault(key, []).append(t)
+        for key, ts in by_rep.items():
+            rep = reps[key]
+            try:
+                res = rep.poll([t.rid for t in ts])
+            except ReplicaUnreachable:
+                self._check_transport(rep)
+                continue  # responses stay queued on the replica
+            for t in ts:
+                resp = res.get(t.rid)
+                if resp is not None and t.frid in self._tracked:
+                    self._handle_response(t, resp)
+
+    def _redispatch_parked(self, now: float):
+        still: List[int] = []
+        for frid in self._parked:
+            t = self._tracked.get(frid)
+            if t is None or t.replica is not None:
+                continue  # finished or re-dispatched since parking
+            if t.not_before is not None and now < t.not_before:
+                still.append(frid)
+                continue
+            t.not_before = None
+            if not self._dispatch(t):
+                still.append(frid)
+        self._parked = still
+
+    def _finish_orphans(self):
+        """When EVERY replica is dead/lost, outstanding work can never
+        complete — answer it with a structured retriable error now (zero
+        hangs) instead of spinning until a timeout."""
+        if not self._tracked or any(self._alive(r) for r in self._replicas):
+            return
+        for frid in list(self._tracked):
+            t = self._tracked[frid]
+            self._finish(t, Response(
+                request_id=frid, status="error",
+                error="no serviceable replica remains (every replica is "
+                      "dead or lost)",
+                retriable=True, prompt_len=int(t.prompt.size),
+                submit_time=t.submit_time, done_time=time.time()))
+
+    def run_until_idle(self, timeout_s: Optional[float] = None):
+        """Drive the fleet until every submitted request holds a terminal
+        response, then run the zero-drop audit (the engine
+        run_until_idle contract, fleet-wide). ``timeout_s`` is a backstop
+        for remote fleets: on expiry the outstanding work answers a
+        structured error — never a hang."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + float(timeout_s))
+        while self._tracked:
+            progressed = self.pump()
+            if deadline is not None and time.monotonic() > deadline:
+                for frid in list(self._tracked):
+                    t = self._tracked[frid]
+                    self._finish(t, Response(
+                        request_id=frid, status="error",
+                        error=(f"front door run_until_idle timed out after "
+                               f"{timeout_s:.1f}s with the request still "
+                               "outstanding"),
+                        retriable=True, prompt_len=int(t.prompt.size),
+                        submit_time=t.submit_time, done_time=time.time()))
+                break
+            if not progressed and self._tracked:
+                time.sleep(self._poll_s)  # remote-only work: don't busy-spin
+        self._audit()
+        for rep in self._replicas:
+            rep.idle_audit()
+
+    def _audit(self):
+        """The fleet drop tripwire: every submitted id must hold exactly
+        one response. Violations count router_requests_dropped (the chaos
+        gate fails on any) and answer an error so no caller hangs."""
+        from ..core import dispatch
+
+        missing = self._submitted - set(self._responses)
+        for frid in missing:
+            dispatch._counters["router_requests_dropped"] += 1
+            self._responses[frid] = Response(
+                request_id=frid, status="error",
+                error="request lost by the front door (dropped) — "
+                      "router bug",
+                done_time=time.time())
+        self._submitted -= missing
+
+    def serve(self, prompts: Seq, **submit_kw) -> List[Response]:
+        """Submit every prompt, run the fleet to idle, return (and evict)
+        the responses in submit order."""
+        frids = [self.submit(p, **submit_kw) for p in prompts]
+        self.run_until_idle()
+        return [self.pop_response(i) for i in frids]
+
+    # -- preemption drain --------------------------------------------------
+    def begin_drain(self):
+        """Stop admitting; the flush (hand parked work to peers, drain
+        local engines) runs at the next pump — this method is safe to
+        call from a signal handler (flag writes only)."""
+        from ..core import dispatch
+
+        if self._draining:
+            return
+        self._draining = True
+        dispatch._emit("route", site="frontdoor", phase="drain_begin",
+                       outstanding=len(self._tracked))
+
+    def _flush_drain(self):
+        """The drain choreography, in order: (1) dispatch router-parked
+        work while replicas still admit — remote peers preferred by
+        _pick's drain rule; (2) THEN drain the local engines (their
+        in-flight completes under the engine drain contract)."""
+        self._drain_flushed = True
+        for frid in list(self._parked):
+            t = self._tracked.get(frid)
+            if t is None or t.replica is not None:
+                continue
+            t.not_before = None
+            if self._dispatch(t):
+                self._parked.remove(frid)
+        for rep in self._replicas:
+            if rep.kind == "local" and not rep._lost:
+                rep.begin_drain()
+
+    def drain(self) -> List[Response]:
+        """begin_drain + run to idle; returns every retained response."""
+        self.begin_drain()
+        self.run_until_idle()
+        return list(self._responses.values())
+
+    def install_preemption_handler(self, signals=(_signal.SIGTERM,)):
+        for s in signals:
+            if s in self._prev_handlers:
+                continue  # already installed — keep the ORIGINAL previous
+            self._prev_handlers[s] = _signal.signal(
+                s, lambda signum, frame: self.begin_drain())
+
+    def uninstall_preemption_handler(self):
+        for s, h in self._prev_handlers.items():
+            _signal.signal(s, h)
+        self._prev_handlers.clear()
+
+    # -- autoscale plumbing ------------------------------------------------
+    def _retire_one(self):
+        """Graceful shrink: drain the least-loaded serviceable LOCAL
+        replica (never the last live one); it closes at idle in
+        _close_retired. Remote-only fleets just emit the proposal — the
+        external fleet manager owns those processes."""
+        from ..core import dispatch
+
+        cands = [r for r in self._replicas
+                 if r.kind == "local" and r.serviceable()
+                 and r not in self._retiring]
+        if not cands or sum(1 for r in self._replicas
+                            if self._alive(r)) <= 1:
+            return None
+        victim = min(cands, key=lambda r: (r.pending(),
+                                           self._inflight_to(r)))
+        victim.begin_drain()
+        self._retiring.append(victim)
+        dispatch._emit("route", site="frontdoor", phase="replica_retire",
+                       replica=victim.name)
+        return victim
+
+    def _close_retired(self):
+        for rep in list(self._retiring):
+            if rep.pending() == 0 and self._inflight_to(rep) == 0:
+                from ..core import dispatch
+
+                rep.idle_audit()
+                rep.close()
+                self._retiring.remove(rep)
+                if rep in self._replicas:
+                    self._replicas.remove(rep)
+                dispatch._emit("route", site="frontdoor",
+                               phase="replica_retired", replica=rep.name)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replicas": [{
+                "name": r.name, "kind": r.kind, "health": r.health(),
+                "lost": bool(getattr(r, "_lost", False)),
+                "retiring": r in self._retiring,
+                "signals": r.signals(),
+            } for r in self._replicas],
+            "outstanding": len(self._tracked),
+            "parked": len(self._parked),
+            "draining": self._draining,
+            "autoscale": self._autoscaler.state(),
+        }
+
+    def close(self, close_replicas: bool = True):
+        self.uninstall_preemption_handler()
+        if close_replicas:
+            for rep in self._replicas:
+                try:
+                    rep.close()
+                except Exception:
+                    pass
+        self._replicas = []
+        self._remote_by_addr = {}
+
+
+class FleetAutoscaler:
+    """Debounced fleet-size proposals from measured serving signals.
+
+    GROW: the fleet-merged queue-wait p99 (max over live replicas' PR 10
+    trip-wire windows) above FLAGS_router_autoscale_p99_ms for
+    FLAGS_router_autoscale_sustain_s proposes n+1 through the
+    RescaleCoordinator serve-scale document (and the on_grow callback —
+    the probe's fleet manager spawns the replica and acks).
+
+    SHRINK: a fully idle fleet (no tracked, queued, or in-flight work
+    anywhere) for FLAGS_router_autoscale_idle_s proposes n-1 and
+    gracefully drains the least-loaded local replica.
+
+    Entirely off while FLAGS_router_autoscale_p99_ms is 0 (the default).
+    All state is wall-clock-parameterized through tick(now) so tests
+    drive it with a virtual clock."""
+
+    def __init__(self, frontdoor: FrontDoor, *, coordinator=None,
+                 on_grow: Optional[Callable] = None,
+                 on_shrink: Optional[Callable] = None):
+        self._fd = frontdoor
+        self._coordinator = coordinator
+        self._on_grow = on_grow
+        self._on_shrink = on_shrink
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._cooldown_until: Optional[float] = None
+        self.grow_proposals = 0
+        self.shrink_proposals = 0
+        self._last: Optional[Dict[str, Any]] = None
+
+    def fleet_queue_wait_p99(self) -> Optional[float]:
+        """Max of the live replicas' recent-window queue-wait p99s — the
+        conservative fleet SLO view (one overwhelmed replica IS a breach;
+        routing should have balanced it away, so a sustained max means
+        the whole fleet is out of headroom)."""
+        vals = []
+        for rep in self._fd._replicas:
+            if not self._fd._alive(rep):
+                continue
+            adm = (rep.signals() or {}).get("admission") or {}
+            v = adm.get("queue_wait_p99_ms")
+            if v is not None:
+                vals.append(float(v))
+        return max(vals) if vals else None
+
+    def _fleet_idle(self) -> bool:
+        if self._fd._tracked:
+            return False
+        for rep in self._fd._replicas:
+            if not self._fd._alive(rep):
+                continue
+            sig = rep.signals() or {}
+            if (sig.get("queue_depth") or 0) or (sig.get("inflight") or 0):
+                return False
+        return True
+
+    def _n_live(self) -> int:
+        return sum(1 for r in self._fd._replicas
+                   if self._fd._alive(r) and r not in self._fd._retiring)
+
+    def tick(self, now: float) -> Optional[int]:
+        """One debounce step; returns the proposal id when one fired."""
+        from ..core import dispatch
+
+        breach_ms = float(flags.flag("router_autoscale_p99_ms"))
+        if breach_ms <= 0:
+            return None  # autoscale proposals off (the default)
+        if self._cooldown_until is not None and now < self._cooldown_until:
+            return None
+        sustain = float(flags.flag("router_autoscale_sustain_s"))
+        idle_s = float(flags.flag("router_autoscale_idle_s"))
+        n = self._n_live()
+        p99 = self.fleet_queue_wait_p99()
+        if p99 is not None and p99 > breach_ms:
+            self._idle_since = None
+            if self._breach_since is None:
+                self._breach_since = now
+                dispatch._emit("route", site="autoscaler",
+                               phase="breach_open", p99_ms=round(p99, 3))
+            elif now - self._breach_since >= sustain:
+                return self._propose(
+                    "grow", n + 1, now,
+                    f"fleet queue-wait p99 {p99:.1f} ms > "
+                    f"{breach_ms:.1f} ms sustained "
+                    f"{now - self._breach_since:.1f}s", p99)
+            return None
+        self._breach_since = None
+        if idle_s > 0 and n > 1 and self._fleet_idle():
+            if self._idle_since is None:
+                self._idle_since = now
+            elif now - self._idle_since >= idle_s:
+                return self._propose(
+                    "shrink", n - 1, now,
+                    f"fleet idle {now - self._idle_since:.1f}s", p99)
+        else:
+            self._idle_since = None
+        return None
+
+    def _propose(self, kind: str, target: int, now: float, why: str,
+                 p99: Optional[float]) -> Optional[int]:
+        from ..core import dispatch
+
+        self._cooldown_until = now + float(
+            flags.flag("router_autoscale_cooldown_s"))
+        self._breach_since = None
+        self._idle_since = None
+        proposal = None
+        if self._coordinator is not None:
+            try:
+                proposal = self._coordinator.propose_serve_scale(
+                    target, reason=why, kind=kind,
+                    signals={"queue_wait_p99_ms": p99,
+                             "replicas": self._n_live()})
+            except Exception as e:
+                dispatch._emit("route", site="autoscaler",
+                               phase="propose_failed",
+                               error=str(e)[:160])
+        if kind == "grow":
+            dispatch._counters["router_autoscale_grow_proposals"] += 1
+            self.grow_proposals += 1
+        else:
+            dispatch._counters["router_autoscale_shrink_proposals"] += 1
+            self.shrink_proposals += 1
+        dispatch._emit("route", site="autoscaler", phase=kind,
+                       target=target, proposal=proposal, why=why[:160])
+        self._last = {"kind": kind, "target": target,
+                      "proposal": proposal, "at": now, "why": why}
+        if kind == "grow" and self._on_grow is not None:
+            try:
+                self._on_grow(target, proposal)
+            except Exception:
+                pass  # the callback is advisory; the doc is the contract
+        if kind == "shrink":
+            self._fd._retire_one()
+            if self._on_shrink is not None:
+                try:
+                    self._on_shrink(target, proposal)
+                except Exception:
+                    pass
+        return proposal
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "enabled": float(flags.flag("router_autoscale_p99_ms")) > 0,
+            "grow_proposals": self.grow_proposals,
+            "shrink_proposals": self.shrink_proposals,
+            "breach_since": self._breach_since,
+            "idle_since": self._idle_since,
+            "cooldown_until": self._cooldown_until,
+            "last": self._last,
+        }

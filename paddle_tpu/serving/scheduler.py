@@ -127,6 +127,11 @@ class Response:
     # per-generated-token logits rows ([vocab] float arrays) when the
     # engine runs with keep_logits=True (parity tests / debugging)
     logits: Optional[List[np.ndarray]] = None
+    # for 'overloaded' (shed) responses: how long the admission controller
+    # estimates the caller (or the FrontDoor re-dispatching to a sibling)
+    # should wait before retrying, from the measured queue-wait EMA; None
+    # when the controller has no measured waits yet
+    retry_after_ms: Optional[float] = None
 
     @property
     def ok(self) -> bool:

@@ -153,6 +153,40 @@ def test_merged_chrome_trace_per_host_lanes_and_clock_alignment():
     assert kinds == {"probe:fleet"}
 
 
+def test_merged_chrome_trace_serving_lanes_host_prefixed_ids():
+    """ISSUE 20 satellite: chrome async (b/n/e) events match by cat+id
+    GLOBALLY, not per pid — two replicas serving the same request-id
+    space must not interleave into one corrupted lane. The merged trace
+    prefixes each serving lane id with the escaped host label."""
+    trace.emit("serve", site="engine", phase="admit", rid=3)
+    trace.emit("serve", site="engine", phase="complete", rid=3, tokens=4)
+    addr = diag.start(port=0)
+    kv = MemoryKv()
+    evil = 'w"1'  # hostile node id: must escape exactly like exposition
+    # both nodes publish the SAME diag addr (one process stands in for
+    # two replicas with colliding rid spaces)
+    for node in ("w0", evil):
+        ObsPublisher(kv=kv, job_id="j", node_id=node, ttl=30.0,
+                     diag_addr=addr).publish(raise_errors=True)
+    doc = FleetAggregator(kv=kv, job_id="j").merged_chrome_trace(
+        kind="serve")
+    lanes = [e for e in doc["traceEvents"] if e.get("cat") == "serving"]
+    assert lanes and all(e["name"] == "request" for e in lanes)
+    esc = metrics.escape_label_value(evil)
+    assert esc != evil  # the fixture really exercises escaping
+    ids = {e["id"] for e in lanes}
+    assert ids == {"w0:3", f"{esc}:3"}  # distinct per host, same rid
+    for host_id in ids:
+        phases = sorted(e["ph"] for e in lanes if e["id"] == host_id)
+        assert phases == ["b", "e"]  # admit opens, complete closes
+    # lane pid follows the host's process lane, and args keep the raw rid
+    pid_by_host = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                   if e.get("ph") == "M"}
+    for e in lanes:
+        assert e["pid"] == pid_by_host[f'host:{e["args"]["node"]}']
+        assert e["args"]["rid"] == 3
+
+
 def test_from_elastic_reuses_manager_identity():
     from paddle_tpu.distributed.fleet.elastic import ElasticManager
 

@@ -1,0 +1,603 @@
+"""Fleet serving gate: the FrontDoor's zero-drop / bitwise-failover /
+autoscale contract proven across real processes (ISSUE 20).
+
+`serve_probe.py` proves the single-engine resilience ladder; this probe
+proves the guarantees only a FLEET can break. N replica processes each
+host one deterministic tiny-GPT Engine behind a loopback ReplicaServer
+(POST /submit, GET /responses) and advertise themselves through obs TTL
+leases (`fleet/obs.py`) on a TCP KV master. The supervisor process runs a
+`paddle.serving.FrontDoor` that discovers the fleet purely through the
+lease plane (FleetAggregator), routes on the replicas' published cost
+signals, and must survive:
+
+  sigkill     SIGKILL one replica mid-decode. Every routed-there request
+              (queued AND in-flight) must be rerouted to the survivor and
+              finish with tokens BITWISE-identical to the single-replica
+              baseline (greedy decode is deterministic); zero requests
+              dropped, the loss visible in router_replicas_lost /
+              router_reroutes — never in router_requests_dropped.
+  partition   stop the KV master mid-run (lease-plane partition). The
+              router must keep serving on its last-known routing table
+              (router_lease_read_failures counts the outage) without
+              declaring any replica lost — zero drops, bitwise finals.
+  storm       2x oversubscription: more concurrent requests than the
+              fleet's admission queues hold. Sheds come back with
+              `retry_after_ms`; the router re-dispatches (backoff-paced,
+              router_shed_reroutes) until every request completes ok —
+              zero drops, bitwise finals, no retry-budget burn.
+  scale_up    storm a 1-replica fleet with autoscale armed. The sustained
+              queue-wait-p99 breach must produce EXACTLY ONE
+              coordinator-driven grow proposal (the serve-scale KV doc,
+              read via read_serve_scale); the probe's fleet manager spawns
+              the new replica and acks; the router joins it by lease and
+              the storm completes — zero drops, bitwise finals.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/serve_fleet_probe.py \
+        [--requests 12] [--scenario all|sigkill|partition|storm|scale_up]
+
+Prints one JSON result line per scenario and "ALL SCENARIOS PASSED" (exit
+0) or the failing scenario (exit 1). Wired into CI as a slow-marked
+subprocess test (tests/test_frontdoor.py), like serve_probe /
+chaos_fleet_probe.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JOB_ID = "servefleet"
+VOCAB = 64
+MAX_NEW = 8
+REPLICA_TTL = 1.5
+PUBLISH_EVERY_S = 0.15
+
+
+def prompt_for(i):
+    """Deterministic prompt i — short enough for the 8-token bucket."""
+    import numpy as np
+
+    return ((np.arange(5, dtype=np.int64) * (2 + i % 5) + i) % (VOCAB - 2)
+            ) + 1
+
+
+# ---------------------------------------------------------------------------
+# Replica worker: one Engine behind a ReplicaServer, obs lease published
+# ---------------------------------------------------------------------------
+def _build_engine(paddle, decode_sleep_ms=0.0, num_blocks=24):
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    eng = paddle.serving.Engine(model, paddle.serving.ServingConfig(
+        block_size=8, num_blocks=num_blocks, prompt_buckets=[8, 16],
+        decode_batch_buckets=[2, 4]))
+    if decode_sleep_ms > 0:
+        # widen the mid-decode kill window / make queue waits measurable
+        orig = eng._decode_batch
+
+        def slow_decode(*a, **kw):
+            time.sleep(decode_sleep_ms / 1000.0)
+            return orig(*a, **kw)
+
+        eng._decode_batch = slow_decode
+    return eng
+
+
+def replica_main(args):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, REPO)
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.obs import ObsPublisher
+
+    wdir = args.dir
+    os.makedirs(wdir, exist_ok=True)
+    log_path = os.path.join(wdir, "log.txt")
+
+    def log(line):
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+
+    log(f"start {os.getpid()}")
+    if args.queue_max:
+        paddle.set_flags({"FLAGS_serving_queue_max": int(args.queue_max)})
+
+    eng = _build_engine(paddle, decode_sleep_ms=args.decode_sleep_ms,
+                        num_blocks=args.num_blocks)
+    srv = paddle.serving.ReplicaServer(eng).start()
+    log(f"addr {srv.addr}")
+    pub = ObsPublisher(master=args.master, job_id=JOB_ID,
+                       node_id=args.node, ttl=args.ttl)
+    stop_file = os.path.join(wdir, "stop")
+
+    def should_stop():
+        return os.path.exists(stop_file) and not eng.pending
+
+    log("ready")
+    srv.run(publisher=pub, publish_every_s=PUBLISH_EVERY_S,
+            should_stop=should_stop)
+    from paddle_tpu.core.dispatch import dispatch_counters
+
+    c = dispatch_counters()
+    log(f"audit dropped={c.get('serve_requests_dropped', 0)} "
+        f"leaks={c.get('serve_block_leaks', 0)}")
+    log(f"stats shed={c.get('serve_requests_shed', 0)} "
+        f"completed={c.get('serve_requests_completed', 0)}")
+    try:
+        pub.withdraw()
+    except Exception:
+        pass
+    srv.close()
+    log("done")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor helpers
+# ---------------------------------------------------------------------------
+def _spawn_replica(node, master, wdir, ttl=REPLICA_TTL, queue_max=0,
+                   decode_sleep_ms=0.0, num_blocks=24):
+    cmd = [sys.executable, os.path.abspath(__file__), "--replica",
+           "--node", node, "--master", master, "--dir", wdir,
+           "--ttl", str(ttl), "--queue-max", str(queue_max),
+           "--decode-sleep-ms", str(decode_sleep_ms),
+           "--num-blocks", str(num_blocks)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_CURRENT_ENDPOINT=node)
+    os.makedirs(wdir, exist_ok=True)
+    errlog = open(os.path.join(wdir, "stderr.txt"), "ab")
+    return subprocess.Popen(cmd, env=env, stdout=errlog, stderr=errlog)
+
+
+def _log_lines(wdir):
+    try:
+        with open(os.path.join(wdir, "log.txt")) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def _wait_line(wdir, prefix, timeout=90):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        for ln in _log_lines(wdir):
+            if ln.startswith(prefix):
+                return ln
+        time.sleep(0.02)
+    raise TimeoutError(f"replica in {wdir} never logged '{prefix}'")
+
+
+def _stop_replica(proc, wdir, timeout=60):
+    """Graceful stop: touch the stop file, wait for the audit line."""
+    with open(os.path.join(wdir, "stop"), "w") as f:
+        f.write("1")
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    return _wait_line(wdir, "audit ", timeout=5)
+
+
+def _start_master(port=0, retries=20):
+    from paddle_tpu.distributed.fleet.elastic import start_master
+
+    last = None
+    for _ in range(retries):
+        try:
+            return start_master(port)
+        except Exception as e:  # port in TIME_WAIT after a restart
+            last = e
+            time.sleep(0.25)
+    raise RuntimeError(f"could not start KV master on port {port}: {last}")
+
+
+def _wait_fleet(fd, n, timeout=60):
+    """Pump the router until its lease-discovered table holds n live
+    replicas (replicas publish every PUBLISH_EVERY_S)."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        fd.refresh_routing(force=True)
+        live = [r for r in fd.replicas if fd._alive(r)]
+        if len(live) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"router never discovered {n} replicas "
+                       f"(has {len(fd.replicas)})")
+
+
+def _warm_fleet(fd, baseline, k=4):
+    """Serve k requests to completion before the scenario clock starts:
+    prefill/decode programs compile here (as on any real fleet's warmup
+    traffic), so storm timing measures serving, not XLA compiles."""
+    frids = {i: fd.submit(prompt_for(i), max_new_tokens=MAX_NEW)
+             for i in range(k)}
+    fd.run_until_idle(timeout_s=120.0)
+    for i, frid in frids.items():
+        r = fd.pop_response(frid)
+        assert r is not None and r.status == "ok", ("warmup", i, r)
+        assert [int(t) for t in r.tokens] == baseline[i], ("warmup", i)
+
+
+def _baseline_tokens(n_requests):
+    """Single-replica in-process greedy reference: prompt i -> tokens."""
+    import paddle_tpu as paddle
+
+    eng = _build_engine(paddle)
+    rids = {i: eng.submit(prompt_for(i), max_new_tokens=MAX_NEW)
+            for i in range(n_requests)}
+    eng.run_until_idle()
+    out = {}
+    for i, rid in rids.items():
+        r = eng.pop_response(rid)
+        assert r is not None and r.status == "ok", (i, r)
+        out[i] = [int(t) for t in r.tokens]
+    eng.close()
+    return out
+
+
+def _run_fleet(fd, n_requests, baseline, *, mid_run=None, timeout_s=120.0):
+    """Submit the request set, optionally injecting a fault mid-run, and
+    check zero drops + bitwise parity against the baseline. Returns
+    (ok, detail dict)."""
+    from paddle_tpu.core.dispatch import dispatch_counters
+
+    frids = {i: fd.submit(prompt_for(i), max_new_tokens=MAX_NEW)
+             for i in range(n_requests)}
+    fired = False
+    t0 = time.time()
+    while fd.pending:
+        if time.time() - t0 > timeout_s:
+            fd.run_until_idle(timeout_s=0.1)  # structured-error backstop
+            break
+        if not fired and mid_run is not None and mid_run(fd):
+            fired = True
+        if not fd.pump():
+            time.sleep(fd._poll_s)
+    fd.run_until_idle(timeout_s=10.0)
+    bad, mismatched = [], []
+    for i, frid in frids.items():
+        r = fd.pop_response(frid)
+        if r is None or r.status != "ok":
+            bad.append((i, None if r is None else r.status,
+                        None if r is None else r.error))
+        elif [int(t) for t in r.tokens] != baseline[i]:
+            mismatched.append(i)
+    c = dispatch_counters()
+    detail = {
+        "requests": n_requests,
+        "not_ok": bad[:6],
+        "mismatched": mismatched[:6],
+        "dropped": c.get("router_requests_dropped", 0),
+        "reroutes": c.get("router_reroutes", 0),
+        "shed_reroutes": c.get("router_shed_reroutes", 0),
+        "replicas_lost": c.get("router_replicas_lost", 0),
+        "lease_read_failures": c.get("router_lease_read_failures", 0),
+        "fault_fired": fired or mid_run is None,
+    }
+    ok = (not bad and not mismatched and detail["dropped"] == 0
+          and detail["fault_fired"])
+    return ok, detail
+
+
+def _make_frontdoor(master, **kw):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.obs import FleetAggregator
+
+    return paddle.serving.FrontDoor(
+        aggregator=FleetAggregator(master=master, job_id=JOB_ID),
+        http_timeout=5.0, **kw)
+
+
+def _router_flags(paddle, **extra):
+    base = {
+        "FLAGS_router_refresh_s": 0.05,
+        "FLAGS_router_lease_grace_s": 3.0,
+        "FLAGS_router_replica_retries": 2,
+        "FLAGS_router_reroute_budget": 2,
+        "FLAGS_router_autoscale_p99_ms": 0.0,
+    }
+    base.update(extra)
+    paddle.set_flags(base)
+
+
+def _reset_counters():
+    from paddle_tpu.core import dispatch
+
+    with dispatch._counters_lock:
+        dispatch._reset_counters_locked()
+
+
+# ---------------------------------------------------------------------------
+# Scenarios
+# ---------------------------------------------------------------------------
+def scenario_sigkill(root, baseline, n_requests, results):
+    """Kill one of two replicas mid-decode: zero drops, bitwise reroute."""
+    import paddle_tpu as paddle
+
+    name = "sigkill"
+    _router_flags(paddle, FLAGS_router_reroute_budget=4)
+    _reset_counters()
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    dirs = [os.path.join(root, f"{name}-r{i}") for i in range(2)]
+    procs = [_spawn_replica(f"r{i}", master, dirs[i],
+                            decode_sleep_ms=20.0) for i in range(2)]
+    fd = _make_frontdoor(master)
+    try:
+        for d in dirs:
+            _wait_line(d, "ready")
+        _wait_fleet(fd, 2)
+        victim_addr = _wait_line(dirs[0], "addr ").split()[1]
+
+        def kill_victim(fd):
+            # only once the victim owns in-flight work is the kill
+            # genuinely mid-decode
+            rep = fd._remote_by_addr.get(victim_addr)
+            if rep is None or fd._inflight_to(rep) == 0:
+                return False
+            procs[0].kill()
+            procs[0].wait()
+            return True
+
+        ok, detail = _run_fleet(fd, n_requests, baseline,
+                                mid_run=kill_victim)
+        ok = ok and detail["replicas_lost"] >= 1 and detail["reroutes"] >= 1
+        clean, audit = _replica_audit_clean_after_stop(procs[1], dirs[1])
+        ok = ok and clean
+        detail["survivor_audit"] = audit
+    finally:
+        _cleanup(fd, procs, srv)
+    results.append({"scenario": name, "ok": ok, **detail})
+    return ok
+
+
+def scenario_partition(root, baseline, n_requests, results):
+    """Stop the KV master mid-run: stale-table routing, zero drops."""
+    import paddle_tpu as paddle
+
+    name = "partition"
+    _router_flags(paddle, FLAGS_router_lease_grace_s=60.0)
+    _reset_counters()
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    dirs = [os.path.join(root, f"{name}-r{i}") for i in range(2)]
+    procs = [_spawn_replica(f"r{i}", master, dirs[i],
+                            decode_sleep_ms=10.0) for i in range(2)]
+    fd = _make_frontdoor(master)
+    stopped = [False]
+    try:
+        for d in dirs:
+            _wait_line(d, "ready")
+        _wait_fleet(fd, 2)
+
+        def stop_master(fd):
+            if sum(1 for t in fd._tracked.values()
+                   if t.replica is not None) == 0:
+                return False
+            srv.stop()
+            stopped[0] = True
+            return True
+
+        ok, detail = _run_fleet(fd, n_requests, baseline,
+                                mid_run=stop_master)
+        # the partition must be observed but never amputate the fleet
+        ok = (ok and detail["lease_read_failures"] >= 1
+              and detail["replicas_lost"] == 0)
+        for p, d in zip(procs, dirs):
+            clean, audit = _replica_audit_clean_after_stop(p, d)
+            ok = ok and clean
+            detail.setdefault("audits", []).append(audit)
+    finally:
+        _cleanup(fd, procs, srv if not stopped[0] else None)
+    results.append({"scenario": name, "ok": ok, **detail})
+    return ok
+
+
+def scenario_storm(root, baseline, n_requests, results):
+    """2x oversubscription: sheds reroute with retry_after_ms backoff
+    until the whole storm completes — zero drops, bitwise."""
+    import paddle_tpu as paddle
+
+    name = "storm"
+    # tiny admission queues force real sheds at 2x; a deep reroute budget
+    # lets the backoff loop absorb them (the gate is zero DROPS)
+    _router_flags(paddle, FLAGS_router_reroute_budget=50)
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    dirs = [os.path.join(root, f"{name}-r{i}") for i in range(2)]
+    procs = [_spawn_replica(f"r{i}", master, dirs[i], queue_max=2,
+                            decode_sleep_ms=5.0, num_blocks=6)
+             for i in range(2)]
+    fd = _make_frontdoor(master)
+    try:
+        for d in dirs:
+            _wait_line(d, "ready")
+        _wait_fleet(fd, 2)
+        _warm_fleet(fd, baseline)
+        _reset_counters()
+        ok, detail = _run_fleet(fd, n_requests, baseline,
+                                timeout_s=180.0)
+        detail["oversubscription"] = round(n_requests / (2 * 2), 2)
+        ok = ok and detail["shed_reroutes"] >= 1
+        for p, d in zip(procs, dirs):
+            clean, audit = _replica_audit_clean_after_stop(p, d)
+            ok = ok and clean
+            detail.setdefault("audits", []).append(audit)
+    finally:
+        _cleanup(fd, procs, srv)
+    results.append({"scenario": name, "ok": ok, **detail})
+    return ok
+
+
+def scenario_scale_up(root, baseline, n_requests, results):
+    """Storm a 1-replica fleet with autoscale armed: exactly one
+    coordinator-driven grow; the fleet manager spawns + acks; the router
+    joins the new replica by lease and the storm completes."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.dispatch import dispatch_counters
+    from paddle_tpu.distributed.fleet.elastic import (
+        RescaleCoordinator,
+        read_serve_scale,
+    )
+    from paddle_tpu.distributed.ps import PsClient
+
+    name = "scale_up"
+    # autoscale stays disarmed through warmup; armed right before the storm
+    _router_flags(paddle, FLAGS_router_reroute_budget=50)
+    srv = _start_master(0)
+    master = f"127.0.0.1:{srv.port}"
+    dirs = [os.path.join(root, f"{name}-r0")]
+    procs = [_spawn_replica("r0", master, dirs[0], queue_max=6,
+                            decode_sleep_ms=20.0, num_blocks=12)]
+    coord = RescaleCoordinator(master=master, job_id=JOB_ID,
+                               node_id="router", np_min=1, np_max=4)
+    fd = _make_frontdoor(master, coordinator=coord)
+    manager_log = []
+    manager_kv = PsClient([master])
+
+    def fleet_manager(fd):
+        """The replica manager's half of the autoscale loop, driven from
+        the probe loop: act on the un-acked proposal exactly once."""
+        doc = read_serve_scale(manager_kv, JOB_ID)
+        if doc is None or doc.get("acked") or doc.get("kind") != "grow":
+            return False
+        nid = f"r{len(procs)}"
+        d = os.path.join(root, f"{name}-{nid}")
+        dirs.append(d)
+        procs.append(_spawn_replica(nid, master, d, queue_max=6,
+                                    decode_sleep_ms=20.0, num_blocks=12))
+        _wait_line(d, "ready")
+        coord.ack_serve_scale(doc["proposal"])
+        manager_log.append({"proposal": doc["proposal"],
+                            "target": doc["target"],
+                            "spawned": nid})
+        return True
+
+    try:
+        _wait_line(dirs[0], "ready")
+        _wait_fleet(fd, 1)
+        _warm_fleet(fd, baseline)
+        paddle.set_flags({
+            "FLAGS_router_autoscale_p99_ms": 25.0,
+            "FLAGS_router_autoscale_sustain_s": 0.5,
+            "FLAGS_router_autoscale_idle_s": 0.0,
+            "FLAGS_router_autoscale_cooldown_s": 3600.0,
+        })
+        _reset_counters()
+        ok, detail = _run_fleet(fd, n_requests, baseline,
+                                mid_run=fleet_manager, timeout_s=180.0)
+        c = dispatch_counters()
+        grows = c.get("router_autoscale_grow_proposals", 0)
+        detail["grow_proposals"] = grows
+        detail["manager_log"] = manager_log
+        detail["fleet_size"] = len(procs)
+        # exactly ONE grow: the serve-scale doc suppresses re-proposal
+        # until acked, and the cooldown covers the rest of the storm
+        ok = ok and grows == 1 and len(manager_log) == 1
+        for p, d in zip(procs, dirs):
+            clean, audit = _replica_audit_clean_after_stop(p, d)
+            ok = ok and clean
+            detail.setdefault("audits", []).append(audit)
+    finally:
+        _cleanup(fd, procs, srv)
+    results.append({"scenario": name, "ok": ok, **detail})
+    return ok
+
+
+def _replica_audit_clean_after_stop(proc, wdir):
+    try:
+        ln = _stop_replica(proc, wdir)
+    except Exception as e:
+        return False, f"stop failed: {e}"
+    return ln == "audit dropped=0 leaks=0", ln
+
+
+def _cleanup(fd, procs, srv):
+    try:
+        fd.close(close_replicas=False)
+    except Exception:
+        pass
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    if srv is not None:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", "sigkill", "partition", "storm",
+                             "scale_up"])
+    # replica mode (internal)
+    ap.add_argument("--replica", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--node", default="r0", help=argparse.SUPPRESS)
+    ap.add_argument("--master", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--dir", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--ttl", type=float, default=REPLICA_TTL,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--queue-max", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--decode-sleep-ms", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--num-blocks", type=int, default=24,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.replica:
+        return replica_main(args)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    n = args.requests
+    storm_n = max(n, 16)
+    scale_n = max(2 * n, 24)
+    results = []
+    ok = True
+    with tempfile.TemporaryDirectory() as root:
+        baseline = _baseline_tokens(max(n, storm_n, scale_n))
+        if args.scenario in ("all", "sigkill"):
+            ok &= scenario_sigkill(root, baseline, n, results)
+        if args.scenario in ("all", "partition"):
+            ok &= scenario_partition(root, baseline, n, results)
+        if args.scenario in ("all", "storm"):
+            ok &= scenario_storm(root, baseline, storm_n, results)
+        if args.scenario in ("all", "scale_up"):
+            ok &= scenario_scale_up(root, baseline, scale_n, results)
+
+    for r in results:
+        print(json.dumps(r))
+    if ok:
+        print("ALL SCENARIOS PASSED")
+        return 0
+    failed = [r["scenario"] for r in results if not r["ok"]]
+    print(f"FAILED: {', '.join(failed)}")
+    return 1
+
+
+if __name__ == "__main__":
+    signal.signal(signal.SIGINT, signal.SIG_DFL)
+    sys.exit(main())
